@@ -1,0 +1,21 @@
+"""copilot_for_consensus_tpu — TPU-native consensus-summarization framework.
+
+A brand-new framework with the capability surface of the reference
+CoPilot-For-Consensus system (event-driven mailing-list RAG pipeline; see
+/root/repo/SURVEY.md), rebuilt TPU-first:
+
+* **Compute plane** (``models/``, ``ops/``, ``parallel/``, ``serving/``,
+  ``ann/``): JAX/XLA/Pallas. An embedding encoder and a continuous-batching
+  generative LLM served from HBM with pjit/GSPMD sharding over an ICI mesh
+  (DP/TP/SP/EP), plus an on-device ANN index so retrieval never leaves the
+  chip.
+* **Host plane** (``core/``, ``bus/``, ``storage/``, ``vectorstore/``,
+  ``services/`` …): the reference's schema-driven config system,
+  adapter/factory architecture, idempotent retry machinery and observability,
+  re-implemented fresh in Python (with C++ for host-side hot paths under
+  ``native/``).
+
+Package layout mirrors SURVEY.md §7's build plan.
+"""
+
+__version__ = "0.1.0"

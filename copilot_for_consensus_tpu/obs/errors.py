@@ -1,0 +1,55 @@
+"""Error reporting abstraction (parity with ``copilot_error_reporting``)."""
+
+from __future__ import annotations
+
+import abc
+import traceback
+from typing import Any
+
+from copilot_for_consensus_tpu.obs.logging import Logger, get_logger
+
+
+class ErrorReporter(abc.ABC):
+    @abc.abstractmethod
+    def report(self, exc: BaseException, context: dict[str, Any] | None = None) -> None: ...
+
+
+class ConsoleErrorReporter(ErrorReporter):
+    def __init__(self, logger: Logger | None = None):
+        self.logger = logger or get_logger()
+
+    def report(self, exc, context=None):
+        self.logger.error(
+            "unhandled error",
+            error=str(exc),
+            error_type=type(exc).__name__,
+            traceback="".join(traceback.format_exception(exc)),
+            **(context or {}),
+        )
+
+
+class SilentErrorReporter(ErrorReporter):
+    def report(self, exc, context=None):
+        pass
+
+
+class CollectingErrorReporter(ErrorReporter):
+    """Stores reports for assertions in tests."""
+
+    def __init__(self):
+        self.reports: list[tuple[BaseException, dict]] = []
+
+    def report(self, exc, context=None):
+        self.reports.append((exc, dict(context or {})))
+
+
+def create_error_reporter(config: Any = None) -> ErrorReporter:
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "console")
+    if driver == "console":
+        return ConsoleErrorReporter()
+    if driver == "silent":
+        return SilentErrorReporter()
+    if driver == "collecting":
+        return CollectingErrorReporter()
+    raise ValueError(f"unknown error_reporter driver {driver!r}")

@@ -1,0 +1,179 @@
+"""Metrics collection.
+
+Parity with ``copilot_metrics`` (ABC increment/observe/gauge/safe_push +
+Prometheus/Pushgateway/Noop drivers). The Prometheus driver here keeps
+counters/histograms/gauges in-process and renders the standard text
+exposition format, served by the health server (obs/health.py) — no client
+library dependency.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Any, Iterable
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0, 120.0)
+
+
+def _label_key(labels: dict[str, str] | None) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+class MetricsCollector(abc.ABC):
+    @abc.abstractmethod
+    def increment(self, name: str, value: float = 1.0,
+                  labels: dict[str, str] | None = None) -> None: ...
+
+    @abc.abstractmethod
+    def observe(self, name: str, value: float,
+                labels: dict[str, str] | None = None) -> None: ...
+
+    @abc.abstractmethod
+    def gauge(self, name: str, value: float,
+              labels: dict[str, str] | None = None) -> None: ...
+
+    def safe_push(self) -> None:
+        """Push to a gateway if this driver pushes; never raises."""
+
+
+class NoopMetrics(MetricsCollector):
+    def increment(self, name, value=1.0, labels=None): ...
+    def observe(self, name, value, labels=None): ...
+    def gauge(self, name, value, labels=None): ...
+
+
+class InMemoryMetrics(MetricsCollector):
+    """Thread-safe in-process metrics; also the Prometheus renderer."""
+
+    def __init__(self, namespace: str = "copilot"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self.counters: dict[str, dict[tuple, float]] = {}
+        self.gauges: dict[str, dict[tuple, float]] = {}
+        self.histograms: dict[str, dict[tuple, list]] = {}
+        self.buckets = DEFAULT_BUCKETS
+
+    def increment(self, name, value=1.0, labels=None):
+        with self._lock:
+            series = self.counters.setdefault(name, {})
+            key = _label_key(labels)
+            series[key] = series.get(key, 0.0) + value
+
+    def gauge(self, name, value, labels=None):
+        with self._lock:
+            self.gauges.setdefault(name, {})[_label_key(labels)] = value
+
+    def observe(self, name, value, labels=None):
+        with self._lock:
+            series = self.histograms.setdefault(name, {})
+            key = _label_key(labels)
+            if key not in series:
+                series[key] = [0.0, 0, [0] * len(self.buckets)]  # sum, count, buckets
+            entry = series[key]
+            entry[0] += value
+            entry[1] += 1
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    entry[2][i] += 1
+
+    # -- accessors ---------------------------------------------------------
+
+    def counter_value(self, name: str, labels: dict[str, str] | None = None) -> float:
+        return self.counters.get(name, {}).get(_label_key(labels), 0.0)
+
+    def gauge_value(self, name: str, labels: dict[str, str] | None = None) -> float:
+        return self.gauges.get(name, {}).get(_label_key(labels), 0.0)
+
+    def histogram_stats(self, name: str, labels: dict[str, str] | None = None):
+        entry = self.histograms.get(name, {}).get(_label_key(labels))
+        if entry is None:
+            return None
+        return {"sum": entry[0], "count": entry[1]}
+
+    # -- Prometheus text exposition ---------------------------------------
+
+    @staticmethod
+    def _escape(value: Any) -> str:
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    def _fmt_labels(self, key: tuple, extra: Iterable[tuple] = ()) -> str:
+        items = list(key) + list(extra)
+        if not items:
+            return ""
+        body = ",".join(f'{k}="{self._escape(v)}"' for k, v in items)
+        return "{" + body + "}"
+
+    def render_prometheus(self) -> str:
+        lines: list[str] = []
+        ns = self.namespace
+        with self._lock:
+            for name, series in sorted(self.counters.items()):
+                lines.append(f"# TYPE {ns}_{name} counter")
+                for key, value in series.items():
+                    lines.append(f"{ns}_{name}{self._fmt_labels(key)} {value}")
+            for name, series in sorted(self.gauges.items()):
+                lines.append(f"# TYPE {ns}_{name} gauge")
+                for key, value in series.items():
+                    lines.append(f"{ns}_{name}{self._fmt_labels(key)} {value}")
+            for name, series in sorted(self.histograms.items()):
+                lines.append(f"# TYPE {ns}_{name} histogram")
+                for key, (total, count, buckets) in series.items():
+                    # observe() increments every bucket with bound >= value,
+                    # so the stored counts are already cumulative.
+                    for bound, bcount in zip(self.buckets, buckets):
+                        lines.append(
+                            f'{ns}_{name}_bucket{self._fmt_labels(key, [("le", bound)])} {bcount}'
+                        )
+                    lines.append(
+                        f'{ns}_{name}_bucket{self._fmt_labels(key, [("le", "+Inf")])} {count}'
+                    )
+                    lines.append(f"{ns}_{name}_sum{self._fmt_labels(key)} {total}")
+                    lines.append(f"{ns}_{name}_count{self._fmt_labels(key)} {count}")
+        return "\n".join(lines) + "\n"
+
+
+class PushgatewayMetrics(InMemoryMetrics):
+    """In-memory metrics pushed to a Prometheus Pushgateway on safe_push().
+
+    Pipeline services push after each event batch, mirroring the reference
+    (``embedding/app/service.py:325-329``). Network errors are swallowed —
+    metrics must never take the pipeline down.
+    """
+
+    def __init__(self, gateway_url: str, job: str, namespace: str = "copilot"):
+        super().__init__(namespace=namespace)
+        self.gateway_url = gateway_url.rstrip("/")
+        self.job = job
+
+    def safe_push(self) -> None:
+        try:
+            import urllib.request
+
+            body = self.render_prometheus().encode()
+            req = urllib.request.Request(
+                f"{self.gateway_url}/metrics/job/{self.job}",
+                data=body, method="PUT",
+                headers={"Content-Type": "text/plain"},
+            )
+            urllib.request.urlopen(req, timeout=5).read()
+        except Exception:
+            pass
+
+
+def create_metrics_collector(config: Any = None) -> MetricsCollector:
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "noop")
+    if driver == "noop":
+        return NoopMetrics()
+    if driver in ("inmemory", "prometheus"):
+        return InMemoryMetrics(namespace=cfg.get("namespace", "copilot"))
+    if driver == "pushgateway":
+        return PushgatewayMetrics(
+            gateway_url=cfg.get("gateway_url", "http://localhost:9091"),
+            job=cfg.get("job", "copilot"),
+            namespace=cfg.get("namespace", "copilot"),
+        )
+    raise ValueError(f"unknown metrics driver {driver!r}")

@@ -1,0 +1,29 @@
+"""Driver registration for security adapters (secret providers now; JWT
+signers and OIDC providers register here as they land)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from copilot_for_consensus_tpu.core.factory import register_driver
+from copilot_for_consensus_tpu.security.secrets import (
+    EnvSecretProvider,
+    LocalSecretProvider,
+    StaticSecretProvider,
+)
+
+
+def create_secret_provider(config: Any) -> Any:
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "env")
+    if driver == "env":
+        return EnvSecretProvider()
+    if driver == "local":
+        return LocalSecretProvider(cfg.get("root", "secrets"))
+    if driver == "static":
+        return StaticSecretProvider(cfg.get("values", {}))
+    raise ValueError(f"unknown secret_provider driver {driver!r}")
+
+
+for _name in ("env", "local", "static"):
+    register_driver("secret_provider", _name, create_secret_provider)

@@ -1,0 +1,89 @@
+"""Secret providers.
+
+Parity with the reference's ``copilot_secrets`` (ABC + local file provider +
+cloud provider + factory). ``secret://name`` references inside configs are
+resolved through one of these at config load time (core/config.py).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import pathlib
+from typing import Callable, Mapping
+
+
+class SecretNotFoundError(KeyError):
+    pass
+
+
+class SecretProvider(abc.ABC):
+    @abc.abstractmethod
+    def get_secret(self, name: str) -> str:
+        """Return the secret value or raise SecretNotFoundError."""
+
+    def __call__(self, name: str) -> str:
+        return self.get_secret(name)
+
+
+class LocalSecretProvider(SecretProvider):
+    """Secrets as individual files in a directory (``secrets/<name>``)."""
+
+    def __init__(self, root: str | pathlib.Path):
+        self.root = pathlib.Path(root)
+
+    def get_secret(self, name: str) -> str:
+        if "/" in name or "\\" in name or name.startswith("."):
+            raise SecretNotFoundError(name)
+        path = self.root / name
+        if not path.is_file():
+            raise SecretNotFoundError(name)
+        return path.read_text().strip()
+
+
+class EnvSecretProvider(SecretProvider):
+    """Secrets from ``COPILOT_SECRET_<NAME>`` environment variables."""
+
+    def __init__(self, env: Mapping[str, str] | None = None):
+        self.env = os.environ if env is None else env
+
+    def get_secret(self, name: str) -> str:
+        key = f"COPILOT_SECRET_{name.upper()}"
+        if key not in self.env:
+            raise SecretNotFoundError(name)
+        return self.env[key]
+
+
+class StaticSecretProvider(SecretProvider):
+    """In-memory secrets for tests."""
+
+    def __init__(self, values: Mapping[str, str]):
+        self.values = dict(values)
+
+    def get_secret(self, name: str) -> str:
+        try:
+            return self.values[name]
+        except KeyError:
+            raise SecretNotFoundError(name) from None
+
+
+class ChainSecretProvider(SecretProvider):
+    def __init__(self, *providers: SecretProvider):
+        self.providers = providers
+
+    def get_secret(self, name: str) -> str:
+        for p in self.providers:
+            try:
+                return p.get_secret(name)
+            except SecretNotFoundError:
+                continue
+        raise SecretNotFoundError(name)
+
+
+def default_secret_resolver(env: Mapping[str, str] | None = None) -> Callable[[str], str]:
+    """Env secrets first, then files under $COPILOT_SECRETS_DIR (or ./secrets)."""
+    env = os.environ if env is None else env
+    secrets_dir = env.get("COPILOT_SECRETS_DIR", "secrets")
+    return ChainSecretProvider(
+        EnvSecretProvider(env), LocalSecretProvider(secrets_dir)
+    )

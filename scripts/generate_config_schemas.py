@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""Generate per-service config JSON schemas under schemas/configs/services/.
+
+Capability parity with the reference's schema-driven config layer
+(``docs/schemas/configs/services/*.json`` + ``generate_typed_configs.py``):
+each service gets a schema whose defaults make ``get_config(service)`` work
+with zero config files — every adapter defaults to its in-process/mock
+driver, mirroring the reference's fake-backend test strategy (SURVEY.md §4).
+
+Run: python scripts/generate_config_schemas.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+OUT = REPO / "copilot_for_consensus_tpu" / "schemas" / "configs" / "services"
+
+
+def adapter(default_driver: str, **extra_defaults) -> dict:
+    props: dict = {"driver": {"type": "string", "default": default_driver}}
+    for key, value in extra_defaults.items():
+        tname = {str: "string", int: "integer", float: "number", bool: "boolean",
+                 list: "array", dict: "object"}[type(value)]
+        props[key] = {"type": tname, "default": value}
+    return {"type": "object", "properties": props, "additionalProperties": True}
+
+
+COMMON = {
+    "service_name": {"type": "string", "default": ""},
+    "bus": adapter("inproc", exchange="copilot.events"),
+    "document_store": adapter("memory"),
+    "logger": adapter("stdout", level="info"),
+    "metrics": adapter("inmemory", namespace="copilot"),
+    "error_reporter": adapter("console"),
+    "event_retry": adapter("default", max_attempts=8),
+    "auth": {
+        "type": "object",
+        "properties": {
+            "enabled": {"type": "boolean", "default": False},
+            "jwks_url": {"type": "string", "default": ""},
+            "issuer": {"type": "string", "default": ""},
+            "audience": {"type": "string", "default": ""},
+        },
+        "additionalProperties": True,
+    },
+    "api": adapter("aiohttp", host="127.0.0.1", port=0),
+}
+
+
+def service_schema(name: str, extra: dict) -> dict:
+    props = json.loads(json.dumps(COMMON))  # deep copy
+    props.update(extra)
+    return {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "$id": f"copilot-for-consensus-tpu/schemas/configs/services/{name}.schema.json",
+        "title": f"{name} service config",
+        "type": "object",
+        "properties": props,
+        "additionalProperties": True,
+    }
+
+
+SERVICES: dict[str, dict] = {
+    "ingestion": {
+        "archive_store": adapter("local", root="var/archives"),
+        "scheduler": {
+            "type": "object",
+            "properties": {
+                "enabled": {"type": "boolean", "default": False},
+                "interval_seconds": {"type": "integer", "default": 3600},
+            },
+            "additionalProperties": True,
+        },
+    },
+    "parsing": {
+        "normalizer": {
+            "type": "object",
+            "properties": {
+                "strip_html": {"type": "boolean", "default": True},
+                "strip_signatures": {"type": "boolean", "default": True},
+                "strip_quoted_replies": {"type": "boolean", "default": True},
+            },
+            "additionalProperties": True,
+        },
+    },
+    "chunking": {
+        "chunker": adapter(
+            "token_window", chunk_size=384, overlap=50,
+            min_chunk_tokens=100, max_chunk_tokens=512,
+        ),
+    },
+    "embedding": {
+        "vector_store": adapter("memory"),
+        "embedding_backend": adapter("mock", model="tpu-minilm-384",
+                                     batch_size=128, max_seq_len=256,
+                                     dimension=384),
+    },
+    "orchestrator": {
+        "vector_store": adapter("memory"),
+        "embedding_backend": adapter("mock", model="tpu-minilm-384",
+                                     batch_size=128, max_seq_len=256,
+                                     dimension=384),
+        "selection": {
+            "type": "object",
+            "properties": {
+                "selector": {"type": "string", "default": "top_k_relevance"},
+                "top_k": {"type": "integer", "default": 12},
+                "context_window_tokens": {"type": "integer", "default": 3000},
+                "candidate_multiplier": {"type": "integer", "default": 2},
+                "min_chunks_per_thread": {"type": "integer", "default": 1},
+            },
+            "additionalProperties": True,
+        },
+    },
+    "summarization": {
+        "llm_backend": adapter("mock", model="tpu-mistral-7b",
+                               max_new_tokens=512, temperature=0.2,
+                               context_window_tokens=4096),
+        "consensus_detector": adapter("heuristic"),
+        "prompts": {
+            "type": "object",
+            "properties": {
+                "system_file": {"type": "string", "default": ""},
+                "user_file": {"type": "string", "default": ""},
+            },
+            "additionalProperties": True,
+        },
+        "rate_limit": adapter("default", max_retries=3, base_delay=1.0),
+    },
+    "reporting": {
+        "webhooks": {
+            "type": "array",
+            "items": {"type": "object"},
+            "default": [],
+        },
+        "page_size": {"type": "integer", "default": 20},
+    },
+    "auth": {
+        "jwt_signer": adapter("local", algorithm="RS256",
+                              issuer="copilot-tpu", audience="copilot",
+                              token_ttl_seconds=3600),
+        "oidc": {
+            "type": "object",
+            "properties": {
+                "providers": {"type": "array", "items": {"type": "object"},
+                              "default": []},
+            },
+            "additionalProperties": True,
+        },
+    },
+    # The resident TPU engine process (no reference analogue — this replaces
+    # the Ollama/llama.cpp containers with a first-party serving engine).
+    "tpu_engine": {
+        "mesh": {
+            "type": "object",
+            "properties": {
+                "dp": {"type": "integer", "default": 1},
+                "tp": {"type": "integer", "default": 1},
+                "sp": {"type": "integer", "default": 1},
+                "ep": {"type": "integer", "default": 1},
+            },
+            "additionalProperties": True,
+        },
+        "embedding_backend": adapter("tpu", model="tpu-minilm-384",
+                                     batch_size=128, max_seq_len=256,
+                                     dimension=384),
+        "llm_backend": adapter("tpu", model="tpu-mistral-7b",
+                               max_new_tokens=512, temperature=0.2),
+        "serving": {
+            "type": "object",
+            "properties": {
+                "max_batch_slots": {"type": "integer", "default": 8},
+                "page_size": {"type": "integer", "default": 128},
+                "max_pages_per_seq": {"type": "integer", "default": 32},
+                "prefill_chunk": {"type": "integer", "default": 512},
+            },
+            "additionalProperties": True,
+        },
+    },
+}
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    for name, extra in SERVICES.items():
+        path = OUT / f"{name}.schema.json"
+        path.write_text(json.dumps(service_schema(name, extra), indent=2) + "\n")
+        print(f"wrote {path.relative_to(REPO)}")
+
+
+if __name__ == "__main__":
+    main()

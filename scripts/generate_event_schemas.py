@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Generate the 17 per-event JSON schema files from the typed event registry.
+
+The dataclasses in ``core/events.py`` are the authoring surface; the emitted
+JSON files under ``schemas/events/`` are the runtime contract that bus
+drivers validate against (capability parity with the reference's
+``docs/schemas/events/*.schema.json`` file set — the reference authors JSON
+first and generates dataclasses; we author dataclasses and emit JSON, same
+single-source-of-truth contract either way).
+
+Run: python scripts/generate_event_schemas.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import sys
+import typing
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from copilot_for_consensus_tpu.core import events  # noqa: E402
+
+OUT = REPO / "copilot_for_consensus_tpu" / "schemas" / "events"
+
+_PRIMITIVES = {str: "string", int: "integer", float: "number", bool: "boolean"}
+
+
+def _field_schema(tp) -> dict:
+    origin = typing.get_origin(tp)
+    if tp in _PRIMITIVES:
+        return {"type": _PRIMITIVES[tp]}
+    if origin in (list, typing.List):
+        (item,) = typing.get_args(tp) or (str,)
+        return {"type": "array", "items": _field_schema(item)}
+    if origin in (dict, typing.Dict):
+        return {"type": "object"}
+    if tp is typing.Any:
+        return {}
+    return {}
+
+
+def event_schema(cls) -> dict:
+    hints = typing.get_type_hints(cls)
+    props = {}
+    for f in dataclasses.fields(cls):
+        props[f.name] = _field_schema(hints.get(f.name, str))
+    return {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "$id": f"copilot-for-consensus-tpu/schemas/events/{cls.event_type}.schema.json",
+        "title": cls.event_type,
+        "type": "object",
+        "properties": props,
+        "required": sorted(props),
+        "additionalProperties": False,
+    }
+
+
+ENVELOPE = {
+    "$schema": "https://json-schema.org/draft/2020-12/schema",
+    "$id": "copilot-for-consensus-tpu/schemas/events/event-envelope.schema.json",
+    "title": "Event Envelope",
+    "type": "object",
+    "properties": {
+        "event_type": {"type": "string", "minLength": 1},
+        "event_id": {"type": "string", "minLength": 1},
+        "timestamp": {"type": "string", "minLength": 1},
+        "version": {"type": "string", "minLength": 1},
+        "data": {"type": "object"},
+    },
+    "required": ["event_type", "event_id", "timestamp", "version", "data"],
+    "additionalProperties": False,
+}
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    (OUT / "event-envelope.schema.json").write_text(
+        json.dumps(ENVELOPE, indent=2) + "\n"
+    )
+    for name, cls in sorted(events.EVENT_TYPES.items()):
+        path = OUT / f"{name}.schema.json"
+        path.write_text(json.dumps(event_schema(cls), indent=2) + "\n")
+        print(f"wrote {path.relative_to(REPO)}")
+
+
+if __name__ == "__main__":
+    main()

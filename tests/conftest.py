@@ -1,0 +1,29 @@
+# Test harness: force an 8-device virtual CPU platform BEFORE jax initialises.
+#
+# Mirrors the reference's fake-backend strategy (SURVEY.md §4): the full
+# multi-chip sharding path is exercised on a virtual device mesh so the suite
+# runs anywhere; bench.py (not pytest) is what touches the real TPU chip.
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures"
+
+
+@pytest.fixture
+def fixtures_dir() -> pathlib.Path:
+    return FIXTURES

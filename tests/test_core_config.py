@@ -1,0 +1,69 @@
+import json
+
+import pytest
+
+from copilot_for_consensus_tpu.core.config import (
+    ConfigError,
+    FrozenConfig,
+    get_config,
+)
+
+
+def test_defaults_from_schema():
+    cfg = get_config("embedding", env={})
+    assert cfg.bus.driver == "inproc"
+    assert cfg.document_store.driver == "memory"
+    assert cfg.embedding_backend.batch_size == 128
+    assert cfg.service_name == "embedding"
+
+
+def test_env_overrides_nested(tmp_path):
+    env = {"COPILOT_EMBEDDING__EMBEDDING_BACKEND__BATCH_SIZE": "64",
+           "COPILOT_EMBEDDING__BUS__DRIVER": "zmq"}
+    cfg = get_config("embedding", env=env)
+    assert cfg.embedding_backend.batch_size == 64
+    assert cfg.bus.driver == "zmq"
+
+
+def test_config_file_and_combined_file(tmp_path):
+    single = tmp_path / "emb.json"
+    single.write_text(json.dumps({"embedding_backend": {"driver": "tpu"}}))
+    cfg = get_config("embedding", env={}, config_path=single)
+    assert cfg.embedding_backend.driver == "tpu"
+
+    combined = tmp_path / "all.json"
+    combined.write_text(json.dumps(
+        {"embedding": {"embedding_backend": {"dimension": 512}},
+         "parsing": {}}))
+    cfg = get_config("embedding", env={"COPILOT_CONFIG": str(combined)})
+    assert cfg.embedding_backend.dimension == 512
+
+
+def test_missing_config_file_fails_fast():
+    with pytest.raises(ConfigError):
+        get_config("embedding", env={}, config_path="/nonexistent/cfg.json")
+
+
+def test_secret_resolution():
+    env = {"COPILOT_EMBEDDING__VECTOR_STORE__API_KEY": '"secret://vk"',
+           "COPILOT_SECRET_VK": "s3cret"}
+    cfg = get_config("embedding", env=env)
+    assert cfg.vector_store.api_key == "s3cret"
+
+
+def test_frozen_config_immutable_and_replace():
+    cfg = FrozenConfig({"a": {"b": 1}, "c": 2})
+    with pytest.raises(AttributeError):
+        cfg.c = 3
+    stamped = cfg.replace(a={"b": 9}, service_name="x")
+    assert stamped.a.b == 9
+    assert cfg.a.b == 1
+    assert stamped.service_name == "x"
+
+
+def test_all_service_schemas_load():
+    for svc in ("ingestion", "parsing", "chunking", "embedding",
+                "orchestrator", "summarization", "reporting", "auth",
+                "tpu_engine"):
+        cfg = get_config(svc, env={})
+        assert cfg.service_name == svc

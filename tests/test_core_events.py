@@ -1,0 +1,65 @@
+import pytest
+
+from copilot_for_consensus_tpu.core import events
+from copilot_for_consensus_tpu.core.validation import (
+    SchemaValidationError,
+    validate_envelope,
+)
+
+
+def test_seventeen_event_types_registered():
+    assert len(events.EVENT_TYPES) == 17
+
+
+def test_envelope_roundtrip():
+    ev = events.ArchiveIngested(archive_id="abc", source_id="s1",
+                                sha256="0" * 64, size_bytes=10)
+    env = ev.to_envelope()
+    assert env["event_type"] == "ArchiveIngested"
+    assert env["version"] == events.ENVELOPE_VERSION
+    back = events.Event.from_envelope(env)
+    assert isinstance(back, events.ArchiveIngested)
+    assert back.archive_id == "abc"
+    assert back.size_bytes == 10
+
+
+@pytest.mark.parametrize("name", sorted(events.EVENT_TYPES))
+def test_every_event_envelope_validates_against_its_schema(name):
+    ev = events.EVENT_TYPES[name]()
+    validate_envelope(ev.to_envelope())
+
+
+def test_envelope_missing_field_rejected():
+    env = events.JSONParsed(message_doc_id="m").to_envelope()
+    del env["timestamp"]
+    with pytest.raises(SchemaValidationError):
+        validate_envelope(env)
+
+
+def test_event_data_wrong_type_rejected():
+    env = events.ArchiveIngested().to_envelope()
+    env["data"]["size_bytes"] = "not-an-int"
+    with pytest.raises(SchemaValidationError):
+        validate_envelope(env)
+
+
+def test_unknown_event_type_rejected():
+    env = events.ArchiveIngested().to_envelope()
+    env["event_type"] = "NoSuchEvent"
+    with pytest.raises((SchemaValidationError, FileNotFoundError)):
+        validate_envelope(env)
+    with pytest.raises(ValueError):
+        events.Event.from_envelope(env)
+
+
+def test_failure_events_share_dlq_shape():
+    for name in events.FAILURE_EVENT_TYPES:
+        ev = events.EVENT_TYPES[name](error="boom", error_type="X", attempts=3)
+        data = ev.to_envelope()["data"]
+        assert data["error"] == "boom"
+        assert data["attempts"] == 3
+
+
+def test_make_event_by_name():
+    ev = events.make_event("SummaryComplete", summary_id="s", thread_id="t")
+    assert isinstance(ev, events.SummaryComplete)

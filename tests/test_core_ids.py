@@ -1,0 +1,33 @@
+from copilot_for_consensus_tpu.core import ids
+
+
+def test_archive_id_deterministic_and_16_hex():
+    a = ids.generate_archive_id_from_bytes(b"hello world")
+    b = ids.generate_archive_id_from_bytes(b"hello world")
+    assert a == b
+    assert len(a) == 16
+    assert int(a, 16) >= 0
+
+
+def test_archive_id_distinguishes_content():
+    assert (ids.generate_archive_id_from_bytes(b"a")
+            != ids.generate_archive_id_from_bytes(b"b"))
+
+
+def test_message_doc_id_uses_index_for_missing_message_id():
+    a = ids.generate_message_doc_id("arch", "", 0)
+    b = ids.generate_message_doc_id("arch", "", 1)
+    assert a != b
+
+
+def test_summary_id_order_invariant_over_chunks():
+    a = ids.generate_summary_id("t1", ["c1", "c2", "c3"])
+    b = ids.generate_summary_id("t1", ["c3", "c1", "c2"])
+    assert a == b
+    assert a != ids.generate_summary_id("t1", ["c1", "c2"])
+    assert a != ids.generate_summary_id("t2", ["c1", "c2", "c3"])
+
+
+def test_namespaces_do_not_collide():
+    assert (ids.generate_chunk_id("x", 0)
+            != ids.generate_message_doc_id("x", "0", 0))

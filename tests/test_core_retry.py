@@ -1,0 +1,82 @@
+import pytest
+
+from copilot_for_consensus_tpu.core.retry import (
+    DocumentNotFoundError,
+    RetryConfig,
+    RetryExhaustedError,
+    RetryPolicy,
+    handle_event_with_retry,
+)
+
+
+def _policy(max_attempts=4):
+    return RetryPolicy(RetryConfig(max_attempts=max_attempts, base_delay=0.001),
+                       sleep=lambda _: None)
+
+
+def test_succeeds_after_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise DocumentNotFoundError("not yet")
+        return "ok"
+
+    assert _policy().run(flaky) == "ok"
+    assert calls["n"] == 3
+
+
+def test_exhaustion_carries_dlq_info():
+    def always_fail():
+        raise DocumentNotFoundError("never")
+
+    with pytest.raises(RetryExhaustedError) as exc_info:
+        _policy(max_attempts=3).run(always_fail, event_type="JSONParsed")
+    err = exc_info.value
+    assert err.attempts == 3
+    assert err.event_type == "JSONParsed"
+    assert err.dlq_info["error_type"] == "DocumentNotFoundError"
+
+
+def test_non_retryable_errors_propagate_immediately():
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise ValueError("fatal")
+
+    with pytest.raises(ValueError):
+        _policy().run(boom)
+    assert calls["n"] == 1
+
+
+def test_backoff_is_exponential_and_capped():
+    p = RetryPolicy(RetryConfig(base_delay=0.1, max_delay=0.5, jitter="none"))
+    assert p.delay_for(1) == pytest.approx(0.1)
+    assert p.delay_for(2) == pytest.approx(0.2)
+    assert p.delay_for(3) == pytest.approx(0.4)
+    assert p.delay_for(4) == pytest.approx(0.5)  # capped
+    assert p.delay_for(10) == pytest.approx(0.5)
+
+
+def test_full_jitter_within_bounds():
+    p = _policy()
+    for attempt in range(1, 5):
+        for _ in range(20):
+            d = p.delay_for(attempt)
+            assert 0.0 <= d <= 0.001 * (2 ** (attempt - 1))
+
+
+def test_handle_event_with_retry_wraps_envelope():
+    seen = []
+
+    def handler(env):
+        seen.append(env)
+        if len(seen) < 2:
+            raise DocumentNotFoundError("race")
+        return "done"
+
+    env = {"event_type": "ChunksPrepared", "data": {}}
+    assert handle_event_with_retry(handler, env, _policy()) == "done"
+    assert len(seen) == 2

@@ -1,0 +1,34 @@
+"""Policy check as an executable test: services must not read os.environ at
+runtime — all environment access goes through the config layer.
+
+Parity with the reference's ``scripts/check_no_runtime_env_vars.py`` CI gate
+(SURVEY.md §5 "Config / flag system").
+"""
+
+import pathlib
+import re
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "copilot_for_consensus_tpu"
+
+# Modules allowed to touch the environment: the config layer itself, secret
+# providers, and device/mesh bootstrap (XLA flags must be set pre-init).
+ALLOWLIST = {
+    "core/config.py",
+    "security/secrets.py",
+    "parallel/mesh.py",
+}
+
+PATTERN = re.compile(r"os\.environ|os\.getenv")
+
+
+def test_no_runtime_env_reads_outside_config_layer():
+    offenders = []
+    for path in PKG.rglob("*.py"):
+        rel = str(path.relative_to(PKG))
+        if rel in ALLOWLIST:
+            continue
+        if PATTERN.search(path.read_text()):
+            offenders.append(rel)
+    assert offenders == [], (
+        f"runtime os.environ access outside config layer: {offenders}"
+    )

@@ -1,0 +1,63 @@
+import io
+import json
+
+from copilot_for_consensus_tpu.obs.errors import CollectingErrorReporter
+from copilot_for_consensus_tpu.obs.logging import MemoryLogger, StdoutLogger
+from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+
+
+def test_stdout_logger_emits_json_lines():
+    buf = io.StringIO()
+    log = StdoutLogger(service="embedding", stream=buf)
+    log.info("processed", count=3, correlation_id="abc")
+    record = json.loads(buf.getvalue())
+    assert record["service"] == "embedding"
+    assert record["message"] == "processed"
+    assert record["count"] == 3
+    assert record["correlation_id"] == "abc"
+
+
+def test_logger_level_filtering_and_bind():
+    buf = io.StringIO()
+    log = StdoutLogger(level="warning", stream=buf)
+    log.info("hidden")
+    assert buf.getvalue() == ""
+    bound = log.bind(thread_id="t1")
+    bound.error("shown")
+    assert json.loads(buf.getvalue())["thread_id"] == "t1"
+
+
+def test_metrics_counters_gauges_histograms():
+    m = InMemoryMetrics()
+    m.increment("events_processed", labels={"stage": "parsing"})
+    m.increment("events_processed", 2, labels={"stage": "parsing"})
+    m.gauge("queue_depth", 7)
+    m.observe("latency_seconds", 0.3)
+    m.observe("latency_seconds", 2.0)
+    assert m.counter_value("events_processed", {"stage": "parsing"}) == 3
+    assert m.gauge_value("queue_depth") == 7
+    assert m.histogram_stats("latency_seconds") == {"sum": 2.3, "count": 2}
+
+
+def test_prometheus_exposition_format():
+    m = InMemoryMetrics(namespace="copilot")
+    m.increment("events", labels={"stage": "chunking"})
+    m.observe("latency_seconds", 0.05)
+    text = m.render_prometheus()
+    assert '# TYPE copilot_events counter' in text
+    assert 'copilot_events{stage="chunking"} 1.0' in text
+    assert 'copilot_latency_seconds_count 1' in text
+    assert 'le="+Inf"' in text
+
+
+def test_collecting_error_reporter():
+    r = CollectingErrorReporter()
+    r.report(ValueError("x"), {"stage": "parse"})
+    assert len(r.reports) == 1
+    assert r.reports[0][1]["stage"] == "parse"
+
+
+def test_memory_logger_captures():
+    log = MemoryLogger()
+    log.warning("hmm", a=1)
+    assert log.records == [{"level": "warning", "message": "hmm", "a": 1}]

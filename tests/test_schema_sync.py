@@ -1,0 +1,46 @@
+"""Policy check: the committed schema files must match their generators.
+
+Editing an event dataclass or config spec without re-running the generator
+would silently diverge the runtime validation contract (generated schemas use
+``additionalProperties: false`` + full ``required`` lists, so divergence means
+every publish of that event fails validation).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SCHEMAS = REPO / "copilot_for_consensus_tpu" / "schemas"
+
+
+def _regenerate_and_compare(script: str, subdir: str, tmp_path):
+    # Run the generator against a copied repo-layout so committed files are
+    # untouched, then diff the schema trees.
+    tmp_repo = tmp_path / "repo"
+    (tmp_repo / "scripts").mkdir(parents=True)
+    (tmp_repo / "scripts" / script).write_text(
+        (REPO / "scripts" / script).read_text())
+    pkg = tmp_repo / "copilot_for_consensus_tpu"
+    pkg.mkdir()
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin"}
+    subprocess.run([sys.executable, str(tmp_repo / "scripts" / script)],
+                   check=True, env=env, capture_output=True)
+    generated_root = pkg / "schemas" / subdir
+    committed_root = SCHEMAS / subdir
+    gen = {p.name: json.loads(p.read_text())
+           for p in generated_root.glob("*.json")}
+    com = {p.name: json.loads(p.read_text())
+           for p in committed_root.glob("*.schema.json")}
+    for name, payload in gen.items():
+        assert name in com, f"generated {name} missing from committed schemas"
+        assert payload == com[name], f"schema drift in {subdir}/{name}: re-run scripts/{script}"
+
+
+def test_event_schemas_in_sync(tmp_path):
+    _regenerate_and_compare("generate_event_schemas.py", "events", tmp_path)
+
+
+def test_config_schemas_in_sync(tmp_path):
+    _regenerate_and_compare("generate_config_schemas.py", "configs/services", tmp_path)

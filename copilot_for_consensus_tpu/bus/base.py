@@ -1,0 +1,93 @@
+"""Publisher/Subscriber ABCs shared by every bus driver."""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Mapping
+
+from copilot_for_consensus_tpu.core.events import Event
+
+# Callback receives the envelope dict; raising triggers nack/requeue.
+EventCallback = Callable[[Mapping[str, Any]], None]
+
+
+class PublishError(Exception):
+    pass
+
+
+class EventPublisher(abc.ABC):
+    """Publishes event envelopes to a topic exchange by routing key."""
+
+    def connect(self) -> None:  # drivers override when they hold connections
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @abc.abstractmethod
+    def publish_envelope(self, envelope: Mapping[str, Any],
+                         routing_key: str | None = None) -> None: ...
+
+    def publish(self, event: Event, routing_key: str | None = None) -> None:
+        """Publish a typed event (envelope built + routing key from type)."""
+        self.publish_envelope(
+            event.to_envelope(), routing_key or type(event).routing_key
+        )
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class EventSubscriber(abc.ABC):
+    """Consumes envelopes from queues bound to routing keys.
+
+    Semantics contract (all drivers):
+    * one logical queue per routing key; competing subscribers on the same
+      queue share work;
+    * the callback completing normally acks the message;
+    * the callback raising requeues it, up to ``max_redeliveries``, after
+      which the envelope goes to the dead-letter queue ``<rk>.dlq``.
+    """
+
+    def connect(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @abc.abstractmethod
+    def subscribe(self, routing_keys: list[str], callback: EventCallback) -> None: ...
+
+    @abc.abstractmethod
+    def start_consuming(self) -> None:
+        """Blocking consume loop (runs until stop())."""
+
+    @abc.abstractmethod
+    def stop(self) -> None: ...
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NoopPublisher(EventPublisher):
+    def publish_envelope(self, envelope, routing_key=None):
+        pass
+
+
+class NoopSubscriber(EventSubscriber):
+    def subscribe(self, routing_keys, callback):
+        pass
+
+    def start_consuming(self):
+        pass
+
+    def stop(self):
+        pass

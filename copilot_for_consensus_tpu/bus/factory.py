@@ -1,0 +1,55 @@
+"""Bus driver registration + create_publisher/create_subscriber helpers.
+
+Parity with ``copilot_message_bus/factory.py:94-144``: construction is
+config-driven, and validation wrapping is applied here so services never
+instantiate raw drivers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from copilot_for_consensus_tpu.bus.base import NoopPublisher, NoopSubscriber
+from copilot_for_consensus_tpu.bus.inproc import InProcPublisher, InProcSubscriber
+from copilot_for_consensus_tpu.bus.validating import (
+    ValidatingPublisher,
+    ValidatingSubscriber,
+)
+from copilot_for_consensus_tpu.core.factory import register_driver
+
+
+def create_publisher(config: Any = None, validate: bool = True):
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "inproc")
+    if driver == "inproc":
+        pub = InProcPublisher(cfg)
+    elif driver == "zmq":
+        from copilot_for_consensus_tpu.bus.zmq_bus import ZmqPublisher
+
+        pub = ZmqPublisher(cfg)
+    elif driver == "noop":
+        pub = NoopPublisher()
+    else:
+        raise ValueError(f"unknown message_bus driver {driver!r}")
+    return ValidatingPublisher(pub) if validate else pub
+
+
+def create_subscriber(config: Any = None, validate: bool = True,
+                      on_invalid=None):
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "inproc")
+    if driver == "inproc":
+        sub = InProcSubscriber(cfg)
+    elif driver == "zmq":
+        from copilot_for_consensus_tpu.bus.zmq_bus import ZmqSubscriber
+
+        sub = ZmqSubscriber(cfg)
+    elif driver == "noop":
+        sub = NoopSubscriber()
+    else:
+        raise ValueError(f"unknown message_bus driver {driver!r}")
+    return ValidatingSubscriber(sub, on_invalid=on_invalid) if validate else sub
+
+
+for _name in ("inproc", "zmq", "noop"):
+    register_driver("message_bus", _name, create_publisher)

@@ -1,0 +1,184 @@
+"""In-process topic broker: the default bus for single-host pipelines and
+tests.
+
+Implements the same observable semantics as the reference's RabbitMQ setup
+(topic exchange ``copilot.events``, one durable queue per routing key,
+manual ack / nack-requeue, redelivery cap with dead-lettering —
+``rabbitmq_subscriber.py:504-560``) without a broker process. Publishers and
+subscribers rendezvous on a named broker in a process-global registry.
+
+Delivery modes:
+* ``drain()`` — pump queues until empty on the caller's thread (tests and
+  the single-process pipeline runner);
+* ``start_consuming()`` — blocking loop with a condition variable (service
+  deployments, one consumer thread per service).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from copilot_for_consensus_tpu.bus.base import (
+    EventCallback,
+    EventPublisher,
+    EventSubscriber,
+)
+
+DEFAULT_EXCHANGE = "copilot.events"
+DLQ_SUFFIX = ".dlq"
+
+
+@dataclass
+class _Queue:
+    name: str
+    items: deque = field(default_factory=deque)  # (envelope, redeliveries)
+    callbacks: list[EventCallback] = field(default_factory=list)
+    rr_next: int = 0  # round-robin cursor over competing consumers
+
+
+class InProcBroker:
+    def __init__(self, name: str = DEFAULT_EXCHANGE, max_redeliveries: int = 3):
+        self.name = name
+        self.max_redeliveries = max_redeliveries
+        self._queues: dict[str, _Queue] = {}
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self.published_count = 0
+        self.dead_lettered: list[tuple[str, Mapping[str, Any]]] = []
+
+    def queue(self, routing_key: str) -> _Queue:
+        with self._lock:
+            if routing_key not in self._queues:
+                self._queues[routing_key] = _Queue(routing_key)
+            return self._queues[routing_key]
+
+    def publish(self, envelope: Mapping[str, Any], routing_key: str) -> None:
+        with self._work:
+            self.queue(routing_key).items.append((dict(envelope), 0))
+            self.published_count += 1
+            self._work.notify_all()
+
+    def bind(self, routing_key: str, callback: EventCallback) -> None:
+        with self._lock:
+            self.queue(routing_key).callbacks.append(callback)
+
+    def unbind(self, routing_key: str, callback: EventCallback) -> None:
+        with self._lock:
+            q = self.queue(routing_key)
+            if callback in q.callbacks:
+                q.callbacks.remove(callback)
+
+    def queue_depth(self, routing_key: str) -> int:
+        with self._lock:
+            return len(self.queue(routing_key).items)
+
+    def _pop_ready(self) -> tuple[_Queue, Mapping[str, Any], int, EventCallback] | None:
+        with self._lock:
+            for q in self._queues.values():
+                if q.items and q.callbacks:
+                    envelope, redeliveries = q.items.popleft()
+                    cb = q.callbacks[q.rr_next % len(q.callbacks)]
+                    q.rr_next += 1
+                    return q, envelope, redeliveries, cb
+        return None
+
+    def _dispatch_one(self) -> bool:
+        """Deliver one message; returns False when nothing is deliverable."""
+        ready = self._pop_ready()
+        if ready is None:
+            return False
+        q, envelope, redeliveries, cb = ready
+        try:
+            cb(envelope)  # normal return = ack
+        except Exception:
+            if redeliveries + 1 >= self.max_redeliveries:
+                with self._work:
+                    self.dead_lettered.append((q.name, envelope))
+                    self.queue(q.name + DLQ_SUFFIX).items.append((envelope, 0))
+                    self._work.notify_all()
+            else:
+                with self._work:
+                    q.items.append((envelope, redeliveries + 1))
+                    self._work.notify_all()
+        return True
+
+    def drain(self, max_messages: int | None = None) -> int:
+        """Dispatch until all bound queues are empty. Returns message count.
+
+        Messages whose handlers publish more messages are processed too —
+        this runs the whole event cascade to quiescence.
+        """
+        n = 0
+        while max_messages is None or n < max_messages:
+            if not self._dispatch_one():
+                break
+            n += 1
+        return n
+
+    def run_forever(self, stop_flag: threading.Event) -> None:
+        while not stop_flag.is_set():
+            if not self._dispatch_one():
+                with self._work:
+                    self._work.wait(timeout=0.1)
+
+
+_BROKERS: dict[str, InProcBroker] = {}
+_BROKERS_LOCK = threading.Lock()
+
+
+def get_broker(name: str = DEFAULT_EXCHANGE) -> InProcBroker:
+    with _BROKERS_LOCK:
+        if name not in _BROKERS:
+            _BROKERS[name] = InProcBroker(name)
+        return _BROKERS[name]
+
+
+def reset_broker(name: str = DEFAULT_EXCHANGE) -> None:
+    with _BROKERS_LOCK:
+        _BROKERS.pop(name, None)
+
+
+class InProcPublisher(EventPublisher):
+    def __init__(self, config: Any = None, broker: InProcBroker | None = None):
+        cfg = dict(config or {})
+        self.broker = broker or get_broker(cfg.get("exchange", DEFAULT_EXCHANGE))
+
+    def publish_envelope(self, envelope, routing_key=None):
+        if routing_key is None:
+            from copilot_for_consensus_tpu.core.events import EVENT_TYPES
+
+            cls = EVENT_TYPES.get(envelope.get("event_type", ""))
+            routing_key = cls.routing_key if cls else "unrouted"
+        self.broker.publish(envelope, routing_key)
+
+
+class InProcSubscriber(EventSubscriber):
+    def __init__(self, config: Any = None, broker: InProcBroker | None = None):
+        cfg = dict(config or {})
+        self.broker = broker or get_broker(cfg.get("exchange", DEFAULT_EXCHANGE))
+        self._bound: list[tuple[str, EventCallback]] = []
+        self._stop = threading.Event()
+
+    def subscribe(self, routing_keys, callback):
+        for rk in routing_keys:
+            self.broker.bind(rk, callback)
+            self._bound.append((rk, callback))
+
+    def start_consuming(self):
+        self._stop.clear()
+        self.broker.run_forever(self._stop)
+
+    def drain(self, max_messages: int | None = None) -> int:
+        return self.broker.drain(max_messages)
+
+    def stop(self):
+        self._stop.set()
+
+    def close(self):
+        self.stop()
+        for rk, cb in self._bound:
+            self.broker.unbind(rk, cb)
+        self._bound.clear()

@@ -1,0 +1,81 @@
+"""Validating decorators: schema-check every envelope at the bus boundary.
+
+Parity with the reference's ``validating_publisher.py`` /
+``validating_subscriber.py`` cross-cutting wrappers — invalid events are
+rejected at publish time (raise) and quarantined at consume time (routed to
+the subscriber's invalid-event hook instead of the handler).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+from copilot_for_consensus_tpu.bus.base import (
+    EventCallback,
+    EventPublisher,
+    EventSubscriber,
+    PublishError,
+)
+from copilot_for_consensus_tpu.core.validation import (
+    FileSchemaProvider,
+    SchemaValidationError,
+    validate_envelope,
+)
+
+
+class ValidatingPublisher(EventPublisher):
+    def __init__(self, inner: EventPublisher,
+                 provider: FileSchemaProvider | None = None):
+        self.inner = inner
+        self.provider = provider
+
+    def connect(self):
+        self.inner.connect()
+
+    def close(self):
+        self.inner.close()
+
+    def publish_envelope(self, envelope, routing_key=None):
+        try:
+            validate_envelope(envelope, self.provider)
+        except (SchemaValidationError, FileNotFoundError) as exc:
+            raise PublishError(f"refusing to publish invalid event: {exc}") from exc
+        self.inner.publish_envelope(envelope, routing_key)
+
+
+class ValidatingSubscriber(EventSubscriber):
+    def __init__(self, inner: EventSubscriber,
+                 provider: FileSchemaProvider | None = None,
+                 on_invalid: Callable[[Mapping[str, Any], Exception], None] | None = None):
+        self.inner = inner
+        self.provider = provider
+        self.on_invalid = on_invalid
+        self.invalid_count = 0
+
+    def connect(self):
+        self.inner.connect()
+
+    def close(self):
+        self.inner.close()
+
+    def subscribe(self, routing_keys, callback: EventCallback):
+        def guarded(envelope):
+            try:
+                validate_envelope(envelope, self.provider)
+            except (SchemaValidationError, FileNotFoundError) as exc:
+                self.invalid_count += 1
+                if self.on_invalid is not None:
+                    self.on_invalid(envelope, exc)
+                return  # ack: an invalid event can never become valid by retry
+            callback(envelope)
+
+        self.inner.subscribe(routing_keys, guarded)
+
+    def start_consuming(self):
+        self.inner.start_consuming()
+
+    def stop(self):
+        self.inner.stop()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -1,0 +1,147 @@
+"""ZeroMQ bus driver: cross-process/cross-host event fan-out.
+
+The distributed-bus role the reference fills with RabbitMQ (SURVEY.md §5
+"Distributed communication backend", tier 2 of the two-tier design). A PUSH/
+PULL pipeline per routing key gives competing-consumer semantics (each
+message to exactly one consumer), like one durable queue per routing key.
+
+Topology: a publisher binds one PUSH socket per routing key at
+``base_port + hash(rk) % port_range`` on ``host``; subscribers connect PULL
+sockets. For multi-host, point ``host`` at the publisher's address. This
+driver favors simplicity over broker durability — undelivered messages live
+in ZMQ buffers, so it's for throughput paths, not the durability-critical
+ones (use the sqlite-backed outbox in storage for those).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any
+
+from copilot_for_consensus_tpu.bus.base import (
+    EventCallback,
+    EventPublisher,
+    EventSubscriber,
+    PublishError,
+)
+
+try:
+    import zmq
+
+    HAS_ZMQ = True
+except ImportError:  # pragma: no cover - environment without pyzmq
+    HAS_ZMQ = False
+
+
+def _port_for(routing_key: str, base_port: int, port_range: int) -> int:
+    # Stable port per routing key (sha-free: must match across processes).
+    h = 0
+    for ch in routing_key:
+        h = (h * 131 + ord(ch)) % port_range
+    return base_port + h
+
+
+class ZmqPublisher(EventPublisher):
+    def __init__(self, config: Any = None):
+        if not HAS_ZMQ:
+            raise PublishError("pyzmq is not available")
+        cfg = dict(config or {})
+        self.host = cfg.get("host", "127.0.0.1")
+        self.base_port = int(cfg.get("base_port", 5700))
+        self.port_range = int(cfg.get("port_range", 64))
+        self._ctx = zmq.Context.instance()
+        self._sockets: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _socket(self, routing_key: str):
+        with self._lock:
+            if routing_key not in self._sockets:
+                sock = self._ctx.socket(zmq.PUSH)
+                sock.setsockopt(zmq.SNDHWM, 100000)
+                sock.setsockopt(zmq.LINGER, 1000)
+                port = _port_for(routing_key, self.base_port, self.port_range)
+                sock.bind(f"tcp://{self.host}:{port}")
+                self._sockets[routing_key] = sock
+            return self._sockets[routing_key]
+
+    def publish_envelope(self, envelope, routing_key=None):
+        if routing_key is None:
+            from copilot_for_consensus_tpu.core.events import EVENT_TYPES
+
+            cls = EVENT_TYPES.get(envelope.get("event_type", ""))
+            routing_key = cls.routing_key if cls else "unrouted"
+        try:
+            self._socket(routing_key).send(json.dumps(envelope).encode())
+        except zmq.ZMQError as exc:
+            raise PublishError(str(exc)) from exc
+
+    def close(self):
+        with self._lock:
+            for sock in self._sockets.values():
+                sock.close()
+            self._sockets.clear()
+
+
+class ZmqSubscriber(EventSubscriber):
+    def __init__(self, config: Any = None):
+        if not HAS_ZMQ:
+            raise PublishError("pyzmq is not available")
+        cfg = dict(config or {})
+        self.host = cfg.get("host", "127.0.0.1")
+        self.base_port = int(cfg.get("base_port", 5700))
+        self.port_range = int(cfg.get("port_range", 64))
+        self.max_redeliveries = int(cfg.get("max_redeliveries", 3))
+        self._ctx = zmq.Context.instance()
+        self._poller = zmq.Poller()
+        self._handlers: dict[Any, EventCallback] = {}
+        self._stop = threading.Event()
+
+    def subscribe(self, routing_keys, callback):
+        for rk in routing_keys:
+            sock = self._ctx.socket(zmq.PULL)
+            sock.setsockopt(zmq.RCVHWM, 100000)
+            port = _port_for(rk, self.base_port, self.port_range)
+            sock.connect(f"tcp://{self.host}:{port}")
+            self._poller.register(sock, zmq.POLLIN)
+            self._handlers[sock] = callback
+
+    def _dispatch(self, sock, callback) -> None:
+        raw = sock.recv()
+        envelope = json.loads(raw)
+        attempts = 0
+        while True:
+            try:
+                callback(envelope)
+                return
+            except Exception:
+                attempts += 1
+                if attempts >= self.max_redeliveries:
+                    return  # dead-letter: drop after cap (no broker to hold it)
+
+    def start_consuming(self):
+        self._stop.clear()
+        while not self._stop.is_set():
+            for sock, _ in self._poller.poll(timeout=100):
+                self._dispatch(sock, self._handlers[sock])
+
+    def drain(self, max_messages: int | None = None) -> int:
+        n = 0
+        while max_messages is None or n < max_messages:
+            events = dict(self._poller.poll(timeout=50))
+            if not events:
+                break
+            for sock in events:
+                self._dispatch(sock, self._handlers[sock])
+                n += 1
+        return n
+
+    def stop(self):
+        self._stop.set()
+
+    def close(self):
+        self.stop()
+        for sock in self._handlers:
+            self._poller.unregister(sock)
+            sock.close()
+        self._handlers.clear()

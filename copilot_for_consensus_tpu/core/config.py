@@ -31,6 +31,7 @@ from typing import Any, Callable, Mapping
 
 from copilot_for_consensus_tpu.core.validation import (
     FileSchemaProvider,
+    SchemaValidationError,
     default_schema_provider,
     validate_json,
 )
@@ -145,13 +146,17 @@ def _apply_env_overrides(data: dict, service: str, env: Mapping[str, str]) -> No
         node[path[-1]] = _parse_env_value(raw)
 
 
-def _resolve_secrets(node: Any, resolver: Callable[[str], str]) -> Any:
+def _resolve_secrets(node: Any, resolver: Callable[[str], str],
+                     resolved: list[str]) -> Any:
     if isinstance(node, dict):
-        return {k: _resolve_secrets(v, resolver) for k, v in node.items()}
+        return {k: _resolve_secrets(v, resolver, resolved)
+                for k, v in node.items()}
     if isinstance(node, list):
-        return [_resolve_secrets(v, resolver) for v in node]
+        return [_resolve_secrets(v, resolver, resolved) for v in node]
     if isinstance(node, str) and node.startswith(SECRET_SCHEME):
-        return resolver(node[len(SECRET_SCHEME):])
+        value = resolver(node[len(SECRET_SCHEME):])
+        resolved.append(value)
+        return value
     return node
 
 
@@ -178,9 +183,13 @@ def get_config(
         if not path.exists():
             raise ConfigError(f"config file not found: {path}")
         file_data = json.loads(path.read_text())
-        # A combined file may hold all services keyed by name.
-        if service in file_data and isinstance(file_data[service], Mapping):
-            file_data = file_data[service]
+        # A combined multi-service file declares itself with a "services"
+        # wrapper: {"services": {"embedding": {...}, "parsing": {...}}}.
+        # Anything else is a per-service file used as-is (guessing from key
+        # names would misfire on services whose schema has a section named
+        # after the service, e.g. auth.auth).
+        if "services" in file_data and isinstance(file_data["services"], Mapping):
+            file_data = file_data["services"].get(service, {})
         _deep_merge(data, file_data)
 
     if overrides:
@@ -192,10 +201,20 @@ def get_config(
         from copilot_for_consensus_tpu.security.secrets import default_secret_resolver
 
         secret_resolver = default_secret_resolver(env)
-    data = _resolve_secrets(data, secret_resolver)
+    resolved_secrets: list[str] = []
+    data = _resolve_secrets(data, secret_resolver, resolved_secrets)
 
     if not data.get("service_name"):
         data["service_name"] = service
     if validate:
-        validate_json(data, f"configs/services/{service}", provider)
+        try:
+            validate_json(data, f"configs/services/{service}", provider)
+        except SchemaValidationError as exc:
+            # Never leak resolved secret values through validation errors.
+            message = str(exc)
+            for value in resolved_secrets:
+                if value:
+                    message = message.replace(value, "***")
+            raise SchemaValidationError(
+                f"configs/services/{service}", message) from None
     return FrozenConfig(data)

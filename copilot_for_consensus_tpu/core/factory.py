@@ -25,6 +25,7 @@ class UnknownDriverError(Exception):
 
 # kind -> driver name -> "module.path:ClassName" or callable
 _REGISTRY: dict[str, dict[str, Any]] = {}
+_LOADED_KINDS: set[str] = set()
 
 # kind -> module that registers its drivers on import
 _KIND_MODULES = {
@@ -58,8 +59,9 @@ def available_drivers(kind: str) -> list[str]:
 
 
 def _ensure_kind_loaded(kind: str) -> None:
-    if kind in _REGISTRY and _REGISTRY[kind]:
+    if kind in _LOADED_KINDS:
         return
+    _LOADED_KINDS.add(kind)
     module = _KIND_MODULES.get(kind)
     if module is None:
         return

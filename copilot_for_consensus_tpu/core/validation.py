@@ -41,7 +41,9 @@ class FileSchemaProvider:
 
     def get_schema(self, name: str) -> dict[str, Any]:
         if name not in self._cache:
-            path = self.root / f"{name}.schema.json"
+            path = (self.root / f"{name}.schema.json").resolve()
+            if not str(path).startswith(str(self.root.resolve()) + "/"):
+                raise FileNotFoundError(f"schema name escapes root: {name!r}")
             if not path.exists():
                 raise FileNotFoundError(f"no schema file for {name!r} at {path}")
             self._cache[name] = json.loads(path.read_text())
@@ -82,8 +84,18 @@ def validate_json(payload: Mapping[str, Any], schema_name: str,
 
 def validate_envelope(envelope: Mapping[str, Any],
                       provider: FileSchemaProvider | None = None) -> None:
-    """Validate the envelope shape, then the event-specific data payload."""
+    """Validate the envelope shape, then the event-specific data payload.
+
+    ``event_type`` comes off the wire: it is checked against the typed event
+    registry before being used to locate a schema, so unknown or malicious
+    values ("../../x") raise SchemaValidationError, never touch paths.
+    """
+    from copilot_for_consensus_tpu.core.events import EVENT_TYPES
+
     provider = provider or default_schema_provider()
     validate_json(envelope, "events/event-envelope", provider)
     etype = envelope["event_type"]
+    if etype not in EVENT_TYPES:
+        raise SchemaValidationError(
+            "events/event-envelope", f"unknown event_type {etype!r}")
     validate_json(envelope["data"], f"events/{etype}", provider)

@@ -1,0 +1,26 @@
+"""Document store driver registration + create_document_store."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from copilot_for_consensus_tpu.core.factory import register_driver
+from copilot_for_consensus_tpu.storage.memory import InMemoryDocumentStore
+from copilot_for_consensus_tpu.storage.sqlite import SQLiteDocumentStore
+from copilot_for_consensus_tpu.storage.validating import ValidatingDocumentStore
+
+
+def create_document_store(config: Any = None, validate: bool = True):
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "memory")
+    if driver == "memory":
+        store = InMemoryDocumentStore(cfg)
+    elif driver == "sqlite":
+        store = SQLiteDocumentStore(cfg)
+    else:
+        raise ValueError(f"unknown document_store driver {driver!r}")
+    return ValidatingDocumentStore(store) if validate else store
+
+
+for _name in ("memory", "sqlite"):
+    register_driver("document_store", _name, create_document_store)

@@ -1,0 +1,172 @@
+"""SQLite document store: the durable single-host driver.
+
+One table per collection (``id TEXT PRIMARY KEY, doc TEXT`` JSON), WAL mode
+for concurrent reader/writer services, Mongo-style filters evaluated by the
+shared matcher. Fills the durable-store role the reference delegates to
+MongoDB (``mongo_document_store.py:33``) without an external process.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import sqlite3
+import threading
+from typing import Any, Mapping, Sequence
+
+from copilot_for_consensus_tpu.storage import registry
+from copilot_for_consensus_tpu.storage.base import (
+    DocumentStore,
+    DuplicateKeyError,
+    StorageError,
+    matches_filter,
+    sort_documents,
+)
+
+_TABLE_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+class SQLiteDocumentStore(DocumentStore):
+    def __init__(self, config: Any = None):
+        cfg = dict(config or {})
+        self.path = cfg.get("path", "var/documents.sqlite3")
+        self._local = threading.local()
+        self._known_tables: set[str] = set()
+        self._lock = threading.Lock()
+
+    # -- connection management (one sqlite connection per thread) ----------
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            if self.path != ":memory:":
+                pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+            conn = sqlite3.connect(self.path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    def close(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    def _table(self, collection: str) -> str:
+        if not _TABLE_RE.match(collection):
+            raise StorageError(f"invalid collection name {collection!r}")
+        table = f"docs_{collection}"
+        if table not in self._known_tables:
+            with self._lock:
+                self._conn().execute(
+                    f"CREATE TABLE IF NOT EXISTS {table} "
+                    "(id TEXT PRIMARY KEY, doc TEXT NOT NULL)"
+                )
+                self._conn().commit()
+                self._known_tables.add(table)
+        return table
+
+    def _key(self, collection: str, doc: Mapping[str, Any]) -> str:
+        pk = registry.primary_key(collection)
+        doc_id = doc.get(pk)
+        if not doc_id:
+            raise DuplicateKeyError(
+                f"document for {collection!r} missing primary key {pk!r}")
+        return str(doc_id)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def insert_document(self, collection, doc):
+        table = self._table(collection)
+        doc_id = self._key(collection, doc)
+        try:
+            self._conn().execute(
+                f"INSERT INTO {table} (id, doc) VALUES (?, ?)",
+                (doc_id, json.dumps(dict(doc))),
+            )
+            self._conn().commit()
+        except sqlite3.IntegrityError as exc:
+            raise DuplicateKeyError(f"{collection}/{doc_id} exists") from exc
+        return doc_id
+
+    def upsert_document(self, collection, doc):
+        table = self._table(collection)
+        doc_id = self._key(collection, doc)
+        self._conn().execute(
+            f"INSERT INTO {table} (id, doc) VALUES (?, ?) "
+            "ON CONFLICT(id) DO UPDATE SET doc=excluded.doc",
+            (doc_id, json.dumps(dict(doc))),
+        )
+        self._conn().commit()
+        return doc_id
+
+    def get_document(self, collection, doc_id):
+        table = self._table(collection)
+        row = self._conn().execute(
+            f"SELECT doc FROM {table} WHERE id=?", (str(doc_id),)
+        ).fetchone()
+        return json.loads(row[0]) if row else None
+
+    def _iter_docs(self, collection):
+        table = self._table(collection)
+        for (raw,) in self._conn().execute(f"SELECT doc FROM {table}"):
+            yield json.loads(raw)
+
+    def query_documents(self, collection, flt=None, *, limit=None, skip=0,
+                        sort: Sequence[tuple[str, int]] | None = None):
+        docs = [d for d in self._iter_docs(collection) if matches_filter(d, flt)]
+        sort_documents(docs, sort)
+        if skip:
+            docs = docs[skip:]
+        if limit is not None:
+            docs = docs[:limit]
+        return docs
+
+    def update_document(self, collection, doc_id, updates):
+        table = self._table(collection)
+        conn = self._conn()
+        with self._lock:
+            row = conn.execute(
+                f"SELECT doc FROM {table} WHERE id=?", (str(doc_id),)
+            ).fetchone()
+            if row is None:
+                return False
+            doc = json.loads(row[0])
+            doc.update(dict(updates))
+            conn.execute(
+                f"UPDATE {table} SET doc=? WHERE id=?",
+                (json.dumps(doc), str(doc_id)),
+            )
+            conn.commit()
+            return True
+
+    def delete_document(self, collection, doc_id):
+        table = self._table(collection)
+        cur = self._conn().execute(
+            f"DELETE FROM {table} WHERE id=?", (str(doc_id),))
+        self._conn().commit()
+        return cur.rowcount > 0
+
+    def delete_documents(self, collection, flt=None):
+        table = self._table(collection)
+        if not flt:
+            cur = self._conn().execute(f"DELETE FROM {table}")
+            self._conn().commit()
+            return cur.rowcount
+        ids = [str(d[registry.primary_key(collection)])
+               for d in self._iter_docs(collection) if matches_filter(d, flt)]
+        for doc_id in ids:
+            self._conn().execute(
+                f"DELETE FROM {table} WHERE id=?", (doc_id,))
+        self._conn().commit()
+        return len(ids)
+
+    def count_documents(self, collection, flt=None):
+        table = self._table(collection)
+        if not flt:
+            return self._conn().execute(
+                f"SELECT COUNT(*) FROM {table}").fetchone()[0]
+        return sum(1 for d in self._iter_docs(collection)
+                   if matches_filter(d, flt))

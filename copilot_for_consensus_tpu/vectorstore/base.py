@@ -1,0 +1,64 @@
+"""VectorStore ABC."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Mapping, Sequence
+
+
+class VectorStoreError(Exception):
+    pass
+
+
+@dataclass
+class QueryResult:
+    id: str
+    score: float
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+
+class VectorStore(abc.ABC):
+    """Embedding storage with upsert semantics and metadata-filtered top-k.
+
+    Scores are cosine similarity in [-1, 1]; higher is better.
+    """
+
+    def connect(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @abc.abstractmethod
+    def add_embedding(self, vec_id: str, vector: Sequence[float],
+                      metadata: Mapping[str, Any] | None = None) -> None: ...
+
+    def add_embeddings(self, items: Iterable[tuple[str, Sequence[float],
+                                                   Mapping[str, Any] | None]]) -> int:
+        n = 0
+        for vec_id, vector, metadata in items:
+            self.add_embedding(vec_id, vector, metadata)
+            n += 1
+        return n
+
+    @abc.abstractmethod
+    def query(self, vector: Sequence[float], top_k: int = 10,
+              flt: Mapping[str, Any] | None = None) -> list[QueryResult]: ...
+
+    @abc.abstractmethod
+    def get(self, vec_id: str) -> tuple[list[float], dict[str, Any]] | None: ...
+
+    @abc.abstractmethod
+    def delete(self, vec_ids: Sequence[str]) -> int: ...
+
+    @abc.abstractmethod
+    def count(self) -> int: ...
+
+    @abc.abstractmethod
+    def clear(self) -> None: ...
+
+    @property
+    @abc.abstractmethod
+    def dimension(self) -> int | None:
+        """Vector dimension, or None until the first vector is added."""

@@ -1,0 +1,134 @@
+"""NumPy exact-search vector store (tests and small corpora).
+
+Keeps vectors L2-normalized in a contiguous matrix so query() is a single
+matvec + argpartition — the same math the TPU driver runs on-device.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import threading
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from copilot_for_consensus_tpu.storage.base import matches_filter
+from copilot_for_consensus_tpu.vectorstore.base import (
+    QueryResult,
+    VectorStore,
+    VectorStoreError,
+)
+
+
+class InMemoryVectorStore(VectorStore):
+    def __init__(self, config: Any = None):
+        cfg = dict(config or {})
+        self._dim: int | None = cfg.get("dimension") or None
+        self._ids: list[str] = []
+        self._index: dict[str, int] = {}
+        self._vectors = np.zeros((0, self._dim or 1), dtype=np.float32)
+        self._metadata: list[dict[str, Any]] = []
+        self._lock = threading.RLock()
+        self.persist_path = cfg.get("persist_path")
+
+    @property
+    def dimension(self) -> int | None:
+        return self._dim
+
+    @staticmethod
+    def _normalize(vector: Sequence[float]) -> np.ndarray:
+        arr = np.asarray(vector, dtype=np.float32)
+        norm = float(np.linalg.norm(arr))
+        return arr / norm if norm > 0 else arr
+
+    def add_embedding(self, vec_id, vector, metadata=None):
+        with self._lock:
+            arr = self._normalize(vector)
+            if self._dim is None:
+                self._dim = arr.shape[0]
+                self._vectors = np.zeros((0, self._dim), dtype=np.float32)
+            if arr.shape[0] != self._dim:
+                raise VectorStoreError(
+                    f"dimension mismatch: store={self._dim} got={arr.shape[0]}")
+            if vec_id in self._index:  # upsert
+                row = self._index[vec_id]
+                self._vectors[row] = arr
+                self._metadata[row] = dict(metadata or {})
+            else:
+                self._index[vec_id] = len(self._ids)
+                self._ids.append(vec_id)
+                self._vectors = np.vstack([self._vectors, arr[None, :]])
+                self._metadata.append(dict(metadata or {}))
+
+    def query(self, vector, top_k=10, flt=None):
+        with self._lock:
+            if not self._ids:
+                return []
+            q = self._normalize(vector)
+            scores = self._vectors @ q
+            if flt:
+                mask = np.array(
+                    [matches_filter(m, flt) for m in self._metadata])
+                scores = np.where(mask, scores, -np.inf)
+            k = min(top_k, len(self._ids))
+            top = np.argpartition(-scores, k - 1)[:k]
+            top = top[np.argsort(-scores[top])]
+            return [
+                QueryResult(self._ids[i], float(scores[i]),
+                            dict(self._metadata[i]))
+                for i in top if np.isfinite(scores[i])
+            ]
+
+    def get(self, vec_id):
+        with self._lock:
+            row = self._index.get(vec_id)
+            if row is None:
+                return None
+            return self._vectors[row].tolist(), dict(self._metadata[row])
+
+    def delete(self, vec_ids):
+        with self._lock:
+            keep = [i for i, vid in enumerate(self._ids)
+                    if vid not in set(vec_ids)]
+            removed = len(self._ids) - len(keep)
+            self._ids = [self._ids[i] for i in keep]
+            self._vectors = self._vectors[keep] if keep else np.zeros(
+                (0, self._dim or 1), dtype=np.float32)
+            self._metadata = [self._metadata[i] for i in keep]
+            self._index = {vid: i for i, vid in enumerate(self._ids)}
+            return removed
+
+    def count(self):
+        with self._lock:
+            return len(self._ids)
+
+    def clear(self):
+        with self._lock:
+            self._ids = []
+            self._index = {}
+            self._vectors = np.zeros((0, self._dim or 1), dtype=np.float32)
+            self._metadata = []
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str | pathlib.Path | None = None) -> None:
+        path = pathlib.Path(path or self.persist_path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with self._lock:
+            np.savez_compressed(
+                path, vectors=self._vectors,
+                ids=np.array(self._ids, dtype=object),
+                metadata=np.array(
+                    [json.dumps(m) for m in self._metadata], dtype=object),
+            )
+
+    def load(self, path: str | pathlib.Path | None = None) -> None:
+        path = pathlib.Path(path or self.persist_path)
+        data = np.load(path, allow_pickle=True)
+        with self._lock:
+            self._vectors = data["vectors"].astype(np.float32)
+            self._ids = [str(x) for x in data["ids"]]
+            self._metadata = [json.loads(str(m)) for m in data["metadata"]]
+            self._index = {vid: i for i, vid in enumerate(self._ids)}
+            self._dim = self._vectors.shape[1] if len(self._ids) else None

@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Generate the deterministic synthetic IETF-style fixture mbox.
+
+Exercises: reply chains, an orphan reply (parent absent), subject-prefix
+variants, RFC-2047 headers, a multipart text+html message, signatures,
+quoted replies, forward markers, draft mentions, a missing Message-ID.
+
+Run: python tests/fixtures/make_fixture_mbox.py
+"""
+
+import pathlib
+
+OUT = pathlib.Path(__file__).parent / "ietf-sample.mbox"
+
+MESSAGES = [
+    # Thread 1: QUIC retransmission — root + 2 replies + 1 orphan reply.
+    """From alice@example.org Mon Jan  5 10:00:00 2026
+From: Alice Example <alice@example.org>
+To: quic@ietf.example.org
+Subject: Retransmission timers in draft-ietf-quic-recovery-29
+Message-ID: <qr-root-1@example.org>
+Date: Mon, 5 Jan 2026 10:00:00 +0000
+Content-Type: text/plain; charset=utf-8
+
+I believe the PTO computation in draft-ietf-quic-recovery-29 section 5.2
+underestimates RTT variance on lossy paths. We measured a 12% spurious
+retransmission rate in our testbed. Proposal: clamp the variance floor to
+kGranularity * 2.
+
+Alice
+""",
+    """From bob@example.net Mon Jan  5 11:30:00 2026
+From: Bob Builder <bob@example.net>
+To: quic@ietf.example.org
+Subject: Re: Retransmission timers in draft-ietf-quic-recovery-29
+Message-ID: <qr-reply-1@example.net>
+In-Reply-To: <qr-root-1@example.org>
+References: <qr-root-1@example.org>
+Date: Mon, 5 Jan 2026 11:30:00 +0000
+Content-Type: text/plain; charset=utf-8
+
+On Mon, 5 Jan 2026 at 10:00, Alice Example wrote:
+> I believe the PTO computation in draft-ietf-quic-recovery-29 section 5.2
+> underestimates RTT variance on lossy paths.
+
++1, we've seen the same in production. The clamp looks right to me.
+I support adopting this change.
+
+--
+Bob Builder
+Distinguished Engineer, Example Networks
+""",
+    """From carol@example.com Mon Jan  5 14:45:00 2026
+From: =?utf-8?b?Q2Fyb2wgTcO8bGxlcg==?= <carol@example.com>
+To: quic@ietf.example.org
+Cc: bob@example.net
+Subject: RE: Retransmission timers in draft-ietf-quic-recovery-29
+Message-ID: <qr-reply-2@example.com>
+In-Reply-To: <qr-reply-1@example.net>
+References: <qr-root-1@example.org> <qr-reply-1@example.net>
+Date: Mon, 5 Jan 2026 14:45:00 +0000
+Content-Type: text/plain; charset=utf-8
+
+I disagree with the blanket clamp; it penalizes clean paths. Could we
+gate it on observed loss rate instead? See also draft-mueller-quic-var-01
+for an alternative formulation.
+
+Best regards,
+Carol
+""",
+    """From dave@example.io Tue Jan  6 09:15:00 2026
+From: Dave Ops <dave@example.io>
+To: quic@ietf.example.org
+Subject: Re: Retransmission timers in draft-ietf-quic-recovery-29
+Message-ID: <qr-reply-3@example.io>
+In-Reply-To: <qr-missing-parent@nowhere.org>
+Date: Tue, 6 Jan 2026 09:15:00 +0000
+Content-Type: text/plain; charset=utf-8
+
+(replying to a message my archive never received)
+
+Agreed with the loss-rate gating idea. Strong concerns about the clamp
+as-is; it doubled tail latency in our CDN simulation.
+""",
+    # Thread 2: HTTP/3 priorities — root (multipart html) + 1 reply.
+    """From erin@example.org Wed Jan  7 08:00:00 2026
+From: Erin Web <erin@example.org>
+To: httpbis@ietf.example.org
+Subject: Consensus call: priority signal defaults
+Message-ID: <h3-root-1@example.org>
+Date: Wed, 7 Jan 2026 08:00:00 +0000
+Content-Type: multipart/alternative; boundary="b1"
+
+--b1
+Content-Type: text/plain; charset=utf-8
+
+This is a consensus call on the default urgency level in
+draft-ietf-httpbis-priority. Please respond by Jan 21.
+
+--b1
+Content-Type: text/html; charset=utf-8
+
+<html><head><style>p{color:red}</style></head><body>
+<p>This is a <b>consensus call</b> on the default urgency level in
+draft-ietf-httpbis-priority. Please respond by Jan 21.</p>
+</body></html>
+
+--b1--
+""",
+    """From frank@example.net Wed Jan  7 16:20:00 2026
+From: frank@example.net
+To: httpbis@ietf.example.org
+Subject: Fwd: Re: Consensus call: priority signal defaults
+Message-ID: <h3-reply-1@example.net>
+In-Reply-To: <h3-root-1@example.org>
+References: <h3-root-1@example.org>
+Date: Wed, 7 Jan 2026 16:20:00 +0000
+Content-Type: text/plain; charset=utf-8
+
+No objection to urgency=3 as default. Ship it.
+
+---- Original Message ----
+From: someone@example.org
+This forwarded tail should be stripped by the normalizer.
+""",
+    # Thread 3: lone announcement, no Message-ID.
+    """From zoe@example.org Thu Jan  8 12:00:00 2026
+From: Zoe Chair <zoe@example.org>
+To: quic@ietf.example.org
+Subject: Interim meeting agenda posted
+Date: Thu, 8 Jan 2026 12:00:00 +0000
+Content-Type: text/plain; charset=utf-8
+
+The agenda for the interim is up. We will discuss draft-ietf-quic-http-34
+and the multipath extension. Remote participation links to follow.
+
+Thanks,
+Zoe
+""",
+]
+
+
+def main() -> None:
+    body = "\n".join(m.replace("\r\n", "\n") for m in MESSAGES)
+    OUT.write_text(body)
+    print(f"wrote {OUT} ({len(MESSAGES)} messages, {len(body)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+import pytest
+
+from copilot_for_consensus_tpu.bus.base import PublishError
+from copilot_for_consensus_tpu.bus.factory import create_publisher, create_subscriber
+from copilot_for_consensus_tpu.bus.inproc import InProcBroker, InProcPublisher, InProcSubscriber
+from copilot_for_consensus_tpu.core.events import ArchiveIngested, JSONParsed
+
+
+@pytest.fixture
+def broker():
+    return InProcBroker("test.exchange")
+
+
+def test_publish_routes_by_event_type(broker):
+    pub = InProcPublisher(broker=broker)
+    pub.publish(ArchiveIngested(archive_id="a1"))
+    assert broker.queue_depth("archive.ingested") == 1
+    assert broker.queue_depth("json.parsed") == 0
+
+
+def test_subscribe_and_drain(broker):
+    pub = InProcPublisher(broker=broker)
+    sub = InProcSubscriber(broker=broker)
+    seen = []
+    sub.subscribe(["archive.ingested"], lambda env: seen.append(env))
+    pub.publish(ArchiveIngested(archive_id="a1"))
+    pub.publish(ArchiveIngested(archive_id="a2"))
+    assert sub.drain() == 2
+    assert [e["data"]["archive_id"] for e in seen] == ["a1", "a2"]
+
+
+def test_cascade_drains_to_quiescence(broker):
+    """A handler that publishes downstream events: drain() runs the cascade."""
+    pub = InProcPublisher(broker=broker)
+    sub = InProcSubscriber(broker=broker)
+    order = []
+
+    def on_archive(env):
+        order.append("archive")
+        pub.publish(JSONParsed(message_doc_id="m1"))
+
+    sub.subscribe(["archive.ingested"], on_archive)
+    sub.subscribe(["json.parsed"], lambda env: order.append("parsed"))
+    pub.publish(ArchiveIngested(archive_id="a1"))
+    assert sub.drain() == 2
+    assert order == ["archive", "parsed"]
+
+
+def test_nack_requeue_then_dead_letter(broker):
+    pub = InProcPublisher(broker=broker)
+    sub = InProcSubscriber(broker=broker)
+    attempts = []
+    sub.subscribe(["archive.ingested"],
+                  lambda env: (_ for _ in ()).throw(RuntimeError("boom")))
+    sub.subscribe(["archive.ingested.dlq"], lambda env: attempts.append("dlq"))
+    pub.publish(ArchiveIngested(archive_id="bad"))
+    sub.drain()
+    assert len(broker.dead_lettered) == 1
+    assert broker.dead_lettered[0][0] == "archive.ingested"
+    assert attempts == ["dlq"]
+
+
+def test_competing_consumers_share_work(broker):
+    pub = InProcPublisher(broker=broker)
+    sub = InProcSubscriber(broker=broker)
+    a, b = [], []
+    sub.subscribe(["archive.ingested"], lambda env: a.append(1))
+    sub.subscribe(["archive.ingested"], lambda env: b.append(1))
+    for i in range(10):
+        pub.publish(ArchiveIngested(archive_id=f"a{i}"))
+    sub.drain()
+    assert len(a) + len(b) == 10
+    assert len(a) == 5 and len(b) == 5  # round-robin
+
+
+def test_validating_publisher_rejects_garbage():
+    pub = create_publisher({"driver": "inproc", "exchange": "val.test"})
+    with pytest.raises(PublishError):
+        pub.publish_envelope({"event_type": "ArchiveIngested"}, "archive.ingested")
+
+
+def test_validating_subscriber_quarantines_invalid():
+    exchange = "val.test.2"
+    invalid = []
+    pub = create_publisher({"driver": "inproc", "exchange": exchange},
+                           validate=False)
+    sub = create_subscriber({"driver": "inproc", "exchange": exchange},
+                            on_invalid=lambda env, exc: invalid.append(env))
+    seen = []
+    sub.subscribe(["archive.ingested"], lambda env: seen.append(env))
+    pub.publish_envelope({"event_type": "ArchiveIngested"}, "archive.ingested")
+    pub.publish(ArchiveIngested(archive_id="ok"))
+    sub.drain()
+    assert len(seen) == 1 and seen[0]["data"]["archive_id"] == "ok"
+    assert len(invalid) == 1
+    assert sub.invalid_count == 1
+
+
+def test_zmq_roundtrip_if_available():
+    zmq_bus = pytest.importorskip("copilot_for_consensus_tpu.bus.zmq_bus")
+    if not zmq_bus.HAS_ZMQ:
+        pytest.skip("pyzmq missing")
+    pub = zmq_bus.ZmqPublisher({"base_port": 5810})
+    sub = zmq_bus.ZmqSubscriber({"base_port": 5810})
+    seen = []
+    sub.subscribe(["archive.ingested"], lambda env: seen.append(env))
+    import time
+    time.sleep(0.2)  # let PULL connect
+    pub.publish(ArchiveIngested(archive_id="z1"))
+    deadline = time.time() + 5
+    while not seen and time.time() < deadline:
+        sub.drain(max_messages=10)
+    pub.close()
+    sub.close()
+    assert seen and seen[0]["data"]["archive_id"] == "z1"

@@ -33,10 +33,31 @@ def test_config_file_and_combined_file(tmp_path):
 
     combined = tmp_path / "all.json"
     combined.write_text(json.dumps(
-        {"embedding": {"embedding_backend": {"dimension": 512}},
-         "parsing": {}}))
+        {"services": {"embedding": {"embedding_backend": {"dimension": 512}},
+                      "parsing": {}}}))
     cfg = get_config("embedding", env={"COPILOT_CONFIG": str(combined)})
     assert cfg.embedding_backend.dimension == 512
+
+
+def test_per_service_file_with_self_named_section(tmp_path):
+    # A service whose schema has a section named after itself (auth.auth)
+    # must not be mistaken for a combined file.
+    p = tmp_path / "auth.json"
+    p.write_text(json.dumps({"auth": {"enabled": True},
+                             "jwt_signer": {"issuer": "x"}}))
+    cfg = get_config("auth", env={}, config_path=p)
+    assert cfg.auth.enabled is True
+    assert cfg.jwt_signer.issuer == "x"
+
+
+def test_secret_values_redacted_in_validation_errors():
+    from copilot_for_consensus_tpu.core.validation import SchemaValidationError
+    env = {"COPILOT_EMBEDDING__EMBEDDING_BACKEND__BATCH_SIZE": '"secret://bs"',
+           "COPILOT_SECRET_BS": "hunter2-super-secret"}
+    with pytest.raises(SchemaValidationError) as exc_info:
+        get_config("embedding", env=env)
+    assert "hunter2-super-secret" not in str(exc_info.value)
+    assert "***" in str(exc_info.value)
 
 
 def test_missing_config_file_fails_fast():

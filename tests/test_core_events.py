@@ -46,10 +46,17 @@ def test_event_data_wrong_type_rejected():
 def test_unknown_event_type_rejected():
     env = events.ArchiveIngested().to_envelope()
     env["event_type"] = "NoSuchEvent"
-    with pytest.raises((SchemaValidationError, FileNotFoundError)):
+    with pytest.raises(SchemaValidationError):
         validate_envelope(env)
     with pytest.raises(ValueError):
         events.Event.from_envelope(env)
+
+
+def test_wire_event_type_cannot_traverse_paths():
+    env = events.ArchiveIngested().to_envelope()
+    env["event_type"] = "../documents/chunks"
+    with pytest.raises(SchemaValidationError):
+        validate_envelope(env)
 
 
 def test_failure_events_share_dlq_shape():

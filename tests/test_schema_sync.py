@@ -33,8 +33,11 @@ def _regenerate_and_compare(script: str, subdir: str, tmp_path):
            for p in generated_root.glob("*.json")}
     com = {p.name: json.loads(p.read_text())
            for p in committed_root.glob("*.schema.json")}
+    assert set(gen) == set(com), (
+        f"schema file set drift in {subdir}: generated-only="
+        f"{sorted(set(gen) - set(com))} committed-only="
+        f"{sorted(set(com) - set(gen))}; re-run scripts/{script}")
     for name, payload in gen.items():
-        assert name in com, f"generated {name} missing from committed schemas"
         assert payload == com[name], f"schema drift in {subdir}/{name}: re-run scripts/{script}"
 
 

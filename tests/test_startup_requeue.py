@@ -1,0 +1,30 @@
+from copilot_for_consensus_tpu.bus.inproc import InProcBroker, InProcPublisher, InProcSubscriber
+from copilot_for_consensus_tpu.core.events import JSONParsed
+from copilot_for_consensus_tpu.core.startup import StartupRequeue
+from copilot_for_consensus_tpu.obs.logging import MemoryLogger
+from copilot_for_consensus_tpu.storage.memory import InMemoryDocumentStore
+
+
+def test_requeue_incomplete_republishes_events():
+    broker = InProcBroker("requeue.test")
+    store = InMemoryDocumentStore()
+    store.insert_document("messages", {
+        "message_doc_id": "m1", "archive_id": "a1", "thread_id": "t1",
+        "chunked": False})
+    store.insert_document("messages", {
+        "message_doc_id": "m2", "archive_id": "a1", "thread_id": "t1",
+        "chunked": True})
+
+    requeue = StartupRequeue(store, InProcPublisher(broker=broker),
+                             MemoryLogger())
+    n = requeue.requeue_incomplete(
+        "messages", {"chunked": False},
+        lambda doc: JSONParsed(message_doc_id=doc["message_doc_id"],
+                               archive_id=doc["archive_id"],
+                               thread_id=doc["thread_id"]))
+    assert n == 1
+    sub = InProcSubscriber(broker=broker)
+    seen = []
+    sub.subscribe(["json.parsed"], lambda env: seen.append(env))
+    sub.drain()
+    assert [e["data"]["message_doc_id"] for e in seen] == ["m1"]

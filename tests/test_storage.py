@@ -1,0 +1,115 @@
+import pytest
+
+from copilot_for_consensus_tpu.core.validation import SchemaValidationError
+from copilot_for_consensus_tpu.storage import (
+    DuplicateKeyError,
+    InMemoryDocumentStore,
+    SQLiteDocumentStore,
+    ValidatingDocumentStore,
+    create_document_store,
+    matches_filter,
+)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        yield InMemoryDocumentStore()
+    else:
+        s = SQLiteDocumentStore({"path": str(tmp_path / "docs.sqlite3")})
+        yield s
+        s.close()
+
+
+def _chunk(cid, thread="t1", embedded=False, tokens=100):
+    return {"chunk_id": cid, "message_doc_id": "m1", "thread_id": thread,
+            "text": "hello", "token_count": tokens,
+            "embedding_generated": embedded}
+
+
+def test_insert_get_roundtrip(store):
+    store.insert_document("chunks", _chunk("c1"))
+    doc = store.get_document("chunks", "c1")
+    assert doc["thread_id"] == "t1"
+
+
+def test_duplicate_key_raises_and_insert_or_ignore(store):
+    store.insert_document("chunks", _chunk("c1"))
+    with pytest.raises(DuplicateKeyError):
+        store.insert_document("chunks", _chunk("c1"))
+    assert store.insert_or_ignore("chunks", _chunk("c1")) is False
+    assert store.insert_or_ignore("chunks", _chunk("c2")) is True
+
+
+def test_query_filters(store):
+    store.insert_document("chunks", _chunk("c1", embedded=True, tokens=50))
+    store.insert_document("chunks", _chunk("c2", embedded=False, tokens=200))
+    store.insert_document("chunks", _chunk("c3", thread="t2", tokens=300))
+    assert {d["chunk_id"] for d in store.query_documents(
+        "chunks", {"embedding_generated": False})} == {"c2", "c3"}
+    assert [d["chunk_id"] for d in store.query_documents(
+        "chunks", {"token_count": {"$gte": 200}},
+        sort=[("token_count", -1)])] == ["c3", "c2"]
+    assert store.count_documents(
+        "chunks", {"thread_id": {"$in": ["t2"]}}) == 1
+    assert store.count_documents(
+        "chunks", {"$or": [{"chunk_id": "c1"}, {"chunk_id": "c3"}]}) == 2
+
+
+def test_update_and_delete(store):
+    store.insert_document("chunks", _chunk("c1"))
+    assert store.update_document("chunks", "c1",
+                                 {"embedding_generated": True}) is True
+    assert store.get_document("chunks", "c1")["embedding_generated"] is True
+    assert store.update_document("chunks", "missing", {"x": 1}) is False
+    assert store.delete_document("chunks", "c1") is True
+    assert store.get_document("chunks", "c1") is None
+
+
+def test_delete_many_and_pagination(store):
+    for i in range(10):
+        store.insert_document("chunks", _chunk(f"c{i}", tokens=i))
+    page = store.query_documents("chunks", sort=[("token_count", 1)],
+                                 limit=3, skip=3)
+    assert [d["chunk_id"] for d in page] == ["c3", "c4", "c5"]
+    assert store.delete_documents("chunks", {"token_count": {"$lt": 5}}) == 5
+    assert store.count_documents("chunks") == 5
+
+
+def test_sqlite_persistence(tmp_path):
+    path = str(tmp_path / "persist.sqlite3")
+    s1 = SQLiteDocumentStore({"path": path})
+    s1.insert_document("threads", {"thread_id": "t1", "subject": "QUIC"})
+    s1.close()
+    s2 = SQLiteDocumentStore({"path": path})
+    assert s2.get_document("threads", "t1")["subject"] == "QUIC"
+    s2.close()
+
+
+def test_validating_store_rejects_bad_docs():
+    store = ValidatingDocumentStore(InMemoryDocumentStore())
+    with pytest.raises(SchemaValidationError):
+        store.insert_document("chunks", {"chunk_id": "c1"})  # missing required
+    store.insert_document("chunks", _chunk("c1"))
+    with pytest.raises(SchemaValidationError):
+        store.update_document("chunks", "c1", {"token_count": "NaN"})
+    # unknown collections pass through
+    store.insert_document("scratch", {"_id": "x", "anything": True})
+
+
+def test_factory_dispatch(tmp_path):
+    s = create_document_store({"driver": "sqlite",
+                               "path": str(tmp_path / "f.sqlite3")})
+    assert isinstance(s, ValidatingDocumentStore)
+    with pytest.raises(ValueError):
+        create_document_store({"driver": "mongodb"})
+
+
+def test_matches_filter_edge_cases():
+    doc = {"a": {"b": 3}, "s": "draft-ietf-quic-http-34"}
+    assert matches_filter(doc, {"a.b": 3})
+    assert matches_filter(doc, {"a.b": {"$lt": 4}})
+    assert matches_filter(doc, {"s": {"$regex": r"draft-[a-z]+-quic"}})
+    assert not matches_filter(doc, {"missing": {"$exists": True}})
+    assert matches_filter(doc, {"missing": {"$exists": False}})
+    assert matches_filter(doc, {"missing": {"$ne": 5}})

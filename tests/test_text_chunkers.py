@@ -1,0 +1,85 @@
+import pytest
+
+from copilot_for_consensus_tpu.text.chunkers import (
+    FixedSizeChunker,
+    SemanticChunker,
+    TokenWindowChunker,
+    create_chunker,
+    estimate_tokens,
+)
+from copilot_for_consensus_tpu.text.drafts import detect_draft_mentions
+
+
+def test_estimate_tokens():
+    assert estimate_tokens("") == 0
+    assert estimate_tokens("one two three four") == int(4 * 1.3)
+
+
+def test_token_window_respects_bounds():
+    text = " ".join(f"word{i}" for i in range(2000))
+    chunks = TokenWindowChunker().chunk(text)
+    assert len(chunks) > 1
+    for c in chunks:
+        assert c.token_count <= 512
+    assert all(c.seq == i for i, c in enumerate(chunks))
+    # overlap: consecutive chunks share words
+    first_words = chunks[0].text.split()
+    second_words = chunks[1].text.split()
+    assert set(first_words[-10:]) & set(second_words[:50])
+
+
+def test_token_window_small_tail_merged():
+    words_per_chunk = int(384 / 1.3)
+    text = " ".join(f"w{i}" for i in range(words_per_chunk + 5))
+    chunks = TokenWindowChunker().chunk(text)
+    assert len(chunks) == 1 or chunks[-1].token_count >= 100
+
+
+def test_token_window_empty_and_tiny():
+    assert TokenWindowChunker().chunk("") == []
+    tiny = TokenWindowChunker().chunk("just a few words")
+    assert len(tiny) == 1
+    assert tiny[0].text == "just a few words"
+
+
+def test_fixed_size_chunker():
+    text = "x" * 4000
+    chunks = FixedSizeChunker(chunk_chars=1500, overlap_chars=200).chunk(text)
+    assert len(chunks) == 3
+    assert all(len(c.text) <= 1500 for c in chunks)
+
+
+def test_semantic_chunker_paragraph_packing():
+    paras = [f"Paragraph {i}. " + "Sentence filler here. " * 10
+             for i in range(10)]
+    text = "\n\n".join(paras)
+    chunks = SemanticChunker(chunk_size=100).chunk(text)
+    assert len(chunks) > 1
+    # paragraphs are not split mid-way when under budget
+    assert all("Paragraph" in c.text for c in chunks)
+
+
+def test_semantic_chunker_splits_giant_paragraph():
+    text = "This is a sentence. " * 200  # one huge paragraph
+    chunks = SemanticChunker(chunk_size=100).chunk(text)
+    assert len(chunks) > 1
+
+
+def test_create_chunker_factory():
+    assert create_chunker({"driver": "token_window"}).name == "token_window"
+    assert create_chunker({"driver": "semantic"}).name == "semantic"
+    assert create_chunker({"driver": "fixed_size"}).name == "fixed_size"
+    with pytest.raises(ValueError):
+        create_chunker({"driver": "bert"})
+    with pytest.raises(ValueError):
+        create_chunker({"driver": "token_window", "chunk_size": 10,
+                        "overlap": 20})
+
+
+def test_draft_detection():
+    text = ("See draft-ietf-quic-recovery-29 and draft-mueller-quic-var-01; "
+            "also draft-ietf-quic-recovery-30 is out. Not-a-draft: "
+            "draftsman, re-draft.")
+    assert detect_draft_mentions(text) == [
+        "draft-ietf-quic-recovery", "draft-mueller-quic-var"]
+    assert detect_draft_mentions("") == []

@@ -1,0 +1,71 @@
+import pathlib
+
+import pytest
+
+from copilot_for_consensus_tpu.text.mbox import (
+    decode_header_value,
+    parse_date,
+    parse_mbox_bytes,
+    parse_mbox_file,
+)
+
+FIXTURE = pathlib.Path(__file__).parent / "fixtures" / "ietf-sample.mbox"
+
+
+@pytest.fixture(scope="module")
+def parsed():
+    return list(parse_mbox_file(FIXTURE))
+
+
+def test_parses_all_messages(parsed):
+    assert len(parsed) == 7
+
+
+def test_headers_decoded(parsed):
+    msgs = [m for m, _ in parsed]
+    assert msgs[0].message_id == "qr-root-1@example.org"
+    assert msgs[0].from_addr == "alice@example.org"
+    assert msgs[0].from_name == "Alice Example"
+    # RFC-2047 encoded name
+    assert msgs[2].from_name == "Carol Müller"
+    # Cc merged into to_addrs
+    assert "bob@example.net" in msgs[2].to_addrs
+
+
+def test_reply_chain_headers(parsed):
+    msgs = [m for m, _ in parsed]
+    assert msgs[1].in_reply_to == "qr-root-1@example.org"
+    assert msgs[2].references == ["qr-root-1@example.org",
+                                  "qr-reply-1@example.net"]
+    assert msgs[6].message_id == ""  # missing Message-ID tolerated
+
+
+def test_dates_utc_iso(parsed):
+    msgs = [m for m, _ in parsed]
+    assert msgs[0].date == "2026-01-05T10:00:00+00:00"
+    assert parse_date("garbage") is None
+    assert parse_date(None) is None
+
+
+def test_multipart_prefers_plain_text(parsed):
+    msg, is_html = parsed[4]
+    assert not is_html
+    assert "consensus call" in msg.body_raw
+    assert "<p>" not in msg.body_raw
+
+
+def test_bytes_roundtrip(parsed):
+    raw = FIXTURE.read_bytes()
+    from_bytes = list(parse_mbox_bytes(raw))
+    assert len(from_bytes) == len(parsed)
+    assert from_bytes[0][0].message_id == parsed[0][0].message_id
+
+
+def test_malformed_archive_yields_nothing():
+    assert list(parse_mbox_bytes(b"this is not an mbox at all")) == []
+
+
+def test_decode_header_edge_cases():
+    assert decode_header_value(None) == ""
+    assert decode_header_value("plain subject") == "plain subject"
+    assert decode_header_value("=?utf-8?q?caf=C3=A9?=") == "café"

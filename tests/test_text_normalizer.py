@@ -1,0 +1,61 @@
+from copilot_for_consensus_tpu.text.normalizer import (
+    NormalizerConfig,
+    TextNormalizer,
+    html_to_text,
+)
+
+
+def test_html_to_text_strips_tags_and_style():
+    html = ("<html><head><style>p{color:red}</style></head><body>"
+            "<p>Hello <b>world</b></p><p>Second</p></body></html>")
+    text = html_to_text(html)
+    assert "Hello world" in text
+    assert "Second" in text
+    assert "color" not in text
+    assert "<" not in text
+
+
+def test_signature_stripped():
+    body = "Real content here.\n\n--\nBob Builder\nExample Networks\n"
+    out = TextNormalizer().normalize(body)
+    assert "Real content" in out
+    assert "Bob Builder" not in out
+
+
+def test_best_regards_stripped():
+    body = "I disagree with the clamp.\n\nBest regards,\nCarol\n"
+    out = TextNormalizer().normalize(body)
+    assert "disagree" in out
+    assert "Carol" not in out
+
+
+def test_quoted_reply_removed():
+    body = ("On Mon, 5 Jan 2026 at 10:00, Alice wrote:\n"
+            "> original text line one\n"
+            "> original text line two\n"
+            "\n"
+            "My actual reply.\n")
+    out = TextNormalizer().normalize(body)
+    assert "My actual reply." in out
+    assert "original text" not in out
+    assert "Alice wrote" not in out
+
+
+def test_forward_marker_truncates():
+    body = "Ship it.\n\n---- Original Message ----\nold forwarded stuff\n"
+    out = TextNormalizer().normalize(body)
+    assert "Ship it." in out
+    assert "forwarded stuff" not in out
+
+
+def test_blank_collapse_and_config_gates():
+    body = "a\n\n\n\n\nb\n"
+    assert TextNormalizer().normalize(body) == "a\n\nb"
+    keep = TextNormalizer(NormalizerConfig(strip_signatures=False))
+    assert "Cheers," in keep.normalize("hi\n\nCheers,\nme")
+
+
+def test_html_message_end_to_end():
+    html = "<p>This is a <b>consensus call</b>.</p>"
+    out = TextNormalizer().normalize(html, is_html=True)
+    assert out == "This is a consensus call."

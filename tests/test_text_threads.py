@@ -1,0 +1,74 @@
+import pathlib
+
+import pytest
+
+from copilot_for_consensus_tpu.text.mbox import parse_mbox_file
+from copilot_for_consensus_tpu.text.threads import (
+    ThreadBuilder,
+    normalize_subject,
+)
+
+FIXTURE = pathlib.Path(__file__).parent / "fixtures" / "ietf-sample.mbox"
+
+
+@pytest.fixture(scope="module")
+def threads():
+    messages = [m for m, _ in parse_mbox_file(FIXTURE)]
+    return ThreadBuilder().build_threads(messages), messages
+
+
+def test_normalize_subject():
+    assert normalize_subject("Re: Re: Foo bar") == "foo bar"
+    assert normalize_subject("RE[2]: Foo") == "foo"
+    assert normalize_subject("Fwd: Re:  Foo   bar ") == "foo bar"
+    assert normalize_subject("AW: Antwort") == "antwort"
+
+
+def test_three_threads_built(threads):
+    built, _ = threads
+    assert len(built) == 3
+
+
+def test_reply_chain_groups_with_orphan(threads):
+    built, messages = threads
+    quic = [t for t in built.values()
+            if "retransmission" in t.subject.lower()]
+    assert len(quic) == 1
+    t = quic[0]
+    # root + 2 chained replies + 1 orphan (subject fallback) = 4
+    assert len(t.message_indices) == 4
+    assert t.root_message_id == "qr-root-1@example.org"
+    assert t.participants == ["alice@example.org", "bob@example.net",
+                              "carol@example.com", "dave@example.io"]
+    assert t.first_date and t.first_date.startswith("2026-01-05")
+    assert t.last_date and t.last_date.startswith("2026-01-06")
+
+
+def test_subject_prefix_variants_group(threads):
+    built, _ = threads
+    h3 = [t for t in built.values() if "priority" in t.subject.lower()]
+    assert len(h3) == 1
+    assert len(h3[0].message_indices) == 2
+
+
+def test_lone_message_thread(threads):
+    built, _ = threads
+    lone = [t for t in built.values() if "interim" in t.subject.lower()]
+    assert len(lone) == 1
+    assert len(lone[0].message_indices) == 1
+
+
+def test_thread_ids_deterministic(threads):
+    built, messages = threads
+    rebuilt = ThreadBuilder().build_threads(messages)
+    assert set(rebuilt) == set(built)
+
+
+def test_cycle_guard():
+    from copilot_for_consensus_tpu.text.mbox import ParsedMessage
+    a = ParsedMessage(index=0, message_id="a@x", in_reply_to="b@x",
+                      subject="loop")
+    b = ParsedMessage(index=1, message_id="b@x", in_reply_to="a@x",
+                      subject="Re: loop")
+    built = ThreadBuilder().build_threads([a, b])
+    assert sum(len(t.message_indices) for t in built.values()) == 2

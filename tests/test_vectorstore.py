@@ -1,0 +1,78 @@
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.vectorstore import (
+    InMemoryVectorStore,
+    VectorStoreError,
+    create_vector_store,
+)
+
+
+def test_add_query_exact_top_k():
+    vs = InMemoryVectorStore()
+    vs.add_embedding("a", [1.0, 0.0, 0.0], {"thread_id": "t1"})
+    vs.add_embedding("b", [0.0, 1.0, 0.0], {"thread_id": "t1"})
+    vs.add_embedding("c", [0.9, 0.1, 0.0], {"thread_id": "t2"})
+    res = vs.query([1.0, 0.0, 0.0], top_k=2)
+    assert [r.id for r in res] == ["a", "c"]
+    assert res[0].score == pytest.approx(1.0)
+    assert res[0].score >= res[1].score
+
+
+def test_metadata_filter():
+    vs = InMemoryVectorStore()
+    vs.add_embedding("a", [1.0, 0.0], {"thread_id": "t1"})
+    vs.add_embedding("b", [0.99, 0.01], {"thread_id": "t2"})
+    res = vs.query([1.0, 0.0], top_k=5, flt={"thread_id": "t2"})
+    assert [r.id for r in res] == ["b"]
+
+
+def test_upsert_semantics():
+    vs = InMemoryVectorStore()
+    vs.add_embedding("a", [1.0, 0.0], {"v": 1})
+    vs.add_embedding("a", [0.0, 1.0], {"v": 2})
+    assert vs.count() == 1
+    vec, meta = vs.get("a")
+    assert meta == {"v": 2}
+    assert np.argmax(vec) == 1
+
+
+def test_dimension_enforced():
+    vs = InMemoryVectorStore()
+    vs.add_embedding("a", [1.0, 0.0, 0.0])
+    assert vs.dimension == 3
+    with pytest.raises(VectorStoreError):
+        vs.add_embedding("b", [1.0, 0.0])
+
+
+def test_delete_and_clear():
+    vs = InMemoryVectorStore()
+    for i in range(5):
+        vs.add_embedding(f"v{i}", np.eye(5)[i])
+    assert vs.delete(["v0", "v3"]) == 2
+    assert vs.count() == 3
+    assert vs.get("v0") is None
+    assert [r.id for r in vs.query(np.eye(5)[1], top_k=1)] == ["v1"]
+    vs.clear()
+    assert vs.count() == 0
+    assert vs.query([1, 0, 0, 0, 0]) == []
+
+
+def test_persistence_roundtrip(tmp_path):
+    path = tmp_path / "vs.npz"
+    vs = InMemoryVectorStore()
+    vs.add_embedding("a", [0.5, 0.5], {"thread_id": "t1"})
+    vs.save(path)
+    vs2 = InMemoryVectorStore()
+    vs2.load(path)
+    assert vs2.count() == 1
+    res = vs2.query([0.5, 0.5], top_k=1)
+    assert res[0].id == "a"
+    assert res[0].metadata == {"thread_id": "t1"}
+
+
+def test_factory():
+    vs = create_vector_store({"driver": "memory", "dimension": 4})
+    assert vs.dimension == 4
+    with pytest.raises(ValueError):
+        create_vector_store({"driver": "qdrant"})

@@ -1,0 +1,31 @@
+"""TPU-native model zoo.
+
+First-party JAX replacements for the inference engines the reference
+delegates to (SURVEY.md §0): the generative LLM role played by
+Ollama/llama.cpp (``adapters/copilot_summarization/.../factory.py:89-94``)
+and the embedding-encoder role played by sentence-transformers
+(``adapters/copilot_embedding/.../sentence_transformer_provider.py:19``).
+
+Pure functional style: parameters are pytrees of ``jnp`` arrays, every
+forward pass is a jit-able function of ``(params, inputs)``, layers are
+stacked on a leading axis and driven by ``lax.scan`` so compile time stays
+flat in depth and pjit shards one stacked tensor per weight.
+"""
+
+from copilot_for_consensus_tpu.models.configs import (
+    DecoderConfig,
+    EncoderConfig,
+    DECODER_CONFIGS,
+    ENCODER_CONFIGS,
+    decoder_config,
+    encoder_config,
+)
+
+__all__ = [
+    "DecoderConfig",
+    "EncoderConfig",
+    "DECODER_CONFIGS",
+    "ENCODER_CONFIGS",
+    "decoder_config",
+    "encoder_config",
+]

@@ -1,0 +1,213 @@
+"""Decoder-only LLM (Mistral / Llama-3 / Mixtral class).
+
+Pre-norm transformer with RoPE, GQA, SwiGLU (or MoE) FFN, RMSNorm.
+Layers are stacked on a leading axis and driven by ``lax.scan``:
+compile time is O(1) in depth and every weight is one pjit-shardable
+tensor. Three entry points:
+
+* ``forward``      — [B, S] → logits [B, S, V] (scoring / training)
+* ``prefill``      — builds the KV cache, returns last-position logits
+* ``decode_step``  — one token per active slot against the cache
+
+This model fills the generative-engine role the reference delegates to
+Ollama / llama.cpp (``adapters/copilot_summarization/.../factory.py:89-94``,
+``local_llm_summarizer.py:106-115``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from copilot_for_consensus_tpu.models.configs import DecoderConfig
+from copilot_for_consensus_tpu.models import layers as L
+from copilot_for_consensus_tpu.models.moe import moe_ffn
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init + sharding metadata
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: DecoderConfig,
+                dtype=jnp.bfloat16) -> Params:
+    """Truncated-normal init, scaled 1/sqrt(fan_in) for projections."""
+    n, d, dh = cfg.n_layers, cfg.d_model, cfg.head_dim
+    hq, hkv, f, v = cfg.n_heads, cfg.n_kv_heads, cfg.d_ff, cfg.vocab_size
+    keys = iter(jax.random.split(rng, 16))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * fan_in ** -0.5).astype(dtype)
+
+    layer: Params = {
+        "attn_norm": jnp.ones((n, d), dtype),
+        "wq": dense(next(keys), (n, d, hq * dh), d),
+        "wk": dense(next(keys), (n, d, hkv * dh), d),
+        "wv": dense(next(keys), (n, d, hkv * dh), d),
+        "wo": dense(next(keys), (n, hq * dh, d), hq * dh),
+        "ffn_norm": jnp.ones((n, d), dtype),
+    }
+    if cfg.is_moe:
+        e = cfg.n_experts
+        layer.update({
+            "router": dense(next(keys), (n, d, e), d),
+            "w_gate": dense(next(keys), (n, e, d, f), d),
+            "w_up": dense(next(keys), (n, e, d, f), d),
+            "w_down": dense(next(keys), (n, e, f, d), f),
+        })
+    else:
+        layer.update({
+            "w_gate": dense(next(keys), (n, d, f), d),
+            "w_up": dense(next(keys), (n, d, f), d),
+            "w_down": dense(next(keys), (n, f, d), f),
+        })
+    params: Params = {
+        "tok_emb": dense(next(keys), (v, d), d),
+        "layers": layer,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (d, v), d)
+    return params
+
+
+def logical_axes(cfg: DecoderConfig) -> Params:
+    """Same structure as params; leaves are logical-axis tuples."""
+    layer = {
+        "attn_norm": (None, "norm"),
+        "wq": (None, "embed", "heads"),
+        "wk": (None, "embed", "kv_heads"),
+        "wv": (None, "embed", "kv_heads"),
+        "wo": (None, "heads", "embed"),
+        "ffn_norm": (None, "norm"),
+    }
+    if cfg.is_moe:
+        layer.update({
+            "router": (None, "embed", None),
+            "w_gate": (None, "experts", "embed", "expert_ffn"),
+            "w_up": (None, "experts", "embed", "expert_ffn"),
+            "w_down": (None, "experts", "expert_ffn", "embed"),
+        })
+    else:
+        layer.update({
+            "w_gate": (None, "embed", "ffn"),
+            "w_up": (None, "embed", "ffn"),
+            "w_down": (None, "ffn", "embed"),
+        })
+    axes: Params = {
+        "tok_emb": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def param_count(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Forward passes
+# ---------------------------------------------------------------------------
+
+
+def _ffn(x: jax.Array, layer: Params, cfg: DecoderConfig) -> jax.Array:
+    return moe_ffn(x, layer, cfg) if cfg.is_moe else L.swiglu(x, layer)
+
+
+def _unembed(x: jax.Array, params: Params, cfg: DecoderConfig) -> jax.Array:
+    x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["tok_emb"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    return (x @ head).astype(jnp.float32)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: DecoderConfig,
+            lengths: jax.Array | None = None,
+            attn_impl: str = "auto") -> jax.Array:
+    """Scoring/training pass: [B, S] int tokens → [B, S, V] fp32 logits."""
+    x = params["tok_emb"][tokens]
+
+    def body(x, layer):
+        h, _, _ = L.attn_prefill(
+            L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+            layer, cfg, lengths=lengths, impl=attn_impl)
+        x = x + h
+        x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                     layer, cfg)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return _unembed(x, params, cfg)
+
+
+def init_cache(cfg: DecoderConfig, batch: int, max_len: int,
+               dtype=jnp.bfloat16) -> Params:
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_logical_axes() -> Params:
+    return {"k": (None, "batch", "kv_heads", None, None),
+            "v": (None, "batch", "kv_heads", None, None)}
+
+
+def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
+            cfg: DecoderConfig, cache: Params,
+            attn_impl: str = "auto") -> tuple[jax.Array, Params]:
+    """Prompt pass. tokens: [B, S] right-padded; lengths: [B]. Writes kv for
+    positions [0, S) into the cache and returns (last-valid-position logits
+    [B, V] fp32, cache)."""
+    b, s = tokens.shape
+    x = params["tok_emb"][tokens]
+
+    def body(x, scanned):
+        layer, k_cache, v_cache = scanned
+        h, k, v = L.attn_prefill(
+            L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+            layer, cfg, lengths=lengths, impl=attn_impl)
+        x = x + h
+        x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                     layer, cfg)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), 0, axis=2)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), 0, axis=2)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    logits = _unembed(x, params, cfg)                       # [B, S, V]
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    return last, {"k": k_new, "v": v_new}
+
+
+def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
+                cfg: DecoderConfig, cache: Params
+                ) -> tuple[jax.Array, Params]:
+    """One decode step. tokens: [B] int — the tokens to feed; positions:
+    [B] — the cache index each token occupies. Returns ([B, V] fp32 logits,
+    updated cache)."""
+    x = params["tok_emb"][tokens][:, None, :]               # [B, 1, D]
+
+    def body(x, scanned):
+        layer, k_cache, v_cache = scanned
+        h, k_cache, v_cache = L.attn_decode(
+            L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+            layer, cfg, positions, k_cache, v_cache)
+        x = x + h
+        x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                     layer, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    return _unembed(x, params, cfg)[:, 0], {"k": k_new, "v": v_new}

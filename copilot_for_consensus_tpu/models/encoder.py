@@ -1,0 +1,118 @@
+"""Bidirectional sentence encoder (MiniLM/BERT class) → text embeddings.
+
+Post-norm transformer with learned positions, GELU MLP, masked mean
+pooling and L2 normalization — the architecture class of
+all-MiniLM-L6-v2, the reference's default embedder
+(``adapters/copilot_embedding/.../sentence_transformer_provider.py:19-51``).
+Unlike the reference's per-text ``embed()`` loop
+(``embedding/app/service.py:393``), this forward is built for real
+cross-text batching: [B, S] in, [B, dim] out, one MXU pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from copilot_for_consensus_tpu.models.configs import EncoderConfig
+from copilot_for_consensus_tpu.models import layers as L
+from copilot_for_consensus_tpu.ops.attention import attention
+
+Params = dict[str, Any]
+
+
+def init_params(rng: jax.Array, cfg: EncoderConfig,
+                dtype=jnp.bfloat16) -> Params:
+    n, d, f, v = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab_size
+    keys = iter(jax.random.split(rng, 12))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * fan_in ** -0.5).astype(dtype)
+
+    return {
+        "tok_emb": dense(next(keys), (v, d), d),
+        "pos_emb": dense(next(keys), (cfg.max_positions, d), d),
+        "emb_norm_w": jnp.ones((d,), dtype),
+        "emb_norm_b": jnp.zeros((d,), dtype),
+        "layers": {
+            "wq": dense(next(keys), (n, d, d), d),
+            "wk": dense(next(keys), (n, d, d), d),
+            "wv": dense(next(keys), (n, d, d), d),
+            "wo": dense(next(keys), (n, d, d), d),
+            "attn_norm_w": jnp.ones((n, d), dtype),
+            "attn_norm_b": jnp.zeros((n, d), dtype),
+            "w_in": dense(next(keys), (n, d, f), d),
+            "b_in": jnp.zeros((n, f), dtype),
+            "w_out": dense(next(keys), (n, f, d), f),
+            "b_out": jnp.zeros((n, d), dtype),
+            "ffn_norm_w": jnp.ones((n, d), dtype),
+            "ffn_norm_b": jnp.zeros((n, d), dtype),
+        },
+    }
+
+
+def logical_axes(cfg: EncoderConfig) -> Params:
+    return {
+        "tok_emb": ("vocab", "embed"),
+        "pos_emb": (None, "embed"),
+        "emb_norm_w": ("norm",),
+        "emb_norm_b": ("norm",),
+        "layers": {
+            "wq": (None, "embed", "heads"),
+            "wk": (None, "embed", "heads"),
+            "wv": (None, "embed", "heads"),
+            "wo": (None, "heads", "embed"),
+            "attn_norm_w": (None, "norm"),
+            "attn_norm_b": (None, "norm"),
+            "w_in": (None, "embed", "ffn"),
+            "b_in": (None, "ffn"),
+            "w_out": (None, "ffn", "embed"),
+            "b_out": (None, "norm"),
+            "ffn_norm_w": (None, "norm"),
+            "ffn_norm_b": (None, "norm"),
+        },
+    }
+
+
+def encode(params: Params, tokens: jax.Array, lengths: jax.Array,
+           cfg: EncoderConfig, attn_impl: str = "auto") -> jax.Array:
+    """tokens: [B, S] right-padded; lengths: [B] → [B, d_model] fp32,
+    L2-normalized (cosine-ready, matching sentence-transformers)."""
+    b, s = tokens.shape
+    if s > cfg.max_positions:
+        raise ValueError(
+            f"sequence length {s} exceeds max_positions "
+            f"{cfg.max_positions}; the caller must truncate or window"
+        )
+    dh = cfg.head_dim
+    positions = jnp.arange(s)
+    x = params["tok_emb"][tokens] + params["pos_emb"][positions][None]
+    x = L.layer_norm(x, params["emb_norm_w"], params["emb_norm_b"],
+                     cfg.norm_eps)
+
+    def body(x, layer):
+        q = (x @ layer["wq"]).reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+        k = (x @ layer["wk"]).reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+        v = (x @ layer["wv"]).reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+        o = attention(q, k, v, causal=False, kv_lengths=lengths,
+                      impl=attn_impl)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        x = L.layer_norm(x + o @ layer["wo"], layer["attn_norm_w"],
+                         layer["attn_norm_b"], cfg.norm_eps)
+        h = L.gelu_mlp(x, layer)
+        x = L.layer_norm(x + h, layer["ffn_norm_w"], layer["ffn_norm_b"],
+                         cfg.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    # Masked mean pooling over valid positions, then L2 normalize.
+    mask = (jnp.arange(s)[None, :] < lengths[:, None])
+    xf = x.astype(jnp.float32) * mask[..., None]
+    pooled = jnp.sum(xf, axis=1) / jnp.maximum(
+        lengths[:, None].astype(jnp.float32), 1.0)
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)

@@ -1,0 +1,137 @@
+"""Shared transformer building blocks (functional, pytree params).
+
+Conventions:
+* params are plain dicts of ``jnp`` arrays; a parallel tree of logical-axis
+  tuples (see ``parallel/sharding.py``) describes how each leaf shards.
+* activations flow in the compute dtype (bf16 by default); norms and
+  softmax statistics accumulate in fp32 — the standard TPU recipe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from copilot_for_consensus_tpu.models.configs import DecoderConfig
+from copilot_for_consensus_tpu.ops.attention import attention, decode_attention
+
+# ---------------------------------------------------------------------------
+# Norms
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array,
+               eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    normed = (xf - mean) * jax.lax.rsqrt(var + eps)
+    out = normed * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embedding (GPT-NeoX rotate-half convention, as used by
+# Llama / Mistral / Mixtral)
+# ---------------------------------------------------------------------------
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)                     # [head_dim/2]
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               inv_freq: jax.Array) -> jax.Array:
+    """x: [B, H, S, D]; positions: [B, S] (int) → same shape, rotated."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, None, :, :]                  # [B,1,S,D/2]
+    sin = jnp.sin(angles)[:, None, :, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Attention block (GQA) — prefill and decode variants share projections
+# ---------------------------------------------------------------------------
+
+
+def _project_qkv(x: jax.Array, layer: dict, cfg: DecoderConfig,
+                 positions: jax.Array):
+    b, s, _ = x.shape
+    dh = cfg.head_dim
+    q = (x @ layer["wq"]).reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+    k = (x @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
+    v = (x @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
+    inv_freq = rope_frequencies(dh, cfg.rope_theta)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    return q, k, v
+
+
+def attn_prefill(x: jax.Array, layer: dict, cfg: DecoderConfig,
+                 lengths: jax.Array | None = None, impl: str = "auto"):
+    """Full-sequence causal attention. Returns (out [B,S,D_model], k, v)
+    with k/v in [B, Hkv, S, Dh] for cache insertion."""
+    b, s, _ = x.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    q, k, v = _project_qkv(x, layer, cfg, positions)
+    o = attention(q, k, v, causal=True, window=cfg.sliding_window,
+                  kv_lengths=lengths, impl=impl)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim)
+    return o @ layer["wo"], k, v
+
+
+def cache_write(cache: jax.Array, col: jax.Array,
+                positions: jax.Array) -> jax.Array:
+    """Write one kv column per slot. cache: [B, Hkv, S_max, Dh];
+    col: [B, Hkv, 1, Dh]; positions: [B]."""
+    return jax.vmap(
+        lambda c, x, p: jax.lax.dynamic_update_slice_in_dim(
+            c, x.astype(c.dtype), p, axis=1
+        )
+    )(cache, col, positions)
+
+
+def attn_decode(x: jax.Array, layer: dict, cfg: DecoderConfig,
+                positions: jax.Array, k_cache: jax.Array,
+                v_cache: jax.Array):
+    """One-token decode. x: [B, 1, D]; positions: [B] — index the new token
+    is written at; caches: [B, Hkv, S_max, Dh]. Returns
+    (out [B,1,D], k_cache, v_cache)."""
+    b = x.shape[0]
+    q, k, v = _project_qkv(x, layer, cfg, positions[:, None])
+    k_cache = cache_write(k_cache, k, positions)
+    v_cache = cache_write(v_cache, v, positions)
+    o = decode_attention(q[:, :, 0, :], k_cache, v_cache,
+                         lengths=positions + 1,
+                         window=cfg.sliding_window)       # [B, Hq, Dh]
+    o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim)
+    return o @ layer["wo"], k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# Feed-forward
+# ---------------------------------------------------------------------------
+
+
+def swiglu(x: jax.Array, layer: dict) -> jax.Array:
+    """SwiGLU MLP: silu(x·Wg) ⊙ (x·Wu) · Wd — Llama/Mistral family FFN."""
+    gate = jax.nn.silu((x @ layer["w_gate"]).astype(jnp.float32))
+    up = (x @ layer["w_up"]).astype(jnp.float32)
+    return ((gate * up).astype(x.dtype)) @ layer["w_down"]
+
+
+def gelu_mlp(x: jax.Array, layer: dict) -> jax.Array:
+    """BERT-style 2-layer GELU MLP (encoder FFN)."""
+    h = jax.nn.gelu((x @ layer["w_in"] + layer["b_in"]).astype(jnp.float32))
+    return h.astype(x.dtype) @ layer["w_out"] + layer["b_out"]

@@ -1,0 +1,15 @@
+"""TPU kernels and numerics.
+
+The hot ops of the serving path: flash attention (Pallas, online-softmax
+tiling for the MXU) and decode attention over KV caches. Every Pallas
+kernel has an XLA reference implementation used for CPU tests and as its
+numerics oracle.
+"""
+
+from copilot_for_consensus_tpu.ops.attention import (
+    attention,
+    attention_xla,
+    decode_attention,
+)
+
+__all__ = ["attention", "attention_xla", "decode_attention"]

@@ -1,0 +1,148 @@
+"""Attention front-end: dispatches to Pallas flash or XLA reference.
+
+Shapes (GQA throughout — Mistral/Llama/Mixtral all use it):
+    q: [B, Hq, S, D]    k, v: [B, Hkv, S, D]    Hq % Hkv == 0
+
+The reference never runs attention itself (it delegates to Ollama /
+llama.cpp — ``local_llm_summarizer.py:106``); this op is the core of the
+first-party engine that replaces them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _gqa_expand(k: jax.Array, hq: int) -> jax.Array:
+    """[B, Hkv, S, D] → [B, Hq, S, D] by repeating each kv head."""
+    b, hkv, s, d = k.shape
+    if hkv == hq:
+        return k
+    return jnp.repeat(k, hq // hkv, axis=1)
+
+
+def make_attention_mask(
+    s_q: int,
+    s_kv: int,
+    *,
+    causal: bool,
+    window: int = 0,
+    q_offset: int = 0,
+    kv_lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Boolean mask [.., s_q, s_kv]; True = attend.
+
+    ``q_offset`` positions the query block inside the kv timeline (used by
+    chunked prefill). ``window`` > 0 applies Mistral-style sliding-window
+    attention. ``kv_lengths`` [B] masks padded kv positions.
+    """
+    q_pos = jnp.arange(s_q)[:, None] + q_offset
+    k_pos = jnp.arange(s_kv)[None, :]
+    mask = jnp.ones((s_q, s_kv), dtype=bool)
+    if causal:
+        mask &= k_pos <= q_pos
+    if window > 0:
+        mask &= k_pos > q_pos - window
+    if kv_lengths is not None:
+        pad = k_pos[None] < kv_lengths[:, None, None]     # [B, 1, s_kv]
+        return mask[None] & pad
+    return mask
+
+
+def attention_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: int = 0,
+    q_offset: int = 0,
+    kv_lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Reference scaled-dot-product attention in pure XLA (fp32 softmax)."""
+    b, hq, s_q, d = q.shape
+    s_kv = k.shape[2]
+    k = _gqa_expand(k, hq)
+    v = _gqa_expand(v, hq)
+    scale = d ** -0.5
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = make_attention_mask(
+        s_q, s_kv, causal=causal, window=window, q_offset=q_offset,
+        kv_lengths=kv_lengths,
+    )
+    if mask.ndim == 3:           # [B, s_q, s_kv] → broadcast over heads
+        mask = mask[:, None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked rows
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: int = 0,
+    kv_lengths: jax.Array | None = None,
+    q_offset: int = 0,
+    impl: str = "auto",
+) -> jax.Array:
+    """Full-sequence attention (prefill / encoder). Dispatches to the Pallas
+    flash kernel on TPU, XLA reference elsewhere. ``q_offset`` (chunked
+    prefill: query block placed at an offset in the kv timeline) currently
+    forces the XLA path."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if q_offset:
+        impl = "xla"
+    if impl == "pallas":
+        from copilot_for_consensus_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+        return flash_attention(
+            q, k, v, causal=causal, window=window, kv_lengths=kv_lengths
+        )
+    return attention_xla(
+        q, k, v, causal=causal, window=window, q_offset=q_offset,
+        kv_lengths=kv_lengths,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    lengths: jax.Array,
+    window: int = 0,
+) -> jax.Array:
+    """Single-token decode attention over a slot KV cache.
+
+    q: [B, Hq, D]; caches: [B, Hkv, S_max, D]; lengths: [B] — number of
+    valid cache positions per slot (the new token's kv already written).
+    Memory-bound; XLA's fused matvec pipeline is already near the HBM
+    roofline here, so no Pallas needed for the slot cache.
+    """
+    b, hq, d = q.shape
+    hkv, s_max = k_cache.shape[1], k_cache.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, hkv, group, d)
+    logits = jnp.einsum(
+        "bhgd,bhsd->bhgs", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (d ** -0.5)
+    pos = jnp.arange(s_max)[None, None, None, :]
+    mask = pos < lengths[:, None, None, None]
+    if window > 0:
+        mask &= pos > lengths[:, None, None, None] - 1 - window
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    out = jnp.einsum("bhgs,bhsd->bhgd", probs.astype(v_cache.dtype), v_cache)
+    return out.reshape(b, hq, d)

@@ -1,0 +1,38 @@
+"""Device-mesh + sharding layer.
+
+The reference scales horizontally with competing queue consumers and has no
+model parallelism (SURVEY.md §2.3). The TPU-native equivalent is a
+`jax.sharding.Mesh` over the slice with named axes:
+
+* ``dp`` — data parallel (batch sharding for embed/prefill fan-out; the
+  analogue of the reference's N competing consumers per queue),
+* ``sp`` — sequence/context parallel (ring attention for long contexts),
+* ``ep`` — expert parallel (Mixtral MoE experts),
+* ``tp`` — tensor parallel (weight sharding of the served LLM over ICI).
+
+Collectives are emitted by XLA from shardings (pjit/GSPMD) — no NCCL/MPI;
+that is the point of the TPU-first design (reference's inter-process comms
+were RabbitMQ + HTTP, ``adapters/copilot_message_bus/``).
+"""
+
+from copilot_for_consensus_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    local_mesh,
+)
+from copilot_for_consensus_tpu.parallel.sharding import (
+    LogicalAxisRules,
+    DEFAULT_RULES,
+    logical_to_spec,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "local_mesh",
+    "LogicalAxisRules",
+    "DEFAULT_RULES",
+    "logical_to_spec",
+    "shard_pytree",
+]

@@ -1,0 +1,91 @@
+"""Mesh construction for single-host, multi-chip, and multi-slice runs."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "sp", "ep", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. Product must equal the device count.
+
+    Leave ``tp`` at 0 to auto-fill it with the remaining devices (serving
+    default: shard the model), or set ``tp`` and leave ``dp`` at 0 to
+    auto-fill the data axis instead.
+    """
+
+    dp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 0
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        dp, sp, ep, tp = self.dp, self.sp, self.ep, self.tp
+        if tp == 0:
+            fixed = max(1, dp) * max(1, sp) * max(1, ep)
+            if n_devices % fixed:
+                raise ValueError(
+                    f"mesh axes dp={dp} sp={sp} ep={ep} do not divide "
+                    f"{n_devices} devices"
+                )
+            tp = n_devices // fixed
+        elif dp == 0:
+            fixed = max(1, sp) * max(1, ep) * tp
+            if n_devices % fixed:
+                raise ValueError(
+                    f"mesh axes sp={sp} ep={ep} tp={tp} do not divide "
+                    f"{n_devices} devices"
+                )
+            dp = n_devices // fixed
+        total = max(1, dp) * max(1, sp) * max(1, ep) * tp
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{sp}x{ep}x{tp}={total} != {n_devices} devices"
+            )
+        return MeshConfig(max(1, dp), max(1, sp), max(1, ep), tp)
+
+    @property
+    def shape(self) -> tuple[int, int, int, int]:
+        return (self.dp, self.sp, self.ep, self.tp)
+
+
+def build_mesh(config: MeshConfig | None = None,
+               devices: list | None = None) -> Mesh:
+    """Build the 4-axis mesh over all (or the given) devices.
+
+    Axis order is (dp, sp, ep, tp) with tp innermost so tensor-parallel
+    collectives ride the fastest ICI links, the standard TPU layout.
+    """
+    devs = devices if devices is not None else jax.devices()
+    cfg = (config or MeshConfig()).resolve(len(devs))
+    arr = np.array(devs).reshape(cfg.shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def local_mesh(tp: int | None = None) -> Mesh:
+    """Convenience single-axis-of-interest mesh on local devices: all tp."""
+    n = len(jax.devices())
+    t = tp or n
+    if n % t:
+        raise ValueError(f"tp={t} does not divide {n} devices")
+    return build_mesh(MeshConfig(dp=n // t, tp=t))
+
+
+def largest_pow2_leq(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n else 1
+
+
+def auto_mesh_for_serving(n_devices: int | None = None) -> Mesh:
+    """Serving default: tp = largest power of two ≤ device count, dp rest."""
+    n = n_devices if n_devices is not None else len(jax.devices())
+    tp = largest_pow2_leq(n)
+    while n % tp:
+        tp //= 2
+    return build_mesh(MeshConfig(dp=n // tp, tp=tp),
+                      devices=jax.devices()[:n])

@@ -5,12 +5,19 @@
 # runs anywhere; bench.py (not pytest) is what touches the real TPU chip.
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override axon/tpu: tests use the fake mesh
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The container's sitecustomize imports jax at interpreter start (to register
+# the axon TPU plugin), so jax snapshotted JAX_PLATFORMS from the original
+# env. Backends are still uninitialized here, so a config update wins.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 import sys

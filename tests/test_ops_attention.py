@@ -1,0 +1,82 @@
+# Flash-attention kernel vs XLA reference oracle (interpret mode on CPU).
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.ops.attention import (
+    attention_xla,
+    decode_attention,
+)
+from copilot_for_consensus_tpu.ops.flash_attention import flash_attention
+
+
+def _rand_qkv(rng, b=2, hq=4, hkv=2, s=96, d=32, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, hq, s, d), dtype)
+    k = jax.random.normal(kk, (b, hkv, s, d), dtype)
+    v = jax.random.normal(kv, (b, hkv, s, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("window", [0, 24])
+def test_flash_matches_xla_causal(window):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0))
+    ref = attention_xla(q, k, v, causal=True, window=window)
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          block_q=32, block_kv=32, interpret=True)
+    # Pallas interpret mode emulates MXU bf16 input rounding → bf16-level
+    # agreement with the fp32 XLA oracle is the expected numerics.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_flash_matches_xla_bidirectional_padded():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), s=80)
+    lengths = jnp.array([80, 37])
+    ref = attention_xla(q, k, v, causal=False, kv_lengths=lengths)
+    out = flash_attention(q, k, v, causal=False, kv_lengths=lengths,
+                          block_q=32, block_kv=32, interpret=True)
+    # Only positions < length are meaningful for padded rows.
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                               rtol=2e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(out[1, :, :37]),
+                               np.asarray(ref[1, :, :37]),
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_flash_non_divisible_seq_is_padded():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), s=50)
+    ref = attention_xla(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_kv=32,
+                          interpret=True)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_decode_matches_full_attention():
+    # Decoding the final token against the cache must equal the last row of
+    # full causal attention.
+    rng = jax.random.PRNGKey(3)
+    q, k, v = _rand_qkv(rng, b=2, s=33)
+    full = attention_xla(q, k, v, causal=True)
+    s_max = 64
+    k_cache = jnp.zeros((2, 2, s_max, 32)).at[:, :, :33].set(k)
+    v_cache = jnp.zeros((2, 2, s_max, 32)).at[:, :, :33].set(v)
+    lengths = jnp.array([33, 33])
+    out = decode_attention(q[:, :, -1], k_cache, v_cache, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full[:, :, -1]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_sliding_window_matches_windowed_attention():
+    rng = jax.random.PRNGKey(4)
+    q, k, v = _rand_qkv(rng, b=1, s=40)
+    full = attention_xla(q, k, v, causal=True, window=16)
+    k_cache = jnp.zeros((1, 2, 64, 32)).at[:, :, :40].set(k)
+    v_cache = jnp.zeros((1, 2, 64, 32)).at[:, :, :40].set(v)
+    out = decode_attention(q[:, :, -1], k_cache, v_cache,
+                           jnp.array([40]), window=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full[:, :, -1]),
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,95 @@
+"""Benchmark: Mistral-7B-class continuous-batching decode throughput.
+
+Run on real TPU (no JAX_PLATFORMS override). Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+
+Baseline: the reference's best published generation number — Mistral-7B
+via Ollama on an RTX 4090 at 150–200 tok/s (midpoint 175; reference
+``docs/operations/ollama-gpu-setup.md:151``, mirrored in BASELINE.md).
+The reference path serves ONE blocking request at a time
+(``local_llm_summarizer.py:106-115``); ours decodes a continuous batch,
+so aggregate tok/s is the apples-to-apples serving-throughput number.
+
+Env knobs: BENCH_MODEL (default mistral-7b), BENCH_SLOTS, BENCH_MAX_LEN,
+BENCH_PROMPT_LEN, BENCH_NEW_TOKENS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_TOK_S = 175.0  # Ollama Mistral-7B on RTX 4090 (midpoint 150-200)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    model = os.environ.get("BENCH_MODEL", "mistral-7b")
+    slots = int(os.environ.get("BENCH_SLOTS", "4"))
+    max_len = int(os.environ.get("BENCH_MAX_LEN", "512"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from copilot_for_consensus_tpu.engine.generation import GenerationEngine
+    from copilot_for_consensus_tpu.models import decoder_config
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.device_kind} ({dev.platform}), model: {model}, "
+        f"slots={slots} max_len={max_len}")
+
+    quantize = os.environ.get("BENCH_QUANTIZE", "1") == "1"
+    cfg = decoder_config(model)
+    t0 = time.monotonic()
+    eng = GenerationEngine(
+        cfg,
+        num_slots=slots,
+        max_len=max_len,
+        prefill_buckets=(prompt_len,),
+        dtype=jnp.bfloat16,
+        seed=0,
+        quantize=quantize,
+    )
+    log(f"engine built (random {model} weights, "
+        f"{'int8' if quantize else 'bf16'}) in {time.monotonic() - t0:.1f}s")
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(3, cfg.vocab_size, size=prompt_len).tolist()
+        for _ in range(slots)
+    ]
+
+    # Warmup: compile prefill + decode + insert.
+    t0 = time.monotonic()
+    eng.generate([prompts[0]], max_new_tokens=4)
+    log(f"warmup (compile) {time.monotonic() - t0:.1f}s")
+
+    # Timed run: keep all slots busy for `new_tokens` decode steps each.
+    t0 = time.monotonic()
+    comps = eng.generate(prompts, max_new_tokens=new_tokens)
+    elapsed = time.monotonic() - t0
+    total_new = sum(len(c.tokens) for c in comps)
+    tok_s = total_new / elapsed
+    log(f"{total_new} tokens in {elapsed:.2f}s across {slots} streams")
+
+    print(json.dumps({
+        "metric": f"{model} continuous-batching decode throughput "
+                  f"(1 chip, {slots} streams, "
+                  f"{'int8' if quantize else 'bf16'} weights)",
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Driver registration shim (registration lives in base.py)."""
+
+from copilot_for_consensus_tpu.archive.base import (  # noqa: F401
+    create_archive_store,
+)

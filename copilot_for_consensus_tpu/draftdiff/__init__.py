@@ -1,0 +1,17 @@
+"""RFC draft diff tracking (reference: ``adapters/copilot_draft_diff``)."""
+
+from copilot_for_consensus_tpu.draftdiff.base import (
+    DraftDiff,
+    DraftDiffProvider,
+    LocalDiffProvider,
+    MockDiffProvider,
+    create_draft_diff_provider,
+)
+
+__all__ = [
+    "DraftDiff",
+    "DraftDiffProvider",
+    "LocalDiffProvider",
+    "MockDiffProvider",
+    "create_draft_diff_provider",
+]

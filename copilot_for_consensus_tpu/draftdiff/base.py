@@ -1,0 +1,133 @@
+"""DraftDiffProvider ABC + drivers.
+
+Reference surface: ``copilot_draft_diff/provider.py:11,19``
+(``get_diff(name, vA, vB)``) with a Datatracker HTTP driver
+(``datatracker_provider.py:10``) and a mock. Zero-egress here, so the
+first-party drivers are ``local`` (unified diff over stored draft text —
+actually computes diffs, which the reference's mock does not) and
+``mock``; ``datatracker`` exists for networked deployments.
+"""
+
+from __future__ import annotations
+
+import abc
+import difflib
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class DraftDiffError(Exception):
+    pass
+
+
+@dataclass
+class DraftDiff:
+    draft_name: str
+    version_a: str
+    version_b: str
+    diff_text: str
+    added_lines: int = 0
+    removed_lines: int = 0
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+
+class DraftDiffProvider(abc.ABC):
+    @abc.abstractmethod
+    def get_diff(self, draft_name: str, version_a: str,
+                 version_b: str) -> DraftDiff: ...
+
+
+class LocalDiffProvider(DraftDiffProvider):
+    """Unified diff over draft versions registered in-process (or loaded
+    from a document store's ``drafts`` collection)."""
+
+    def __init__(self, document_store=None, collection: str = "drafts"):
+        self._texts: dict[tuple[str, str], str] = {}
+        self.store = document_store
+        self.collection = collection
+
+    def register(self, draft_name: str, version: str, text: str) -> None:
+        self._texts[(draft_name, version)] = text
+
+    def _load(self, draft_name: str, version: str) -> str:
+        key = (draft_name, version)
+        if key in self._texts:
+            return self._texts[key]
+        if self.store is not None:
+            doc = self.store.get_document(
+                self.collection, f"{draft_name}-{version}")
+            if doc:
+                return doc.get("text", "")
+        raise DraftDiffError(
+            f"draft {draft_name} version {version} not found")
+
+    def get_diff(self, draft_name, version_a, version_b):
+        a = self._load(draft_name, version_a).splitlines(keepends=True)
+        b = self._load(draft_name, version_b).splitlines(keepends=True)
+        lines = list(difflib.unified_diff(
+            a, b, fromfile=f"{draft_name}-{version_a}",
+            tofile=f"{draft_name}-{version_b}"))
+        return DraftDiff(
+            draft_name=draft_name, version_a=version_a,
+            version_b=version_b, diff_text="".join(lines),
+            added_lines=sum(1 for l in lines
+                            if l.startswith("+") and not l.startswith("+++")),
+            removed_lines=sum(1 for l in lines
+                              if l.startswith("-")
+                              and not l.startswith("---")),
+        )
+
+
+class MockDiffProvider(DraftDiffProvider):
+    def get_diff(self, draft_name, version_a, version_b):
+        return DraftDiff(draft_name, version_a, version_b,
+                         diff_text=f"mock diff {draft_name} "
+                                   f"{version_a}..{version_b}")
+
+
+class DatatrackerDiffProvider(DraftDiffProvider):
+    """IETF datatracker HTTP API (needs egress)."""
+
+    BASE = "https://author-tools.ietf.org/api/iddiff"
+
+    def __init__(self, timeout_s: float = 30.0):
+        self.timeout_s = timeout_s
+
+    def get_diff(self, draft_name, version_a, version_b):
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        url = (f"{self.BASE}?doc_1={urllib.parse.quote(draft_name)}-"
+               f"{version_a}&doc_2={urllib.parse.quote(draft_name)}-"
+               f"{version_b}")
+        try:
+            with urllib.request.urlopen(url,
+                                        timeout=self.timeout_s) as resp:
+                text = resp.read().decode("utf-8", errors="replace")
+        except (urllib.error.URLError, OSError) as exc:
+            raise DraftDiffError(
+                f"datatracker fetch failed: {exc}") from exc
+        return DraftDiff(draft_name, version_a, version_b, diff_text=text)
+
+
+def create_draft_diff_provider(config: Any = None, **kwargs: Any
+                               ) -> DraftDiffProvider:
+    driver = "mock"
+    if config is not None:
+        driver = (config.get("driver", "mock") if isinstance(config, dict)
+                  else getattr(config, "driver", "mock"))
+    if driver == "mock":
+        return MockDiffProvider()
+    if driver == "local":
+        return LocalDiffProvider(
+            document_store=kwargs.get("document_store"))
+    if driver == "datatracker":
+        return DatatrackerDiffProvider()
+    raise ValueError(f"unknown draft_diff driver {driver!r}")
+
+
+from copilot_for_consensus_tpu.core.factory import register_driver  # noqa: E402
+
+for _name in ("mock", "local", "datatracker"):
+    register_driver("draft_diff_provider", _name, create_draft_diff_provider)

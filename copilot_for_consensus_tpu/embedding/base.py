@@ -1,0 +1,95 @@
+"""EmbeddingProvider ABC + mock driver.
+
+Interface parity with the reference ABC
+(``copilot_embedding/base.py:12-25``: ``embed(text) -> list[float]``),
+extended with the batched call the reference lacks — its embedding
+service loops ``embed()`` per text (``embedding/app/service.py:393``);
+our services call ``embed_batch`` and get real cross-text batching.
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+import math
+from typing import Sequence
+
+
+class EmbeddingError(Exception):
+    pass
+
+
+class EmbeddingProvider(abc.ABC):
+    @property
+    @abc.abstractmethod
+    def dimension(self) -> int: ...
+
+    @property
+    def model_name(self) -> str:
+        return type(self).__name__
+
+    @abc.abstractmethod
+    def embed(self, text: str) -> list[float]: ...
+
+    def embed_batch(self, texts: Sequence[str]) -> list[list[float]]:
+        return [self.embed(t) for t in texts]
+
+
+class MockEmbeddingProvider(EmbeddingProvider):
+    """Deterministic, normalized hash vectors. Texts sharing words get
+    correlated vectors, so top-k retrieval behaves sensibly in tests."""
+
+    def __init__(self, dimension: int = 32):
+        self._dim = dimension
+
+    @property
+    def dimension(self) -> int:
+        return self._dim
+
+    @property
+    def model_name(self) -> str:
+        return "mock"
+
+    def embed(self, text: str) -> list[float]:
+        vec = [0.0] * self._dim
+        for word in (text or "").lower().split():
+            h = hashlib.sha1(word.encode()).digest()
+            idx = int.from_bytes(h[:4], "big") % self._dim
+            sign = 1.0 if h[4] % 2 else -1.0
+            vec[idx] += sign
+        norm = math.sqrt(sum(x * x for x in vec)) or 1.0
+        return [x / norm for x in vec]
+
+
+class TPUEmbeddingProvider(EmbeddingProvider):
+    """First-party TPU encoder behind the adapter interface."""
+
+    def __init__(self, model: str = "minilm-l6", *, params=None, mesh=None,
+                 tokenizer=None, batch_size: int = 64, dtype=None,
+                 attn_impl: str = "auto"):
+        # Heavy imports deferred so host-only processes never load jax.
+        import jax.numpy as jnp
+
+        from copilot_for_consensus_tpu.engine.embedding import EmbeddingEngine
+        from copilot_for_consensus_tpu.models import encoder_config
+
+        cfg = encoder_config(model)
+        self._engine = EmbeddingEngine(
+            cfg, params, mesh=mesh, tokenizer=tokenizer,
+            batch_size=batch_size, dtype=dtype or jnp.bfloat16,
+            attn_impl=attn_impl)
+        self._model = model
+
+    @property
+    def dimension(self) -> int:
+        return self._engine.dimension
+
+    @property
+    def model_name(self) -> str:
+        return f"tpu:{self._model}"
+
+    def embed(self, text: str) -> list[float]:
+        return self._engine.embed(text)
+
+    def embed_batch(self, texts: Sequence[str]) -> list[list[float]]:
+        return self._engine.embed_batch(texts).tolist()

@@ -1,0 +1,21 @@
+"""TPU serving engines.
+
+The resident compute plane that replaces the reference's external
+inference services (SURVEY.md §0): a continuous-batching generation
+engine in the role of Ollama / llama.cpp, and a cross-text-batching
+embedding engine in the role of sentence-transformers.
+"""
+
+from copilot_for_consensus_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    HashWordTokenizer,
+    Tokenizer,
+    create_tokenizer,
+)
+
+__all__ = [
+    "Tokenizer",
+    "ByteTokenizer",
+    "HashWordTokenizer",
+    "create_tokenizer",
+]

@@ -1,0 +1,105 @@
+"""Cross-text-batching embedding engine.
+
+Replaces the sentence-transformers role in the reference's embedding
+service — and fixes its central inefficiency: the reference embeds one
+text at a time inside its "batch" loop
+(``embedding/app/service.py:284,393`` — per-text ``embed()``, no
+cross-text batching). Here texts are tokenized, grouped into
+(batch, bucket) tiles with a handful of static shapes, and pushed through
+the encoder in single MXU passes; the dp mesh axis shards the batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from copilot_for_consensus_tpu.engine.tokenizer import (
+    HashWordTokenizer,
+    Tokenizer,
+)
+from copilot_for_consensus_tpu.models import encoder
+from copilot_for_consensus_tpu.models.configs import EncoderConfig
+from copilot_for_consensus_tpu.parallel.sharding import shard_pytree
+
+
+class EmbeddingEngine:
+    """Batched text → vector encoder."""
+
+    def __init__(
+        self,
+        cfg: EncoderConfig,
+        params: Any | None = None,
+        *,
+        mesh=None,
+        tokenizer: Tokenizer | None = None,
+        batch_size: int = 64,
+        buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
+        seed: int = 0,
+        dtype=jnp.bfloat16,
+        attn_impl: str = "auto",
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.batch_size = batch_size
+        self.buckets = tuple(sorted(set(
+            min(b, cfg.max_positions) for b in buckets)))
+        self.tokenizer = tokenizer or HashWordTokenizer(cfg.vocab_size)
+        if self.tokenizer.vocab_size > cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {self.tokenizer.vocab_size} exceeds "
+                f"encoder vocab {cfg.vocab_size}")
+        if params is None:
+            params = encoder.init_params(jax.random.PRNGKey(seed), cfg,
+                                         dtype=dtype)
+        if mesh is not None:
+            params = shard_pytree(params, encoder.logical_axes(cfg), mesh)
+        self.params = params
+        self._encode_fn = jax.jit(
+            lambda p, t, l: encoder.encode(p, t, l, cfg,
+                                           attn_impl=attn_impl))
+
+    @property
+    def dimension(self) -> int:
+        return self.cfg.d_model
+
+    def embed(self, text: str) -> list[float]:
+        """Single-text parity with the reference's
+        ``EmbeddingProvider.embed(text) -> list[float]``
+        (``copilot_embedding/base.py:12-25``)."""
+        return self.embed_batch([text])[0].tolist()
+
+    def embed_batch(self, texts: Sequence[str]) -> np.ndarray:
+        """[N] texts → [N, dim] fp32, L2-normalized. Order preserved."""
+        if not texts:
+            return np.zeros((0, self.cfg.d_model), dtype=np.float32)
+        max_bucket = self.buckets[-1]
+        encoded: list[list[int]] = []
+        for t in texts:
+            ids = self.tokenizer.encode(t)[:max_bucket]
+            encoded.append(ids or [self.tokenizer.pad_id])
+
+        out = np.zeros((len(texts), self.cfg.d_model), dtype=np.float32)
+        # Group indices by bucket so each jitted shape sees full tiles.
+        by_bucket: dict[int, list[int]] = {}
+        for i, ids in enumerate(encoded):
+            b = next(bb for bb in self.buckets if len(ids) <= bb)
+            by_bucket.setdefault(b, []).append(i)
+
+        for bucket, idxs in by_bucket.items():
+            for start in range(0, len(idxs), self.batch_size):
+                group = idxs[start:start + self.batch_size]
+                n = len(group)
+                tokens = np.zeros((self.batch_size, bucket), dtype=np.int32)
+                lengths = np.ones(self.batch_size, dtype=np.int32)
+                for row, i in enumerate(group):
+                    ids = encoded[i]
+                    tokens[row, :len(ids)] = ids
+                    lengths[row] = len(ids)
+                vecs = self._encode_fn(self.params, jnp.asarray(tokens),
+                                       jnp.asarray(lengths))
+                out[group] = np.asarray(jax.device_get(vecs))[:n]
+        return out

@@ -1,0 +1,313 @@
+"""Continuous-batching LLM generation engine.
+
+The first-party replacement for the blocking single-request HTTP call the
+reference makes per summary (``local_llm_summarizer.py:106-115`` — "THE
+DOMINANT LATENCY" in SURVEY.md §3.2). Design:
+
+* **Slot batch.** The decode state is a fixed batch of ``num_slots``
+  sequences with a shared KV cache ``[L, slots, Hkv, max_len, Dh]``.
+  Every decode step advances *all* active slots in one fused program —
+  requests join and leave the batch without recompilation (continuous
+  batching, the vLLM/Orca scheduling model, built TPU-style with static
+  shapes).
+* **Prefill/decode disaggregation.** Prompts are prefetched through a
+  bucketed prefill (padded to the next bucket so XLA sees a handful of
+  shapes), then their kv block is inserted into a free slot; decode is a
+  single [slots]-wide matvec-bound step.
+* **Sharding.** Params shard over the mesh per ``models.decoder
+  .logical_axes`` (tp over heads/ffn/vocab); the cache shards its slot
+  axis over dp and kv-head axis over tp. Collectives are emitted by XLA.
+
+The engine is synchronous and single-owner: services drive it through
+``submit()`` + ``step()`` (or ``generate()`` for batch use) from their
+consumer thread, mirroring how the reference's summarization service owns
+its single LLM connection.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from copilot_for_consensus_tpu.engine.sampling import SamplingConfig, sample
+from copilot_for_consensus_tpu.engine.tokenizer import Tokenizer
+from copilot_for_consensus_tpu.models import decoder, quant
+from copilot_for_consensus_tpu.models.configs import DecoderConfig
+from copilot_for_consensus_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    shard_pytree,
+)
+
+try:  # NamedSharding only used when a mesh is provided
+    from jax.sharding import Mesh, NamedSharding
+except Exception:  # pragma: no cover
+    Mesh = Any  # type: ignore
+
+
+@dataclass
+class Request:
+    request_id: int
+    prompt: list[int]
+    max_new_tokens: int
+    submitted_at: float = field(default_factory=time.monotonic)
+    decode_started_at: float = 0.0
+
+
+@dataclass
+class Completion:
+    request_id: int
+    prompt_len: int
+    tokens: list[int]
+    finish_reason: str            # "eos" | "length"
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+
+
+def _next_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class GenerationEngine:
+    """Continuous-batching decoder serving. One instance per process/slice."""
+
+    def __init__(
+        self,
+        cfg: DecoderConfig,
+        params: Any | None = None,
+        *,
+        mesh: "Mesh | None" = None,
+        num_slots: int = 8,
+        max_len: int = 1024,
+        prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024),
+        sampling: SamplingConfig = SamplingConfig(),
+        eos_id: int = 2,
+        seed: int = 0,
+        dtype=jnp.bfloat16,
+        attn_impl: str = "auto",
+        quantize: bool = False,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.num_slots = num_slots
+        self.max_len = min(max_len, cfg.max_seq_len)
+        self.buckets = tuple(
+            b for b in sorted(set(min(b, self.max_len)
+                                  for b in prefill_buckets)))
+        self.sampling = sampling
+        self.eos_id = eos_id
+        self.attn_impl = attn_impl
+        self._key = jax.random.PRNGKey(seed)
+
+        axes = decoder.logical_axes(cfg)
+        if params is None:
+            if quantize:
+                params = quant.init_random_quantized(
+                    jax.random.PRNGKey(seed), cfg, dtype=dtype)
+            else:
+                params = decoder.init_params(jax.random.PRNGKey(seed), cfg,
+                                             dtype=dtype)
+        elif quantize and not quant.is_quantized(
+                params.get("layers", {}).get("wq")):
+            # Caller provided full-precision weights: quantize on the fly.
+            # (Real checkpoints should be quantized offline on the host —
+            # this transient needs both copies in memory.)
+            params = quant.quantize_params(params)
+        if quantize:
+            axes = quant.quantize_logical_axes(axes)
+        if mesh is not None:
+            params = shard_pytree(params, axes, mesh)
+        self.params = params
+
+        cache = decoder.init_cache(cfg, num_slots, self.max_len, dtype=dtype)
+        if mesh is not None:
+            # Replicate cache axes the mesh doesn't divide (e.g. tp larger
+            # than the kv-head count — standard GQA serving replicates kv).
+            rules = dict(DEFAULT_RULES)
+            if cfg.n_kv_heads % mesh.shape["tp"]:
+                rules["kv_heads"] = None
+            if num_slots % mesh.shape["dp"]:
+                rules["batch"] = None
+            cache = shard_pytree(cache, decoder.cache_logical_axes(), mesh,
+                                 rules)
+        self._cache = cache
+
+        # ---- jitted programs -------------------------------------------
+        impl = attn_impl
+
+        def _prefill(params, tokens, lengths):
+            scratch = decoder.init_cache(cfg, tokens.shape[0],
+                                         tokens.shape[1], dtype=dtype)
+            logits, scratch = decoder.prefill(params, tokens, lengths, cfg,
+                                              scratch, attn_impl=impl)
+            return logits, scratch
+
+        self._prefill_fn = jax.jit(_prefill)
+
+        def _insert(cache, pref, slot):
+            k = jax.lax.dynamic_update_slice(
+                cache["k"], pref["k"].astype(cache["k"].dtype),
+                (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(
+                cache["v"], pref["v"].astype(cache["v"].dtype),
+                (0, slot, 0, 0, 0))
+            return {"k": k, "v": v}
+
+        self._insert_fn = jax.jit(_insert, donate_argnums=(0,))
+
+        def _decode(params, tokens, positions, cache, key):
+            logits, cache = decoder.decode_step(params, tokens, positions,
+                                                cfg, cache)
+            toks = sample(logits, key, self.sampling)
+            return toks, cache
+
+        self._decode_fn = jax.jit(_decode, donate_argnums=(3,))
+
+        def _sample_only(logits, key):
+            return sample(logits, key, self.sampling)
+
+        self._sample_fn = jax.jit(_sample_only)
+
+        # ---- host-side slot state --------------------------------------
+        self._free = list(range(num_slots))
+        self._active: dict[int, Request] = {}          # slot → request
+        self._generated: dict[int, list[int]] = {}     # slot → new tokens
+        self._positions = np.zeros(num_slots, dtype=np.int32)
+        self._next_tok = np.zeros(num_slots, dtype=np.int32)
+        self._t_prefill: dict[int, float] = {}
+        self._queue: list[Request] = []
+        self._done: dict[int, Completion] = {}
+        self._next_id = 0
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt: list[int], max_new_tokens: int = 256) -> int:
+        """Enqueue a tokenized prompt; returns a request id."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        limit = min(self.max_len - 1, self.buckets[-1])
+        if len(prompt) > limit:
+            # Keep the tail: instructions/questions sit at the end of RAG
+            # prompts. The orchestrator budgets context to avoid this.
+            prompt = prompt[-limit:]
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append(Request(rid, list(prompt), max_new_tokens))
+        return rid
+
+    def step(self) -> list[Completion]:
+        """Admit queued requests into free slots, run one decode step for
+        all active slots, retire finished ones. Returns completions."""
+        self._admit()
+        if self._active:
+            self._decode_once()
+        return self._drain_done()
+
+    def generate(self, prompts: list[list[int]],
+                 max_new_tokens: int = 256) -> list[Completion]:
+        """Batch convenience: submit all, run to completion, return in
+        submission order."""
+        ids = [self.submit(p, max_new_tokens) for p in prompts]
+        results: dict[int, Completion] = {}
+        while len(results) < len(ids):
+            for c in self.step():
+                results[c.request_id] = c
+        return [results[i] for i in ids]
+
+    def generate_text(self, prompts: list[str], tokenizer: Tokenizer,
+                      max_new_tokens: int = 256) -> list[str]:
+        comps = self.generate(
+            [tokenizer.encode(p, add_bos=True) for p in prompts],
+            max_new_tokens)
+        return [tokenizer.decode(c.tokens) for c in comps]
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _admit(self) -> None:
+        while self._queue and self._free:
+            req = self._queue.pop(0)
+            slot = self._free.pop(0)
+            t0 = time.monotonic()
+            plen = len(req.prompt)
+            bucket = _next_bucket(plen, self.buckets)
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            tokens[0, :plen] = req.prompt
+            lengths = jnp.asarray([plen], dtype=jnp.int32)
+            logits, pref_cache = self._prefill_fn(
+                self.params, jnp.asarray(tokens), lengths)
+            self._cache = self._insert_fn(self._cache, pref_cache,
+                                          jnp.int32(slot))
+            self._key, sub = jax.random.split(self._key)
+            first = int(jax.device_get(self._sample_fn(logits, sub))[0])
+            self._active[slot] = req
+            self._generated[slot] = [first]
+            self._positions[slot] = plen
+            self._next_tok[slot] = first
+            self._t_prefill[slot] = time.monotonic() - t0
+            req.decode_started_at = time.monotonic()
+            if first == self.eos_id or req.max_new_tokens <= 1:
+                self._retire(slot,
+                             "eos" if first == self.eos_id else "length")
+
+    def _decode_once(self) -> None:
+        self._key, sub = jax.random.split(self._key)
+        toks, self._cache = self._decode_fn(
+            self.params,
+            jnp.asarray(self._next_tok),
+            jnp.asarray(self._positions),
+            self._cache,
+            sub,
+        )
+        toks = np.asarray(jax.device_get(toks))
+        for slot, req in list(self._active.items()):
+            tok = int(toks[slot])
+            self._generated[slot].append(tok)
+            self._positions[slot] += 1
+            self._next_tok[slot] = tok
+            gen = self._generated[slot]
+            finished = (
+                tok == self.eos_id
+                or len(gen) >= req.max_new_tokens
+                or self._positions[slot] >= self.max_len - 1
+            )
+            if finished:
+                self._retire(slot, "eos" if tok == self.eos_id else "length")
+
+    def _retire(self, slot: int, reason: str) -> None:
+        req = self._active.pop(slot)
+        gen = self._generated.pop(slot)
+        if gen and gen[-1] == self.eos_id:
+            gen = gen[:-1]
+        self._done[req.request_id] = Completion(
+            request_id=req.request_id,
+            prompt_len=len(req.prompt),
+            tokens=gen,
+            finish_reason=reason,
+            prefill_s=self._t_prefill.pop(slot, 0.0),
+            decode_s=time.monotonic() - req.decode_started_at,
+        )
+        self._free.append(slot)
+
+    def _drain_done(self) -> list[Completion]:
+        out = list(self._done.values())
+        self._done.clear()
+        return out

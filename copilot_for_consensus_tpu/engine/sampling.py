@@ -1,0 +1,40 @@
+"""Token sampling: greedy / temperature / top-k / nucleus, jit-friendly.
+
+All branches are static (config-time) choices so the decode step compiles
+to one fused program; only the PRNG key and logits are traced.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingConfig:
+    temperature: float = 0.0      # 0 → greedy
+    top_k: int = 0                # 0 → disabled
+    top_p: float = 1.0            # 1 → disabled
+
+
+def sample(logits: jax.Array, key: jax.Array,
+           cfg: SamplingConfig) -> jax.Array:
+    """logits: [B, V] fp32 → [B] int32 token ids."""
+    if cfg.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / cfg.temperature
+    if cfg.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -cfg.top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if cfg.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative mass ≥ top_p.
+        cutoff_idx = jnp.sum(cum < cfg.top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,139 @@
+"""Tokenizers for the serving engines.
+
+Three drivers behind one interface:
+
+* ``ByteTokenizer`` — raw UTF-8 bytes + specials; zero-dependency, works
+  with any vocab ≥ 259. Default for self-contained runs and tests.
+* ``HashWordTokenizer`` — deterministic word-hash ids; the encoder-side
+  stand-in when no trained vocabulary is shipped (embeddings only need a
+  stable text→id map to be meaningful relative to each other).
+* ``HFTokenizer`` — loads a real trained BPE/WordPiece ``tokenizer.json``
+  via the ``tokenizers`` library for production checkpoints.
+
+The reference delegates tokenization to its external engines entirely and
+budgets with a ~1.3 tokens/word estimator
+(``orchestrator/app/context_selectors.py:17``); here the real ids are
+first-party.
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+N_SPECIALS = 3
+
+
+class Tokenizer(abc.ABC):
+    pad_id = PAD_ID
+    bos_id = BOS_ID
+    eos_id = EOS_ID
+
+    @property
+    @abc.abstractmethod
+    def vocab_size(self) -> int: ...
+
+    @abc.abstractmethod
+    def encode(self, text: str, add_bos: bool = False,
+               add_eos: bool = False) -> list[int]: ...
+
+    @abc.abstractmethod
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer(Tokenizer):
+    """UTF-8 bytes shifted past the special ids."""
+
+    def __init__(self, vocab_size: int = 259):
+        if vocab_size < 256 + N_SPECIALS:
+            raise ValueError("ByteTokenizer needs vocab_size >= 259")
+        self._vocab = vocab_size
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab
+
+    def encode(self, text: str, add_bos: bool = False,
+               add_eos: bool = False) -> list[int]:
+        ids = [b + N_SPECIALS for b in text.encode("utf-8")]
+        if add_bos:
+            ids.insert(0, BOS_ID)
+        if add_eos:
+            ids.append(EOS_ID)
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i - N_SPECIALS for i in ids
+                     if N_SPECIALS <= i < 256 + N_SPECIALS)
+        return data.decode("utf-8", errors="replace")
+
+
+class HashWordTokenizer(Tokenizer):
+    """Stable word→id hashing (sha1 mod vocab). Not invertible — decode
+    returns placeholders — so only suitable for the encoder path."""
+
+    def __init__(self, vocab_size: int = 30522):
+        if vocab_size <= N_SPECIALS + 1:
+            raise ValueError("vocab too small")
+        self._vocab = vocab_size
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab
+
+    def encode(self, text: str, add_bos: bool = False,
+               add_eos: bool = False) -> list[int]:
+        span = self._vocab - N_SPECIALS
+        ids = [
+            N_SPECIALS + int.from_bytes(
+                hashlib.sha1(w.lower().encode()).digest()[:4], "big") % span
+            for w in text.split()
+        ]
+        if add_bos:
+            ids.insert(0, BOS_ID)
+        if add_eos:
+            ids.append(EOS_ID)
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        return " ".join(f"<{i}>" for i in ids)
+
+
+class HFTokenizer(Tokenizer):
+    """A trained ``tokenizer.json`` via the HuggingFace tokenizers lib."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _HFTok  # lazy: optional dep
+        self._tok = _HFTok.from_file(path)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def encode(self, text: str, add_bos: bool = False,
+               add_eos: bool = False) -> list[int]:
+        ids = list(self._tok.encode(text).ids)
+        if add_bos:
+            ids.insert(0, BOS_ID)
+        if add_eos:
+            ids.append(EOS_ID)
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode([i for i in ids if i >= N_SPECIALS])
+
+
+def create_tokenizer(driver: str = "byte", *, vocab_size: int = 259,
+                     path: str | None = None) -> Tokenizer:
+    if driver == "byte":
+        return ByteTokenizer(vocab_size)
+    if driver == "hash_word":
+        return HashWordTokenizer(vocab_size)
+    if driver == "hf":
+        if not path:
+            raise ValueError("hf tokenizer needs a path")
+        return HFTokenizer(path)
+    raise ValueError(f"unknown tokenizer driver {driver!r}")

@@ -124,9 +124,9 @@ def _ffn(x: jax.Array, layer: Params, cfg: DecoderConfig) -> jax.Array:
 
 def _unembed(x: jax.Array, params: Params, cfg: DecoderConfig) -> jax.Array:
     x = L.rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["tok_emb"].T if cfg.tie_embeddings
-            else params["lm_head"])
-    return (x @ head).astype(jnp.float32)
+    if cfg.tie_embeddings:
+        return (x @ params["tok_emb"].T).astype(jnp.float32)
+    return L.qmatmul(x, params["lm_head"]).astype(jnp.float32)
 
 
 def forward(params: Params, tokens: jax.Array, cfg: DecoderConfig,

@@ -16,6 +16,22 @@ from copilot_for_consensus_tpu.models.configs import DecoderConfig
 from copilot_for_consensus_tpu.ops.attention import attention, decode_attention
 
 # ---------------------------------------------------------------------------
+# Matmul with transparent int8 weight dequantization
+# ---------------------------------------------------------------------------
+
+
+def qmatmul(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` where ``w`` is a plain array or an int8 quantized leaf
+    (``models.quant``). Dequant scale applies after the matmul — exact,
+    since scales are per output channel."""
+    from copilot_for_consensus_tpu.models.quant import is_quantized
+
+    if is_quantized(w):
+        return (x @ w["q"].astype(x.dtype)) * w["scale"].astype(x.dtype)
+    return x @ w
+
+
+# ---------------------------------------------------------------------------
 # Norms
 # ---------------------------------------------------------------------------
 
@@ -69,9 +85,12 @@ def _project_qkv(x: jax.Array, layer: dict, cfg: DecoderConfig,
                  positions: jax.Array):
     b, s, _ = x.shape
     dh = cfg.head_dim
-    q = (x @ layer["wq"]).reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
-    k = (x @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
-    v = (x @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
+    q = qmatmul(x, layer["wq"]).reshape(
+        b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+    k = qmatmul(x, layer["wk"]).reshape(
+        b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
+    v = qmatmul(x, layer["wv"]).reshape(
+        b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
     inv_freq = rope_frequencies(dh, cfg.rope_theta)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
@@ -88,7 +107,7 @@ def attn_prefill(x: jax.Array, layer: dict, cfg: DecoderConfig,
     o = attention(q, k, v, causal=True, window=cfg.sliding_window,
                   kv_lengths=lengths, impl=impl)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim)
-    return o @ layer["wo"], k, v
+    return qmatmul(o, layer["wo"]), k, v
 
 
 def cache_write(cache: jax.Array, col: jax.Array,
@@ -116,7 +135,7 @@ def attn_decode(x: jax.Array, layer: dict, cfg: DecoderConfig,
                          lengths=positions + 1,
                          window=cfg.sliding_window)       # [B, Hq, Dh]
     o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim)
-    return o @ layer["wo"], k_cache, v_cache
+    return qmatmul(o, layer["wo"]), k_cache, v_cache
 
 
 # ---------------------------------------------------------------------------
@@ -126,9 +145,9 @@ def attn_decode(x: jax.Array, layer: dict, cfg: DecoderConfig,
 
 def swiglu(x: jax.Array, layer: dict) -> jax.Array:
     """SwiGLU MLP: silu(x·Wg) ⊙ (x·Wu) · Wd — Llama/Mistral family FFN."""
-    gate = jax.nn.silu((x @ layer["w_gate"]).astype(jnp.float32))
-    up = (x @ layer["w_up"]).astype(jnp.float32)
-    return ((gate * up).astype(x.dtype)) @ layer["w_down"]
+    gate = jax.nn.silu(qmatmul(x, layer["w_gate"]).astype(jnp.float32))
+    up = qmatmul(x, layer["w_up"]).astype(jnp.float32)
+    return qmatmul((gate * up).astype(x.dtype), layer["w_down"])
 
 
 def gelu_mlp(x: jax.Array, layer: dict) -> jax.Array:

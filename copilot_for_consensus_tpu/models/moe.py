@@ -19,6 +19,21 @@ import jax.numpy as jnp
 from copilot_for_consensus_tpu.models.configs import DecoderConfig
 
 
+def _q_einsum(spec: str, x: jax.Array, w, prefer_f32: bool = False
+              ) -> jax.Array:
+    """Expert einsum with transparent int8 weight dequantization (scales
+    are per output channel, so they apply after the contraction).
+    ``prefer_f32`` keeps fp32 accumulation on the full-precision path."""
+    from copilot_for_consensus_tpu.models.quant import is_quantized
+
+    if is_quantized(w):
+        return (jnp.einsum(spec, x, w["q"].astype(x.dtype))
+                * w["scale"].astype(x.dtype))
+    if prefer_f32:
+        return jnp.einsum(spec, x, w, preferred_element_type=jnp.float32)
+    return jnp.einsum(spec, x, w)
+
+
 def moe_capacity(n_tokens: int, cfg: DecoderConfig) -> int:
     cap = int(cfg.expert_capacity_factor * n_tokens
               * cfg.experts_per_token / cfg.n_experts)
@@ -62,12 +77,12 @@ def moe_ffn(x: jax.Array, layer: dict, cfg: DecoderConfig) -> jax.Array:
 
     expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)          # [E, C, D]
     gate = jax.nn.silu(
-        jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"],
-                   preferred_element_type=jnp.float32))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"],
-                    preferred_element_type=jnp.float32)
+        _q_einsum("ecd,edf->ecf", expert_in, layer["w_gate"],
+                  prefer_f32=True).astype(jnp.float32))
+    up = _q_einsum("ecd,edf->ecf", expert_in, layer["w_up"],
+                   prefer_f32=True).astype(jnp.float32)
     h = (gate * up).astype(x.dtype)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, layer["w_down"])  # [E, C, D]
+    expert_out = _q_einsum("ecf,efd->ecd", h, layer["w_down"])   # [E, C, D]
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
     return out.reshape(b, s, d)
 

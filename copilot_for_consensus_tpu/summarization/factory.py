@@ -1,0 +1,44 @@
+"""LLM-backend driver registry (reference dispatch: ``factory.py:89-94``
+of ``copilot_summarization`` — llm_local/llm_llamacpp/llm_openai/... all
+collapse into ``tpu`` here, plus ``mock``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from copilot_for_consensus_tpu.core.factory import register_driver
+from copilot_for_consensus_tpu.summarization.base import (
+    MockSummarizer,
+    Summarizer,
+)
+
+
+def _cfg_get(config: Any, key: str, default=None):
+    if config is None:
+        return default
+    if isinstance(config, dict):
+        return config.get(key, default)
+    return getattr(config, key, default)
+
+
+def create_summarizer(config: Any = None, **kwargs: Any) -> Summarizer:
+    driver = _cfg_get(config, "driver", "mock")
+    if driver == "mock":
+        return MockSummarizer(
+            max_sentences=int(_cfg_get(config, "max_sentences", 3)))
+    if driver == "tpu":
+        from copilot_for_consensus_tpu.summarization.tpu_summarizer import (
+            TPUSummarizer,
+        )
+        return TPUSummarizer(
+            model=_cfg_get(config, "model", "mistral-7b"),
+            max_new_tokens=int(_cfg_get(config, "max_new_tokens", 256)),
+            num_slots=int(_cfg_get(config, "num_slots", 4)),
+            max_len=int(_cfg_get(config, "max_len", 4096)),
+            **kwargs,
+        )
+    raise ValueError(f"unknown llm_backend driver {driver!r}")
+
+
+register_driver("llm_backend", "mock", create_summarizer)
+register_driver("llm_backend", "tpu", create_summarizer)

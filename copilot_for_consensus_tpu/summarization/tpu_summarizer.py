@@ -1,0 +1,107 @@
+"""TPU summarizer: prompt building over the continuous-batching engine.
+
+Replaces the reference's per-request HTTP call to Ollama
+(``local_llm_summarizer.py:106-115``) with an in-process engine. Prompt
+template variables match the reference's substitution set
+(``summarization/app/service.py:450``: thread_id, email_chunks,
+participants, message_count, subject).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from copilot_for_consensus_tpu.summarization.base import (
+    Summarizer,
+    Summary,
+    ThreadContext,
+    citations_from_chunks,
+)
+
+DEFAULT_SYSTEM = (
+    "You are a mailing-list analyst. Summarize the discussion thread "
+    "faithfully, noting points of agreement and disagreement."
+)
+DEFAULT_TEMPLATE = (
+    "{system}\n\n"
+    "Thread: {subject} (id {thread_id})\n"
+    "Participants: {participants}\n"
+    "Messages: {message_count}\n\n"
+    "Excerpts:\n{email_chunks}\n\n"
+    "Summary:"
+)
+
+
+def build_prompt(thread: ThreadContext, template: str = DEFAULT_TEMPLATE,
+                 system: str = DEFAULT_SYSTEM) -> str:
+    excerpts = "\n---\n".join(
+        (c.get("text") or "").strip() for c in thread.chunks)
+    return template.format(
+        system=system,
+        subject=thread.subject,
+        thread_id=thread.thread_id,
+        participants=", ".join(thread.participants[:12]),
+        message_count=thread.message_count,
+        email_chunks=excerpts,
+    )
+
+
+class TPUSummarizer(Summarizer):
+    def __init__(self, model: str = "mistral-7b", *, engine=None,
+                 tokenizer=None, max_new_tokens: int = 256,
+                 template: str = DEFAULT_TEMPLATE,
+                 system: str = DEFAULT_SYSTEM, num_slots: int = 4,
+                 max_len: int = 4096, params=None, mesh=None, dtype=None):
+        # jax imports deferred: host-only processes must not load them.
+        from copilot_for_consensus_tpu.engine.tokenizer import (
+            ByteTokenizer,
+            Tokenizer,
+        )
+
+        self._model = model
+        self.max_new_tokens = max_new_tokens
+        self.template = template
+        self.system = system
+        if engine is None:
+            import jax.numpy as jnp
+
+            from copilot_for_consensus_tpu.engine.generation import (
+                GenerationEngine,
+            )
+            from copilot_for_consensus_tpu.models import decoder_config
+
+            cfg = decoder_config(model)
+            engine = GenerationEngine(
+                cfg, params, mesh=mesh, num_slots=num_slots,
+                max_len=min(max_len, cfg.max_seq_len),
+                dtype=dtype if dtype is not None else jnp.bfloat16)
+        self.engine = engine
+        self.tokenizer: Tokenizer = tokenizer or ByteTokenizer(
+            max(259, self.engine.cfg.vocab_size)
+            if self.engine.cfg.vocab_size >= 259 else 259)
+        if self.tokenizer.vocab_size > self.engine.cfg.vocab_size:
+            raise ValueError("tokenizer vocab exceeds model vocab")
+
+    def summarize(self, thread: ThreadContext) -> Summary:
+        return self.summarize_batch([thread])[0]
+
+    def summarize_batch(self, threads: list[ThreadContext]) -> list[Summary]:
+        """Continuous batching: all threads share the decode batch."""
+        prompts = [
+            self.tokenizer.encode(
+                build_prompt(t, self.template, self.system), add_bos=True)
+            for t in threads
+        ]
+        comps = self.engine.generate(prompts,
+                                     max_new_tokens=self.max_new_tokens)
+        out = []
+        for thread, comp in zip(threads, comps):
+            out.append(Summary(
+                thread_id=thread.thread_id,
+                summary_text=self.tokenizer.decode(comp.tokens).strip(),
+                citations=citations_from_chunks(thread.chunks),
+                model=f"tpu:{self._model}",
+                prompt_tokens=comp.prompt_len,
+                completion_tokens=len(comp.tokens),
+            ))
+        return out

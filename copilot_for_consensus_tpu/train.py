@@ -1,0 +1,57 @@
+"""Training step for the decoder family (fine-tuning path).
+
+The reference trains nothing (inference is delegated; SURVEY.md §0), but a
+TPU-native framework that owns its models needs the fine-tuning loop:
+next-token cross-entropy, optax optimizer, and a jit-able ``train_step``
+whose params/opt-state shard over the mesh exactly like serving params do
+— the same logical-axis tables drive both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from copilot_for_consensus_tpu.models import decoder
+from copilot_for_consensus_tpu.models.configs import DecoderConfig
+
+
+def next_token_loss(params: Any, tokens: jax.Array, lengths: jax.Array,
+                    cfg: DecoderConfig, attn_impl: str = "auto"
+                    ) -> jax.Array:
+    """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1],
+    masked to valid (non-pad) positions."""
+    logits = decoder.forward(params, tokens[:, :-1], cfg,
+                             lengths=jnp.minimum(lengths, tokens.shape[1] - 1),
+                             attn_impl=attn_impl)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = (jnp.arange(targets.shape[1])[None, :]
+            < (lengths - 1)[:, None]).astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_train_step(cfg: DecoderConfig, optimizer: optax.GradientTransformation,
+                    attn_impl: str = "auto") -> Callable:
+    """Returns ``step(params, opt_state, tokens, lengths) ->
+    (params, opt_state, loss)``; jit/pjit it with sharded params."""
+
+    def step(params, opt_state, tokens, lengths):
+        loss, grads = jax.value_and_grad(next_token_loss)(
+            params, tokens, lengths, cfg, attn_impl)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def default_optimizer(lr: float = 1e-4) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01),
+    )

@@ -1,0 +1,66 @@
+# Embedding engine: batching/bucketing must preserve order and numerics.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from copilot_for_consensus_tpu.engine.embedding import EmbeddingEngine
+from copilot_for_consensus_tpu.engine.tokenizer import HashWordTokenizer
+from copilot_for_consensus_tpu.models.configs import encoder_config
+
+CFG = encoder_config("tiny")
+
+
+def _engine(**kw):
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("buckets", (8, 16, 32))
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("attn_impl", "xla")
+    return EmbeddingEngine(CFG, **kw)
+
+
+def test_embed_batch_shape_and_norms():
+    eng = _engine()
+    texts = ["hello world", "consensus reached on the draft",
+             "short", " ".join(["w"] * 100)]
+    out = eng.embed_batch(texts)
+    assert out.shape == (4, CFG.d_model)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-4)
+
+
+def test_batched_equals_individual():
+    # Mixed lengths land in different buckets; order must be preserved and
+    # each row must equal its solo embedding.
+    eng = _engine()
+    texts = [f"word{i} " * (i + 1) for i in range(9)]
+    batched = eng.embed_batch(texts)
+    for i, t in enumerate(texts):
+        solo = eng.embed_batch([t])[0]
+        np.testing.assert_allclose(batched[i], solo, rtol=1e-4, atol=1e-5)
+
+
+def test_embed_single_parity():
+    eng = _engine()
+    v = eng.embed("the working group agrees")
+    assert isinstance(v, list) and len(v) == CFG.d_model
+
+
+def test_empty_and_degenerate_inputs():
+    eng = _engine()
+    assert eng.embed_batch([]).shape == (0, CFG.d_model)
+    out = eng.embed_batch(["", "   "])
+    assert out.shape == (2, CFG.d_model)
+    assert np.all(np.isfinite(out))
+
+
+def test_same_text_same_vector_different_text_different_vector():
+    eng = _engine()
+    a, b, c = eng.embed_batch(["alpha beta gamma", "alpha beta gamma",
+                               "totally different text here"])
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+    assert np.linalg.norm(a - c) > 1e-3
+
+
+def test_tokenizer_vocab_guard():
+    import pytest
+    with pytest.raises(ValueError):
+        _engine(tokenizer=HashWordTokenizer(10 * CFG.vocab_size))

@@ -1,0 +1,40 @@
+import pytest
+
+from copilot_for_consensus_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    HashWordTokenizer,
+    create_tokenizer,
+)
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer(512)
+    text = "Hello, IETF wörking group! \n-- sig"
+    ids = tok.encode(text, add_bos=True, add_eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert tok.decode(ids) == text
+    assert max(ids) < 512
+
+
+def test_byte_vocab_guard():
+    with pytest.raises(ValueError):
+        ByteTokenizer(100)
+
+
+def test_hash_word_stable_and_bounded():
+    tok = HashWordTokenizer(1000)
+    a = tok.encode("Consensus on the draft")
+    b = tok.encode("consensus ON the DRAFT")
+    assert a == b                      # case-normalized
+    assert all(3 <= i < 1000 for i in a)
+
+
+def test_factory_dispatch():
+    assert isinstance(create_tokenizer("byte", vocab_size=300),
+                      ByteTokenizer)
+    assert isinstance(create_tokenizer("hash_word", vocab_size=300),
+                      HashWordTokenizer)
+    with pytest.raises(ValueError):
+        create_tokenizer("nope")
+    with pytest.raises(ValueError):
+        create_tokenizer("hf")

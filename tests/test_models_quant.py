@@ -1,0 +1,94 @@
+# int8 weight-only quantization: exactness of the scale algebra, forward
+# closeness, engine integration, sharding-axes transform.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from copilot_for_consensus_tpu.engine.generation import GenerationEngine
+from copilot_for_consensus_tpu.models import decoder, quant
+from copilot_for_consensus_tpu.models.configs import decoder_config
+from copilot_for_consensus_tpu.models.layers import qmatmul
+from copilot_for_consensus_tpu.parallel import MeshConfig, build_mesh
+from copilot_for_consensus_tpu.parallel.sharding import spec_tree
+
+
+def test_qmatmul_equals_dequantized_matmul():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 48))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64))
+    qw = quant.quantize_tensor(w)
+    ref = x @ (qw["q"].astype(jnp.float32) * qw["scale"])
+    out = qmatmul(x, qw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_quantization_error_is_small():
+    w = jax.random.normal(jax.random.PRNGKey(2), (128, 96)) * 0.05
+    qw = quant.quantize_tensor(w)
+    deq = qw["q"].astype(jnp.float32) * qw["scale"]
+    err = np.abs(np.asarray(deq - w))
+    assert err.max() <= np.abs(np.asarray(w)).max() / 127 + 1e-7
+
+
+def test_quantized_forward_close_to_full_precision():
+    cfg = decoder_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(3), cfg,
+                                 dtype=jnp.float32)
+    qparams = quant.quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0,
+                                cfg.vocab_size)
+    ref = decoder.forward(params, tokens, cfg, attn_impl="xla")
+    out = decoder.forward(qparams, tokens, cfg, attn_impl="xla")
+    # int8 weights: logits agree to ~1e-1 absolute on a tiny model.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.35,
+                               rtol=0.1)
+    # top-1 predictions should essentially all agree
+    agree = np.mean(np.argmax(np.asarray(out), -1)
+                    == np.argmax(np.asarray(ref), -1))
+    assert agree > 0.9
+
+
+def test_moe_quantized_forward_runs():
+    cfg = decoder_config("tiny-moe")
+    params = decoder.init_params(jax.random.PRNGKey(5), cfg,
+                                 dtype=jnp.float32)
+    qparams = quant.quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0,
+                                cfg.vocab_size)
+    out = decoder.forward(qparams, tokens, cfg, attn_impl="xla")
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_quantized_axes_match_quantized_params():
+    cfg = decoder_config("tiny")
+    params = quant.quantize_params(
+        decoder.init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    axes = quant.quantize_logical_axes(decoder.logical_axes(cfg))
+    assert (jax.tree.structure(axes,
+                               is_leaf=lambda x: isinstance(x, tuple))
+            == jax.tree.structure(params))
+    # spec tree builds without unknown-axis errors
+    spec_tree(axes)
+
+
+def test_init_random_quantized_structure_and_engine():
+    cfg = decoder_config("tiny")
+    params = quant.init_random_quantized(jax.random.PRNGKey(1), cfg,
+                                         dtype=jnp.float32)
+    assert params["layers"]["wq"]["q"].dtype == jnp.int8
+    assert params["layers"]["attn_norm"].dtype == jnp.float32
+    eng = GenerationEngine(cfg, num_slots=2, max_len=32,
+                           prefill_buckets=(16,), dtype=jnp.float32,
+                           attn_impl="xla", quantize=True)
+    comps = eng.generate([[5, 6, 7]], max_new_tokens=4)
+    assert len(comps[0].tokens) == 4
+
+
+def test_quantized_engine_on_mesh():
+    cfg = decoder_config("tiny")
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    eng = GenerationEngine(cfg, mesh=mesh, num_slots=2, max_len=32,
+                           prefill_buckets=(16,), dtype=jnp.float32,
+                           attn_impl="xla", quantize=True)
+    comps = eng.generate([[5, 6, 7], [9, 10, 11]], max_new_tokens=4)
+    assert all(len(c.tokens) == 4 for c in comps)

@@ -58,10 +58,12 @@ class LocalVolumeArchiveStore(ArchiveStore):
         self.root.mkdir(parents=True, exist_ok=True)
 
     def _path(self, archive_id: str) -> pathlib.Path:
-        safe = "".join(c for c in archive_id if c.isalnum() or c in "-_")
-        if not safe:
+        # Reject rather than sanitize: a silently-renamed id would break
+        # content addressing (and ../ traversal must never reach disk).
+        if not archive_id or not all(
+                c.isalnum() or c in "-_" for c in archive_id):
             raise ArchiveStoreError(f"invalid archive id {archive_id!r}")
-        return self.root / f"{safe}.mbox"
+        return self.root / f"{archive_id}.mbox"
 
     def save(self, archive_id, content, metadata=None):
         p = self._path(archive_id)

@@ -33,47 +33,93 @@ DLQ_SUFFIX = ".dlq"
 
 @dataclass
 class _Queue:
-    name: str
+    """One queue per (routing_key, group): groups model RabbitMQ's
+    queue-per-service topology — different groups each get a copy of every
+    message (fan-out, e.g. SourceDeletionRequested cleaned up by every
+    stage), while consumers inside one group compete round-robin (N
+    replicas of one service sharing its queue)."""
+
+    routing_key: str
+    group: str
     items: deque = field(default_factory=deque)  # (envelope, redeliveries)
     callbacks: list[EventCallback] = field(default_factory=list)
     rr_next: int = 0  # round-robin cursor over competing consumers
+
+    @property
+    def name(self) -> str:
+        return self.routing_key
 
 
 class InProcBroker:
     def __init__(self, name: str = DEFAULT_EXCHANGE, max_redeliveries: int = 3):
         self.name = name
         self.max_redeliveries = max_redeliveries
-        self._queues: dict[str, _Queue] = {}
+        self._queues: dict[tuple[str, str], _Queue] = {}
+        self._pending: dict[str, deque] = {}   # published before any bind
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
         self.published_count = 0
         self.dead_lettered: list[tuple[str, Mapping[str, Any]]] = []
 
-    def queue(self, routing_key: str) -> _Queue:
+    def queue(self, routing_key: str, group: str = "default") -> _Queue:
         with self._lock:
-            if routing_key not in self._queues:
-                self._queues[routing_key] = _Queue(routing_key)
-            return self._queues[routing_key]
+            key = (routing_key, group)
+            if key not in self._queues:
+                q = _Queue(routing_key, group)
+                # First queue on this key inherits messages parked before
+                # any consumer was bound (topic exchanges drop these;
+                # in-proc keeps them so publish-then-subscribe works).
+                parked = self._pending.pop(routing_key, None)
+                if parked:
+                    q.items.extend(parked)
+                self._queues[key] = q
+            return self._queues[key]
+
+    def _group_queues(self, routing_key: str) -> list[_Queue]:
+        return [q for (rk, _), q in self._queues.items()
+                if rk == routing_key]
 
     def publish(self, envelope: Mapping[str, Any], routing_key: str) -> None:
         with self._work:
-            self.queue(routing_key).items.append((dict(envelope), 0))
+            # Only live queues (with consumers) receive copies; otherwise
+            # park, so messages never strand in a dead group's queue.
+            queues = [q for q in self._group_queues(routing_key)
+                      if q.callbacks]
+            if queues:
+                for q in queues:
+                    q.items.append((dict(envelope), 0))
+            else:
+                self._pending.setdefault(routing_key,
+                                         deque()).append((dict(envelope), 0))
             self.published_count += 1
             self._work.notify_all()
 
-    def bind(self, routing_key: str, callback: EventCallback) -> None:
+    def bind(self, routing_key: str, callback: EventCallback,
+             group: str = "default") -> None:
         with self._lock:
-            self.queue(routing_key).callbacks.append(callback)
+            self.queue(routing_key, group).callbacks.append(callback)
 
-    def unbind(self, routing_key: str, callback: EventCallback) -> None:
+    def unbind(self, routing_key: str, callback: EventCallback,
+               group: str = "default") -> None:
         with self._lock:
-            q = self.queue(routing_key)
+            q = self._queues.get((routing_key, group))
+            if q is None:
+                return
             if callback in q.callbacks:
                 q.callbacks.remove(callback)
+            if not q.callbacks:
+                # Last consumer gone: drop the queue and re-park its
+                # undelivered messages for the next subscriber.
+                del self._queues[(routing_key, group)]
+                if q.items:
+                    self._pending.setdefault(routing_key,
+                                             deque()).extend(q.items)
 
     def queue_depth(self, routing_key: str) -> int:
         with self._lock:
-            return len(self.queue(routing_key).items)
+            total = len(self._pending.get(routing_key, ()))
+            return total + sum(len(q.items)
+                               for q in self._group_queues(routing_key))
 
     def _pop_ready(self) -> tuple[_Queue, Mapping[str, Any], int, EventCallback] | None:
         with self._lock:
@@ -97,8 +143,7 @@ class InProcBroker:
             if redeliveries + 1 >= self.max_redeliveries:
                 with self._work:
                     self.dead_lettered.append((q.name, envelope))
-                    self.queue(q.name + DLQ_SUFFIX).items.append((envelope, 0))
-                    self._work.notify_all()
+                    self.publish(envelope, q.name + DLQ_SUFFIX)
             else:
                 with self._work:
                     q.items.append((envelope, redeliveries + 1))
@@ -156,15 +201,21 @@ class InProcPublisher(EventPublisher):
 
 
 class InProcSubscriber(EventSubscriber):
-    def __init__(self, config: Any = None, broker: InProcBroker | None = None):
+    """``group`` (config key or kwarg) names this consumer's queue group:
+    subscribers sharing a group compete for messages (service replicas);
+    distinct groups each receive every message (distinct services)."""
+
+    def __init__(self, config: Any = None, broker: InProcBroker | None = None,
+                 group: str | None = None):
         cfg = dict(config or {})
         self.broker = broker or get_broker(cfg.get("exchange", DEFAULT_EXCHANGE))
+        self.group = group or cfg.get("group") or f"sub-{id(self):x}"
         self._bound: list[tuple[str, EventCallback]] = []
         self._stop = threading.Event()
 
     def subscribe(self, routing_keys, callback):
         for rk in routing_keys:
-            self.broker.bind(rk, callback)
+            self.broker.bind(rk, callback, group=self.group)
             self._bound.append((rk, callback))
 
     def start_consuming(self):
@@ -180,5 +231,5 @@ class InProcSubscriber(EventSubscriber):
     def close(self):
         self.stop()
         for rk, cb in self._bound:
-            self.broker.unbind(rk, cb)
+            self.broker.unbind(rk, cb, group=self.group)
         self._bound.clear()

@@ -42,16 +42,31 @@ class ConsensusDetector(abc.ABC):
 
 
 _AGREE_PATTERNS = [
-    r"\b\+1\b", r"\bagree[sd]?\b", r"\bsounds good\b", r"\blgtm\b",
+    r"(?:^|[\s(])\+1\b", r"\bagree[sd]?\b", r"\bsounds good\b", r"\blgtm\b",
     r"\bsupport (?:this|the) (?:proposal|draft|change)\b",
     r"\bno objection[s]?\b", r"\bworks for me\b", r"\bin favou?r\b",
     r"\bship it\b", r"\bconsensus\b",
 ]
 _DISAGREE_PATTERNS = [
-    r"\b-1\b", r"\bdisagree[sd]?\b", r"\bobject(?:ion[s]?|s|ed)?\b",
+    r"(?:^|[\s(])-1\b", r"\bdisagree[sd]?\b", r"\bobject(?:ion[s]?|s|ed)?\b",
     r"\boppose[sd]?\b", r"\bconcern(?:s|ed)?\b", r"\bproblematic\b",
     r"\bblock(?:ing|er)?\b", r"\bstrongly against\b", r"\bbroken\b",
 ]
+
+
+def _signal_from_counts(agree: int, disagree: int, evidence: list[str],
+                        strong: float, rough: float,
+                        min_signals: int) -> ConsensusSignal:
+    total = agree + disagree
+    if total < min_signals:
+        return ConsensusSignal(ConsensusLevel.NO_SIGNAL, 0.0, agree,
+                               disagree, evidence)
+    ratio = agree / total
+    score = 2.0 * ratio - 1.0
+    level = (ConsensusLevel.STRONG_CONSENSUS if ratio >= strong
+             else ConsensusLevel.ROUGH_CONSENSUS if ratio >= rough
+             else ConsensusLevel.CONTESTED)
+    return ConsensusSignal(level, score, agree, disagree, evidence)
 
 
 class HeuristicConsensusDetector(ConsensusDetector):
@@ -77,19 +92,9 @@ class HeuristicConsensusDetector(ConsensusDetector):
             elif d > a:
                 disagree += 1
                 evidence.append(f"disagree: {body.strip()[:80]}")
-        total = agree + disagree
-        if total < self.min_signals:
-            return ConsensusSignal(ConsensusLevel.NO_SIGNAL, 0.0, agree,
-                                   disagree, evidence)
-        ratio = agree / total
-        score = 2.0 * ratio - 1.0
-        if ratio >= self.strong_threshold:
-            level = ConsensusLevel.STRONG_CONSENSUS
-        elif ratio >= self.rough_threshold:
-            level = ConsensusLevel.ROUGH_CONSENSUS
-        else:
-            level = ConsensusLevel.CONTESTED
-        return ConsensusSignal(level, score, agree, disagree, evidence)
+        return _signal_from_counts(agree, disagree, evidence,
+                                   self.strong_threshold,
+                                   self.rough_threshold, self.min_signals)
 
 
 class MockConsensusDetector(ConsensusDetector):
@@ -140,16 +145,8 @@ class EmbeddingConsensusDetector(ConsensusDetector):
             elif sd - sa > self.margin:
                 disagree += 1
                 evidence.append(f"disagree({sd - sa:.2f}): {body[:60]}")
-        total = agree + disagree
-        if total < min_signals:
-            return ConsensusSignal(ConsensusLevel.NO_SIGNAL, 0.0, agree,
-                                   disagree, evidence)
-        ratio = agree / total
-        score = 2.0 * ratio - 1.0
-        level = (ConsensusLevel.STRONG_CONSENSUS if ratio >= strong
-                 else ConsensusLevel.ROUGH_CONSENSUS if ratio >= rough
-                 else ConsensusLevel.CONTESTED)
-        return ConsensusSignal(level, score, agree, disagree, evidence)
+        return _signal_from_counts(agree, disagree, evidence, strong,
+                                   rough, min_signals)
 
 
 def create_consensus_detector(config: Any = None, **kwargs: Any

@@ -24,9 +24,9 @@ class FetchError(Exception):
 class SourceConfig:
     name: str
     fetcher: str = "local"                 # local|http|imap|rsync|mock
-    uri: str = ""                          # path / url / server
+    location: str = ""                     # path / url / server
     enabled: bool = True
-    schedule_minutes: int = 0              # 0 = manual trigger only
+    schedule_seconds: int = 0              # 0 = manual trigger only
     options: dict[str, Any] = field(default_factory=dict)
 
 
@@ -47,7 +47,7 @@ class LocalFetcher(ArchiveFetcher):
     """Reads mbox files from a local path (file or directory)."""
 
     def fetch(self, source: SourceConfig) -> Iterator[FetchedArchive]:
-        path = pathlib.Path(source.uri)
+        path = pathlib.Path(source.location)
         if not path.exists():
             raise FetchError(f"local path does not exist: {path}")
         files = [path] if path.is_file() else sorted(
@@ -80,14 +80,14 @@ class HTTPFetcher(ArchiveFetcher):
         import urllib.request
 
         try:
-            with urllib.request.urlopen(source.uri,
+            with urllib.request.urlopen(source.location,
                                         timeout=self.timeout_s) as resp:
                 content = resp.read()
         except (urllib.error.URLError, OSError) as exc:
-            raise FetchError(f"http fetch failed for {source.uri}: "
+            raise FetchError(f"http fetch failed for {source.location}: "
                              f"{exc}") from exc
-        name = source.uri.rstrip("/").rsplit("/", 1)[-1] or "archive.mbox"
-        yield FetchedArchive(uri=source.uri, filename=name, content=content)
+        name = source.location.rstrip("/").rsplit("/", 1)[-1] or "archive.mbox"
+        yield FetchedArchive(uri=source.location, filename=name, content=content)
 
 
 class IMAPFetcher(ArchiveFetcher):
@@ -99,7 +99,7 @@ class IMAPFetcher(ArchiveFetcher):
 
         opts = source.options
         try:
-            conn = imaplib.IMAP4_SSL(source.uri)
+            conn = imaplib.IMAP4_SSL(source.location)
             conn.login(opts.get("username", ""), opts.get("password", ""))
             conn.select(opts.get("mailbox", "INBOX"), readonly=True)
             _, data = conn.search(None, "ALL")
@@ -110,9 +110,9 @@ class IMAPFetcher(ArchiveFetcher):
                 lines.append(b"From fetcher@imap\n" + raw + b"\n")
             conn.logout()
         except (OSError, imaplib.IMAP4.error) as exc:
-            raise FetchError(f"imap fetch failed for {source.uri}: "
+            raise FetchError(f"imap fetch failed for {source.location}: "
                              f"{exc}") from exc
-        yield FetchedArchive(uri=f"imap://{source.uri}",
+        yield FetchedArchive(uri=f"imap://{source.location}",
                              filename=f"{source.name}.mbox",
                              content=b"".join(lines))
 
@@ -130,10 +130,10 @@ class RsyncFetcher(ArchiveFetcher):
         dest = pathlib.Path(self.scratch_dir) / source.name
         dest.mkdir(parents=True, exist_ok=True)
         proc = subprocess.run(
-            ["rsync", "-az", "--timeout=60", source.uri, str(dest) + "/"],
+            ["rsync", "-az", "--timeout=60", source.location, str(dest) + "/"],
             capture_output=True, text=True)
         if proc.returncode != 0:
-            raise FetchError(f"rsync failed for {source.uri}: "
+            raise FetchError(f"rsync failed for {source.location}: "
                              f"{proc.stderr.strip()}")
         yield from LocalFetcher().fetch(
-            SourceConfig(name=source.name, uri=str(dest)))
+            SourceConfig(name=source.name, location=str(dest)))

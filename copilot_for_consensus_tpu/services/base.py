@@ -1,0 +1,108 @@
+"""Shared service plumbing: event dispatch, retry, failure events, metrics.
+
+Mirrors the crosscutting behavior every reference service repeats
+(SURVEY.md §3.5): handler wraps ``handle_event_with_retry``; terminal
+failures publish the stage's ``*Failed`` event to its ``.failed`` queue;
+every handled event bumps counters and a latency histogram.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Mapping
+
+from copilot_for_consensus_tpu.bus.base import EventPublisher
+from copilot_for_consensus_tpu.core.events import Event
+from copilot_for_consensus_tpu.core.retry import (
+    RetryExhaustedError,
+    RetryPolicy,
+)
+from copilot_for_consensus_tpu.obs.errors import ErrorReporter
+from copilot_for_consensus_tpu.obs.logging import Logger, get_logger
+from copilot_for_consensus_tpu.obs.metrics import (
+    MetricsCollector,
+    NoopMetrics,
+)
+from copilot_for_consensus_tpu.storage.base import DocumentStore
+
+
+class BaseService:
+    """Owns adapters; routes envelopes to ``on_<EventType>`` methods."""
+
+    name = "base"
+    #: event types this service consumes (routing keys derived from them)
+    consumes: tuple[str, ...] = ()
+
+    def __init__(
+        self,
+        publisher: EventPublisher,
+        store: DocumentStore,
+        *,
+        logger: Logger | None = None,
+        metrics: MetricsCollector | None = None,
+        error_reporter: ErrorReporter | None = None,
+        retry: RetryPolicy | None = None,
+    ):
+        self.publisher = publisher
+        self.store = store
+        self.logger = (logger or get_logger()).bind(service=self.name)
+        self.metrics = metrics or NoopMetrics()
+        self.error_reporter = error_reporter
+        self.retry = retry or RetryPolicy()
+
+    # -- bus wiring ------------------------------------------------------
+
+    def routing_keys(self) -> list[str]:
+        from copilot_for_consensus_tpu.core.events import EVENT_TYPES
+        return [EVENT_TYPES[t].routing_key for t in self.consumes]
+
+    def handle_envelope(self, envelope: Mapping[str, Any]) -> None:
+        """Bus callback. Raises to trigger nack/requeue on transient
+        errors; terminal errors publish the failure event and swallow."""
+        etype = envelope.get("event_type", "")
+        handler: Callable | None = getattr(self, f"on_{etype}", None)
+        if handler is None:
+            return
+        t0 = time.monotonic()
+        try:
+            self.retry.run(lambda: handler(Event.from_envelope(envelope)),
+                           event_type=etype)
+            self.metrics.increment(f"{self.name}_events_total",
+                                   labels={"event": etype, "ok": "true"})
+        except RetryExhaustedError as exc:
+            self.metrics.increment(f"{self.name}_events_total",
+                                   labels={"event": etype, "ok": "false"})
+            self.logger.error("retries exhausted", event=etype,
+                              error=str(exc.last_error))
+            if self.error_reporter is not None:
+                self.error_reporter.report(exc, {"event": etype})
+            self._publish_failure(envelope, exc.last_error,
+                                  attempts=exc.attempts)
+        except Exception as exc:  # unexpected → terminal failure event
+            self.metrics.increment(f"{self.name}_events_total",
+                                   labels={"event": etype, "ok": "false"})
+            self.logger.error("handler failed", event=etype,
+                              error=str(exc), error_type=type(exc).__name__)
+            if self.error_reporter is not None:
+                self.error_reporter.report(exc, {"event": etype})
+            self._publish_failure(envelope, exc, attempts=1)
+        finally:
+            self.metrics.observe(f"{self.name}_handle_seconds",
+                                 time.monotonic() - t0,
+                                 labels={"event": etype})
+
+    def _publish_failure(self, envelope: Mapping[str, Any],
+                         error: BaseException | None,
+                         attempts: int) -> None:
+        evt = self.failure_event(envelope, error, attempts)
+        if evt is not None:
+            self.publisher.publish(evt)
+
+    def failure_event(self, envelope: Mapping[str, Any],
+                      error: BaseException | None,
+                      attempts: int) -> Event | None:
+        """Override: map a failed envelope to the stage's *Failed event."""
+        return None
+
+    def startup(self) -> None:
+        """Override: startup requeue of stuck documents."""

@@ -1,0 +1,89 @@
+"""Chunking service: messages → token-bounded retrieval chunks.
+
+Reference behaviors kept (``chunking/app/service.py:39,270,457``):
+dup-key-tolerant chunk insert (``:343``), chunk doc shape (``:498-516``),
+deterministic chunk ids, ``chunking_complete`` status flag, cascade
+cleanup on source deletion (``:609``).
+"""
+
+from __future__ import annotations
+
+from copilot_for_consensus_tpu.core import events as ev
+from copilot_for_consensus_tpu.core.ids import generate_chunk_id
+from copilot_for_consensus_tpu.core.retry import DocumentNotFoundError
+from copilot_for_consensus_tpu.services.base import BaseService
+from copilot_for_consensus_tpu.text.chunkers import Chunker, TokenWindowChunker
+
+
+class ChunkingService(BaseService):
+    name = "chunking"
+    consumes = ("JSONParsed", "SourceDeletionRequested")
+
+    def __init__(self, publisher, store, chunker: Chunker | None = None,
+                 **kw):
+        super().__init__(publisher, store, **kw)
+        self.chunker = chunker or TokenWindowChunker()
+
+    def on_JSONParsed(self, event: ev.JSONParsed) -> None:
+        self.process_message(event.message_doc_id, event.correlation_id)
+
+    def process_message(self, message_doc_id: str,
+                        correlation_id: str = "") -> list[str]:
+        msg = self.store.get_document("messages", message_doc_id)
+        if msg is None:
+            raise DocumentNotFoundError(
+                f"message {message_doc_id} not in store")
+        chunks = self.chunker.chunk(msg.get("body", ""))
+        chunk_ids = []
+        for chunk in chunks:
+            cid = generate_chunk_id(message_doc_id, chunk.seq)
+            chunk_ids.append(cid)
+            # Idempotent: replaying JSONParsed must not duplicate chunks
+            # (reference dup-key-tolerant insert, service.py:343).
+            self.store.insert_or_ignore("chunks", {
+                "chunk_id": cid,
+                "message_doc_id": message_doc_id,
+                "thread_id": msg.get("thread_id", ""),
+                "archive_id": msg.get("archive_id", ""),
+                "source_id": msg.get("source_id", ""),
+                "seq": chunk.seq,
+                "text": chunk.text,
+                "token_count": chunk.token_count,
+                "chunker": self.chunker.name,
+                "embedding_generated": False,
+            })
+        self.store.update_document("messages", message_doc_id,
+                                   {"chunked": True})
+        if chunk_ids:
+            self.publisher.publish(ev.ChunksPrepared(
+                message_doc_id=message_doc_id,
+                thread_id=msg.get("thread_id", ""),
+                archive_id=msg.get("archive_id", ""),
+                chunk_ids=chunk_ids, correlation_id=correlation_id))
+        self.metrics.increment("chunking_chunks_total", len(chunk_ids))
+        return chunk_ids
+
+    def on_SourceDeletionRequested(self, event: ev.SourceDeletionRequested):
+        n = self.store.delete_documents("chunks",
+                                        {"source_id": event.source_id})
+        self.publisher.publish(ev.SourceCleanupProgress(
+            source_id=event.source_id, stage="chunking", deleted_count=n,
+            correlation_id=event.correlation_id))
+
+    def startup(self) -> None:
+        from copilot_for_consensus_tpu.core.startup import StartupRequeue
+        StartupRequeue(self.store, self.publisher,
+                       self.logger).requeue_incomplete(
+            "messages", {"chunked": False},
+            lambda d: ev.JSONParsed(
+                message_doc_id=d["message_doc_id"],
+                archive_id=d.get("archive_id", ""),
+                thread_id=d.get("thread_id", "")))
+
+    def failure_event(self, envelope, error, attempts):
+        data = envelope.get("data", {})
+        return ev.ChunkingFailed(
+            message_doc_id=data.get("message_doc_id", ""),
+            error=str(error), error_type=type(error).__name__,
+            attempts=attempts,
+            correlation_id=data.get("correlation_id", ""))

@@ -1,0 +1,123 @@
+"""Embedding service: chunks → vectors in the vector store.
+
+Reference behaviors kept (``embedding/app/service.py:35,213``): query
+chunks with ``embedding_generated=False`` (``:250``), upsert to the
+vector store with chunk metadata (``:421-438``), flip the status flag
+(``:444``), publish ``EmbeddingsGenerated``, cascade cleanup (``:556``).
+Improved: the reference embeds per-text inside its batch loop
+(``:284,393``); here the whole batch goes through
+``EmbeddingProvider.embed_batch`` — one MXU pass on the TPU driver.
+"""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime, timezone
+
+from copilot_for_consensus_tpu.core import events as ev
+from copilot_for_consensus_tpu.core.retry import DocumentNotFoundError
+from copilot_for_consensus_tpu.embedding.base import EmbeddingProvider
+from copilot_for_consensus_tpu.services.base import BaseService
+from copilot_for_consensus_tpu.vectorstore.base import VectorStore
+
+
+class EmbeddingService(BaseService):
+    name = "embedding"
+    consumes = ("ChunksPrepared", "SourceDeletionRequested")
+
+    def __init__(self, publisher, store, provider: EmbeddingProvider,
+                 vector_store: VectorStore, batch_size: int = 64, **kw):
+        super().__init__(publisher, store, **kw)
+        self.provider = provider
+        self.vector_store = vector_store
+        self.batch_size = batch_size
+
+    def on_ChunksPrepared(self, event: ev.ChunksPrepared) -> None:
+        self.process_chunks(event.chunk_ids, event.correlation_id)
+
+    def process_chunks(self, chunk_ids: list[str],
+                       correlation_id: str = "") -> int:
+        docs = self.store.query_documents(
+            "chunks", {"chunk_id": {"$in": chunk_ids},
+                       "embedding_generated": False})
+        if not docs and chunk_ids:
+            known = self.store.count_documents(
+                "chunks", {"chunk_id": {"$in": chunk_ids}})
+            if known == 0:
+                raise DocumentNotFoundError(
+                    f"none of {len(chunk_ids)} chunks in store yet")
+            return 0  # all already embedded — idempotent replay
+
+        t0 = time.monotonic()
+        done = 0
+        thread_ids: set[str] = set()
+        for start in range(0, len(docs), self.batch_size):
+            batch = docs[start:start + self.batch_size]
+            vectors = self.provider.embed_batch(
+                [d.get("text", "") for d in batch])
+            self.vector_store.add_embeddings(
+                (d["chunk_id"], vec, {
+                    "thread_id": d.get("thread_id", ""),
+                    "message_doc_id": d.get("message_doc_id", ""),
+                    "source_id": d.get("source_id", ""),
+                }) for d, vec in zip(batch, vectors))
+            for d in batch:
+                self.store.update_document("chunks", d["chunk_id"], {
+                    "embedding_generated": True,
+                    "embedded_at": datetime.now(timezone.utc).isoformat(),
+                    "embedding_model": self.provider.model_name,
+                })
+                thread_ids.add(d.get("thread_id", ""))
+                done += 1
+        self.metrics.observe("embedding_batch_seconds",
+                             time.monotonic() - t0)
+        self.metrics.increment("embedding_chunks_total", done)
+        if done:
+            self.publisher.publish(ev.EmbeddingsGenerated(
+                chunk_ids=[d["chunk_id"] for d in docs],
+                thread_ids=sorted(t for t in thread_ids if t),
+                model=self.provider.model_name,
+                dimension=self.provider.dimension,
+                correlation_id=correlation_id))
+        return done
+
+    def on_SourceDeletionRequested(self, event: ev.SourceDeletionRequested):
+        # Filtered delete on the store itself: chunk documents may already
+        # be gone (the chunking stage cleans its own collection in
+        # parallel), so the vector store is the source of truth here.
+        try:
+            n = self.vector_store.delete_by_filter(
+                {"source_id": event.source_id})
+        except NotImplementedError:
+            docs = self.store.query_documents(
+                "chunks", {"source_id": event.source_id})
+            n = self.vector_store.delete([d["chunk_id"] for d in docs])
+        self.publisher.publish(ev.SourceCleanupProgress(
+            source_id=event.source_id, stage="embedding",
+            deleted_count=n, correlation_id=event.correlation_id))
+        self.publisher.publish(ev.SourceCleanupCompleted(
+            source_id=event.source_id,
+            stages_completed=["ingestion", "parsing", "chunking",
+                              "embedding"],
+            correlation_id=event.correlation_id))
+
+    def startup(self) -> None:
+        from copilot_for_consensus_tpu.core.startup import StartupRequeue
+
+        def factory(d):
+            return ev.ChunksPrepared(
+                message_doc_id=d.get("message_doc_id", ""),
+                thread_id=d.get("thread_id", ""),
+                archive_id=d.get("archive_id", ""),
+                chunk_ids=[d["chunk_id"]])
+
+        StartupRequeue(self.store, self.publisher,
+                       self.logger).requeue_incomplete(
+            "chunks", {"embedding_generated": False}, factory)
+
+    def failure_event(self, envelope, error, attempts):
+        data = envelope.get("data", {})
+        return ev.EmbeddingGenerationFailed(
+            chunk_ids=data.get("chunk_ids", []), error=str(error),
+            error_type=type(error).__name__, attempts=attempts,
+            correlation_id=data.get("correlation_id", ""))

@@ -1,0 +1,158 @@
+"""Parsing service: mbox archive → normalized message + thread documents.
+
+Reference behaviors kept (``parsing/app/service.py:257``):
+* stdlib-mailbox parse, header decode, body extraction
+  (``app/parser.py:42,161-299`` → our ``text/mbox.py``),
+* normalization: HTML strip, signature + quoted-reply removal
+  (``app/normalizer.py:17,128,144`` → ``text/normalizer.py``),
+* thread building by in_reply_to/references chain with subject fallback
+  (``app/thread_builder.py:16,125`` → ``text/threads.py``),
+* draft mention detection (``app/draft_detector.py:9`` → ``text/drafts.py``),
+* ONE ``JSONParsed`` event per message (``service.py:681``).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+
+from copilot_for_consensus_tpu.archive.base import ArchiveStore
+from copilot_for_consensus_tpu.core import events as ev
+from copilot_for_consensus_tpu.core.ids import (
+    generate_message_doc_id,
+    generate_thread_id,
+)
+from copilot_for_consensus_tpu.core.retry import DocumentNotFoundError
+from copilot_for_consensus_tpu.services.base import BaseService
+from copilot_for_consensus_tpu.text.drafts import detect_draft_mentions
+from copilot_for_consensus_tpu.text.mbox import parse_mbox_bytes
+from copilot_for_consensus_tpu.text.normalizer import TextNormalizer
+from copilot_for_consensus_tpu.text.threads import ThreadBuilder
+
+
+def _now_iso() -> str:
+    return datetime.now(timezone.utc).isoformat()
+
+
+class ParsingService(BaseService):
+    name = "parsing"
+    consumes = ("ArchiveIngested", "SourceDeletionRequested")
+
+    def __init__(self, publisher, store, archive_store: ArchiveStore,
+                 normalizer: TextNormalizer | None = None, **kw):
+        super().__init__(publisher, store, **kw)
+        self.archive_store = archive_store
+        self.normalizer = normalizer or TextNormalizer()
+        self.thread_builder = ThreadBuilder()
+
+    def on_ArchiveIngested(self, event: ev.ArchiveIngested) -> None:
+        self.process_archive(event.archive_id, event.correlation_id)
+
+    def process_archive(self, archive_id: str,
+                        correlation_id: str = "") -> int:
+        archive_doc = self.store.get_document("archives", archive_id)
+        if archive_doc is None:
+            # Event arrived before the DB write became visible — the race
+            # copilot_event_retry exists for (reference event_handler.py:22).
+            raise DocumentNotFoundError(f"archive {archive_id} not in store")
+        raw = self.archive_store.load(archive_id)
+        source_id = archive_doc.get("source_id", "")
+
+        parsed = []
+        html_flags = {}
+        for msg, is_html in parse_mbox_bytes(raw):
+            parsed.append(msg)
+            html_flags[id(msg)] = is_html
+        threads = self.thread_builder.build_threads(parsed)
+        thread_of_index: dict[int, str] = {}
+        for tid, th in threads.items():
+            for i in th.message_indices:
+                thread_of_index[i] = tid
+
+        doc_ids = [
+            generate_message_doc_id(archive_id, msg.message_id, idx)
+            for idx, msg in enumerate(parsed)
+        ]
+        published = 0
+        for idx, msg in enumerate(parsed):
+            doc_id = doc_ids[idx]
+            thread_id = thread_of_index.get(idx, "")
+            body = self.normalizer.normalize(
+                msg.body_raw, is_html=html_flags.get(id(msg), False))
+            inserted = self.store.insert_or_ignore("messages", {
+                "message_doc_id": doc_id,
+                "archive_id": archive_id,
+                "source_id": source_id,
+                "message_id": msg.message_id,
+                "thread_id": thread_id,
+                "subject": msg.subject,
+                "from_addr": msg.from_addr,
+                "from_name": msg.from_name,
+                "to_addrs": msg.to_addrs,
+                "date": msg.date,
+                "in_reply_to": msg.in_reply_to,
+                "references": msg.references,
+                "body": body,
+                "draft_mentions": detect_draft_mentions(body),
+                "chunked": False,
+            })
+            if inserted:
+                self.publisher.publish(ev.JSONParsed(
+                    message_doc_id=doc_id, archive_id=archive_id,
+                    thread_id=thread_id, correlation_id=correlation_id))
+                published += 1
+
+        for tid, th in threads.items():
+            members = [parsed[i] for i in th.message_indices]
+            draft_mentions = sorted({
+                d for m in members
+                for d in detect_draft_mentions(m.body_raw)})
+            self.store.upsert_document("threads", {
+                "thread_id": tid,
+                "archive_ids": [archive_id],
+                "source_id": source_id,
+                "subject": th.subject,
+                "root_message_id": th.root_message_id,
+                "message_ids": [m.message_id for m in members],
+                "message_doc_ids": [doc_ids[i] for i in th.message_indices],
+                "participants": th.participants,
+                "message_count": len(members),
+                "first_message_date": th.first_date,
+                "last_message_date": th.last_date,
+                "draft_mentions": draft_mentions,
+            })
+
+        self.store.update_document("archives", archive_id, {
+            "parsed": True,
+            "parsed_at": _now_iso(),
+            "message_count": len(parsed),
+        })
+        self.metrics.increment("parsing_messages_total", len(parsed))
+        self.logger.info("archive parsed", archive_id=archive_id,
+                         messages=len(parsed), threads=len(threads))
+        return published
+
+    def on_SourceDeletionRequested(self, event: ev.SourceDeletionRequested):
+        n = self.store.delete_documents("messages",
+                                        {"source_id": event.source_id})
+        n += self.store.delete_documents("threads",
+                                         {"source_id": event.source_id})
+        self.publisher.publish(ev.SourceCleanupProgress(
+            source_id=event.source_id, stage="parsing", deleted_count=n,
+            correlation_id=event.correlation_id))
+
+    def startup(self) -> None:
+        from copilot_for_consensus_tpu.core.startup import StartupRequeue
+        StartupRequeue(self.store, self.publisher,
+                       self.logger).requeue_incomplete(
+            "archives", {"parsed": False},
+            lambda d: ev.ArchiveIngested(
+                archive_id=d["archive_id"],
+                source_id=d.get("source_id", ""),
+                archive_uri=d.get("archive_uri", "")))
+
+    def failure_event(self, envelope, error, attempts):
+        data = envelope.get("data", {})
+        return ev.ParsingFailed(
+            archive_id=data.get("archive_id", ""), error=str(error),
+            error_type=type(error).__name__, attempts=attempts,
+            correlation_id=data.get("correlation_id", ""))

@@ -1,0 +1,155 @@
+"""Pipeline wiring: all services on one bus, config-driven.
+
+The single-process equivalent of the reference's docker-compose stack —
+its fake-backend strategy (SURVEY.md §4) made the full pipeline runnable
+with zero infra; this runner is that mode as a first-class object, and
+the production mode just swaps drivers via config (zmq bus, sqlite store,
+tpu engines) without touching service code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from copilot_for_consensus_tpu.bus.inproc import (
+    InProcBroker,
+    InProcPublisher,
+    InProcSubscriber,
+)
+from copilot_for_consensus_tpu.bus.validating import ValidatingPublisher
+from copilot_for_consensus_tpu.consensus.base import create_consensus_detector
+from copilot_for_consensus_tpu.core.retry import RetryConfig, RetryPolicy
+from copilot_for_consensus_tpu.embedding.factory import (
+    create_embedding_provider,
+)
+from copilot_for_consensus_tpu.fetch.base import LocalFetcher, MockFetcher
+from copilot_for_consensus_tpu.archive.base import InMemoryArchiveStore
+from copilot_for_consensus_tpu.obs.logging import SilentLogger
+from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+from copilot_for_consensus_tpu.services.chunking import ChunkingService
+from copilot_for_consensus_tpu.services.embedding import EmbeddingService
+from copilot_for_consensus_tpu.services.ingestion import IngestionService
+from copilot_for_consensus_tpu.services.orchestrator import (
+    ContextSelector,
+    OrchestrationService,
+)
+from copilot_for_consensus_tpu.services.reporting import ReportingService
+from copilot_for_consensus_tpu.services.summarization import (
+    SummarizationService,
+)
+from copilot_for_consensus_tpu.storage.factory import create_document_store
+from copilot_for_consensus_tpu.summarization.factory import create_summarizer
+from copilot_for_consensus_tpu.text.chunkers import TokenWindowChunker
+from copilot_for_consensus_tpu.vectorstore.factory import create_vector_store
+
+
+@dataclass
+class Pipeline:
+    broker: InProcBroker
+    store: Any
+    vector_store: Any
+    ingestion: IngestionService
+    parsing: Any
+    chunking: ChunkingService
+    embedding: EmbeddingService
+    orchestrator: OrchestrationService
+    summarization: SummarizationService
+    reporting: ReportingService
+    metrics: InMemoryMetrics
+    subscribers: list = field(default_factory=list)
+
+    @property
+    def services(self):
+        return (self.ingestion, self.parsing, self.chunking, self.embedding,
+                self.orchestrator, self.summarization, self.reporting)
+
+    def startup(self) -> None:
+        for svc in self.services:
+            svc.startup()
+
+    def drain(self, max_messages: int | None = None) -> int:
+        """Dispatch queued events until quiescent (in-proc mode)."""
+        return self.broker.drain(max_messages)
+
+    def ingest_and_run(self, source_id: str) -> dict[str, int]:
+        """Trigger a source, run the pipeline to quiescence, return
+        document counts — the one-call end-to-end path."""
+        self.ingestion.trigger_source(source_id)
+        self.drain()
+        return self.reporting.stats()
+
+
+def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
+    """Wire every service onto one in-proc broker.
+
+    config keys (all optional): ``document_store``, ``vector_store``,
+    ``embedding``, ``llm``, ``chunking``, ``orchestrator``,
+    ``summarization`` — each a driver-config mapping.
+    """
+    cfg = dict(config or {})
+    broker = InProcBroker()
+    store = create_document_store(cfg.get("document_store",
+                                          {"driver": "memory"}))
+    store.connect()
+    vector_store = create_vector_store(cfg.get("vector_store",
+                                               {"driver": "memory"}))
+    vector_store.connect()
+    provider = create_embedding_provider(cfg.get("embedding",
+                                                 {"driver": "mock"}))
+    summarizer = create_summarizer(cfg.get("llm", {"driver": "mock"}))
+    consensus = create_consensus_detector(
+        cfg.get("consensus", {"driver": "heuristic"}))
+    metrics = InMemoryMetrics()
+    logger = SilentLogger() if not cfg.get("verbose") else None
+    archive_store = InMemoryArchiveStore()
+    retry = RetryPolicy(RetryConfig(max_attempts=3, base_delay=0.01,
+                                    max_delay=0.05))
+
+    def publisher() -> ValidatingPublisher:
+        return ValidatingPublisher(InProcPublisher(broker=broker))
+
+    common = dict(logger=logger, metrics=metrics, retry=retry)
+    ingestion = IngestionService(
+        publisher(), store, archive_store,
+        fetchers={"local": LocalFetcher(),
+                  "mock": cfg.get("mock_fetcher") or MockFetcher()},
+        **common)
+    from copilot_for_consensus_tpu.services.parsing import ParsingService
+    parsing = ParsingService(publisher(), store, archive_store, **common)
+    chunking = ChunkingService(
+        publisher(), store,
+        chunker=TokenWindowChunker(**cfg.get("chunking", {})), **common)
+    embedding = EmbeddingService(publisher(), store, provider, vector_store,
+                                 **common)
+    orch_cfg = cfg.get("orchestrator", {})
+    orchestrator = OrchestrationService(
+        publisher(), store, vector_store=vector_store,
+        embedding_provider=provider,
+        selector=ContextSelector(
+            top_k=int(orch_cfg.get("top_k", 12)),
+            context_window_tokens=int(
+                orch_cfg.get("context_window_tokens", 3000))),
+        **common)
+    summarization = SummarizationService(
+        publisher(), store, summarizer, consensus_detector=consensus,
+        **common)
+    reporting = ReportingService(
+        publisher(), store,
+        webhook_url=cfg.get("webhook_url", ""),
+        webhook_sender=cfg.get("webhook_sender"),
+        embedding_provider=provider, vector_store=vector_store, **common)
+
+    pipeline = Pipeline(
+        broker=broker, store=store, vector_store=vector_store,
+        ingestion=ingestion, parsing=parsing, chunking=chunking,
+        embedding=embedding, orchestrator=orchestrator,
+        summarization=summarization, reporting=reporting, metrics=metrics)
+
+    for svc in pipeline.services:
+        # One queue group per service: fan-out across services (every
+        # stage sees SourceDeletionRequested), competition within one.
+        sub = InProcSubscriber(broker=broker, group=svc.name)
+        sub.subscribe(svc.routing_keys(), svc.handle_envelope)
+        pipeline.subscribers.append(sub)
+    return pipeline

@@ -1,0 +1,136 @@
+"""Summarization service: execute LLM summarization of selected context.
+
+Reference behaviors kept (``summarization/app/service.py:199``):
+* context strictly from the orchestrator's pre-selected chunks (``:545``),
+* citations derived from chunks, not LLM output (``:291-307``),
+* deterministic summary id (``:741``) → idempotent storage,
+* rate-limit-aware retry (``:367-402``).
+Plus consensus annotation: the detector (heuristic or embedding-ML) runs
+over the thread's messages and its signal is stored with the summary —
+the capability the reference's ``copilot_consensus`` package is building
+toward.
+"""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime, timezone
+
+from copilot_for_consensus_tpu.consensus.base import ConsensusDetector
+from copilot_for_consensus_tpu.core import events as ev
+from copilot_for_consensus_tpu.core.retry import (
+    DocumentNotFoundError,
+    RetryableError,
+)
+from copilot_for_consensus_tpu.services.base import BaseService
+from copilot_for_consensus_tpu.summarization.base import (
+    RateLimitError,
+    Summarizer,
+    ThreadContext,
+)
+
+
+class SummarizationService(BaseService):
+    name = "summarization"
+    consumes = ("SummarizationRequested",)
+
+    def __init__(self, publisher, store, summarizer: Summarizer,
+                 consensus_detector: ConsensusDetector | None = None,
+                 context_window_tokens: int = 4096, **kw):
+        super().__init__(publisher, store, **kw)
+        self.summarizer = summarizer
+        self.consensus_detector = consensus_detector
+        self.context_window_tokens = context_window_tokens
+
+    def on_SummarizationRequested(self,
+                                  event: ev.SummarizationRequested) -> None:
+        self.process_thread(event.thread_id, event.summary_id,
+                            event.selected_chunks, event.context_selection,
+                            event.correlation_id)
+
+    def process_thread(self, thread_id: str, summary_id: str,
+                       selected_chunks: list[str],
+                       context_selection: dict | None = None,
+                       correlation_id: str = "") -> str | None:
+        if self.store.get_document("summaries", summary_id) is not None:
+            return None  # idempotent replay
+        thread = self.store.get_document("threads", thread_id)
+        if thread is None:
+            raise DocumentNotFoundError(f"thread {thread_id} not in store")
+        chunk_docs = self.store.query_documents(
+            "chunks", {"chunk_id": {"$in": selected_chunks}})
+        if not chunk_docs and selected_chunks:
+            raise DocumentNotFoundError("selected chunks not visible yet")
+        order = {cid: i for i, cid in enumerate(selected_chunks)}
+        chunk_docs.sort(key=lambda d: order.get(d["chunk_id"], 1 << 30))
+        scores = (context_selection or {}).get("scores", {})
+
+        context = ThreadContext(
+            thread_id=thread_id,
+            subject=thread.get("subject", ""),
+            participants=thread.get("participants", []),
+            message_count=thread.get("message_count", 0),
+            chunks=[{
+                "chunk_id": d["chunk_id"],
+                "message_doc_id": d.get("message_doc_id", ""),
+                "text": d.get("text", ""),
+                "score": scores.get(d["chunk_id"], 0.0),
+            } for d in chunk_docs],
+            context_window_tokens=self.context_window_tokens,
+        )
+
+        t0 = time.monotonic()
+        try:
+            summary = self.summarizer.summarize(context)
+        except RateLimitError as exc:
+            # Let the retry policy back off (reference ``:367-402``).
+            raise RetryableError(
+                f"rate limited, retry after {exc.retry_after_s}s") from exc
+        latency = time.monotonic() - t0
+
+        doc = {
+            "summary_id": summary_id,
+            "thread_id": thread_id,
+            "summary_text": summary.summary_text,
+            "model": summary.model,
+            "chunk_ids": selected_chunks,
+            "citations": [{
+                "chunk_id": c.chunk_id,
+                "message_doc_id": c.message_doc_id,
+                "snippet": c.snippet,
+                "score": c.score,
+            } for c in summary.citations],
+            "context_selection": context_selection or {},
+            "prompt_tokens": summary.prompt_tokens,
+            "completion_tokens": summary.completion_tokens,
+            "generation_seconds": latency,
+            "created_at": datetime.now(timezone.utc).isoformat(),
+        }
+        if self.consensus_detector is not None:
+            messages = self.store.query_documents(
+                "messages", {"thread_id": thread_id})
+            signal = self.consensus_detector.detect(messages)
+            doc["consensus"] = {
+                "level": signal.level.value,
+                "score": signal.score,
+                "agree_count": signal.agree_count,
+                "disagree_count": signal.disagree_count,
+            }
+        self.store.upsert_document("summaries", doc)
+        self.store.update_document("threads", thread_id,
+                                   {"summary_id": summary_id})
+        self.metrics.observe("summarization_latency_seconds", latency)
+        self.metrics.increment("summarization_summaries_total")
+        self.publisher.publish(ev.SummaryComplete(
+            summary_id=summary_id, thread_id=thread_id,
+            correlation_id=correlation_id))
+        return summary_id
+
+    def failure_event(self, envelope, error, attempts):
+        data = envelope.get("data", {})
+        return ev.SummarizationFailed(
+            thread_id=data.get("thread_id", ""),
+            summary_id=data.get("summary_id", ""),
+            error=str(error), error_type=type(error).__name__,
+            attempts=attempts,
+            correlation_id=data.get("correlation_id", ""))

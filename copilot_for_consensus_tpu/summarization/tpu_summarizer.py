@@ -77,8 +77,7 @@ class TPUSummarizer(Summarizer):
                 dtype=dtype if dtype is not None else jnp.bfloat16)
         self.engine = engine
         self.tokenizer: Tokenizer = tokenizer or ByteTokenizer(
-            max(259, self.engine.cfg.vocab_size)
-            if self.engine.cfg.vocab_size >= 259 else 259)
+            max(259, self.engine.cfg.vocab_size))
         if self.tokenizer.vocab_size > self.engine.cfg.vocab_size:
             raise ValueError("tokenizer vocab exceeds model vocab")
 

@@ -52,6 +52,12 @@ class VectorStore(abc.ABC):
     @abc.abstractmethod
     def delete(self, vec_ids: Sequence[str]) -> int: ...
 
+    def delete_by_filter(self, flt: Mapping[str, Any]) -> int:
+        """Delete every vector whose metadata matches ``flt``. Drivers
+        that can't filter server-side may override or raise."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support filtered deletion")
+
     @abc.abstractmethod
     def count(self) -> int: ...
 

@@ -87,6 +87,12 @@ class InMemoryVectorStore(VectorStore):
                 return None
             return self._vectors[row].tolist(), dict(self._metadata[row])
 
+    def delete_by_filter(self, flt):
+        with self._lock:
+            doomed = [vid for vid, row in self._index.items()
+                      if matches_filter(self._metadata[row], flt)]
+        return self.delete(doomed)
+
     def delete(self, vec_ids):
         with self._lock:
             keep = [i for i, vid in enumerate(self._ids)

@@ -1,0 +1,110 @@
+# Adapter packages: consensus, draft diff, fetchers, archive stores.
+import pytest
+
+from copilot_for_consensus_tpu.archive.base import (
+    ArchiveStoreError,
+    DocumentArchiveStore,
+    InMemoryArchiveStore,
+    LocalVolumeArchiveStore,
+)
+from copilot_for_consensus_tpu.consensus.base import (
+    ConsensusLevel,
+    EmbeddingConsensusDetector,
+    HeuristicConsensusDetector,
+)
+from copilot_for_consensus_tpu.draftdiff.base import LocalDiffProvider
+from copilot_for_consensus_tpu.embedding.base import MockEmbeddingProvider
+from copilot_for_consensus_tpu.fetch.base import (
+    FetchError,
+    LocalFetcher,
+    SourceConfig,
+)
+from copilot_for_consensus_tpu.storage.factory import create_document_store
+
+
+def _msgs(*bodies):
+    return [{"body": b, "from_addr": f"u{i}@x"} for i, b in enumerate(bodies)]
+
+
+class TestConsensus:
+    def test_strong_consensus(self):
+        det = HeuristicConsensusDetector()
+        sig = det.detect(_msgs("+1 from me", "I agree with the draft",
+                               "LGTM, ship it", "sounds good"))
+        assert sig.level == ConsensusLevel.STRONG_CONSENSUS
+        assert sig.score > 0.5
+        assert sig.agree_count == 4
+
+    def test_contested(self):
+        det = HeuristicConsensusDetector()
+        sig = det.detect(_msgs("+1", "I object strongly", "-1 broken",
+                               "agree", "this is problematic"))
+        assert sig.level == ConsensusLevel.CONTESTED
+
+    def test_no_signal_below_min(self):
+        det = HeuristicConsensusDetector()
+        sig = det.detect(_msgs("what time is the meeting?"))
+        assert sig.level == ConsensusLevel.NO_SIGNAL
+
+    def test_embedding_detector_runs(self):
+        det = EmbeddingConsensusDetector(MockEmbeddingProvider(64))
+        sig = det.detect(_msgs("I agree, sounds good, +1",
+                               "I agree, support the proposal",
+                               "objection, this is problematic"))
+        assert sig.agree_count + sig.disagree_count >= 2
+
+
+class TestDraftDiff:
+    def test_local_unified_diff(self):
+        p = LocalDiffProvider()
+        p.register("draft-ietf-quic-recovery", "28", "line a\nline b\n")
+        p.register("draft-ietf-quic-recovery", "29", "line a\nline c\n")
+        d = p.get_diff("draft-ietf-quic-recovery", "28", "29")
+        assert d.added_lines == 1 and d.removed_lines == 1
+        assert "+line c" in d.diff_text
+
+    def test_document_store_backed(self):
+        store = create_document_store({"driver": "memory"}, validate=False)
+        store.upsert_document("drafts", {"_id": "d-01", "text": "v1\n"})
+        store.upsert_document("drafts", {"_id": "d-02", "text": "v2\n"})
+        p = LocalDiffProvider(document_store=store)
+        d = p.get_diff("d", "01", "02")
+        assert "+v2" in d.diff_text
+
+
+class TestFetch:
+    def test_local_fetcher_missing_path(self):
+        with pytest.raises(FetchError):
+            list(LocalFetcher().fetch(SourceConfig(name="x",
+                                                   location="/nope/nothing")))
+
+    def test_local_fetcher_reads_file(self, fixtures_dir):
+        out = list(LocalFetcher().fetch(SourceConfig(
+            name="x", location=str(fixtures_dir / "ietf-sample.mbox"))))
+        assert len(out) == 1
+        assert out[0].content.startswith(b"From ")
+
+
+class TestArchiveStore:
+    def test_memory_roundtrip(self):
+        s = InMemoryArchiveStore()
+        s.save("abc", b"data")
+        assert s.exists("abc") and s.load("abc") == b"data"
+        assert s.delete("abc") and not s.exists("abc")
+        with pytest.raises(ArchiveStoreError):
+            s.load("abc")
+
+    def test_local_volume_roundtrip(self, tmp_path):
+        s = LocalVolumeArchiveStore(str(tmp_path))
+        uri = s.save("abc123", b"mbox bytes")
+        assert uri.startswith("file://")
+        assert s.load("abc123") == b"mbox bytes"
+        with pytest.raises(ArchiveStoreError):
+            s._path("../evil")
+
+    def test_document_backed(self):
+        store = create_document_store({"driver": "memory"}, validate=False)
+        s = DocumentArchiveStore(store)
+        s.save("a1", b"\x00\xffbinary")
+        assert s.load("a1") == b"\x00\xffbinary"
+        assert s.delete("a1") and not s.exists("a1")

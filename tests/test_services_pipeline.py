@@ -1,0 +1,139 @@
+# End-to-end pipeline on fakes: fixture mbox → reports, with idempotency,
+# cascade delete, and failure-event behavior. Mirrors the reference's
+# zero-infra full-pipeline strategy (SURVEY.md §4).
+import pytest
+
+from copilot_for_consensus_tpu.core import events as ev
+from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+
+@pytest.fixture
+def pipeline(fixtures_dir):
+    p = build_pipeline()
+    p.ingestion.create_source({
+        "source_id": "ietf-test", "name": "ietf-test",
+        "fetcher": "local",
+        "location": str(fixtures_dir / "ietf-sample.mbox"),
+    })
+    return p
+
+
+def test_end_to_end_fixture_mbox(pipeline):
+    stats = pipeline.ingest_and_run("ietf-test")
+    assert stats["archives"] == 1
+    assert stats["messages"] > 0
+    assert stats["threads"] > 0
+    assert stats["chunks"] >= stats["messages"]
+    assert stats["summaries"] == stats["threads"]
+    assert stats["reports"] == stats["threads"]
+
+    # every chunk embedded + in the vector store
+    chunks = pipeline.store.query_documents("chunks", {})
+    assert all(c["embedding_generated"] for c in chunks)
+    assert pipeline.vector_store.count() == len(chunks)
+
+    # reports carry citations into real chunks and a consensus signal
+    report = pipeline.reporting.get_reports(limit=1)[0]
+    assert report["citations"]
+    cited = report["citations"][0]["chunk_id"]
+    assert pipeline.store.get_document("chunks", cited) is not None
+    summaries = pipeline.store.query_documents("summaries", {})
+    assert all("consensus" in s for s in summaries)
+
+    # threads link back to their summary
+    for th in pipeline.store.query_documents("threads", {}):
+        assert th.get("summary_id")
+
+
+def test_reingest_is_idempotent(pipeline):
+    first = pipeline.ingest_and_run("ietf-test")
+    second = pipeline.ingest_and_run("ietf-test")
+    assert first == second  # sha256 dedupe: no new docs anywhere
+
+
+def test_replayed_events_do_not_duplicate(pipeline):
+    pipeline.ingest_and_run("ietf-test")
+    stats = pipeline.reporting.stats()
+    # Replay every forward event type through the bus again.
+    msg = pipeline.store.query_documents("messages", {}, limit=1)[0]
+    archive = pipeline.store.query_documents("archives", {}, limit=1)[0]
+    pub = pipeline.ingestion.publisher
+    pub.publish(ev.ArchiveIngested(archive_id=archive["archive_id"],
+                                   source_id="ietf-test"))
+    pub.publish(ev.JSONParsed(message_doc_id=msg["message_doc_id"],
+                              archive_id=msg["archive_id"],
+                              thread_id=msg["thread_id"]))
+    pipeline.drain()
+    assert pipeline.reporting.stats() == stats
+
+
+def test_changed_context_triggers_resummarization(pipeline):
+    pipeline.ingest_and_run("ietf-test")
+    n_before = pipeline.reporting.stats()["summaries"]
+    # New message in an existing thread → new chunks → new summary id.
+    th = pipeline.store.query_documents("threads", {}, limit=1)[0]
+    archive_id = th["archive_ids"][0]
+    pipeline.store.insert_or_ignore("messages", {
+        "message_doc_id": "m-new", "archive_id": archive_id,
+        "source_id": "ietf-test", "message_id": "<new@x>",
+        "thread_id": th["thread_id"], "subject": th["subject"],
+        "from_addr": "late@example.org", "date": None,
+        "body": "I strongly disagree with the proposed change. -1.",
+        "chunked": False,
+    })
+    pipeline.chunking.publisher.publish(ev.JSONParsed(
+        message_doc_id="m-new", archive_id=archive_id,
+        thread_id=th["thread_id"]))
+    pipeline.drain()
+    assert pipeline.reporting.stats()["summaries"] == n_before + 1
+
+
+def test_source_cascade_delete(pipeline):
+    pipeline.ingest_and_run("ietf-test")
+    pipeline.ingestion.delete_source("ietf-test")
+    pipeline.drain()
+    stats = pipeline.reporting.stats()
+    assert stats["archives"] == 0
+    assert stats["messages"] == 0
+    assert stats["chunks"] == 0
+    assert pipeline.vector_store.count() == 0
+    # cleanup-completed event observed end of cascade
+    assert pipeline.store.get_document("sources", "ietf-test") is None
+
+
+def test_failure_event_published_on_bad_archive(pipeline):
+    failures = []
+    pipeline.broker.bind("parsing.failed",
+                         lambda env: failures.append(env))
+    # ArchiveIngested for an archive id that never lands in the store:
+    # parsing retries DocumentNotFoundError, exhausts, emits ParsingFailed.
+    pipeline.parsing.publisher.publish(
+        ev.ArchiveIngested(archive_id="missing-archive"))
+    pipeline.drain()
+    assert failures
+    assert failures[0]["data"]["archive_id"] == "missing-archive"
+
+
+def test_startup_requeue_resumes_stuck_documents(pipeline):
+    pipeline.ingestion.trigger_source("ietf-test")
+    pipeline.drain()
+    # Simulate a crash that lost the ChunksPrepared event: flags reset.
+    chunk = pipeline.store.query_documents("chunks", {}, limit=1)[0]
+    pipeline.store.update_document("chunks", chunk["chunk_id"],
+                                   {"embedding_generated": False})
+    pipeline.vector_store.delete([chunk["chunk_id"]])
+    n = pipeline.vector_store.count()
+    pipeline.startup()
+    pipeline.drain()
+    assert pipeline.vector_store.count() == n + 1
+    assert pipeline.store.get_document(
+        "chunks", chunk["chunk_id"])["embedding_generated"]
+
+
+def test_semantic_search_finds_reports(pipeline):
+    pipeline.ingest_and_run("ietf-test")
+    msg = pipeline.store.query_documents("messages", {}, limit=1)[0]
+    topic_word = next((w for w in msg["body"].split() if len(w) > 5),
+                      msg["subject"].split()[0])
+    hits = pipeline.reporting.search_reports(topic_word)
+    assert isinstance(hits, list)

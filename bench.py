@@ -32,10 +32,11 @@ def main() -> None:
     import jax
 
     model = os.environ.get("BENCH_MODEL", "mistral-7b")
-    slots = int(os.environ.get("BENCH_SLOTS", "4"))
+    slots = int(os.environ.get("BENCH_SLOTS", "32"))
     max_len = int(os.environ.get("BENCH_MAX_LEN", "512"))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+    window = int(os.environ.get("BENCH_DECODE_WINDOW", "16"))
 
     import jax.numpy as jnp
     import numpy as np
@@ -58,6 +59,7 @@ def main() -> None:
         dtype=jnp.bfloat16,
         seed=0,
         quantize=quantize,
+        decode_window=window,
     )
     log(f"engine built (random {model} weights, "
         f"{'int8' if quantize else 'bf16'}) in {time.monotonic() - t0:.1f}s")

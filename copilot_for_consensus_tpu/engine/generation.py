@@ -93,6 +93,7 @@ class GenerationEngine:
         dtype=jnp.bfloat16,
         attn_impl: str = "auto",
         quantize: bool = False,
+        decode_window: int = 8,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -104,6 +105,11 @@ class GenerationEngine:
         self.sampling = sampling
         self.eos_id = eos_id
         self.attn_impl = attn_impl
+        self.decode_window = max(1, decode_window)
+        if self.max_len - self.decode_window < 1:
+            raise ValueError(
+                f"decode_window {self.decode_window} leaves no prompt room "
+                f"in max_len {self.max_len}")
         self._key = jax.random.PRNGKey(seed)
 
         axes = decoder.logical_axes(cfg)
@@ -114,7 +120,12 @@ class GenerationEngine:
             else:
                 params = decoder.init_params(jax.random.PRNGKey(seed), cfg,
                                              dtype=dtype)
-        elif quantize and not quant.is_quantized(
+        if quantize and mesh is not None:
+            # The fused Pallas int8 kernel is not GSPMD-partitionable yet;
+            # sharded engines fall back to the XLA dequant expression,
+            # which partitions naturally over tp.
+            quant.set_pallas_qmatmul(False)
+        if params is not None and quantize and not quant.is_quantized(
                 params.get("layers", {}).get("wq")):
             # Caller provided full-precision weights: quantize on the fly.
             # (Real checkpoints should be quantized offline on the host —
@@ -163,10 +174,23 @@ class GenerationEngine:
         self._insert_fn = jax.jit(_insert, donate_argnums=(0,))
 
         def _decode(params, tokens, positions, cache, key):
-            logits, cache = decoder.decode_step(params, tokens, positions,
-                                                cfg, cache)
-            toks = sample(logits, key, self.sampling)
-            return toks, cache
+            """``decode_window`` steps fused in one program: decode →
+            sample → feed back, all on-device. One dispatch and one host
+            sync per window instead of per token — the difference between
+            dispatch-bound and HBM-bound decode."""
+
+            def body(carry, _):
+                tok, pos, cache, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = decoder.decode_step(params, tok, pos, cfg,
+                                                    cache)
+                nxt = sample(logits, sub, self.sampling)
+                return (nxt, pos + 1, cache, key), nxt
+
+            (tok, pos, cache, _), toks = jax.lax.scan(
+                body, (tokens, positions, cache, key), None,
+                length=self.decode_window)
+            return toks, cache          # toks: [window, slots]
 
         self._decode_fn = jax.jit(_decode, donate_argnums=(3,))
 
@@ -194,7 +218,8 @@ class GenerationEngine:
         """Enqueue a tokenized prompt; returns a request id."""
         if not prompt:
             raise ValueError("empty prompt")
-        limit = min(self.max_len - 1, self.buckets[-1])
+        # Leave one decode window of cache headroom past the prompt.
+        limit = min(self.max_len - self.decode_window, self.buckets[-1])
         if len(prompt) > limit:
             # Keep the tail: instructions/questions sit at the end of RAG
             # prompts. The orchestrator budgets context to avoid this.
@@ -269,6 +294,7 @@ class GenerationEngine:
                              "eos" if first == self.eos_id else "length")
 
     def _decode_once(self) -> None:
+        window = self.decode_window
         self._key, sub = jax.random.split(self._key)
         toks, self._cache = self._decode_fn(
             self.params,
@@ -277,20 +303,28 @@ class GenerationEngine:
             self._cache,
             sub,
         )
-        toks = np.asarray(jax.device_get(toks))
+        toks = np.asarray(jax.device_get(toks))      # [window, slots]
         for slot, req in list(self._active.items()):
-            tok = int(toks[slot])
-            self._generated[slot].append(tok)
-            self._positions[slot] += 1
-            self._next_tok[slot] = tok
             gen = self._generated[slot]
-            finished = (
-                tok == self.eos_id
-                or len(gen) >= req.max_new_tokens
-                or self._positions[slot] >= self.max_len - 1
-            )
+            finished = None
+            for step in range(window):
+                tok = int(toks[step, slot])
+                gen.append(tok)
+                if tok == self.eos_id:
+                    finished = "eos"
+                    break
+                if len(gen) >= req.max_new_tokens:
+                    finished = "length"
+                    break
+            self._positions[slot] += window
+            self._next_tok[slot] = int(toks[window - 1, slot])
+            # Keep a full window of cache headroom: the next window writes
+            # positions [pos, pos+window).
+            if (finished is None
+                    and self._positions[slot] + window > self.max_len - 1):
+                finished = "length"
             if finished:
-                self._retire(slot, "eos" if tok == self.eos_id else "length")
+                self._retire(slot, finished)
 
     def _retire(self, slot: int, reason: str) -> None:
         req = self._active.pop(slot)

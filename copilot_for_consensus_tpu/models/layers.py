@@ -23,10 +23,21 @@ from copilot_for_consensus_tpu.ops.attention import attention, decode_attention
 def qmatmul(x: jax.Array, w) -> jax.Array:
     """``x @ w`` where ``w`` is a plain array or an int8 quantized leaf
     (``models.quant``). Dequant scale applies after the matmul — exact,
-    since scales are per output channel."""
-    from copilot_for_consensus_tpu.models.quant import is_quantized
+    since scales are per output channel. On TPU the quantized path runs
+    the fused Pallas kernel (``ops/quant_matmul.py``) so the bf16
+    dequantized weight never touches HBM."""
+    from copilot_for_consensus_tpu.models.quant import (
+        is_quantized,
+        pallas_qmatmul_enabled,
+    )
 
     if is_quantized(w):
+        if (w["q"].ndim == 2 and pallas_qmatmul_enabled()
+                and jax.default_backend() == "tpu"):
+            from copilot_for_consensus_tpu.ops.quant_matmul import (
+                int8_matmul,
+            )
+            return int8_matmul(x, w["q"], w["scale"])
         return (x @ w["q"].astype(x.dtype)) * w["scale"].astype(x.dtype)
     return x @ w
 

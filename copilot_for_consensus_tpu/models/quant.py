@@ -35,6 +35,22 @@ def is_quantized(leaf: Any) -> bool:
     return isinstance(leaf, dict) and "q" in leaf and "scale" in leaf
 
 
+# Process-wide switch for the fused Pallas int8 matmul. Sharded engines
+# disable it (the kernel is not GSPMD-partitionable; the XLA dequant
+# expression partitions naturally over tp). Process-global because model
+# forwards are traced lazily from engine internals.
+_PALLAS_QMATMUL = True
+
+
+def set_pallas_qmatmul(enabled: bool) -> None:
+    global _PALLAS_QMATMUL
+    _PALLAS_QMATMUL = enabled
+
+
+def pallas_qmatmul_enabled() -> bool:
+    return _PALLAS_QMATMUL
+
+
 def quantize_tensor(w: jax.Array) -> dict[str, jax.Array]:
     """Symmetric int8 over axis -2 (the contraction axis of ``x @ W``)."""
     wf = w.astype(jnp.float32)

@@ -92,12 +92,21 @@ def attention(
     window: int = 0,
     kv_lengths: jax.Array | None = None,
     q_offset: int = 0,
-    impl: str = "auto",
+    impl="auto",
 ) -> jax.Array:
     """Full-sequence attention (prefill / encoder). Dispatches to the Pallas
-    flash kernel on TPU, XLA reference elsewhere. ``q_offset`` (chunked
+    flash kernel on TPU, XLA reference elsewhere. ``impl`` may also be a
+    callable with this same (q, k, v, causal, window, kv_lengths)
+    contract — e.g. ``parallel.ring.make_ring_attention(mesh)`` for
+    sequence-parallel long-context forwards. ``q_offset`` (chunked
     prefill: query block placed at an offset in the kv timeline) currently
     forces the XLA path."""
+    if callable(impl):
+        if q_offset:
+            raise NotImplementedError(
+                "q_offset with a custom attention impl")
+        return impl(q, k, v, causal=causal, window=window,
+                    kv_lengths=kv_lengths)
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if q_offset:

@@ -37,8 +37,9 @@ def _engine(**kw):
     return GenerationEngine(CFG, PARAMS, **kw)
 
 
-def test_greedy_matches_naive_forward():
-    eng = _engine()
+@pytest.mark.parametrize("window", [1, 4])
+def test_greedy_matches_naive_forward(window):
+    eng = _engine(decode_window=window)
     prompts = [[5, 9, 13], [40, 41, 42, 43, 44, 45, 46]]
     comps = eng.generate(prompts, max_new_tokens=6)
     for p, c in zip(prompts, comps):
@@ -63,12 +64,13 @@ def test_mid_stream_join_does_not_disturb_running_slot():
     # to solo decoding — the continuous-batching invariant.
     solo = _engine().generate([[11, 12, 13]], max_new_tokens=8)[0].tokens
 
-    eng = _engine()
+    eng = _engine(decode_window=2)
+    done = {}
     a = eng.submit([11, 12, 13], max_new_tokens=8)
     for _ in range(3):
-        eng.step()
+        for c in eng.step():
+            done[c.request_id] = c
     b = eng.submit([30, 31, 32, 33], max_new_tokens=3)
-    done = {}
     for _ in range(30):
         for c in eng.step():
             done[c.request_id] = c
@@ -88,10 +90,10 @@ def test_slot_reuse_after_retirement():
 
 
 def test_long_prompt_truncates_to_tail():
-    eng = _engine(max_len=32, prefill_buckets=(32,))
+    eng = _engine(max_len=32, prefill_buckets=(32,), decode_window=1)
     prompt = list(np.arange(100) % 200 + 3)
     c = eng.generate([prompt], max_new_tokens=2)[0]
-    assert c.prompt_len == 31          # max_len - 1
+    assert c.prompt_len == 31          # max_len - decode_window
     assert len(c.tokens) <= 2
 
 

@@ -1,0 +1,30 @@
+# Fused int8 matmul kernel vs the XLA dequant expression.
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.models.quant import quantize_tensor
+from copilot_for_consensus_tpu.ops.quant_matmul import int8_matmul
+
+
+@pytest.mark.parametrize("m,d,f", [(4, 64, 96), (1, 128, 512), (9, 32, 33)])
+def test_matches_xla_dequant(m, d, f):
+    w = jax.random.normal(jax.random.PRNGKey(0), (d, f)) * 0.05
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, d))
+    qw = quantize_tensor(w)
+    ref = (x @ qw["q"].astype(x.dtype)) * qw["scale"].astype(x.dtype)
+    out = int8_matmul(x, qw["q"], qw["scale"], block_f=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2,
+                               atol=1e-2)
+
+
+def test_leading_batch_dims():
+    w = jax.random.normal(jax.random.PRNGKey(2), (32, 48)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 3, 32))
+    qw = quantize_tensor(w)
+    ref = (x @ qw["q"].astype(x.dtype)) * qw["scale"].astype(x.dtype)
+    out = int8_matmul(x, qw["q"], qw["scale"], block_f=16, interpret=True)
+    assert out.shape == (2, 3, 48)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2,
+                               atol=1e-2)

@@ -1,0 +1,107 @@
+# On-device vector store vs the in-memory oracle.
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.vectorstore.factory import create_vector_store
+
+
+def _fill(store, n=50, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    store.add_embeddings(
+        (f"v{i}", vecs[i], {"thread_id": f"t{i % 5}", "seq": i})
+        for i in range(n))
+    return vecs
+
+
+@pytest.fixture
+def tpu_store():
+    return create_vector_store({"driver": "tpu"})
+
+
+@pytest.fixture
+def oracle():
+    return create_vector_store({"driver": "memory"})
+
+
+def test_topk_matches_memory_oracle(tpu_store, oracle):
+    vecs = _fill(tpu_store)
+    _fill(oracle)
+    q = np.random.default_rng(1).normal(size=16)
+    got = tpu_store.query(q, top_k=7)
+    want = oracle.query(q, top_k=7)
+    assert [r.id for r in got] == [r.id for r in want]
+    np.testing.assert_allclose([r.score for r in got],
+                               [r.score for r in want], atol=2e-2)
+
+
+def test_filtered_query_selective_path(tpu_store, oracle):
+    _fill(tpu_store)
+    _fill(oracle)
+    q = np.random.default_rng(2).normal(size=16)
+    got = tpu_store.query(q, top_k=5, flt={"thread_id": "t3"})
+    want = oracle.query(q, top_k=5, flt={"thread_id": "t3"})
+    assert [r.id for r in got] == [r.id for r in want]
+    assert all(r.metadata["thread_id"] == "t3" for r in got)
+
+
+def test_upsert_and_delete(tpu_store):
+    _fill(tpu_store, n=10)
+    assert tpu_store.count() == 10
+    # upsert changes the vector in place
+    newv = np.zeros(16)
+    newv[0] = 1.0
+    tpu_store.add_embedding("v3", newv, {"thread_id": "tX"})
+    assert tpu_store.count() == 10
+    hits = tpu_store.query(newv, top_k=1)
+    assert hits[0].id == "v3"
+    assert tpu_store.delete(["v3", "v4"]) == 2
+    assert tpu_store.count() == 8
+    assert tpu_store.get("v3") is None
+    assert all(r.id not in ("v3", "v4")
+               for r in tpu_store.query(newv, top_k=8))
+
+
+def test_delete_by_filter(tpu_store):
+    _fill(tpu_store)
+    n = tpu_store.delete_by_filter({"thread_id": "t1"})
+    assert n == 10
+    assert tpu_store.count() == 40
+
+
+def test_growth_past_initial_capacity(tpu_store):
+    _fill(tpu_store, n=100)       # initial capacity is 16 → multiple grows
+    assert tpu_store.count() == 100
+    q = np.random.default_rng(3).normal(size=16)
+    assert len(tpu_store.query(q, top_k=10)) == 10
+
+
+def test_persistence_roundtrip(tpu_store, tmp_path):
+    _fill(tpu_store, n=20)
+    tpu_store.delete(["v0"])
+    path = str(tmp_path / "index.npz")
+    tpu_store.save(path)
+    other = create_vector_store({"driver": "tpu"})
+    assert other.load(path) == 19
+    q = np.random.default_rng(4).normal(size=16)
+    a = [r.id for r in tpu_store.query(q, top_k=5)]
+    b = [r.id for r in other.query(q, top_k=5)]
+    assert a == b
+
+
+def test_dimension_mismatch_raises(tpu_store):
+    tpu_store.add_embedding("a", np.ones(8))
+    import pytest as _p
+    from copilot_for_consensus_tpu.vectorstore.base import VectorStoreError
+    with _p.raises(VectorStoreError):
+        tpu_store.add_embedding("b", np.ones(9))
+
+
+def test_pipeline_runs_on_tpu_store(fixtures_dir):
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+    p = build_pipeline({"vector_store": {"driver": "tpu"}})
+    p.ingestion.create_source({
+        "source_id": "s", "name": "s", "fetcher": "local",
+        "location": str(fixtures_dir / "ietf-sample.mbox")})
+    stats = p.ingest_and_run("s")
+    assert stats["reports"] == stats["threads"] > 0

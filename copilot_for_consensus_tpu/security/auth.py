@@ -1,0 +1,361 @@
+"""Auth service: OIDC login (PKCE), local JWT mint, role store, middleware.
+
+Reference surface: ``auth/app/service.py:171`` (initiate_login ``:398``
+with PKCE pair + state + nonce, handle_callback ``:471``, validate_token
+``:583``, get_jwks ``:625``), ``app/role_store.py`` (roles admin / reader
+/ processor / orchestrator, ``README.md:99-112``), and the JWKS-backed
+route middleware (``copilot_auth/middleware.py:52,588``). Network OIDC
+providers (github/google/microsoft/datatracker) are config-selectable
+and egress-gated; the mock provider carries tests and local runs, as in
+the reference (``copilot_auth/mock_provider.py``).
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import hashlib
+import json
+import secrets as pysecrets
+import time
+import urllib.parse
+from typing import Any
+
+from copilot_for_consensus_tpu.security.jwt import JWTError, JWTManager
+from copilot_for_consensus_tpu.services.http import HTTPError, Request
+
+ROLES = ("admin", "reader", "processor", "orchestrator")
+
+
+class AuthError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# OIDC providers
+# ---------------------------------------------------------------------------
+
+
+class OIDCProvider(abc.ABC):
+    name = "base"
+    authorize_url = ""
+    token_url = ""
+    userinfo_url = ""
+
+    def __init__(self, client_id: str = "", client_secret: str = "",
+                 redirect_uri: str = ""):
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.redirect_uri = redirect_uri
+
+    def build_authorize_url(self, state: str, nonce: str,
+                            code_challenge: str) -> str:
+        params = {
+            "client_id": self.client_id,
+            "redirect_uri": self.redirect_uri,
+            "response_type": "code",
+            "scope": "openid email profile",
+            "state": state,
+            "nonce": nonce,
+            "code_challenge": code_challenge,
+            "code_challenge_method": "S256",
+        }
+        return self.authorize_url + "?" + urllib.parse.urlencode(params)
+
+    def exchange_code(self, code: str, code_verifier: str
+                      ) -> dict[str, Any]:
+        """code → token response (network)."""
+        import urllib.request
+        data = urllib.parse.urlencode({
+            "grant_type": "authorization_code",
+            "code": code,
+            "client_id": self.client_id,
+            "client_secret": self.client_secret,
+            "redirect_uri": self.redirect_uri,
+            "code_verifier": code_verifier,
+        }).encode()
+        req = urllib.request.Request(
+            self.token_url, data=data,
+            headers={"Accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return json.loads(resp.read())
+
+    def fetch_userinfo(self, access_token: str) -> dict[str, Any]:
+        import urllib.request
+        req = urllib.request.Request(
+            self.userinfo_url,
+            headers={"Authorization": f"Bearer {access_token}",
+                     "Accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return json.loads(resp.read())
+
+
+class GitHubProvider(OIDCProvider):
+    name = "github"
+    authorize_url = "https://github.com/login/oauth/authorize"
+    token_url = "https://github.com/login/oauth/access_token"
+    userinfo_url = "https://api.github.com/user"
+
+
+class GoogleProvider(OIDCProvider):
+    name = "google"
+    authorize_url = "https://accounts.google.com/o/oauth2/v2/auth"
+    token_url = "https://oauth2.googleapis.com/token"
+    userinfo_url = "https://openidconnect.googleapis.com/v1/userinfo"
+
+
+class MicrosoftProvider(OIDCProvider):
+    name = "microsoft"
+    authorize_url = ("https://login.microsoftonline.com/common/oauth2/"
+                     "v2.0/authorize")
+    token_url = ("https://login.microsoftonline.com/common/oauth2/"
+                 "v2.0/token")
+    userinfo_url = "https://graph.microsoft.com/oidc/userinfo"
+
+
+class DatatrackerProvider(OIDCProvider):
+    name = "datatracker"
+    authorize_url = "https://datatracker.ietf.org/oauth/authorize/"
+    token_url = "https://datatracker.ietf.org/oauth/token/"
+    userinfo_url = "https://datatracker.ietf.org/oauth/userinfo/"
+
+
+class MockProvider(OIDCProvider):
+    """In-process provider: any code of the form ``mock:<email>``
+    exchanges successfully. Test backbone."""
+
+    name = "mock"
+    authorize_url = "mock://authorize"
+
+    def exchange_code(self, code: str, code_verifier: str):
+        if not code.startswith("mock:"):
+            raise AuthError("mock code must be 'mock:<email>'")
+        return {"access_token": code}
+
+    def fetch_userinfo(self, access_token: str):
+        email = access_token.split(":", 1)[1]
+        return {"email": email, "sub": email,
+                "name": email.split("@")[0]}
+
+
+PROVIDERS = {cls.name: cls for cls in
+             (GitHubProvider, GoogleProvider, MicrosoftProvider,
+              DatatrackerProvider, MockProvider)}
+
+
+def create_oidc_provider(config: Any = None, **kwargs: Any) -> OIDCProvider:
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "mock")
+    cls = PROVIDERS.get(driver)
+    if cls is None:
+        raise ValueError(f"unknown oidc provider {driver!r}")
+    return cls(client_id=cfg.get("client_id", ""),
+               client_secret=cfg.get("client_secret", ""),
+               redirect_uri=cfg.get("redirect_uri", ""))
+
+
+# ---------------------------------------------------------------------------
+# Role store (reference auth/app/role_store.py)
+# ---------------------------------------------------------------------------
+
+
+class RoleStore:
+    COLLECTION = "user_roles"
+
+    def __init__(self, document_store, default_role: str = "reader"):
+        self.store = document_store
+        self.default_role = default_role
+
+    def roles_for(self, email: str) -> list[str]:
+        doc = self.store.get_document(self.COLLECTION, email)
+        if doc is None:
+            return [self.default_role] if self.default_role else []
+        return list(doc.get("roles", []))
+
+    def assign(self, email: str, roles: list[str]) -> None:
+        bad = set(roles) - set(ROLES)
+        if bad:
+            raise AuthError(f"unknown roles: {sorted(bad)}")
+        self.store.upsert_document(self.COLLECTION,
+                                   {"_id": email, "email": email,
+                                    "roles": sorted(set(roles))})
+
+    def remove(self, email: str) -> bool:
+        return self.store.delete_document(self.COLLECTION, email)
+
+    def list_users(self) -> list[dict]:
+        return self.store.query_documents(self.COLLECTION, {})
+
+
+# ---------------------------------------------------------------------------
+# Auth service
+# ---------------------------------------------------------------------------
+
+
+class AuthService:
+    def __init__(self, jwt_manager: JWTManager, role_store: RoleStore,
+                 providers: dict[str, OIDCProvider] | None = None,
+                 login_ttl_seconds: int = 600):
+        self.jwt = jwt_manager
+        self.roles = role_store
+        self.providers = providers or {"mock": MockProvider()}
+        self.login_ttl_seconds = login_ttl_seconds
+        self._pending: dict[str, dict[str, Any]] = {}  # state → login ctx
+
+    def initiate_login(self, provider: str = "mock") -> dict[str, str]:
+        prov = self.providers.get(provider)
+        if prov is None:
+            raise AuthError(f"unknown provider {provider!r}")
+        state = pysecrets.token_urlsafe(24)
+        nonce = pysecrets.token_urlsafe(16)
+        verifier = pysecrets.token_urlsafe(48)
+        challenge = base64.urlsafe_b64encode(
+            hashlib.sha256(verifier.encode()).digest()
+        ).rstrip(b"=").decode()
+        self._pending[state] = {
+            "provider": provider, "verifier": verifier, "nonce": nonce,
+            "expires": time.time() + self.login_ttl_seconds,
+        }
+        return {"state": state,
+                "authorize_url": prov.build_authorize_url(
+                    state, nonce, challenge)}
+
+    def handle_callback(self, state: str, code: str) -> dict[str, Any]:
+        ctx = self._pending.pop(state, None)
+        if ctx is None or ctx["expires"] < time.time():
+            raise AuthError("unknown or expired login state")
+        prov = self.providers[ctx["provider"]]
+        tokens = prov.exchange_code(code, ctx["verifier"])
+        info = prov.fetch_userinfo(tokens.get("access_token", ""))
+        email = info.get("email") or info.get("sub") or ""
+        if not email:
+            raise AuthError("provider returned no identity")
+        roles = self.roles.roles_for(email)
+        token = self.jwt.mint(email, roles=roles,
+                              extra_claims={"provider": prov.name,
+                                            "name": info.get("name", "")})
+        return {"access_token": token, "token_type": "Bearer",
+                "email": email, "roles": roles}
+
+    def validate_token(self, token: str) -> dict[str, Any]:
+        try:
+            return self.jwt.verify(token)
+        except JWTError as exc:
+            raise AuthError(str(exc)) from exc
+
+    def get_jwks(self) -> dict[str, Any]:
+        return self.jwt.jwks()
+
+
+# ---------------------------------------------------------------------------
+# HTTP middleware (reference copilot_auth/middleware.py:52,588)
+# ---------------------------------------------------------------------------
+
+PUBLIC_PATHS = ("/health", "/readyz", "/metrics", "/auth/login",
+                "/auth/callback", "/.well-known/jwks.json")
+
+
+def create_jwt_middleware(jwt_manager: JWTManager,
+                          required_roles: dict[str, list[str]]
+                          | None = None,
+                          public_paths=PUBLIC_PATHS):
+    """Router middleware: verifies Bearer tokens, stamps claims into
+    ``req.context``, enforces per-path-prefix role requirements."""
+    required_roles = required_roles or {}
+
+    def middleware(req: Request) -> None:
+        if any(req.path.startswith(p) for p in public_paths):
+            return
+        header = req.headers.get("Authorization") or req.headers.get(
+            "authorization") or ""
+        if not header.startswith("Bearer "):
+            raise HTTPError(401, "missing bearer token")
+        try:
+            claims = jwt_manager.verify(header[7:])
+        except JWTError as exc:
+            raise HTTPError(401, f"invalid token: {exc}")
+        req.context.update(claims)
+        roles = set(claims.get("roles", []))
+        for prefix, needed in required_roles.items():
+            if req.path.startswith(prefix):
+                if not roles.intersection(needed):
+                    raise HTTPError(
+                        403, f"requires one of roles {needed}")
+                break
+
+    return middleware
+
+
+def auth_router(service: AuthService):
+    """Auth HTTP surface (reference ``auth/main.py:115-1074``)."""
+    from copilot_for_consensus_tpu.services.http import Router
+
+    router = Router()
+
+    @router.get("/auth/login")
+    def login(req):
+        return service.initiate_login(req.query.get("provider", "mock"))
+
+    @router.get("/auth/callback")
+    def callback(req):
+        state = req.query.get("state", "")
+        code = req.query.get("code", "")
+        try:
+            return service.handle_callback(state, code)
+        except AuthError as exc:
+            raise HTTPError(401, str(exc))
+
+    @router.get("/auth/userinfo")
+    def userinfo(req):
+        header = req.headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            raise HTTPError(401, "missing bearer token")
+        try:
+            claims = service.validate_token(header[7:])
+        except AuthError as exc:
+            raise HTTPError(401, str(exc))
+        return {"sub": claims.get("sub"), "roles": claims.get("roles"),
+                "provider": claims.get("provider")}
+
+    @router.get("/.well-known/jwks.json")
+    def jwks(req):
+        return service.get_jwks()
+
+    @router.get("/auth/admin/users")
+    def list_users(req):
+        _require_admin(req, service)
+        return {"users": service.roles.list_users()}
+
+    @router.put("/auth/admin/users/{email}")
+    def assign_roles(req):
+        _require_admin(req, service)
+        body = req.json()
+        if not isinstance(body, dict) or "roles" not in body:
+            raise HTTPError(400, "body must have roles")
+        try:
+            service.roles.assign(req.params["email"], body["roles"])
+        except AuthError as exc:
+            raise HTTPError(400, str(exc))
+        return {"email": req.params["email"],
+                "roles": service.roles.roles_for(req.params["email"])}
+
+    @router.delete("/auth/admin/users/{email}")
+    def remove_user(req):
+        _require_admin(req, service)
+        if not service.roles.remove(req.params["email"]):
+            raise HTTPError(404, "user not found")
+        return {"status": "removed"}
+
+    return router
+
+
+def _require_admin(req: Request, service: AuthService) -> None:
+    header = req.headers.get("Authorization", "")
+    if not header.startswith("Bearer "):
+        raise HTTPError(401, "missing bearer token")
+    try:
+        claims = service.validate_token(header[7:])
+    except AuthError as exc:
+        raise HTTPError(401, str(exc))
+    if "admin" not in claims.get("roles", []):
+        raise HTTPError(403, "admin role required")

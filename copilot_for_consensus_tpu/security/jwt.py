@@ -1,0 +1,212 @@
+"""JWT mint/verify (RS256 + JWKS) and signing backends.
+
+Parity with the reference's ``copilot_auth/jwt_manager.py:35`` (mint /
+verify RS256 with JWKS publication) and ``copilot_jwt_signer`` (signer
+ABC with local-PEM and KMS drivers). Implemented on ``cryptography``
+directly — no PyJWT in the image, and the JWS subset needed (RS256/HS256
+compact serialization) is small enough to own.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import hashlib
+import hmac as hmac_mod
+import json
+import time
+import uuid
+from typing import Any
+
+
+class JWTError(Exception):
+    pass
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def _b64url_uint(n: int) -> str:
+    raw = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return _b64url(raw)
+
+
+# ---------------------------------------------------------------------------
+# Signers (reference: copilot_jwt_signer)
+# ---------------------------------------------------------------------------
+
+
+class JWTSigner(abc.ABC):
+    alg: str = ""
+    kid: str = ""
+
+    @abc.abstractmethod
+    def sign(self, signing_input: bytes) -> bytes: ...
+
+    @abc.abstractmethod
+    def verify(self, signing_input: bytes, signature: bytes) -> bool: ...
+
+    def public_jwk(self) -> dict[str, Any] | None:
+        return None
+
+
+class LocalRS256Signer(JWTSigner):
+    """RSA keypair signer (reference ``local_signer.py``): generates a
+    keypair on first use or loads PEM from disk/secret."""
+
+    alg = "RS256"
+
+    def __init__(self, private_pem: bytes | str | None = None,
+                 key_size: int = 2048):
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.hazmat.primitives.serialization import (
+            load_pem_private_key,
+        )
+
+        if private_pem:
+            pem = (private_pem.encode() if isinstance(private_pem, str)
+                   else private_pem)
+            self._key = load_pem_private_key(pem, password=None)
+        else:
+            self._key = rsa.generate_private_key(
+                public_exponent=65537, key_size=key_size)
+        pub = self._key.public_key().public_numbers()
+        digest = hashlib.sha256(
+            f"{pub.n:x}:{pub.e:x}".encode()).hexdigest()
+        self.kid = digest[:16]
+
+    def private_pem(self) -> bytes:
+        from cryptography.hazmat.primitives import serialization
+        return self._key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption())
+
+    def sign(self, signing_input: bytes) -> bytes:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        return self._key.sign(signing_input, padding.PKCS1v15(),
+                              hashes.SHA256())
+
+    def verify(self, signing_input: bytes, signature: bytes) -> bool:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        try:
+            self._key.public_key().verify(
+                signature, signing_input, padding.PKCS1v15(),
+                hashes.SHA256())
+            return True
+        except InvalidSignature:
+            return False
+
+    def public_jwk(self) -> dict[str, Any]:
+        pub = self._key.public_key().public_numbers()
+        return {"kty": "RSA", "use": "sig", "alg": "RS256",
+                "kid": self.kid, "n": _b64url_uint(pub.n),
+                "e": _b64url_uint(pub.e)}
+
+
+class HS256Signer(JWTSigner):
+    """Shared-secret HMAC signer (single-tenant deployments/tests)."""
+
+    alg = "HS256"
+
+    def __init__(self, secret: str | bytes):
+        self._secret = secret.encode() if isinstance(secret, str) else secret
+        self.kid = hashlib.sha256(self._secret).hexdigest()[:16]
+
+    def sign(self, signing_input: bytes) -> bytes:
+        return hmac_mod.new(self._secret, signing_input,
+                            hashlib.sha256).digest()
+
+    def verify(self, signing_input: bytes, signature: bytes) -> bool:
+        return hmac_mod.compare_digest(self.sign(signing_input), signature)
+
+
+def create_jwt_signer(config: Any = None, **kwargs: Any) -> JWTSigner:
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "local_rs256")
+    if driver == "local_rs256":
+        return LocalRS256Signer(private_pem=cfg.get("private_pem")
+                                or kwargs.get("private_pem"))
+    if driver == "hs256":
+        secret = cfg.get("secret") or kwargs.get("secret")
+        if not secret:
+            raise ValueError("hs256 signer needs a secret")
+        return HS256Signer(secret)
+    raise ValueError(f"unknown jwt_signer driver {driver!r}")
+
+
+# ---------------------------------------------------------------------------
+# JWT manager (reference: copilot_auth/jwt_manager.py:35)
+# ---------------------------------------------------------------------------
+
+
+class JWTManager:
+    def __init__(self, signer: JWTSigner, issuer: str = "copilot",
+                 audience: str = "copilot-api",
+                 ttl_seconds: int = 3600):
+        self.signer = signer
+        self.issuer = issuer
+        self.audience = audience
+        self.ttl_seconds = ttl_seconds
+
+    def mint(self, subject: str, roles: list[str] | None = None,
+             extra_claims: dict[str, Any] | None = None,
+             ttl_seconds: int | None = None) -> str:
+        now = int(time.time())
+        claims = {
+            "iss": self.issuer, "aud": self.audience, "sub": subject,
+            "iat": now, "exp": now + (ttl_seconds or self.ttl_seconds),
+            "jti": uuid.uuid4().hex, "roles": roles or [],
+            **(extra_claims or {}),
+        }
+        header = {"alg": self.signer.alg, "typ": "JWT",
+                  "kid": self.signer.kid}
+        signing_input = (
+            _b64url(json.dumps(header, separators=(",", ":")).encode())
+            + "." +
+            _b64url(json.dumps(claims, separators=(",", ":")).encode())
+        ).encode()
+        sig = self.signer.sign(signing_input)
+        return signing_input.decode() + "." + _b64url(sig)
+
+    def verify(self, token: str, *, verify_aud: bool = True
+               ) -> dict[str, Any]:
+        """Returns the claims; raises JWTError on any failure."""
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise JWTError("malformed token")
+        signing_input = (parts[0] + "." + parts[1]).encode()
+        try:
+            header = json.loads(_b64url_decode(parts[0]))
+            claims = json.loads(_b64url_decode(parts[1]))
+            sig = _b64url_decode(parts[2])
+        except Exception as exc:
+            raise JWTError(f"undecodable token: {exc}") from exc
+        if header.get("alg") != self.signer.alg:
+            raise JWTError(
+                f"algorithm mismatch: {header.get('alg')}")
+        if not self.signer.verify(signing_input, sig):
+            raise JWTError("signature verification failed")
+        now = time.time()
+        if claims.get("exp") is not None and now > claims["exp"]:
+            raise JWTError("token expired")
+        if claims.get("nbf") is not None and now < claims["nbf"]:
+            raise JWTError("token not yet valid")
+        if claims.get("iss") != self.issuer:
+            raise JWTError("issuer mismatch")
+        if verify_aud and claims.get("aud") != self.audience:
+            raise JWTError("audience mismatch")
+        return claims
+
+    def jwks(self) -> dict[str, Any]:
+        jwk = self.signer.public_jwk()
+        return {"keys": [jwk] if jwk else []}

@@ -1,0 +1,181 @@
+"""REST APIs over the pipeline services.
+
+Route surface mirrors the reference:
+* ingestion — sources CRUD, trigger, upload
+  (``ingestion/app/api.py:137-326``),
+* reporting — reports list/get/search, threads/messages/chunks browse,
+  sources (``reporting/main.py:73-474``).
+
+Handlers are thin adapters from HTTP to the service classes; auth is a
+router middleware (``security.middleware``) installed by the bootstrap
+when enabled.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any
+
+from copilot_for_consensus_tpu.services.http import (
+    HTTPError,
+    Request,
+    Router,
+)
+
+
+def _int(req: Request, key: str, default: int, lo: int = 0,
+         hi: int = 1000) -> int:
+    try:
+        return max(lo, min(hi, int(req.query.get(key, default))))
+    except ValueError:
+        raise HTTPError(400, f"invalid {key}")
+
+
+def ingestion_router(service) -> Router:
+    router = Router()
+
+    @router.get("/api/sources")
+    def list_sources(req):
+        return {"sources": service.list_sources()}
+
+    @router.post("/api/sources")
+    def create_source(req):
+        body = req.json()
+        if not isinstance(body, dict) or not body.get("name"):
+            raise HTTPError(400, "body must be a source object with name")
+        return service.create_source(body), 201
+
+    @router.get("/api/sources/{source_id}")
+    def get_source(req):
+        doc = service.get_source(req.params["source_id"])
+        if doc is None:
+            raise HTTPError(404, "source not found")
+        return doc
+
+    @router.put("/api/sources/{source_id}")
+    def update_source(req):
+        body = req.json()
+        if not isinstance(body, dict):
+            raise HTTPError(400, "body must be an object")
+        if not service.update_source(req.params["source_id"], body):
+            raise HTTPError(404, "source not found")
+        return service.get_source(req.params["source_id"])
+
+    @router.delete("/api/sources/{source_id}")
+    def delete_source(req):
+        if service.get_source(req.params["source_id"]) is None:
+            raise HTTPError(404, "source not found")
+        service.delete_source(
+            req.params["source_id"],
+            requested_by=req.context.get("sub", ""))
+        return {"status": "deletion requested"}, 202
+
+    @router.post("/api/sources/{source_id}/trigger")
+    def trigger(req):
+        try:
+            ingested = service.trigger_source(req.params["source_id"])
+        except KeyError:
+            raise HTTPError(404, "source not found")
+        return {"ingested_archives": ingested}, 202
+
+    @router.post("/api/upload")
+    def upload(req):
+        """Direct archive upload: {"filename": ..., "content_b64": ...,
+        "source_id": ...} (reference upload endpoint)."""
+        body = req.json()
+        if not isinstance(body, dict) or "content_b64" not in body:
+            raise HTTPError(400, "need content_b64")
+        try:
+            content = base64.b64decode(body["content_b64"])
+        except Exception:
+            raise HTTPError(400, "content_b64 is not valid base64")
+        source_id = body.get("source_id", "upload")
+        if service.get_source(source_id) is None:
+            service.create_source({"source_id": source_id,
+                                   "name": source_id,
+                                   "fetcher": "upload"})
+        archive_id = service.ingest_archive(
+            source_id=source_id, content=content,
+            filename=body.get("filename", "upload.mbox"))
+        if archive_id is None:
+            return {"status": "duplicate", "archive_id": None}
+        return {"status": "ingested", "archive_id": archive_id}, 201
+
+    return router
+
+
+def reporting_router(service) -> Router:
+    router = Router()
+
+    @router.get("/api/reports")
+    def reports(req):
+        return {"reports": service.get_reports(
+            thread_id=req.query.get("thread_id"),
+            sort_by=req.query.get("sort_by", "published_at"),
+            descending=req.query.get("order", "desc") != "asc",
+            offset=_int(req, "offset", 0, hi=1 << 30),
+            limit=_int(req, "limit", 50))}
+
+    @router.get("/api/reports/search")
+    def search(req):
+        topic = req.query.get("topic", "")
+        if not topic:
+            raise HTTPError(400, "topic query parameter required")
+        semantic = req.query.get("semantic")
+        return {"reports": service.search_reports(
+            topic, limit=_int(req, "limit", 20),
+            semantic=None if semantic is None else semantic == "true")}
+
+    @router.get("/api/reports/{report_id}")
+    def report(req):
+        doc = service.get_report(req.params["report_id"])
+        if doc is None:
+            raise HTTPError(404, "report not found")
+        return doc
+
+    @router.get("/api/threads")
+    def threads(req):
+        return {"threads": service.get_threads(
+            offset=_int(req, "offset", 0, hi=1 << 30),
+            limit=_int(req, "limit", 50))}
+
+    @router.get("/api/threads/{thread_id}")
+    def thread(req):
+        doc = service.get_thread(req.params["thread_id"])
+        if doc is None:
+            raise HTTPError(404, "thread not found")
+        return doc
+
+    @router.get("/api/threads/{thread_id}/messages")
+    def thread_messages(req):
+        return {"messages": service.get_messages(
+            req.params["thread_id"],
+            offset=_int(req, "offset", 0, hi=1 << 30),
+            limit=_int(req, "limit", 50))}
+
+    @router.get("/api/messages")
+    def messages(req):
+        return {"messages": service.get_messages(
+            req.query.get("thread_id"),
+            offset=_int(req, "offset", 0, hi=1 << 30),
+            limit=_int(req, "limit", 50))}
+
+    @router.get("/api/messages/{message_doc_id}")
+    def message(req):
+        doc = service.get_message(req.params["message_doc_id"])
+        if doc is None:
+            raise HTTPError(404, "message not found")
+        return doc
+
+    @router.get("/api/messages/{message_doc_id}/chunks")
+    def message_chunks(req):
+        return {"chunks": service.get_chunks(
+            req.params["message_doc_id"],
+            offset=_int(req, "offset", 0, hi=1 << 30),
+            limit=_int(req, "limit", 50))}
+
+    @router.get("/api/sources")
+    def sources(req):
+        return {"sources": service.get_sources()}
+
+    return router

@@ -1,0 +1,177 @@
+"""Service bootstrap: config → adapters → service → consumer + HTTP.
+
+The canonical boot shape of every reference service
+(``embedding/main.py:169-406``): load typed config, construct adapters
+via factories, wire the service class, start the subscriber thread
+(non-daemon, fail-fast — ``:125-143,386-391``), serve health + REST over
+HTTP. ``serve_pipeline`` runs the whole stack in one process (the
+single-host / single-TPU-VM deployment mode); per-service processes use
+``ServiceRuntime`` with the zmq bus driver instead.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from copilot_for_consensus_tpu.obs.logging import get_logger
+from copilot_for_consensus_tpu.services.http import (
+    HTTPServer,
+    Router,
+    health_router,
+)
+
+
+@dataclass
+class ServiceRuntime:
+    """One service's runtime: consumer thread + HTTP server."""
+
+    service: Any
+    subscriber: Any
+    router: Router
+    host: str = "127.0.0.1"
+    port: int = 0
+    http: HTTPServer | None = None
+    _consumer: threading.Thread | None = field(default=None, repr=False)
+    _started: bool = field(default=False, repr=False)
+
+    def start(self) -> "ServiceRuntime":
+        self.service.startup()                    # startup requeue
+        self.subscriber.subscribe(self.service.routing_keys(),
+                                  self.service.handle_envelope)
+        self._consumer = threading.Thread(
+            target=self.subscriber.start_consuming,
+            name=f"{self.service.name}-consumer", daemon=True)
+        self._consumer.start()
+        self.http = HTTPServer(self.router, self.host, self.port)
+        self.http.start()
+        self._started = True
+        get_logger().info("service started", service=self.service.name,
+                          port=self.http.port)
+        return self
+
+    def consumer_alive(self) -> bool:
+        return self._consumer is not None and self._consumer.is_alive()
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self.subscriber.stop()
+        if self.http is not None:
+            self.http.stop()
+        self._started = False
+
+
+def build_service_router(service, *, metrics=None, extra: Router | None
+                         = None, ready_check=None,
+                         auth_middleware=None) -> Router:
+    router = Router()
+    router.merge(health_router(
+        service.name,
+        ready_check=ready_check,
+        stats=getattr(service, "stats", None),
+        metrics=metrics))
+    if extra is not None:
+        router.merge(extra)
+    if auth_middleware is not None:
+        router.middleware.append(auth_middleware)
+    return router
+
+
+@dataclass
+class PipelineServer:
+    """Single-process deployment: full pipeline + gateway-style router."""
+
+    pipeline: Any
+    http: HTTPServer
+    auth_service: Any = None
+    _stop: threading.Event = field(default_factory=threading.Event)
+    _pump: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def start(self) -> "PipelineServer":
+        self.pipeline.startup()
+        self._pump = threading.Thread(
+            target=self.pipeline.broker.run_forever, args=(self._stop,),
+            name="bus-pump", daemon=True)
+        self._pump.start()
+        self.http.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.http.stop()
+
+
+def serve_pipeline(config: Mapping[str, Any] | None = None,
+                   host: str = "127.0.0.1", port: int = 0
+                   ) -> PipelineServer:
+    """Build the pipeline + one unified HTTP surface (the role of the
+    reference's nginx gateway: /ingestion + /reporting + /auth under one
+    port, ``infra/nginx/nginx.conf``)."""
+    from copilot_for_consensus_tpu.security.auth import (
+        AuthService,
+        RoleStore,
+        auth_router,
+        create_jwt_middleware,
+        create_oidc_provider,
+    )
+    from copilot_for_consensus_tpu.security.jwt import (
+        JWTManager,
+        create_jwt_signer,
+    )
+    from copilot_for_consensus_tpu.services.api import (
+        ingestion_router,
+        reporting_router,
+    )
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    cfg = dict(config or {})
+    pipeline = build_pipeline(cfg)
+
+    router = Router()
+    router.merge(health_router(
+        "pipeline",
+        stats=pipeline.reporting.stats,
+        metrics=pipeline.metrics))
+    router.merge(ingestion_router(pipeline.ingestion))
+    router.merge(reporting_router(pipeline.reporting))
+
+    auth_service = None
+    auth_cfg = cfg.get("auth")
+    if auth_cfg is not None:
+        signer = create_jwt_signer(auth_cfg.get("signer",
+                                                {"driver": "local_rs256"}))
+        jwt = JWTManager(signer,
+                         issuer=auth_cfg.get("issuer", "copilot"),
+                         audience=auth_cfg.get("audience", "copilot-api"))
+        roles = RoleStore(pipeline.store,
+                          default_role=auth_cfg.get("default_role",
+                                                    "reader"))
+        for email, user_roles in (auth_cfg.get("bootstrap_admins")
+                                  or {}).items():
+            roles.assign(email, user_roles)
+        providers = {
+            name: create_oidc_provider({"driver": name, **pcfg})
+            for name, pcfg in (auth_cfg.get("providers")
+                               or {"mock": {}}).items()
+        }
+        auth_service = AuthService(jwt, roles, providers)
+        router.merge(auth_router(auth_service))
+        if auth_cfg.get("require_auth", True):
+            router.middleware.append(create_jwt_middleware(
+                jwt,
+                required_roles=auth_cfg.get("required_roles", {
+                    "/api/sources": ["admin", "processor"],
+                    "/api/upload": ["admin", "processor"],
+                })))
+
+    server = PipelineServer(
+        pipeline=pipeline,
+        http=HTTPServer(router, host, port),
+        auth_service=auth_service)
+    return server

@@ -1,0 +1,204 @@
+"""Stdlib HTTP micro-framework for service endpoints.
+
+FastAPI/uvicorn are not in this image (and are heavier than the need):
+every service exposes /health, /readyz, /stats, /metrics plus its REST
+routes (reference: ``embedding/main.py:396-402``, ``reporting/main.py:
+73-474``, ``ingestion/app/api.py:137-326``). This router + threading
+HTTP server covers that surface with zero dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(self, method: str, path: str, query: dict[str, str],
+                 headers: dict[str, str], body: bytes,
+                 params: dict[str, str]):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.params = params          # path parameters
+        self.context: dict[str, Any] = {}   # set by middleware (auth)
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError as exc:
+            raise HTTPError(400, f"invalid JSON body: {exc}") from exc
+
+
+class Response:
+    def __init__(self, body: Any = None, status: int = 200,
+                 content_type: str = "application/json",
+                 headers: dict[str, str] | None = None):
+        self.status = status
+        self.content_type = content_type
+        self.headers = headers or {}
+        if isinstance(body, (bytes, str)):
+            self.raw = body.encode() if isinstance(body, str) else body
+        else:
+            self.raw = json.dumps(body).encode()
+
+
+Handler = Callable[[Request], Response | dict | list | tuple | None]
+Middleware = Callable[[Request], None]   # raises HTTPError to reject
+
+
+class Router:
+    """Path-pattern routing: ``/api/sources/{name}/trigger``."""
+
+    def __init__(self):
+        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+        self.middleware: list[Middleware] = []
+
+    def route(self, method: str, pattern: str):
+        regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+
+        def deco(fn: Handler) -> Handler:
+            self._routes.append((method.upper(), regex, fn))
+            return fn
+        return deco
+
+    def get(self, pattern: str):
+        return self.route("GET", pattern)
+
+    def post(self, pattern: str):
+        return self.route("POST", pattern)
+
+    def put(self, pattern: str):
+        return self.route("PUT", pattern)
+
+    def delete(self, pattern: str):
+        return self.route("DELETE", pattern)
+
+    def merge(self, other: "Router", prefix: str = "") -> None:
+        for method, regex, fn in other._routes:
+            pattern = prefix + regex.pattern.strip("^$")
+            self._routes.append((method, re.compile("^" + pattern + "$"),
+                                 fn))
+
+    def dispatch(self, method: str, raw_path: str,
+                 headers: dict[str, str], body: bytes) -> Response:
+        parsed = urlparse(raw_path)
+        query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+        matched_path = False
+        for m, regex, fn in self._routes:
+            match = regex.match(parsed.path)
+            if match is None:
+                continue
+            matched_path = True
+            if m != method.upper():
+                continue
+            req = Request(method.upper(), parsed.path, query, headers,
+                          body, match.groupdict())
+            try:
+                for mw in self.middleware:
+                    mw(req)
+                out = fn(req)
+            except HTTPError as exc:
+                return Response({"error": exc.message}, status=exc.status)
+            if isinstance(out, Response):
+                return out
+            if isinstance(out, tuple):       # (body, status)
+                return Response(out[0], status=out[1])
+            if out is None:
+                return Response("", status=204, content_type="text/plain")
+            return Response(out)
+        if matched_path:
+            return Response({"error": "method not allowed"}, status=405)
+        return Response({"error": "not found"}, status=404)
+
+
+class HTTPServer:
+    """Threaded server around a Router; ``start()`` is non-blocking."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        router_ref = router
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _serve(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                resp = router_ref.dispatch(
+                    self.command, self.path, dict(self.headers), body)
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(resp.raw)))
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(resp.raw)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _serve
+
+            def log_message(self, *args):  # quiet by default
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="http-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def health_router(service_name: str, *, ready_check=None, stats=None,
+                  metrics=None) -> Router:
+    """The /health /readyz /stats /metrics quartet every service exposes
+    (reference ``embedding/main.py:68-111,396-402``)."""
+    router = Router()
+
+    @router.get("/health")
+    def health(req):
+        return {"status": "ok", "service": service_name}
+
+    @router.get("/readyz")
+    def readyz(req):
+        if ready_check is not None and not ready_check():
+            return {"status": "not ready", "service": service_name}, 503
+        return {"status": "ready", "service": service_name}
+
+    @router.get("/stats")
+    def stats_ep(req):
+        return stats() if stats is not None else {}
+
+    @router.get("/metrics")
+    def metrics_ep(req):
+        if metrics is None or not hasattr(metrics, "render_prometheus"):
+            return Response("", content_type="text/plain")
+        return Response(metrics.render_prometheus(),
+                        content_type="text/plain; version=0.0.4")
+
+    return router

@@ -1,0 +1,2 @@
+"""Operator tooling (reference: ``scripts/`` — failed-queue CLI,
+retry-stuck-documents job)."""

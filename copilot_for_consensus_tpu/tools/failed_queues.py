@@ -1,0 +1,141 @@
+"""Failed-queue operator CLI: list / inspect / requeue / purge.
+
+Parity with the reference's ``scripts/manage_failed_queues.py:41-48``.
+Failure events land on ``*.failed`` queues (and bus-level dead letters on
+``*.dlq``); this tool lets an operator inspect them and push the
+originating work back through the pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any
+
+from copilot_for_consensus_tpu.bus.inproc import InProcBroker
+from copilot_for_consensus_tpu.core.events import (
+    EVENT_TYPES,
+    FAILURE_EVENT_TYPES,
+    make_event,
+)
+
+# failure event type → (trigger event type, field mapping fn)
+_REQUEUE_MAP = {
+    "ArchiveIngestionFailed": None,           # re-trigger the source instead
+    "ParsingFailed": ("ArchiveIngested",
+                      lambda d: {"archive_id": d.get("archive_id", "")}),
+    "ChunkingFailed": ("JSONParsed",
+                       lambda d: {"message_doc_id":
+                                  d.get("message_doc_id", "")}),
+    "EmbeddingGenerationFailed": ("ChunksPrepared",
+                                  lambda d: {"chunk_ids":
+                                             d.get("chunk_ids", [])}),
+    "OrchestrationFailed": ("EmbeddingsGenerated",
+                            lambda d: {"thread_ids":
+                                       [d.get("thread_id", "")]}),
+    "SummarizationFailed": None,              # orchestrator re-decides
+    "ReportDeliveryFailed": ("SummaryComplete",
+                             lambda d: {"summary_id":
+                                        d.get("summary_id", "")}),
+}
+
+
+class FailedQueueManager:
+    """Programmatic surface; the CLI below is a thin wrapper."""
+
+    def __init__(self, broker: InProcBroker, publisher=None):
+        self.broker = broker
+        self.publisher = publisher
+
+    def failed_routing_keys(self) -> list[str]:
+        return sorted(EVENT_TYPES[t].routing_key
+                      for t in FAILURE_EVENT_TYPES)
+
+    def list_queues(self) -> dict[str, int]:
+        out = {}
+        for rk in self.failed_routing_keys():
+            depth = self.broker.queue_depth(rk)
+            if depth:
+                out[rk] = depth
+        for (rk, _group), q in list(self.broker._queues.items()):
+            if rk.endswith(".dlq") and q.items:
+                out[rk] = out.get(rk, 0) + len(q.items)
+        return out
+
+    def inspect(self, routing_key: str, limit: int = 10
+                ) -> list[dict[str, Any]]:
+        envs = self.broker._pending.get(routing_key, [])
+        out = [dict(e) for e, _ in list(envs)[:limit]]
+        for (rk, _g), q in self.broker._queues.items():
+            if rk == routing_key:
+                out.extend(dict(e) for e, _ in list(q.items)[:limit])
+        return out[:limit]
+
+    def requeue(self, routing_key: str, limit: int | None = None) -> int:
+        """Convert failure envelopes back into their trigger events."""
+        if self.publisher is None:
+            raise RuntimeError("requeue needs a publisher")
+        envelopes = self._drain(routing_key, limit)
+        n = 0
+        for env in envelopes:
+            etype = env.get("event_type", "")
+            mapping = _REQUEUE_MAP.get(etype)
+            if mapping is None:
+                continue
+            trigger_type, extract = mapping
+            data = dict(env.get("data", {}))
+            fields = extract(data)
+            fields["correlation_id"] = data.get("correlation_id", "")
+            self.publisher.publish(make_event(trigger_type, **fields))
+            n += 1
+        return n
+
+    def purge(self, routing_key: str) -> int:
+        return len(self._drain(routing_key, None))
+
+    def _drain(self, routing_key: str, limit: int | None
+               ) -> list[dict[str, Any]]:
+        out: list[dict[str, Any]] = []
+        pending = self.broker._pending.get(routing_key)
+        while pending and (limit is None or len(out) < limit):
+            out.append(dict(pending.popleft()[0]))
+        for (rk, _g), q in self.broker._queues.items():
+            if rk != routing_key:
+                continue
+            while q.items and (limit is None or len(out) < limit):
+                out.append(dict(q.items.popleft()[0]))
+        return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    from copilot_for_consensus_tpu.bus.inproc import (
+        InProcPublisher,
+        get_broker,
+    )
+
+    parser = argparse.ArgumentParser(description="failed-queue operator CLI")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list")
+    for cmd in ("inspect", "requeue", "purge"):
+        p = sub.add_parser(cmd)
+        p.add_argument("routing_key")
+        if cmd != "purge":
+            p.add_argument("--limit", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    broker = get_broker()
+    mgr = FailedQueueManager(broker, InProcPublisher(broker=broker))
+    if args.cmd == "list":
+        print(json.dumps(mgr.list_queues(), indent=2))
+    elif args.cmd == "inspect":
+        print(json.dumps(mgr.inspect(args.routing_key, args.limit),
+                         indent=2))
+    elif args.cmd == "requeue":
+        print(mgr.requeue(args.routing_key, args.limit))
+    elif args.cmd == "purge":
+        print(mgr.purge(args.routing_key))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

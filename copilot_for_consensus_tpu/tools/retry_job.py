@@ -1,0 +1,116 @@
+"""Periodic stuck-document retry job.
+
+Parity with the reference's ``scripts/retry_stuck_documents.py:143``:
+scan each collection for documents stuck mid-pipeline longer than a
+threshold, re-publish their trigger events with exponential backoff
+(5/10/20 → 60 min schedule, ``:280``), bounded per-document attempts
+(``attempt_count`` / ``last_attempt_at``), run in a loop (``:575``) or
+one-shot.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Any, Callable
+
+from copilot_for_consensus_tpu.core import events as ev
+
+
+def _now_iso() -> str:
+    return datetime.now(timezone.utc).isoformat()
+
+
+def _age_seconds(iso: str | None, now: float) -> float:
+    if not iso:
+        return float("inf")
+    try:
+        return now - datetime.fromisoformat(iso).timestamp()
+    except ValueError:
+        return float("inf")
+
+
+@dataclass
+class RetryRule:
+    collection: str
+    stuck_filter: dict[str, Any]
+    event_factory: Callable[[dict], ev.Event]
+    max_attempts: int = 5
+    # exponential schedule (minutes): attempt n waits schedule[min(n, last)]
+    backoff_minutes: tuple[float, ...] = (5, 10, 20, 60)
+
+
+def default_rules() -> list[RetryRule]:
+    return [
+        RetryRule(
+            "archives", {"parsed": False},
+            lambda d: ev.ArchiveIngested(
+                archive_id=d["archive_id"],
+                source_id=d.get("source_id", ""),
+                archive_uri=d.get("uri", "")),
+            max_attempts=3),
+        RetryRule(
+            "messages", {"chunked": False},
+            lambda d: ev.JSONParsed(
+                message_doc_id=d["message_doc_id"],
+                archive_id=d.get("archive_id", ""),
+                thread_id=d.get("thread_id", "")),
+            max_attempts=5),
+        RetryRule(
+            "chunks", {"embedding_generated": False},
+            lambda d: ev.ChunksPrepared(
+                message_doc_id=d.get("message_doc_id", ""),
+                thread_id=d.get("thread_id", ""),
+                archive_id=d.get("archive_id", ""),
+                chunk_ids=[d["chunk_id"]]),
+            max_attempts=5),
+    ]
+
+
+@dataclass
+class RetryStuckDocumentsJob:
+    store: Any
+    publisher: Any
+    rules: list[RetryRule] = field(default_factory=default_rules)
+    min_stuck_seconds: float = 300.0
+
+    def run_once(self, now: float | None = None) -> dict[str, int]:
+        """One sweep; returns per-collection requeue counts."""
+        now = time.time() if now is None else now
+        counts: dict[str, int] = {}
+        for rule in self.rules:
+            pk = self._primary_key(rule.collection)
+            n = 0
+            for doc in self.store.query_documents(rule.collection,
+                                                  rule.stuck_filter):
+                attempts = int(doc.get("attempt_count", 0))
+                if attempts >= rule.max_attempts:
+                    continue
+                ref_ts = doc.get("last_attempt_at") or doc.get(
+                    "ingested_at") or doc.get("parsed_at")
+                age = _age_seconds(ref_ts, now)
+                backoff = rule.backoff_minutes[
+                    min(attempts, len(rule.backoff_minutes) - 1)] * 60
+                if age < max(self.min_stuck_seconds, backoff):
+                    continue
+                self.publisher.publish(rule.event_factory(doc))
+                self.store.update_document(rule.collection, doc[pk], {
+                    "attempt_count": attempts + 1,
+                    "last_attempt_at": _now_iso(),
+                })
+                n += 1
+            counts[rule.collection] = n
+        return counts
+
+    @staticmethod
+    def _primary_key(collection: str) -> str:
+        from copilot_for_consensus_tpu.storage.registry import primary_key
+        return primary_key(collection)
+
+    def run_loop(self, interval_seconds: float = 300.0,
+                 stop_flag=None) -> None:
+        import threading
+        stop = stop_flag or threading.Event()
+        while not stop.wait(interval_seconds):
+            self.run_once()

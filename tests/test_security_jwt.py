@@ -1,0 +1,87 @@
+# JWT mint/verify: RS256 + JWKS, HS256, expiry/claim checks.
+import time
+
+import pytest
+
+from copilot_for_consensus_tpu.security.jwt import (
+    HS256Signer,
+    JWTError,
+    JWTManager,
+    LocalRS256Signer,
+    create_jwt_signer,
+)
+
+
+@pytest.fixture(scope="module")
+def rs_manager():
+    return JWTManager(LocalRS256Signer(), issuer="iss", audience="aud")
+
+
+def test_rs256_roundtrip(rs_manager):
+    token = rs_manager.mint("user@x", roles=["reader"])
+    claims = rs_manager.verify(token)
+    assert claims["sub"] == "user@x"
+    assert claims["roles"] == ["reader"]
+    assert claims["iss"] == "iss"
+
+
+def test_rs256_jwks_has_key(rs_manager):
+    jwks = rs_manager.jwks()
+    key = jwks["keys"][0]
+    assert key["kty"] == "RSA" and key["alg"] == "RS256"
+    assert key["kid"] == rs_manager.signer.kid
+
+
+def test_tampered_token_rejected(rs_manager):
+    token = rs_manager.mint("user@x")
+    head, payload, sig = token.split(".")
+    # flip a character in the payload
+    bad = payload[:-2] + ("A" if payload[-2] != "A" else "B") + payload[-1]
+    with pytest.raises(JWTError):
+        rs_manager.verify(f"{head}.{bad}.{sig}")
+
+
+def test_expired_token_rejected(rs_manager):
+    token = rs_manager.mint("user@x", ttl_seconds=-10)
+    with pytest.raises(JWTError, match="expired"):
+        rs_manager.verify(token)
+
+
+def test_wrong_audience_rejected(rs_manager):
+    other = JWTManager(rs_manager.signer, issuer="iss", audience="other")
+    token = other.mint("user@x")
+    with pytest.raises(JWTError, match="audience"):
+        rs_manager.verify(token)
+
+
+def test_hs256_roundtrip_and_cross_secret():
+    a = JWTManager(HS256Signer("secret-a"))
+    b = JWTManager(HS256Signer("secret-b"))
+    token = a.mint("u")
+    assert a.verify(token)["sub"] == "u"
+    with pytest.raises(JWTError):
+        b.verify(token)
+
+
+def test_alg_confusion_rejected():
+    # HS256 token must not verify against an RS256 manager (alg pinning).
+    hs = JWTManager(HS256Signer("s"), issuer="copilot")
+    rs = JWTManager(LocalRS256Signer(), issuer="copilot")
+    with pytest.raises(JWTError, match="algorithm"):
+        rs.verify(hs.mint("u"))
+
+
+def test_pem_persistence_roundtrip():
+    signer = LocalRS256Signer()
+    restored = LocalRS256Signer(private_pem=signer.private_pem())
+    m1 = JWTManager(signer)
+    m2 = JWTManager(restored)
+    assert m2.verify(m1.mint("u"))["sub"] == "u"
+    assert signer.kid == restored.kid
+
+
+def test_factory():
+    assert create_jwt_signer({"driver": "hs256", "secret": "x"}).alg == "HS256"
+    assert create_jwt_signer().alg == "RS256"
+    with pytest.raises(ValueError):
+        create_jwt_signer({"driver": "nope"})

@@ -1,0 +1,75 @@
+# Operator tools: failed-queue CLI manager + retry-stuck-documents job.
+import time
+
+from copilot_for_consensus_tpu.core import events as ev
+from copilot_for_consensus_tpu.services.runner import build_pipeline
+from copilot_for_consensus_tpu.tools.failed_queues import FailedQueueManager
+from copilot_for_consensus_tpu.tools.retry_job import (
+    RetryStuckDocumentsJob,
+)
+
+
+def _broken_pipeline(fixtures_dir):
+    p = build_pipeline()
+    p.ingestion.create_source({
+        "source_id": "s", "name": "s", "fetcher": "local",
+        "location": str(fixtures_dir / "ietf-sample.mbox")})
+    return p
+
+
+def test_failed_queue_list_inspect_requeue(fixtures_dir):
+    p = _broken_pipeline(fixtures_dir)
+    # Break parsing: event references an archive that never lands.
+    p.parsing.publisher.publish(ev.ArchiveIngested(archive_id="ghost"))
+    p.drain()
+    mgr = FailedQueueManager(p.broker, p.parsing.publisher)
+    queues = mgr.list_queues()
+    assert queues.get("parsing.failed") == 1
+    inspected = mgr.inspect("parsing.failed")
+    assert inspected[0]["data"]["archive_id"] == "ghost"
+    # requeue converts it back into an ArchiveIngested trigger
+    n = mgr.requeue("parsing.failed")
+    assert n == 1
+    assert mgr.list_queues().get("parsing.failed") is None
+    # the re-published trigger fails again (archive still missing) —
+    # proving the requeued event actually flowed
+    p.drain()
+    assert mgr.list_queues().get("parsing.failed") == 1
+    assert mgr.purge("parsing.failed") == 1
+
+
+def test_retry_job_requeues_stuck_chunks(fixtures_dir):
+    p = _broken_pipeline(fixtures_dir)
+    p.ingest_and_run("s")
+    chunk = p.store.query_documents("chunks", {}, limit=1)[0]
+    p.store.update_document("chunks", chunk["chunk_id"],
+                            {"embedding_generated": False})
+    p.vector_store.delete([chunk["chunk_id"]])
+    job = RetryStuckDocumentsJob(p.store, p.embedding.publisher,
+                                 min_stuck_seconds=0.0)
+    # First sweep: no last_attempt_at/ingested_at on chunks → eligible.
+    counts = job.run_once(now=time.time() + 10_000)
+    assert counts["chunks"] == 1
+    p.drain()
+    doc = p.store.get_document("chunks", chunk["chunk_id"])
+    assert doc["embedding_generated"]
+    assert doc["attempt_count"] == 1
+
+
+def test_retry_job_respects_backoff_and_max_attempts(fixtures_dir):
+    p = _broken_pipeline(fixtures_dir)
+    p.store.insert_or_ignore("archives", {
+        "archive_id": "stuck-archive", "sha256": "0" * 64,
+        "parsed": False, "source_id": "s",
+    })
+    job = RetryStuckDocumentsJob(p.store, p.ingestion.publisher,
+                                 min_stuck_seconds=0.0)
+    far_future = time.time() + 1e6
+    assert job.run_once(now=far_future)["archives"] == 1
+    # immediately after an attempt: backoff blocks the next sweep
+    assert job.run_once(now=time.time())["archives"] == 0
+    # attempts bounded
+    for i in range(10):
+        job.run_once(now=far_future + i * 1e6)
+    doc = p.store.get_document("archives", "stuck-archive")
+    assert doc["attempt_count"] == 3     # archives rule max_attempts

@@ -17,6 +17,7 @@ import base64
 import hashlib
 import json
 import secrets as pysecrets
+import threading
 import time
 import urllib.parse
 from typing import Any
@@ -193,14 +194,35 @@ class RoleStore:
 
 
 class AuthService:
+    #: Hard cap on concurrently-pending login states; beyond this the
+    #: oldest-expiring entries are evicted (unauthenticated /auth/login
+    #: floods must not grow memory without bound).
+    MAX_PENDING = 10_000
+
     def __init__(self, jwt_manager: JWTManager, role_store: RoleStore,
                  providers: dict[str, OIDCProvider] | None = None,
                  login_ttl_seconds: int = 600):
         self.jwt = jwt_manager
         self.roles = role_store
-        self.providers = providers or {"mock": MockProvider()}
+        # No silent mock default: the mock provider exchanges any
+        # `mock:<email>` code for a valid identity, so it must be passed
+        # in explicitly (the bootstrap layer gates it behind
+        # auth.allow_insecure_mock when enforcement is on).
+        self.providers = dict(providers or {})
         self.login_ttl_seconds = login_ttl_seconds
         self._pending: dict[str, dict[str, Any]] = {}  # state → login ctx
+        # HTTPServer is threaded; prune iterates while callbacks pop.
+        self._pending_lock = threading.Lock()
+
+    def _prune_pending_locked(self) -> None:
+        now = time.time()
+        for state in [s for s, c in self._pending.items()
+                      if c["expires"] < now]:
+            del self._pending[state]
+        while len(self._pending) >= self.MAX_PENDING:
+            oldest = min(self._pending, key=lambda s:
+                         self._pending[s]["expires"])
+            del self._pending[oldest]
 
     def initiate_login(self, provider: str = "mock") -> dict[str, str]:
         prov = self.providers.get(provider)
@@ -212,16 +234,19 @@ class AuthService:
         challenge = base64.urlsafe_b64encode(
             hashlib.sha256(verifier.encode()).digest()
         ).rstrip(b"=").decode()
-        self._pending[state] = {
-            "provider": provider, "verifier": verifier, "nonce": nonce,
-            "expires": time.time() + self.login_ttl_seconds,
-        }
+        with self._pending_lock:
+            self._prune_pending_locked()
+            self._pending[state] = {
+                "provider": provider, "verifier": verifier, "nonce": nonce,
+                "expires": time.time() + self.login_ttl_seconds,
+            }
         return {"state": state,
                 "authorize_url": prov.build_authorize_url(
                     state, nonce, challenge)}
 
     def handle_callback(self, state: str, code: str) -> dict[str, Any]:
-        ctx = self._pending.pop(state, None)
+        with self._pending_lock:
+            ctx = self._pending.pop(state, None)
         if ctx is None or ctx["expires"] < time.time():
             raise AuthError("unknown or expired login state")
         prov = self.providers[ctx["provider"]]
@@ -264,7 +289,10 @@ def create_jwt_middleware(jwt_manager: JWTManager,
     required_roles = required_roles or {}
 
     def middleware(req: Request) -> None:
-        if any(req.path.startswith(p) for p in public_paths):
+        # Exact or path-segment-boundary match only: /metrics is public,
+        # a hypothetical /metrics-private must not be.
+        if any(req.path == p or req.path.startswith(p + "/")
+               for p in public_paths):
             return
         header = req.headers.get("Authorization") or req.headers.get(
             "authorization") or ""

@@ -104,7 +104,10 @@ def ingestion_router(service) -> Router:
     return router
 
 
-def reporting_router(service) -> Router:
+def reporting_router(service, include_sources: bool = True) -> Router:
+    """Reporting REST surface. ``include_sources=False`` drops the
+    GET /api/sources browse route for deployments where ingestion already
+    owns that path on a shared router (serve_pipeline)."""
     router = Router()
 
     @router.get("/api/reports")
@@ -174,8 +177,9 @@ def reporting_router(service) -> Router:
             offset=_int(req, "offset", 0, hi=1 << 30),
             limit=_int(req, "limit", 50))}
 
-    @router.get("/api/sources")
-    def sources(req):
-        return {"sources": service.get_sources()}
+    if include_sources:
+        @router.get("/api/sources")
+        def sources(req):
+            return {"sources": service.get_sources()}
 
     return router

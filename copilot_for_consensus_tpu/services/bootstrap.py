@@ -139,7 +139,10 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
         stats=pipeline.reporting.stats,
         metrics=pipeline.metrics))
     router.merge(ingestion_router(pipeline.ingestion))
-    router.merge(reporting_router(pipeline.reporting))
+    # ingestion owns GET /api/sources on the unified surface; reporting's
+    # copy exists for standalone reporting-only deployments.
+    router.merge(reporting_router(pipeline.reporting,
+                                  include_sources=False))
 
     auth_service = None
     auth_cfg = cfg.get("auth")
@@ -155,14 +158,34 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
         for email, user_roles in (auth_cfg.get("bootstrap_admins")
                                   or {}).items():
             roles.assign(email, user_roles)
+        require_auth = auth_cfg.get("require_auth", True)
+        providers_cfg = auth_cfg.get("providers") or {}
+        # The mock provider mints a JWT for any `mock:<email>` code via the
+        # public /auth/callback path, so with auth enforcement on it must be
+        # an explicit, eyes-open opt-in — never a silent default.
+        allow_mock = auth_cfg.get("allow_insecure_mock", False)
+        if require_auth:
+            if not providers_cfg and allow_mock:
+                providers_cfg = {"mock": {}}
+            if not providers_cfg:
+                raise ValueError(
+                    "auth.require_auth is on but auth.providers is empty; "
+                    "configure a real OIDC provider, or set "
+                    "auth.allow_insecure_mock=true for test deployments")
+            if "mock" in providers_cfg and not allow_mock:
+                raise ValueError(
+                    "auth.providers includes the insecure mock driver with "
+                    "require_auth on; set auth.allow_insecure_mock=true to "
+                    "accept that any caller can mint tokens")
+        elif not providers_cfg:
+            providers_cfg = {"mock": {}}
         providers = {
             name: create_oidc_provider({"driver": name, **pcfg})
-            for name, pcfg in (auth_cfg.get("providers")
-                               or {"mock": {}}).items()
+            for name, pcfg in providers_cfg.items()
         }
         auth_service = AuthService(jwt, roles, providers)
         router.merge(auth_router(auth_service))
-        if auth_cfg.get("require_auth", True):
+        if require_auth:
             router.middleware.append(create_jwt_middleware(
                 jwt,
                 required_roles=auth_cfg.get("required_roles", {

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
@@ -114,15 +115,28 @@ class Router:
                 for mw in self.middleware:
                     mw(req)
                 out = fn(req)
+                # Response construction serializes the handler's return
+                # value; a non-JSON-able value must hit the backstop too.
+                if isinstance(out, Response):
+                    return out
+                if isinstance(out, tuple):       # (body, status)
+                    return Response(out[0], status=out[1])
+                if out is None:
+                    return Response("", status=204,
+                                    content_type="text/plain")
+                return Response(out)
             except HTTPError as exc:
                 return Response({"error": exc.message}, status=exc.status)
-            if isinstance(out, Response):
-                return out
-            if isinstance(out, tuple):       # (body, status)
-                return Response(out[0], status=out[1])
-            if out is None:
-                return Response("", status=204, content_type="text/plain")
-            return Response(out)
+            except Exception as exc:
+                # A handler bug must yield a 500 response, not a dropped
+                # connection (reference services respond through FastAPI's
+                # exception layer; this is our equivalent backstop).
+                from copilot_for_consensus_tpu.obs.logging import get_logger
+                get_logger().error(
+                    "unhandled error in handler", method=method,
+                    path=parsed.path, error=f"{type(exc).__name__}: {exc}",
+                    traceback=traceback.format_exc())
+                return Response({"error": "internal error"}, status=500)
         if matched_path:
             return Response({"error": "method not allowed"}, status=405)
         return Response({"error": "not found"}, status=404)

@@ -1,0 +1,32 @@
+"""Checkpoint subsystem: HF import, native quantized serving format.
+
+Fills the model-weights role the reference delegates to Ollama's blob
+store and HF hub downloads (``local_llm_summarizer.py``,
+``sentence_transformer_provider.py``) — first-party, mmap-fast, with
+offline int8 quantization for serving.
+"""
+
+from copilot_for_consensus_tpu.checkpoint.hf import (
+    CheckpointError,
+    config_from_hf,
+    load_hf_checkpoint,
+    load_hf_params,
+    read_hf_config,
+)
+from copilot_for_consensus_tpu.checkpoint.native import (
+    FORMAT,
+    convert,
+    is_native,
+    load_checkpoint,
+    load_native,
+    load_tokenizer,
+    quantize_tree,
+    save_native,
+)
+
+__all__ = [
+    "CheckpointError", "FORMAT", "config_from_hf", "convert", "is_native",
+    "load_checkpoint", "load_hf_checkpoint", "load_hf_params",
+    "load_native", "load_tokenizer", "quantize_tree", "read_hf_config",
+    "save_native",
+]

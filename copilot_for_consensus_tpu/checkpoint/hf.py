@@ -1,0 +1,213 @@
+"""HuggingFace checkpoint import: safetensors → decoder pytree.
+
+Replaces the reference's reliance on external engines to own the weights
+(Ollama pulls GGUF blobs, ``adapters/copilot_summarization/
+copilot_summarization/local_llm_summarizer.py:106-115``): here the
+framework loads Mistral/Llama/Mixtral-family HF checkpoints directly into
+the JAX decoder's stacked-layer pytree.
+
+Layout notes:
+* torch ``nn.Linear`` stores ``[out, in]``; our matmuls are ``x @ W`` with
+  ``W: [in, out]`` — every projection transposes on load.
+* per-layer tensors stack on a leading ``n_layers`` axis (the decoder
+  drives layers with ``lax.scan``), so we allocate the stacked array once
+  and fill it layer by layer with lazily-read tensors.
+* RoPE: both sides use the rotate-half convention, so q/k need no
+  permutation.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any, Callable
+
+import numpy as np
+
+from copilot_for_consensus_tpu.models.configs import DecoderConfig
+
+try:  # numpy bf16 via ml_dtypes (ships with jax)
+    import ml_dtypes
+
+    _DTYPES = {"bfloat16": ml_dtypes.bfloat16, "float32": np.float32,
+               "float16": np.float16}
+except Exception:  # pragma: no cover
+    _DTYPES = {"float32": np.float32, "float16": np.float16}
+
+
+class CheckpointError(RuntimeError):
+    pass
+
+
+def read_hf_config(path: str | pathlib.Path) -> dict:
+    cfg_file = pathlib.Path(path) / "config.json"
+    if not cfg_file.exists():
+        raise CheckpointError(f"no config.json under {path}")
+    return json.loads(cfg_file.read_text())
+
+
+def config_from_hf(hf: dict) -> DecoderConfig:
+    """Map an HF ``config.json`` to a :class:`DecoderConfig`."""
+    model_type = hf.get("model_type", "")
+    if model_type not in ("mistral", "llama", "mixtral"):
+        raise CheckpointError(
+            f"unsupported model_type {model_type!r} (mistral/llama/mixtral)")
+    d_model = hf["hidden_size"]
+    n_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or d_model // n_heads
+    if head_dim != d_model // n_heads:
+        raise CheckpointError(
+            f"head_dim {head_dim} != hidden_size/num_heads "
+            f"{d_model // n_heads}: decoupled head_dim is unsupported")
+    scaling = hf.get("rope_scaling")
+    if scaling and scaling.get("rope_type", scaling.get("type")) not in (
+            None, "default"):
+        # Silently dropping e.g. llama3 rope scaling would load fine and
+        # garble every long-context forward — fail loudly instead.
+        raise CheckpointError(
+            f"rope_scaling {scaling!r} is unsupported (plain RoPE only)")
+    return DecoderConfig(
+        name=hf.get("_name_or_path") or model_type,
+        vocab_size=hf["vocab_size"],
+        d_model=d_model,
+        n_layers=hf["num_hidden_layers"],
+        n_heads=n_heads,
+        n_kv_heads=hf.get("num_key_value_heads", n_heads),
+        d_ff=hf["intermediate_size"],
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        max_seq_len=hf.get("max_position_embeddings", 32768),
+        sliding_window=hf.get("sliding_window") or 0,
+        norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        n_experts=hf.get("num_local_experts", 0),
+        experts_per_token=hf.get("num_experts_per_tok", 2),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+
+
+def _tensor_index(path: pathlib.Path) -> dict[str, pathlib.Path]:
+    """tensor name → shard file, for single-file and sharded checkpoints."""
+    index_file = path / "model.safetensors.index.json"
+    if index_file.exists():
+        index = json.loads(index_file.read_text())
+        return {name: path / shard
+                for name, shard in index["weight_map"].items()}
+    single = path / "model.safetensors"
+    if single.exists():
+        from safetensors import safe_open
+
+        with safe_open(single, framework="np") as f:
+            return {name: single for name in f.keys()}
+    raise CheckpointError(f"no model.safetensors[.index.json] under {path}")
+
+
+class _LazyReader:
+    """Reads tensors by name across shard files, one file handle per shard."""
+
+    def __init__(self, path: pathlib.Path):
+        self.index = _tensor_index(path)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.index
+
+    def get(self, name: str) -> np.ndarray:
+        from safetensors import safe_open
+
+        shard = self.index.get(name)
+        if shard is None:
+            raise CheckpointError(f"tensor {name!r} missing from checkpoint")
+        with safe_open(shard, framework="np") as f:
+            return f.get_tensor(name)
+
+
+def _stacked(reader: _LazyReader, n_layers: int, dtype,
+             name_for: Callable[[int], str],
+             transform: Callable[[np.ndarray], np.ndarray] = lambda x: x
+             ) -> np.ndarray:
+    """Allocate [n_layers, ...] once, fill with per-layer reads."""
+    first = transform(reader.get(name_for(0))).astype(dtype)
+    out = np.empty((n_layers,) + first.shape, dtype=dtype)
+    out[0] = first
+    for i in range(1, n_layers):
+        out[i] = transform(reader.get(name_for(i))).astype(dtype)
+    return out
+
+
+def load_hf_params(path: str | pathlib.Path, cfg: DecoderConfig,
+                   dtype: str = "bfloat16") -> dict[str, Any]:
+    """Load an HF Mistral/Llama/Mixtral checkpoint as our decoder pytree
+    (numpy leaves; caller moves to device / shards / quantizes)."""
+    np_dtype = _DTYPES.get(dtype)
+    if np_dtype is None:
+        raise CheckpointError(f"unsupported dtype {dtype!r}")
+    reader = _LazyReader(pathlib.Path(path))
+    n = cfg.n_layers
+    T = np.ascontiguousarray
+
+    def t(w: np.ndarray) -> np.ndarray:       # torch [out,in] → [in,out]
+        return T(w.T)
+
+    def lname(stem: str) -> Callable[[int], str]:
+        return lambda i: f"model.layers.{i}.{stem}.weight"
+
+    layer: dict[str, Any] = {
+        "attn_norm": _stacked(reader, n, np_dtype,
+                              lname("input_layernorm")),
+        "wq": _stacked(reader, n, np_dtype, lname("self_attn.q_proj"), t),
+        "wk": _stacked(reader, n, np_dtype, lname("self_attn.k_proj"), t),
+        "wv": _stacked(reader, n, np_dtype, lname("self_attn.v_proj"), t),
+        "wo": _stacked(reader, n, np_dtype, lname("self_attn.o_proj"), t),
+        "ffn_norm": _stacked(reader, n, np_dtype,
+                             lname("post_attention_layernorm")),
+    }
+    if cfg.is_moe:
+        e = cfg.n_experts
+
+        def expert_stack(w_name: str) -> np.ndarray:
+            # [n_layers, n_experts, in, out]
+            first = t(reader.get(
+                f"model.layers.0.block_sparse_moe.experts.0.{w_name}.weight"))
+            out = np.empty((n, e) + first.shape, dtype=np_dtype)
+            for i in range(n):
+                for j in range(e):
+                    out[i, j] = t(reader.get(
+                        f"model.layers.{i}.block_sparse_moe."
+                        f"experts.{j}.{w_name}.weight")).astype(np_dtype)
+            return out
+
+        layer.update({
+            # router stays fp32: routing decisions are precision-sensitive
+            "router": _stacked(reader, n, np.float32,
+                               lname("block_sparse_moe.gate"), t),
+            "w_gate": expert_stack("w1"),
+            "w_up": expert_stack("w3"),
+            "w_down": expert_stack("w2"),
+        })
+    else:
+        layer.update({
+            "w_gate": _stacked(reader, n, np_dtype, lname("mlp.gate_proj"),
+                               t),
+            "w_up": _stacked(reader, n, np_dtype, lname("mlp.up_proj"), t),
+            "w_down": _stacked(reader, n, np_dtype, lname("mlp.down_proj"),
+                               t),
+        })
+
+    params: dict[str, Any] = {
+        "tok_emb": reader.get("model.embed_tokens.weight").astype(np_dtype),
+        "layers": layer,
+        "final_norm": reader.get("model.norm.weight").astype(np_dtype),
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in reader:
+            params["lm_head"] = t(
+                reader.get("lm_head.weight")).astype(np_dtype)
+        else:
+            raise CheckpointError(
+                "config says untied embeddings but lm_head.weight is "
+                "missing from the checkpoint")
+    return params
+
+
+def load_hf_checkpoint(path: str | pathlib.Path, dtype: str = "bfloat16"
+                       ) -> tuple[DecoderConfig, dict[str, Any]]:
+    cfg = config_from_hf(read_hf_config(path))
+    return cfg, load_hf_params(path, cfg, dtype)

@@ -1,0 +1,212 @@
+"""Native serving checkpoints: mmap-fast safetensors + config metadata.
+
+The serving-side half of the checkpoint story (reference counterpart:
+Ollama's model blob cache — external; here first-party). A native
+checkpoint directory holds:
+
+* ``model.safetensors`` — the decoder pytree flattened with ``/``-joined
+  keys. Int8-quantized leaves appear naturally as ``<path>/q`` +
+  ``<path>/scale`` (the in-memory representation is already a dict).
+* ``meta.json`` — DecoderConfig fields + format marker + tokenizer info.
+* ``tokenizer.json`` — optional; copied from the source HF checkpoint so
+  serving needs exactly one directory.
+
+Quantization happens offline on the host (numpy) where RAM is plentiful,
+so a 7B never needs bf16+int8 copies in HBM at once — load time becomes
+an mmap read instead of a device-side quantization pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import shutil
+from typing import Any
+
+import numpy as np
+
+from copilot_for_consensus_tpu.checkpoint.hf import (
+    CheckpointError,
+    _DTYPES,
+    load_hf_checkpoint,
+)
+from copilot_for_consensus_tpu.models.configs import DecoderConfig
+from copilot_for_consensus_tpu.models.quant import DECODER_QUANT_LEAVES
+
+FORMAT = "copilot-tpu-native-v1"
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat: dict[str, np.ndarray]) -> dict:
+    tree: dict = {}
+    for key, v in flat.items():
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def _quantize_np(w: np.ndarray) -> dict[str, np.ndarray]:
+    """Host-side mirror of ``models.quant.quantize_tensor`` (numpy)."""
+    wf = w.astype(np.float32)
+    amax = np.max(np.abs(wf), axis=-2, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(wf / scale), -127, 127).astype(np.int8)
+    return {"q": q, "scale": scale}
+
+
+def quantize_tree(params: dict,
+                  leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES
+                  ) -> dict:
+    """Int8-ize the given leaves of a numpy pytree, in place per leaf."""
+    out = {k: (quantize_tree(v, tuple(
+        rest[1:] for rest in leaves if rest and rest[0] == k))
+        if isinstance(v, dict) else v) for k, v in params.items()}
+    for path in leaves:
+        if len(path) == 1 and path[0] in params and not isinstance(
+                params[path[0]], dict):
+            out[path[0]] = _quantize_np(np.asarray(params[path[0]]))
+    return out
+
+
+def _norm_token_id(value, default: int) -> tuple[int, list[int]]:
+    """HF configs may carry an int or a list (Llama-3.1 multi-EOS).
+    Returns (primary, all)."""
+    if isinstance(value, (list, tuple)) and value:
+        ids = [int(v) for v in value]
+        return ids[0], ids
+    if value is None:
+        return default, [default]
+    return int(value), [int(value)]
+
+
+def save_native(path: str | pathlib.Path, cfg: DecoderConfig, params: dict,
+                *, tokenizer_file: str | pathlib.Path | None = None,
+                bos_id=1, eos_id=2) -> None:
+    from safetensors.numpy import save_file
+
+    out = pathlib.Path(path)
+    out.mkdir(parents=True, exist_ok=True)
+    flat = _flatten(params)
+    save_file(flat, out / "model.safetensors")
+    bos, _ = _norm_token_id(bos_id, 1)
+    eos, eos_ids = _norm_token_id(eos_id, 2)
+    meta = {
+        "format": FORMAT,
+        "config": dataclasses.asdict(cfg),
+        "quantized": any(k.endswith("/q") for k in flat),
+        "bos_id": bos,
+        "eos_id": eos,
+        "eos_ids": eos_ids,
+    }
+    (out / "meta.json").write_text(json.dumps(meta, indent=2))
+    if tokenizer_file is not None and pathlib.Path(tokenizer_file).exists():
+        shutil.copy(tokenizer_file, out / "tokenizer.json")
+
+
+def is_native(path: str | pathlib.Path) -> bool:
+    meta = pathlib.Path(path) / "meta.json"
+    if not meta.exists():
+        return False
+    try:
+        return json.loads(meta.read_text()).get("format") == FORMAT
+    except (json.JSONDecodeError, OSError):
+        return False
+
+
+def load_native(path: str | pathlib.Path
+                ) -> tuple[DecoderConfig, dict, dict]:
+    """Returns (cfg, params, meta). Leaves are numpy (zero-copy where the
+    safetensors mmap allows); caller device-puts / shards."""
+    from safetensors.numpy import load_file
+
+    p = pathlib.Path(path)
+    meta = json.loads((p / "meta.json").read_text())
+    if meta.get("format") != FORMAT:
+        raise CheckpointError(f"{path} is not a {FORMAT} checkpoint")
+    cfg = DecoderConfig(**meta["config"])
+    params = _unflatten(load_file(p / "model.safetensors"))
+    return cfg, params, meta
+
+
+def load_checkpoint(path: str | pathlib.Path, dtype: str = "bfloat16"
+                    ) -> tuple[DecoderConfig, dict, dict]:
+    """Auto-detect: native dir → as saved; HF dir → converted in memory.
+
+    Returns (cfg, params, meta) with numpy leaves.
+    """
+    p = pathlib.Path(path)
+    if is_native(p):
+        return load_native(p)
+    cfg, params = load_hf_checkpoint(p, dtype)
+    hf_cfg = json.loads((p / "config.json").read_text())
+    bos, _ = _norm_token_id(hf_cfg.get("bos_token_id"), 1)
+    eos, eos_ids = _norm_token_id(hf_cfg.get("eos_token_id"), 2)
+    meta = {
+        "format": "hf", "quantized": False,
+        "bos_id": bos, "eos_id": eos, "eos_ids": eos_ids,
+    }
+    return cfg, params, meta
+
+
+def convert(src: str | pathlib.Path, dst: str | pathlib.Path, *,
+            quantize: bool = True, dtype: str = "bfloat16") -> dict:
+    """Offline converter: HF checkpoint → native serving checkpoint.
+
+    The role of ``ollama pull`` + GGUF quantization in the reference
+    stack, first-party. Returns the written meta dict.
+    """
+    src, dst = pathlib.Path(src), pathlib.Path(dst)
+    cfg, params = load_hf_checkpoint(src, dtype)
+    if quantize:
+        params = quantize_tree(params)
+    hf_cfg = json.loads((src / "config.json").read_text())
+    save_native(
+        dst, cfg, params,
+        tokenizer_file=src / "tokenizer.json",
+        bos_id=hf_cfg.get("bos_token_id", 1) or 1,
+        eos_id=hf_cfg.get("eos_token_id", 2) or 2)
+    return json.loads((dst / "meta.json").read_text())
+
+
+def load_tokenizer(path: str | pathlib.Path):
+    """HFTokenizer from a checkpoint dir's ``tokenizer.json``, with
+    bos/eos ids taken from the checkpoint metadata. None if absent."""
+    from copilot_for_consensus_tpu.engine.tokenizer import HFTokenizer
+
+    p = pathlib.Path(path)
+    tok_file = p / "tokenizer.json"
+    if not tok_file.exists():
+        return None
+    bos, eos = 1, [2]
+    meta_file = p / "meta.json"
+    cfg_file = p / "config.json"
+    if meta_file.exists():
+        meta = json.loads(meta_file.read_text())
+        bos = meta.get("bos_id", 1)
+        eos = meta.get("eos_ids") or [meta.get("eos_id", 2)]
+    elif cfg_file.exists():
+        hf = json.loads(cfg_file.read_text())
+        bos, _ = _norm_token_id(hf.get("bos_token_id"), 1)
+        _, eos = _norm_token_id(hf.get("eos_token_id"), 2)
+    return HFTokenizer(str(tok_file), bos_id=bos, eos_id=eos)
+
+
+__all__ = [
+    "CheckpointError", "FORMAT", "convert", "is_native", "load_checkpoint",
+    "load_native", "load_tokenizer", "quantize_tree", "save_native",
+    "_DTYPES",
+]

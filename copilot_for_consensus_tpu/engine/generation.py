@@ -103,7 +103,11 @@ class GenerationEngine:
             b for b in sorted(set(min(b, self.max_len)
                                   for b in prefill_buckets)))
         self.sampling = sampling
-        self.eos_id = eos_id
+        # eos_id may be a list (Llama-3.1-style multi-EOS checkpoints).
+        eos_list = list(eos_id) if isinstance(eos_id, (list, tuple)) \
+            else [int(eos_id)]
+        self.eos_id = int(eos_list[0])
+        self._eos_set = frozenset(int(e) for e in eos_list)
         self.attn_impl = attn_impl
         self.decode_window = max(1, decode_window)
         if self.max_len - self.decode_window < 1:
@@ -134,7 +138,12 @@ class GenerationEngine:
         if quantize:
             axes = quant.quantize_logical_axes(axes)
         if mesh is not None:
+            # shard_pytree device_puts numpy leaves shard-by-shard, so a
+            # host-resident (mmap'd) checkpoint never fully materializes
+            # on one device.
             params = shard_pytree(params, axes, mesh)
+        else:
+            params = jax.tree.map(jnp.asarray, params)
         self.params = params
 
         cache = decoder.init_cache(cfg, num_slots, self.max_len, dtype=dtype)
@@ -214,6 +223,29 @@ class GenerationEngine:
     # public API
     # ------------------------------------------------------------------
 
+    @classmethod
+    def from_checkpoint(cls, path: str, *, dtype=jnp.bfloat16,
+                        **engine_kw) -> "GenerationEngine":
+        """Build an engine from a checkpoint directory — native (offline-
+        quantized, mmap-fast) or HF safetensors (converted in memory).
+        Replaces random-weight init as the serving path; the capability of
+        the reference's ``factory.py:89-94`` driver dispatch to a real
+        model."""
+        import ml_dtypes
+
+        from copilot_for_consensus_tpu import checkpoint as ckpt
+
+        np_dtype = np.dtype(dtype) if dtype != jnp.bfloat16 else np.dtype(
+            ml_dtypes.bfloat16)
+        # Leaves stay numpy (mmap-backed): __init__ device-puts them —
+        # shard-by-shard when a mesh is given, whole-tree otherwise.
+        cfg, params, meta = ckpt.load_checkpoint(
+            path, dtype=str(np_dtype))
+        engine_kw.setdefault("eos_id", meta.get("eos_ids",
+                                                meta.get("eos_id", 2)))
+        return cls(cfg, params, dtype=dtype,
+                   quantize=bool(meta.get("quantized")), **engine_kw)
+
     def submit(self, prompt: list[int], max_new_tokens: int = 256) -> int:
         """Enqueue a tokenized prompt; returns a request id."""
         if not prompt:
@@ -289,9 +321,9 @@ class GenerationEngine:
             self._next_tok[slot] = first
             self._t_prefill[slot] = time.monotonic() - t0
             req.decode_started_at = time.monotonic()
-            if first == self.eos_id or req.max_new_tokens <= 1:
+            if first in self._eos_set or req.max_new_tokens <= 1:
                 self._retire(slot,
-                             "eos" if first == self.eos_id else "length")
+                             "eos" if first in self._eos_set else "length")
 
     def _decode_once(self) -> None:
         window = self.decode_window
@@ -310,7 +342,7 @@ class GenerationEngine:
             for step in range(window):
                 tok = int(toks[step, slot])
                 gen.append(tok)
-                if tok == self.eos_id:
+                if tok in self._eos_set:
                     finished = "eos"
                     break
                 if len(gen) >= req.max_new_tokens:
@@ -329,7 +361,7 @@ class GenerationEngine:
     def _retire(self, slot: int, reason: str) -> None:
         req = self._active.pop(slot)
         gen = self._generated.pop(slot)
-        if gen and gen[-1] == self.eos_id:
+        if gen and gen[-1] in self._eos_set:
             gen = gen[:-1]
         self._done[req.request_id] = Completion(
             request_id=req.request_id,

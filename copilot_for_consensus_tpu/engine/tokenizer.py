@@ -103,11 +103,25 @@ class HashWordTokenizer(Tokenizer):
 
 
 class HFTokenizer(Tokenizer):
-    """A trained ``tokenizer.json`` via the HuggingFace tokenizers lib."""
+    """A trained ``tokenizer.json`` via the HuggingFace tokenizers lib.
 
-    def __init__(self, path: str):
+    ``bos_id``/``eos_id`` default to the Llama/Mistral-family convention
+    (1/2) but can be overridden from checkpoint metadata. ``eos_id`` may
+    be a list (Llama-3.1-style multi-EOS configs); the first id is used
+    when appending, all are stripped on decode. ``pad_id`` is only
+    filtered when explicitly given — id 0 is a real vocab token in some
+    families."""
+
+    def __init__(self, path: str, *, bos_id: int = BOS_ID,
+                 eos_id=EOS_ID, pad_id: int | None = None):
         from tokenizers import Tokenizer as _HFTok  # lazy: optional dep
         self._tok = _HFTok.from_file(path)
+        self.bos_id = int(bos_id)
+        eos_list = list(eos_id) if isinstance(eos_id, (list, tuple)) \
+            else [int(eos_id)]
+        self.eos_id = int(eos_list[0])
+        self.eos_ids = tuple(int(e) for e in eos_list)
+        self.pad_id = pad_id if pad_id is not None else -1
 
     @property
     def vocab_size(self) -> int:
@@ -117,17 +131,19 @@ class HFTokenizer(Tokenizer):
                add_eos: bool = False) -> list[int]:
         ids = list(self._tok.encode(text).ids)
         if add_bos:
-            ids.insert(0, BOS_ID)
+            ids.insert(0, self.bos_id)
         if add_eos:
-            ids.append(EOS_ID)
+            ids.append(self.eos_id)
         return ids
 
     def decode(self, ids: list[int]) -> str:
-        return self._tok.decode([i for i in ids if i >= N_SPECIALS])
+        specials = {self.pad_id, self.bos_id, *self.eos_ids}
+        return self._tok.decode([i for i in ids if i not in specials])
 
 
 def create_tokenizer(driver: str = "byte", *, vocab_size: int = 259,
-                     path: str | None = None) -> Tokenizer:
+                     path: str | None = None, bos_id: int = BOS_ID,
+                     eos_id: int = EOS_ID) -> Tokenizer:
     if driver == "byte":
         return ByteTokenizer(vocab_size)
     if driver == "hash_word":
@@ -135,5 +151,5 @@ def create_tokenizer(driver: str = "byte", *, vocab_size: int = 259,
     if driver == "hf":
         if not path:
             raise ValueError("hf tokenizer needs a path")
-        return HFTokenizer(path)
+        return HFTokenizer(path, bos_id=bos_id, eos_id=eos_id)
     raise ValueError(f"unknown tokenizer driver {driver!r}")

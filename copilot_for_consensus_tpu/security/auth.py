@@ -322,7 +322,10 @@ def auth_router(service: AuthService):
 
     @router.get("/auth/login")
     def login(req):
-        return service.initiate_login(req.query.get("provider", "mock"))
+        try:
+            return service.initiate_login(req.query.get("provider", "mock"))
+        except AuthError as exc:
+            raise HTTPError(400, str(exc))
 
     @router.get("/auth/callback")
     def callback(req):

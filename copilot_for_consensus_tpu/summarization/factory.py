@@ -35,6 +35,7 @@ def create_summarizer(config: Any = None, **kwargs: Any) -> Summarizer:
             max_new_tokens=int(_cfg_get(config, "max_new_tokens", 256)),
             num_slots=int(_cfg_get(config, "num_slots", 4)),
             max_len=int(_cfg_get(config, "max_len", 4096)),
+            checkpoint=_cfg_get(config, "checkpoint"),
             **kwargs,
         )
     raise ValueError(f"unknown llm_backend driver {driver!r}")

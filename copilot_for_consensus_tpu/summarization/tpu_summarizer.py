@@ -51,7 +51,8 @@ class TPUSummarizer(Summarizer):
                  tokenizer=None, max_new_tokens: int = 256,
                  template: str = DEFAULT_TEMPLATE,
                  system: str = DEFAULT_SYSTEM, num_slots: int = 4,
-                 max_len: int = 4096, params=None, mesh=None, dtype=None):
+                 max_len: int = 4096, params=None, mesh=None, dtype=None,
+                 checkpoint: str | None = None):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
             ByteTokenizer,
@@ -70,11 +71,32 @@ class TPUSummarizer(Summarizer):
             )
             from copilot_for_consensus_tpu.models import decoder_config
 
-            cfg = decoder_config(model)
-            engine = GenerationEngine(
-                cfg, params, mesh=mesh, num_slots=num_slots,
-                max_len=min(max_len, cfg.max_seq_len),
-                dtype=dtype if dtype is not None else jnp.bfloat16)
+            if checkpoint is not None:
+                # Real weights: the serving default for production
+                # (reference: factory dispatch to a pulled Ollama model,
+                # ``factory.py:89-94``).
+                engine = GenerationEngine.from_checkpoint(
+                    checkpoint, mesh=mesh, num_slots=num_slots,
+                    max_len=max_len,
+                    dtype=dtype if dtype is not None else jnp.bfloat16)
+                self._model = f"checkpoint:{checkpoint}"
+                if tokenizer is None:
+                    from copilot_for_consensus_tpu.checkpoint import (
+                        load_tokenizer,
+                    )
+                    tokenizer = load_tokenizer(checkpoint)
+                    if tokenizer is None:
+                        # A byte-level fallback against a BPE-trained
+                        # model yields garbage; refuse loudly.
+                        raise ValueError(
+                            f"checkpoint {checkpoint} has no "
+                            "tokenizer.json; pass tokenizer= explicitly")
+            else:
+                cfg = decoder_config(model)
+                engine = GenerationEngine(
+                    cfg, params, mesh=mesh, num_slots=num_slots,
+                    max_len=min(max_len, cfg.max_seq_len),
+                    dtype=dtype if dtype is not None else jnp.bfloat16)
         self.engine = engine
         self.tokenizer: Tokenizer = tokenizer or ByteTokenizer(
             max(259, self.engine.cfg.vocab_size))

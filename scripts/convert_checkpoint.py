@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Offline checkpoint converter: HF safetensors → native serving format.
+
+Usage:
+    python scripts/convert_checkpoint.py SRC_HF_DIR DST_DIR [--no-quantize]
+        [--dtype bfloat16|float32|float16]
+
+The native format is mmap-fast and (by default) int8 weight-only
+quantized, so serving startup is seconds of reads instead of minutes of
+device-side quantization (the role `ollama pull`'s GGUF blobs play for
+the reference, `local_llm_summarizer.py:106-115`). Runs entirely on the
+host — no accelerator needed.
+"""
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("src", help="HF checkpoint dir (config.json + "
+                                "*.safetensors)")
+    ap.add_argument("dst", help="output native checkpoint dir")
+    ap.add_argument("--no-quantize", action="store_true",
+                    help="keep full-precision weights")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=("bfloat16", "float32", "float16"))
+    args = ap.parse_args()
+
+    from copilot_for_consensus_tpu.checkpoint import convert
+
+    meta = convert(args.src, args.dst, quantize=not args.no_quantize,
+                   dtype=args.dtype)
+    print(json.dumps(meta, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,10 +33,13 @@ def main() -> None:
 
     model = os.environ.get("BENCH_MODEL", "mistral-7b")
     slots = int(os.environ.get("BENCH_SLOTS", "32"))
-    max_len = int(os.environ.get("BENCH_MAX_LEN", "512"))
+    # 256 covers prompt 128 + 64 new tokens + window slack; decode is
+    # HBM-bound so cache extent is throughput (with kv-bucketed decode
+    # the extent adapts, but the allocation bound still matters).
+    max_len = int(os.environ.get("BENCH_MAX_LEN", "256"))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
-    window = int(os.environ.get("BENCH_DECODE_WINDOW", "16"))
+    window = int(os.environ.get("BENCH_DECODE_WINDOW", "32"))
 
     import jax.numpy as jnp
     import numpy as np
@@ -70,10 +73,11 @@ def main() -> None:
         for _ in range(slots)
     ]
 
-    # Warmup: compile prefill + decode + insert.
+    # Warmup: compile the steady-state programs — full-batch prefill,
+    # batched insert, and every decode kv bucket the timed run will hit.
     t0 = time.monotonic()
-    eng.generate([prompts[0]], max_new_tokens=4)
-    log(f"warmup (compile) {time.monotonic() - t0:.1f}s")
+    eng.generate(prompts, max_new_tokens=new_tokens)
+    log(f"warmup (compile + first full run) {time.monotonic() - t0:.1f}s")
 
     # Timed run: keep all slots busy for `new_tokens` decode steps each.
     t0 = time.monotonic()

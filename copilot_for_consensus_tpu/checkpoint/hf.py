@@ -101,22 +101,30 @@ def _tensor_index(path: pathlib.Path) -> dict[str, pathlib.Path]:
 
 
 class _LazyReader:
-    """Reads tensors by name across shard files, one file handle per shard."""
+    """Reads tensors by name across shard files, one file handle per shard
+    (a Mixtral load issues ~1000 tensor reads; re-opening and re-parsing
+    the safetensors header per read is pure cold-start waste)."""
 
     def __init__(self, path: pathlib.Path):
         self.index = _tensor_index(path)
+        self._handles: dict[pathlib.Path, Any] = {}
 
     def __contains__(self, name: str) -> bool:
         return name in self.index
 
-    def get(self, name: str) -> np.ndarray:
-        from safetensors import safe_open
+    def _handle(self, shard: pathlib.Path):
+        f = self._handles.get(shard)
+        if f is None:
+            from safetensors import safe_open
 
+            f = self._handles[shard] = safe_open(shard, framework="np")
+        return f
+
+    def get(self, name: str) -> np.ndarray:
         shard = self.index.get(name)
         if shard is None:
             raise CheckpointError(f"tensor {name!r} missing from checkpoint")
-        with safe_open(shard, framework="np") as f:
-            return f.get_tensor(name)
+        return self._handle(shard).get_tensor(name)
 
 
 def _stacked(reader: _LazyReader, n_layers: int, dtype,

@@ -95,7 +95,7 @@ def _norm_token_id(value, default: int) -> tuple[int, list[int]]:
 
 def save_native(path: str | pathlib.Path, cfg: DecoderConfig, params: dict,
                 *, tokenizer_file: str | pathlib.Path | None = None,
-                bos_id=1, eos_id=2) -> None:
+                bos_id=None, eos_id=None) -> None:
     from safetensors.numpy import save_file
 
     out = pathlib.Path(path)
@@ -174,11 +174,13 @@ def convert(src: str | pathlib.Path, dst: str | pathlib.Path, *,
     if quantize:
         params = quantize_tree(params)
     hf_cfg = json.loads((src / "config.json").read_text())
+    # Raw values straight through — save_native's _norm_token_id handles
+    # None and list forms; coalescing here would corrupt a real id 0.
     save_native(
         dst, cfg, params,
         tokenizer_file=src / "tokenizer.json",
-        bos_id=hf_cfg.get("bos_token_id", 1) or 1,
-        eos_id=hf_cfg.get("eos_token_id", 2) or 2)
+        bos_id=hf_cfg.get("bos_token_id"),
+        eos_id=hf_cfg.get("eos_token_id"))
     return json.loads((dst / "meta.json").read_text())
 
 

@@ -171,28 +171,33 @@ class GenerationEngine:
 
         self._prefill_fn = jax.jit(_prefill)
 
-        def _insert(cache, pref, slot):
-            k = jax.lax.dynamic_update_slice(
-                cache["k"], pref["k"].astype(cache["k"].dtype),
-                (0, slot, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(
-                cache["v"], pref["v"].astype(cache["v"].dtype),
-                (0, slot, 0, 0, 0))
+        def _insert_batch(cache, pref, slots):
+            """Insert N prefilled kv blocks into their slots in one
+            program. ``slots`` may contain out-of-range ids for padded
+            prefill rows — 'drop' mode discards those updates."""
+            s = pref["k"].shape[3]
+            k = cache["k"].at[:, slots, :, :s, :].set(
+                pref["k"].astype(cache["k"].dtype), mode="drop")
+            v = cache["v"].at[:, slots, :, :s, :].set(
+                pref["v"].astype(cache["v"].dtype), mode="drop")
             return {"k": k, "v": v}
 
-        self._insert_fn = jax.jit(_insert, donate_argnums=(0,))
+        self._insert_fn = jax.jit(_insert_batch, donate_argnums=(0,))
 
-        def _decode(params, tokens, positions, cache, key):
+        def _decode(params, tokens, positions, cache, key, *, kv_len):
             """``decode_window`` steps fused in one program: decode →
             sample → feed back, all on-device. One dispatch and one host
             sync per window instead of per token — the difference between
-            dispatch-bound and HBM-bound decode."""
+            dispatch-bound and HBM-bound decode. ``kv_len`` (static,
+            bucketed by the caller) bounds the cache prefix attention
+            reads — decode is HBM-bound, so this is proportional
+            bandwidth back."""
 
             def body(carry, _):
                 tok, pos, cache, key = carry
                 key, sub = jax.random.split(key)
                 logits, cache = decoder.decode_step(params, tok, pos, cfg,
-                                                    cache)
+                                                    cache, kv_len=kv_len)
                 nxt = sample(logits, sub, self.sampling)
                 return (nxt, pos + 1, cache, key), nxt
 
@@ -201,7 +206,8 @@ class GenerationEngine:
                 length=self.decode_window)
             return toks, cache          # toks: [window, slots]
 
-        self._decode_fn = jax.jit(_decode, donate_argnums=(3,))
+        self._decode_fn = jax.jit(_decode, donate_argnums=(3,),
+                                  static_argnames=("kv_len",))
 
         def _sample_only(logits, key):
             return sample(logits, key, self.sampling)
@@ -300,30 +306,63 @@ class GenerationEngine:
     # ------------------------------------------------------------------
 
     def _admit(self) -> None:
+        """Admit every queued request a free slot can take, as ONE
+        batched prefill. The r1 per-request path cost a full weight pass
+        plus a host sync per admission — on hardware where a device→host
+        round trip is tens of ms, 32 admissions burned seconds. Now:
+        one prefill over [N, bucket] (reads the weights once), one
+        batched cache insert, one sample, one host fetch of the N first
+        tokens."""
+        if not (self._queue and self._free):
+            return
+        t0 = time.monotonic()
+        batch: list[tuple[int, Request]] = []
         while self._queue and self._free:
-            req = self._queue.pop(0)
-            slot = self._free.pop(0)
-            t0 = time.monotonic()
-            plen = len(req.prompt)
-            bucket = _next_bucket(plen, self.buckets)
-            tokens = np.zeros((1, bucket), dtype=np.int32)
-            tokens[0, :plen] = req.prompt
-            lengths = jnp.asarray([plen], dtype=jnp.int32)
-            logits, pref_cache = self._prefill_fn(
-                self.params, jnp.asarray(tokens), lengths)
-            self._cache = self._insert_fn(self._cache, pref_cache,
-                                          jnp.int32(slot))
-            self._key, sub = jax.random.split(self._key)
-            first = int(jax.device_get(self._sample_fn(logits, sub))[0])
+            batch.append((self._free.pop(0), self._queue.pop(0)))
+        plens = [len(req.prompt) for _, req in batch]
+        bucket = _next_bucket(max(plens), self.buckets)
+        # Pad N to the next power of two: bounds compile-shape count at
+        # log2(num_slots) per bucket. Padded rows prefill garbage and are
+        # dropped by the out-of-range slot id in the insert.
+        n = 1
+        while n < len(batch):
+            n *= 2
+        tokens = np.zeros((n, bucket), dtype=np.int32)
+        lengths = np.ones((n,), dtype=np.int32)
+        slots = np.full((n,), self.num_slots, dtype=np.int32)  # OOB pad
+        for i, (slot, req) in enumerate(batch):
+            tokens[i, :plens[i]] = req.prompt
+            lengths[i] = plens[i]
+            slots[i] = slot
+        logits, pref_cache = self._prefill_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(lengths))
+        self._cache = self._insert_fn(self._cache, pref_cache,
+                                      jnp.asarray(slots))
+        self._key, sub = jax.random.split(self._key)
+        first = np.asarray(jax.device_get(
+            self._sample_fn(logits, sub)))           # the ONE host sync
+        prefill_s = time.monotonic() - t0
+        for i, (slot, req) in enumerate(batch):
+            tok = int(first[i])
             self._active[slot] = req
-            self._generated[slot] = [first]
-            self._positions[slot] = plen
-            self._next_tok[slot] = first
-            self._t_prefill[slot] = time.monotonic() - t0
+            self._generated[slot] = [tok]
+            self._positions[slot] = plens[i]
+            self._next_tok[slot] = tok
+            self._t_prefill[slot] = prefill_s
             req.decode_started_at = time.monotonic()
-            if first in self._eos_set or req.max_new_tokens <= 1:
+            if tok in self._eos_set or req.max_new_tokens <= 1:
                 self._retire(slot,
-                             "eos" if first in self._eos_set else "length")
+                             "eos" if tok in self._eos_set else "length")
+
+    def _kv_bucket(self) -> int:
+        """Static attention extent for the next decode window: the
+        occupied cache prefix rounded up to 128, so only a handful of
+        decode programs ever compile."""
+        if not self._active:
+            return min(128 + self.decode_window, self.max_len)
+        hi = max(int(self._positions[s]) for s in self._active)
+        need = hi + self.decode_window + 1
+        return min(-(-need // 128) * 128, self.max_len)
 
     def _decode_once(self) -> None:
         window = self.decode_window
@@ -334,6 +373,7 @@ class GenerationEngine:
             jnp.asarray(self._positions),
             self._cache,
             sub,
+            kv_len=self._kv_bucket(),
         )
         toks = np.asarray(jax.device_get(toks))      # [window, slots]
         for slot, req in list(self._active.items()):

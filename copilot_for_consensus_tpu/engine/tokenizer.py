@@ -130,9 +130,11 @@ class HFTokenizer(Tokenizer):
     def encode(self, text: str, add_bos: bool = False,
                add_eos: bool = False) -> list[int]:
         ids = list(self._tok.encode(text).ids)
-        if add_bos:
+        # Real Mistral/Llama tokenizer.json files carry a post-processor
+        # that already emits BOS; don't double it.
+        if add_bos and (not ids or ids[0] != self.bos_id):
             ids.insert(0, self.bos_id)
-        if add_eos:
+        if add_eos and (not ids or ids[-1] not in self.eos_ids):
             ids.append(self.eos_id)
         return ids
 

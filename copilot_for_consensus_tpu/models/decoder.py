@@ -191,23 +191,31 @@ def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
 
 
 def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
-                cfg: DecoderConfig, cache: Params
+                cfg: DecoderConfig, cache: Params,
+                kv_len: int | None = None
                 ) -> tuple[jax.Array, Params]:
     """One decode step. tokens: [B] int — the tokens to feed; positions:
-    [B] — the cache index each token occupies. Returns ([B, V] fp32 logits,
+    [B] — the cache index each token occupies; ``kv_len`` (static) bounds
+    the cache prefix attention reads. Returns ([B, V] fp32 logits,
     updated cache)."""
     x = params["tok_emb"][tokens][:, None, :]               # [B, 1, D]
 
-    def body(x, scanned):
-        layer, k_cache, v_cache = scanned
-        h, k_cache, v_cache = L.attn_decode(
+    # The stacked cache rides the scan CARRY with per-column scatter
+    # writes (attn_decode_stacked): as scan xs/ys it would be fully
+    # re-materialized (read + write) every token step — more HBM traffic
+    # than the weights at serving shapes.
+    def body(carry, scanned):
+        x, k_cache, v_cache = carry
+        layer, li = scanned
+        h, k_cache, v_cache = L.attn_decode_stacked(
             L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
-            layer, cfg, positions, k_cache, v_cache)
+            layer, cfg, positions, k_cache, v_cache, li, kv_len=kv_len)
         x = x + h
         x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
                      layer, cfg)
-        return x, (k_cache, v_cache)
+        return (x, k_cache, v_cache), None
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    (x, k_new, v_new), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)))
     return _unembed(x, params, cfg)[:, 0], {"k": k_new, "v": v_new}

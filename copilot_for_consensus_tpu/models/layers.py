@@ -121,30 +121,31 @@ def attn_prefill(x: jax.Array, layer: dict, cfg: DecoderConfig,
     return qmatmul(o, layer["wo"]), k, v
 
 
-def cache_write(cache: jax.Array, col: jax.Array,
-                positions: jax.Array) -> jax.Array:
-    """Write one kv column per slot. cache: [B, Hkv, S_max, Dh];
-    col: [B, Hkv, 1, Dh]; positions: [B]."""
-    return jax.vmap(
-        lambda c, x, p: jax.lax.dynamic_update_slice_in_dim(
-            c, x.astype(c.dtype), p, axis=1
-        )
-    )(cache, col, positions)
+def attn_decode_stacked(x: jax.Array, layer: dict, cfg: DecoderConfig,
+                        positions: jax.Array, k_cache: jax.Array,
+                        v_cache: jax.Array, li: jax.Array,
+                        kv_len: int | None = None):
+    """Decode attention against the FULL stacked cache [L,B,Hkv,S,Dh].
 
-
-def attn_decode(x: jax.Array, layer: dict, cfg: DecoderConfig,
-                positions: jax.Array, k_cache: jax.Array,
-                v_cache: jax.Array):
-    """One-token decode. x: [B, 1, D]; positions: [B] — index the new token
-    is written at; caches: [B, Hkv, S_max, Dh]. Returns
-    (out [B,1,D], k_cache, v_cache)."""
+    Writes one kv column per slot into layer ``li`` via scatter (touches
+    only B columns, not a whole layer slice) and reads only the
+    ``kv_len`` prefix. This lets the layer loop carry the stacked cache
+    — the alternative (cache as scan xs/ys) re-materializes every layer's
+    full cache slice per token step, which at serving shapes costs more
+    HBM traffic than the weights themselves."""
     b = x.shape[0]
     q, k, v = _project_qkv(x, layer, cfg, positions[:, None])
-    k_cache = cache_write(k_cache, k, positions)
-    v_cache = cache_write(v_cache, v, positions)
-    o = decode_attention(q[:, :, 0, :], k_cache, v_cache,
+    bidx = jnp.arange(b)
+    k_cache = k_cache.at[li, bidx, :, positions, :].set(
+        k[:, :, 0, :].astype(k_cache.dtype), mode="drop")
+    v_cache = v_cache.at[li, bidx, :, positions, :].set(
+        v[:, :, 0, :].astype(v_cache.dtype), mode="drop")
+    k_l = jax.lax.dynamic_index_in_dim(k_cache, li, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(v_cache, li, 0, keepdims=False)
+    o = decode_attention(q[:, :, 0, :], k_l, v_l,
                          lengths=positions + 1,
-                         window=cfg.sliding_window)       # [B, Hq, Dh]
+                         window=cfg.sliding_window,
+                         kv_len=kv_len)                   # [B, Hq, Dh]
     o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim)
     return qmatmul(o, layer["wo"]), k_cache, v_cache
 

@@ -124,21 +124,27 @@ def attention(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("window",))
+@functools.partial(jax.jit, static_argnames=("window", "kv_len"))
 def decode_attention(
     q: jax.Array,
     k_cache: jax.Array,
     v_cache: jax.Array,
     lengths: jax.Array,
     window: int = 0,
+    kv_len: int | None = None,
 ) -> jax.Array:
     """Single-token decode attention over a slot KV cache.
 
     q: [B, Hq, D]; caches: [B, Hkv, S_max, D]; lengths: [B] — number of
     valid cache positions per slot (the new token's kv already written).
-    Memory-bound; XLA's fused matvec pipeline is already near the HBM
-    roofline here, so no Pallas needed for the slot cache.
+    ``kv_len`` (static) restricts the read to cache prefix [0, kv_len) —
+    decode is HBM-bound, so attending over only the occupied prefix
+    instead of all of S_max is a direct bandwidth saving; the engine
+    buckets it so only a handful of shapes compile.
     """
+    if kv_len is not None and kv_len < k_cache.shape[2]:
+        k_cache = k_cache[:, :, :kv_len]
+        v_cache = v_cache[:, :, :kv_len]
     b, hq, d = q.shape
     hkv, s_max = k_cache.shape[1], k_cache.shape[2]
     group = hq // hkv

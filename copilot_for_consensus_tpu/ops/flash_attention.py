@@ -25,7 +25,7 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(
-    len_ref,      # SMEM [1]            valid kv length for this batch row
+    len_ref,      # SMEM [B]            valid kv length per batch row
     q_ref,        # VMEM [1, 1, bq, d]
     k_ref,        # VMEM [1, 1, bk, d]
     v_ref,        # VMEM [1, 1, bk, d]
@@ -40,6 +40,7 @@ def _flash_kernel(
     bk: int,
     scale: float,
 ):
+    bi = pl.program_id(0)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -73,7 +74,7 @@ def _flash_kernel(
 
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = k_pos < len_ref[0]
+        mask = k_pos < len_ref[bi]
         if causal:
             mask &= k_pos <= q_pos
         if window > 0:
@@ -145,7 +146,10 @@ def flash_attention(
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1,), lambda bi, hi, qi, ki: (bi,),
+            # whole lengths vector in SMEM; indexed by program_id(0) in
+            # the kernel (a rank-1 block of 1 over [B] is rejected by the
+            # TPU lowering's tiling rules when B > 1)
+            pl.BlockSpec((b,), lambda bi, hi, qi, ki: (0,),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, bq, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),

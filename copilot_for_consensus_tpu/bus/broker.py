@@ -1,0 +1,440 @@
+"""Broker-grade inter-process bus: one ROUTER socket, durable queues.
+
+The distributed-bus role the reference fills with RabbitMQ (publisher
+confirms ``rabbitmq_publisher.py:146-149``; manual ack + nack-requeue
+``rabbitmq_subscriber.py:504-560``; durable pre-declared queues
+``infra/rabbitmq/definitions.json``). Design:
+
+* **One broker socket.** All routing keys multiplex over a single ZMQ
+  ROUTER endpoint — no per-key ports, no hash collisions (the round-1
+  port-hash topology collided 17 keys into 64 ports). Publishers and
+  consumers are DEALER clients doing strict request/reply with timeouts.
+* **Durable by default.** Every published envelope lands in a sqlite
+  (WAL) queue table before the publisher confirm is sent; a broker crash
+  or restart loses nothing. In-flight deliveries carry a lease — if a
+  consumer dies mid-message, the lease expires and the message requeues.
+* **Ack / nack-requeue / DLQ.** Callback success acks; failure nacks and
+  requeues with an attempt count; past ``max_redeliveries`` the message
+  parks in the dead-letter state, visible to the failed-queues CLI.
+* **At-least-once.** Retries on timeouts can duplicate deliveries; the
+  pipeline is idempotent end-to-end (deterministic ids, upserts), same
+  contract as the reference's bus.
+
+The broker runs embedded (``Broker.start()`` thread) or standalone:
+``python -m copilot_for_consensus_tpu.bus.broker --port 5700 --db q.db``.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Any
+
+from copilot_for_consensus_tpu.bus.base import (
+    EventCallback,
+    EventPublisher,
+    EventSubscriber,
+    PublishError,
+)
+
+try:
+    import zmq
+
+    HAS_ZMQ = True
+except ImportError:  # pragma: no cover - environment without pyzmq
+    HAS_ZMQ = False
+
+DEFAULT_PORT = 5700
+DEFAULT_LEASE_S = 30.0
+
+
+class _QueueStore:
+    """sqlite-backed message queues. One table, state machine per row:
+    pending → inflight → (acked | pending | dead)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._lock = threading.Lock()
+        with self._lock, self._db:
+            self._db.execute("""
+                CREATE TABLE IF NOT EXISTS messages (
+                    id INTEGER PRIMARY KEY AUTOINCREMENT,
+                    rk TEXT NOT NULL,
+                    envelope TEXT NOT NULL,
+                    state TEXT NOT NULL DEFAULT 'pending',
+                    attempts INTEGER NOT NULL DEFAULT 0,
+                    lease_expires REAL,
+                    enqueued_at REAL NOT NULL
+                )""")
+            self._db.execute(
+                "CREATE INDEX IF NOT EXISTS idx_rk_state "
+                "ON messages (rk, state, id)")
+            # Broker (re)start: whatever was in flight requeues.
+            self._db.execute(
+                "UPDATE messages SET state='pending', lease_expires=NULL "
+                "WHERE state='inflight'")
+
+    def enqueue(self, rk: str, envelope: str) -> int:
+        with self._lock, self._db:
+            cur = self._db.execute(
+                "INSERT INTO messages (rk, envelope, enqueued_at) "
+                "VALUES (?, ?, ?)", (rk, envelope, time.time()))
+            return cur.lastrowid
+
+    def fetch(self, rks: list[str], limit: int, lease_s: float
+              ) -> list[tuple[int, str, str, int]]:
+        """Atomically move up to ``limit`` pending messages (across the
+        given keys) to inflight. Returns (id, rk, envelope, attempts)."""
+        now = time.time()
+        qmarks = ",".join("?" for _ in rks)
+        with self._lock, self._db:
+            rows = self._db.execute(
+                f"SELECT id, rk, envelope, attempts FROM messages "
+                f"WHERE state='pending' AND rk IN ({qmarks}) "
+                f"ORDER BY id LIMIT ?", (*rks, limit)).fetchall()
+            if rows:
+                ids = [r[0] for r in rows]
+                self._db.execute(
+                    f"UPDATE messages SET state='inflight', "
+                    f"lease_expires=? WHERE id IN "
+                    f"({','.join('?' for _ in ids)})",
+                    (now + lease_s, *ids))
+            return rows
+
+    def ack(self, ids: list[int]) -> None:
+        if not ids:
+            return
+        with self._lock, self._db:
+            self._db.execute(
+                f"DELETE FROM messages WHERE id IN "
+                f"({','.join('?' for _ in ids)}) AND state='inflight'",
+                ids)
+
+    def nack(self, ids: list[int], max_redeliveries: int) -> None:
+        if not ids:
+            return
+        qmarks = ",".join("?" for _ in ids)
+        with self._lock, self._db:
+            self._db.execute(
+                f"UPDATE messages SET attempts=attempts+1, "
+                f"lease_expires=NULL, state=CASE WHEN attempts+1 >= ? "
+                f"THEN 'dead' ELSE 'pending' END "
+                f"WHERE id IN ({qmarks}) AND state='inflight'",
+                (max_redeliveries, *ids))
+
+    def expire_leases(self) -> int:
+        with self._lock, self._db:
+            cur = self._db.execute(
+                "UPDATE messages SET state='pending', lease_expires=NULL "
+                "WHERE state='inflight' AND lease_expires < ?",
+                (time.time(),))
+            return cur.rowcount
+
+    def counts(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT rk, state, COUNT(*) FROM messages "
+                "GROUP BY rk, state").fetchall()
+        out: dict[str, dict[str, int]] = {}
+        for rk, state, n in rows:
+            out.setdefault(rk, {})[state] = n
+        return out
+
+    def dead_letters(self, rk: str | None = None
+                     ) -> list[tuple[int, str, str, int]]:
+        q = ("SELECT id, rk, envelope, attempts FROM messages "
+             "WHERE state='dead'")
+        args: tuple = ()
+        if rk:
+            q += " AND rk=?"
+            args = (rk,)
+        with self._lock:
+            return self._db.execute(q + " ORDER BY id", args).fetchall()
+
+    def requeue_dead(self, rk: str | None = None) -> int:
+        q = "UPDATE messages SET state='pending', attempts=0 " \
+            "WHERE state='dead'"
+        args: tuple = ()
+        if rk:
+            q += " AND rk=?"
+            args = (rk,)
+        with self._lock, self._db:
+            return self._db.execute(q, args).rowcount
+
+    def purge_dead(self, rk: str | None = None) -> int:
+        q = "DELETE FROM messages WHERE state='dead'"
+        args: tuple = ()
+        if rk:
+            q += " AND rk=?"
+            args = (rk,)
+        with self._lock, self._db:
+            return self._db.execute(q, args).rowcount
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
+class Broker:
+    """The broker process: ROUTER socket + durable queue store."""
+
+    def __init__(self, port: int = DEFAULT_PORT, db_path: str = ":memory:",
+                 host: str = "127.0.0.1", max_redeliveries: int = 3,
+                 lease_s: float = DEFAULT_LEASE_S):
+        if not HAS_ZMQ:
+            raise PublishError("pyzmq is not available")
+        self.host = host
+        self.port = port
+        self.store = _QueueStore(db_path)
+        self.max_redeliveries = max_redeliveries
+        self.lease_s = lease_s
+        self._ctx = zmq.Context.instance()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._bound = threading.Event()
+
+    # ---- request handling -------------------------------------------
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "pub":
+            mid = self.store.enqueue(req["rk"], json.dumps(req["envelope"]))
+            return {"ok": True, "id": mid}            # publisher confirm
+        if op == "fetch":
+            self.store.expire_leases()
+            rows = self.store.fetch(req["rks"], int(req.get("max", 16)),
+                                    self.lease_s)
+            return {"ok": True, "msgs": [
+                {"id": i, "rk": rk, "envelope": json.loads(env),
+                 "attempts": at} for i, rk, env, at in rows]}
+        if op == "ack":
+            self.store.ack(list(req.get("ids", [])))
+            return {"ok": True}
+        if op == "nack":
+            self.store.nack(list(req.get("ids", [])), self.max_redeliveries)
+            return {"ok": True}
+        if op == "counts":
+            return {"ok": True, "counts": self.store.counts()}
+        if op == "dead":
+            return {"ok": True, "msgs": [
+                {"id": i, "rk": rk, "envelope": json.loads(env),
+                 "attempts": at}
+                for i, rk, env, at in self.store.dead_letters(
+                    req.get("rk"))]}
+        if op == "requeue_dead":
+            return {"ok": True, "n": self.store.requeue_dead(req.get("rk"))}
+        if op == "purge_dead":
+            return {"ok": True, "n": self.store.purge_dead(req.get("rk"))}
+        if op == "ping":
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # ---- run loop ----------------------------------------------------
+
+    def run(self) -> None:
+        sock = self._ctx.socket(zmq.ROUTER)
+        sock.setsockopt(zmq.LINGER, 0)
+        if self.port == 0:
+            self.port = sock.bind_to_random_port(f"tcp://{self.host}")
+        else:
+            sock.bind(f"tcp://{self.host}:{self.port}")
+        self._bound.set()
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        try:
+            while not self._stop.is_set():
+                if not dict(poller.poll(timeout=100)):
+                    continue
+                frames = sock.recv_multipart()
+                identity, payload = frames[0], frames[-1]
+                try:
+                    reply = self._handle(json.loads(payload))
+                except Exception as exc:   # malformed request
+                    reply = {"ok": False, "error": str(exc)}
+                sock.send_multipart(
+                    [identity, b"", json.dumps(reply).encode()])
+        finally:
+            sock.close()
+
+    def start(self) -> "Broker":
+        self._thread = threading.Thread(target=self.run, name="bus-broker",
+                                        daemon=True)
+        self._thread.start()
+        if not self._bound.wait(timeout=5):
+            raise PublishError("broker failed to bind")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.store.close()
+
+    @property
+    def address(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+
+class _Client:
+    """One DEALER connection doing strict request/reply with timeouts."""
+
+    def __init__(self, address: str, timeout_ms: int = 5000,
+                 retries: int = 3):
+        if not HAS_ZMQ:
+            raise PublishError("pyzmq is not available")
+        self.address = address
+        self.timeout_ms = timeout_ms
+        self.retries = retries
+        self._ctx = zmq.Context.instance()
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        if self._sock is not None:
+            self._sock.close(linger=0)
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.connect(self.address)
+
+    def request(self, req: dict) -> dict:
+        """Send one request, await the reply. Times out → reconnect and
+        retry (at-least-once: a retried 'pub' may duplicate; consumers
+        are idempotent by pipeline contract)."""
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            payload = json.dumps(req).encode()
+            last = "timeout"
+            for _ in range(self.retries):
+                self._sock.send_multipart([b"", payload])
+                poller = zmq.Poller()
+                poller.register(self._sock, zmq.POLLIN)
+                if dict(poller.poll(timeout=self.timeout_ms)):
+                    frames = self._sock.recv_multipart()
+                    reply = json.loads(frames[-1])
+                    if not reply.get("ok"):
+                        raise PublishError(reply.get("error", "broker nak"))
+                    return reply
+                self._connect()      # stale socket: drop + reconnect
+            raise PublishError(f"broker unreachable at {self.address} "
+                               f"({last})")
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close(linger=0)
+                self._sock = None
+
+
+class BrokerPublisher(EventPublisher):
+    """Publishes with broker confirms (the role of RabbitMQ publisher
+    confirms, ``rabbitmq_publisher.py:146-149``)."""
+
+    def __init__(self, config: Any = None):
+        cfg = dict(config or {})
+        address = cfg.get("address") or (
+            f"tcp://{cfg.get('host', '127.0.0.1')}:"
+            f"{cfg.get('port', DEFAULT_PORT)}")
+        self._client = _Client(address,
+                               timeout_ms=int(cfg.get("timeout_ms", 5000)))
+
+    def publish_envelope(self, envelope, routing_key=None):
+        if routing_key is None:
+            from copilot_for_consensus_tpu.core.events import EVENT_TYPES
+
+            cls = EVENT_TYPES.get(envelope.get("event_type", ""))
+            routing_key = cls.routing_key if cls else "unrouted"
+        self._client.request(
+            {"op": "pub", "rk": routing_key, "envelope": dict(envelope)})
+
+    def close(self):
+        self._client.close()
+
+
+class BrokerSubscriber(EventSubscriber):
+    """Pull-based consumer: fetch → dispatch → ack/nack per message."""
+
+    def __init__(self, config: Any = None):
+        cfg = dict(config or {})
+        address = cfg.get("address") or (
+            f"tcp://{cfg.get('host', '127.0.0.1')}:"
+            f"{cfg.get('port', DEFAULT_PORT)}")
+        self._client = _Client(address,
+                               timeout_ms=int(cfg.get("timeout_ms", 5000)))
+        self.poll_interval_s = float(cfg.get("poll_interval_s", 0.05))
+        self.batch = int(cfg.get("batch", 16))
+        self._routes: dict[str, EventCallback] = {}
+        self._stop = threading.Event()
+
+    def subscribe(self, routing_keys, callback):
+        for rk in routing_keys:
+            self._routes[rk] = callback
+
+    def _dispatch(self, msg: dict) -> None:
+        cb = self._routes.get(msg["rk"])
+        if cb is None:
+            self._client.request({"op": "ack", "ids": [msg["id"]]})
+            return
+        try:
+            cb(msg["envelope"])
+        except Exception:
+            self._client.request({"op": "nack", "ids": [msg["id"]]})
+        else:
+            self._client.request({"op": "ack", "ids": [msg["id"]]})
+
+    def drain(self, max_messages: int | None = None) -> int:
+        """Process what's queued now; returns the number handled."""
+        n = 0
+        while max_messages is None or n < max_messages:
+            want = self.batch if max_messages is None else min(
+                self.batch, max_messages - n)
+            reply = self._client.request(
+                {"op": "fetch", "rks": sorted(self._routes), "max": want})
+            msgs = reply.get("msgs", [])
+            if not msgs:
+                break
+            for m in msgs:
+                self._dispatch(m)
+                n += 1
+        return n
+
+    def start_consuming(self):
+        self._stop.clear()
+        while not self._stop.is_set():
+            if self.drain() == 0:
+                self._stop.wait(self.poll_interval_s)
+
+    def stop(self):
+        self._stop.set()
+
+    def close(self):
+        self.stop()
+        self._client.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="copilot bus broker")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--db", default=":memory:",
+                    help="sqlite path for durable queues")
+    ap.add_argument("--max-redeliveries", type=int, default=3)
+    ap.add_argument("--lease-s", type=float, default=DEFAULT_LEASE_S)
+    args = ap.parse_args(argv)
+    broker = Broker(port=args.port, db_path=args.db, host=args.host,
+                    max_redeliveries=args.max_redeliveries,
+                    lease_s=args.lease_s)
+    print(f"broker listening on {broker.address} (db={args.db})",
+          flush=True)
+    broker.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,8 +7,9 @@ decorators — SURVEY.md §2.1). Drivers here:
 * ``inproc`` — a process-local topic broker with durable-queue semantics
   (ack / nack-requeue / redelivery cap / dead-letter), the default for
   single-host runs and tests (the reference's fake-backend strategy, §4);
-* ``zmq``   — ZeroMQ pub/sub for cross-process fan-out on one host or over
-  TCP between hosts;
+* ``broker`` (alias ``zmq``) — the inter-process tier: one ZMQ ROUTER
+  broker with sqlite-durable queues, publisher confirms, ack/nack-requeue
+  leases and dead-lettering (``bus/broker.py``);
 * ``noop``  — drops everything.
 
 On TPU pods this host bus is tier 2 of the two-tier comms design
@@ -22,12 +23,20 @@ from copilot_for_consensus_tpu.bus.base import (
     EventSubscriber,
     PublishError,
 )
+from copilot_for_consensus_tpu.bus.broker import (
+    Broker,
+    BrokerPublisher,
+    BrokerSubscriber,
+)
 from copilot_for_consensus_tpu.bus.inproc import InProcBroker, get_broker
 
 __all__ = [
     "EventPublisher",
     "EventSubscriber",
     "PublishError",
+    "Broker",
+    "BrokerPublisher",
+    "BrokerSubscriber",
     "InProcBroker",
     "get_broker",
 ]

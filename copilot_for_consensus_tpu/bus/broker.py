@@ -241,7 +241,20 @@ class Broker:
         if self.port == 0:
             self.port = sock.bind_to_random_port(f"tcp://{self.host}")
         else:
-            sock.bind(f"tcp://{self.host}:{self.port}")
+            # A broker restarting right after a crash can race the old
+            # socket's TIME_WAIT; retry instead of dying on EADDRINUSE.
+            # Deadline stays under start()'s _bound.wait(5) so a failed
+            # bind surfaces there rather than binding after the caller
+            # already gave up. Non-transient errnos re-raise immediately.
+            deadline = time.time() + 4
+            while True:
+                try:
+                    sock.bind(f"tcp://{self.host}:{self.port}")
+                    break
+                except zmq.ZMQError as exc:
+                    if exc.errno != zmq.EADDRINUSE or time.time() > deadline:
+                        raise
+                    time.sleep(0.2)
         self._bound.set()
         poller = zmq.Poller()
         poller.register(sock, zmq.POLLIN)
@@ -376,15 +389,19 @@ class BrokerSubscriber(EventSubscriber):
 
     def _dispatch(self, msg: dict) -> None:
         cb = self._routes.get(msg["rk"])
-        if cb is None:
-            self._client.request({"op": "ack", "ids": [msg["id"]]})
-            return
+        ok = True
+        if cb is not None:
+            try:
+                cb(msg["envelope"])
+            except Exception:
+                ok = False
         try:
-            cb(msg["envelope"])
-        except Exception:
-            self._client.request({"op": "nack", "ids": [msg["id"]]})
-        else:
-            self._client.request({"op": "ack", "ids": [msg["id"]]})
+            self._client.request(
+                {"op": "ack" if ok else "nack", "ids": [msg["id"]]})
+        except PublishError:
+            # Broker unreachable: the lease will expire and the message
+            # redelivers — at-least-once holds without us crashing.
+            pass
 
     def drain(self, max_messages: int | None = None) -> int:
         """Process what's queued now; returns the number handled."""
@@ -403,9 +420,20 @@ class BrokerSubscriber(EventSubscriber):
         return n
 
     def start_consuming(self):
+        """Consume until stop(); survives broker outages by backing off and
+        reconnecting (the reference subscriber's reconnect loop,
+        ``rabbitmq_subscriber.py``)."""
         self._stop.clear()
+        backoff = self.poll_interval_s
         while not self._stop.is_set():
-            if self.drain() == 0:
+            try:
+                n = self.drain()
+            except PublishError:
+                self._stop.wait(min(backoff, 5.0))
+                backoff = min(backoff * 2, 5.0)
+                continue
+            backoff = self.poll_interval_s
+            if n == 0:
                 self._stop.wait(self.poll_interval_s)
 
     def stop(self):

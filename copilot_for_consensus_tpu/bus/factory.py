@@ -23,10 +23,10 @@ def create_publisher(config: Any = None, validate: bool = True):
     driver = cfg.get("driver", "inproc")
     if driver == "inproc":
         pub = InProcPublisher(cfg)
-    elif driver == "zmq":
-        from copilot_for_consensus_tpu.bus.zmq_bus import ZmqPublisher
+    elif driver in ("broker", "zmq"):   # zmq kept as a config alias
+        from copilot_for_consensus_tpu.bus.broker import BrokerPublisher
 
-        pub = ZmqPublisher(cfg)
+        pub = BrokerPublisher(cfg)
     elif driver == "noop":
         pub = NoopPublisher()
     else:
@@ -40,10 +40,10 @@ def create_subscriber(config: Any = None, validate: bool = True,
     driver = cfg.get("driver", "inproc")
     if driver == "inproc":
         sub = InProcSubscriber(cfg)
-    elif driver == "zmq":
-        from copilot_for_consensus_tpu.bus.zmq_bus import ZmqSubscriber
+    elif driver in ("broker", "zmq"):
+        from copilot_for_consensus_tpu.bus.broker import BrokerSubscriber
 
-        sub = ZmqSubscriber(cfg)
+        sub = BrokerSubscriber(cfg)
     elif driver == "noop":
         sub = NoopSubscriber()
     else:
@@ -51,5 +51,5 @@ def create_subscriber(config: Any = None, validate: bool = True,
     return ValidatingSubscriber(sub, on_invalid=on_invalid) if validate else sub
 
 
-for _name in ("inproc", "zmq", "noop"):
+for _name in ("inproc", "broker", "zmq", "noop"):
     register_driver("message_bus", _name, create_publisher)

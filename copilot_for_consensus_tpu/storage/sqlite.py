@@ -1,9 +1,16 @@
 """SQLite document store: the durable single-host driver.
 
 One table per collection (``id TEXT PRIMARY KEY, doc TEXT`` JSON), WAL mode
-for concurrent reader/writer services, Mongo-style filters evaluated by the
-shared matcher. Fills the durable-store role the reference delegates to
-MongoDB (``mongo_document_store.py:33``) without an external process.
+for concurrent reader/writer services. Fills the durable-store role the
+reference delegates to MongoDB (``mongo_document_store.py:33``) without an
+external process — including its index story: the Mongo driver declares
+per-collection indexes on the hot filter fields
+(``mongo_document_store.py:33``); here the same fields get SQLite
+*expression indexes* over ``json_extract(doc, '$.field')``, and the
+Mongo-style filter subset compiles to SQL ``WHERE`` clauses that use them.
+Queries the compiler can't express exactly (``$regex``, ``None`` inside
+``$in`` lists, exotic paths) fall back to the shared Python matcher, so
+semantics never change — only the plan does.
 """
 
 from __future__ import annotations
@@ -25,6 +32,137 @@ from copilot_for_consensus_tpu.storage.base import (
 )
 
 _TABLE_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+_PATH_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*(\.[A-Za-z_][A-Za-z0-9_]*)*$")
+
+# Hot filter/sort fields per collection → expression indexes, mirroring the
+# role of the reference Mongo driver's per-collection index declarations
+# (``mongo_document_store.py:33``). Extra entries are harmless; missing ones
+# only cost a scan.
+INDEX_FIELDS: dict[str, tuple[str, ...]] = {
+    "archives": ("source_id", "status"),
+    "messages": ("thread_id", "source_id", "archive_id", "status"),
+    "threads": ("source_id", "status"),
+    "chunks": ("thread_id", "source_id", "message_doc_id",
+               "embedding_generated", "seq"),
+    "summaries": ("thread_id", "source_id", "status"),
+    "reports": ("thread_id", "summary_id", "status"),
+}
+
+
+def _ex(path: str) -> str:
+    """The indexed extraction expression for a validated dotted path."""
+    return f"json_extract(doc, '$.{path}')"
+
+
+def _ty(path: str) -> str:
+    return f"json_type(doc, '$.{path}')"
+
+
+class _Incompatible(Exception):
+    """Filter/sort shape the SQL compiler can't express exactly."""
+
+
+def _compile_condition(path: str, cond: Any, params: list) -> str:
+    if not _PATH_RE.match(path):
+        raise _Incompatible(path)
+    if isinstance(cond, Mapping) and any(k.startswith("$") for k in cond):
+        clauses = []
+        for op, arg in cond.items():
+            if op == "$exists":
+                clauses.append(f"{_ty(path)} IS " +
+                               ("NOT NULL" if arg else "NULL"))
+            elif op == "$ne":
+                if arg is None:
+                    clauses.append(f"({_ty(path)} IS NOT NULL "
+                                   f"AND {_ty(path)} != 'null')")
+                elif not isinstance(arg, (str, int, float, bool)):
+                    raise _Incompatible(op)
+                else:
+                    params.append(arg)
+                    clauses.append(f"({_ex(path)} IS NULL "
+                                   f"OR {_ex(path)} != ?)")
+            elif op in ("$in", "$nin"):
+                vals = list(arg)
+                if any(v is None for v in vals) or not all(
+                        isinstance(v, (str, int, float, bool)) for v in vals):
+                    raise _Incompatible(op)
+                if not vals:
+                    # Matcher: $in [] never matches; $nin [] matches any
+                    # doc whose field exists ('NOT IN (NULL)' would be
+                    # NULL → reject-all, so special-case both).
+                    clauses.append("0" if op == "$in"
+                                   else f"{_ty(path)} IS NOT NULL")
+                    continue
+                marks = ",".join("?" for _ in vals)
+                params.extend(vals)
+                if op == "$in":
+                    clauses.append(f"{_ex(path)} IN ({marks})")
+                else:
+                    clauses.append(
+                        f"({_ty(path)} IS NOT NULL AND ({_ty(path)}='null' "
+                        f"OR {_ex(path)} NOT IN ({marks})))")
+            elif op in ("$lt", "$lte", "$gt", "$gte"):
+                if not isinstance(arg, (str, int, float)) or isinstance(
+                        arg, bool):
+                    raise _Incompatible(op)
+                sql_op = {"$lt": "<", "$lte": "<=",
+                          "$gt": ">", "$gte": ">="}[op]
+                # Type guard: the Python matcher raises TypeError on a
+                # str-vs-number comparison; SQL can't raise, so mixed-type
+                # rows are excluded instead of silently type-ordered.
+                # Python bools ARE ints, so they stay comparable to numbers.
+                want = ("'text'" if isinstance(arg, str)
+                        else "'integer','real','true','false'")
+                params.append(arg)
+                clauses.append(f"({_ty(path)} IN ({want}) "
+                               f"AND {_ex(path)} {sql_op} ?)")
+            else:  # $regex and anything unknown → Python matcher
+                raise _Incompatible(op)
+        return "(" + " AND ".join(clauses) + ")"
+    # Plain equality.
+    if cond is None:
+        return f"{_ty(path)} = 'null'"
+    if not isinstance(cond, (str, int, float, bool)):
+        raise _Incompatible(type(cond).__name__)
+    params.append(cond)
+    return f"{_ex(path)} = ?"
+
+
+def _compile_filter(flt: Mapping[str, Any] | None, params: list) -> str:
+    """Compile the Mongo-subset filter to a WHERE expression with exactly the
+    semantics of :func:`matches_filter`; raises _Incompatible otherwise."""
+    if not flt:
+        return "1"
+    clauses = []
+    for key, cond in flt.items():
+        if key == "$or":
+            subs = [_compile_filter(sub, params) for sub in cond]
+            clauses.append("(" + " OR ".join(subs or ["0"]) + ")")
+        elif key == "$and":
+            subs = [_compile_filter(sub, params) for sub in cond]
+            clauses.append("(" + " AND ".join(subs or ["1"]) + ")")
+        elif key.startswith("$"):
+            raise _Incompatible(key)
+        else:
+            clauses.append(_compile_condition(key, cond, params))
+    return "(" + " AND ".join(clauses) + ")"
+
+
+def _compile_sort(sort: Sequence[tuple[str, int]] | None) -> str:
+    """ORDER BY matching sort_documents: ascending puts None last,
+    descending (full reverse) puts None first; rowid breaks ties in
+    insertion order like Python's stable sort."""
+    if not sort:
+        # Match the fallback/memory stores' insertion order (rowid order);
+        # without this, an index scan would return rows grouped by key.
+        return " ORDER BY rowid ASC"
+    terms = []
+    for field_name, direction in sort:
+        if not _PATH_RE.match(field_name):
+            raise _Incompatible(field_name)
+        d = "DESC" if direction < 0 else "ASC"
+        terms.append(f"{_ex(field_name)} IS NULL {d}, {_ex(field_name)} {d}")
+    return " ORDER BY " + ", ".join(terms) + ", rowid ASC"
 
 
 class SQLiteDocumentStore(DocumentStore):
@@ -64,6 +202,11 @@ class SQLiteDocumentStore(DocumentStore):
                     f"CREATE TABLE IF NOT EXISTS {table} "
                     "(id TEXT PRIMARY KEY, doc TEXT NOT NULL)"
                 )
+                for field_name in INDEX_FIELDS.get(collection, ()):
+                    self._conn().execute(
+                        f"CREATE INDEX IF NOT EXISTS "
+                        f"idx_{collection}_{field_name} ON {table} "
+                        f"({_ex(field_name)})")
                 self._conn().commit()
                 self._known_tables.add(table)
         return table
@@ -116,13 +259,26 @@ class SQLiteDocumentStore(DocumentStore):
 
     def query_documents(self, collection, flt=None, *, limit=None, skip=0,
                         sort: Sequence[tuple[str, int]] | None = None):
-        docs = [d for d in self._iter_docs(collection) if matches_filter(d, flt)]
-        sort_documents(docs, sort)
-        if skip:
-            docs = docs[skip:]
-        if limit is not None:
-            docs = docs[:limit]
-        return docs
+        table = self._table(collection)
+        try:
+            params: list = []
+            where = _compile_filter(flt, params)
+            order = _compile_sort(sort)
+        except _Incompatible:
+            docs = [d for d in self._iter_docs(collection)
+                    if matches_filter(d, flt)]
+            sort_documents(docs, sort)
+            if skip:
+                docs = docs[skip:]
+            if limit is not None:
+                docs = docs[:limit]
+            return docs
+        sql = f"SELECT doc FROM {table} WHERE {where}{order}"
+        if limit is not None or skip:
+            sql += " LIMIT ? OFFSET ?"
+            params.extend([-1 if limit is None else limit, skip])
+        return [json.loads(raw) for (raw,)
+                in self._conn().execute(sql, params)]
 
     def update_document(self, collection, doc_id, updates):
         table = self._table(collection)
@@ -151,22 +307,31 @@ class SQLiteDocumentStore(DocumentStore):
 
     def delete_documents(self, collection, flt=None):
         table = self._table(collection)
-        if not flt:
-            cur = self._conn().execute(f"DELETE FROM {table}")
+        try:
+            params: list = []
+            where = _compile_filter(flt, params)
+        except _Incompatible:
+            ids = [str(d[registry.primary_key(collection)])
+                   for d in self._iter_docs(collection)
+                   if matches_filter(d, flt)]
+            for doc_id in ids:
+                self._conn().execute(
+                    f"DELETE FROM {table} WHERE id=?", (doc_id,))
             self._conn().commit()
-            return cur.rowcount
-        ids = [str(d[registry.primary_key(collection)])
-               for d in self._iter_docs(collection) if matches_filter(d, flt)]
-        for doc_id in ids:
-            self._conn().execute(
-                f"DELETE FROM {table} WHERE id=?", (doc_id,))
+            return len(ids)
+        cur = self._conn().execute(
+            f"DELETE FROM {table} WHERE {where}", params)
         self._conn().commit()
-        return len(ids)
+        return cur.rowcount
 
     def count_documents(self, collection, flt=None):
         table = self._table(collection)
-        if not flt:
-            return self._conn().execute(
-                f"SELECT COUNT(*) FROM {table}").fetchone()[0]
-        return sum(1 for d in self._iter_docs(collection)
-                   if matches_filter(d, flt))
+        try:
+            params: list = []
+            where = _compile_filter(flt, params)
+        except _Incompatible:
+            return sum(1 for d in self._iter_docs(collection)
+                       if matches_filter(d, flt))
+        return self._conn().execute(
+            f"SELECT COUNT(*) FROM {table} WHERE {where}",
+            params).fetchone()[0]

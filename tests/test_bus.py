@@ -96,20 +96,156 @@ def test_validating_subscriber_quarantines_invalid():
     assert sub.invalid_count == 1
 
 
-def test_zmq_roundtrip_if_available():
-    zmq_bus = pytest.importorskip("copilot_for_consensus_tpu.bus.zmq_bus")
-    if not zmq_bus.HAS_ZMQ:
+# ---- broker (inter-process tier) ----------------------------------------
+
+broker_mod = pytest.importorskip("copilot_for_consensus_tpu.bus.broker")
+
+
+@pytest.fixture
+def live_broker():
+    if not broker_mod.HAS_ZMQ:
         pytest.skip("pyzmq missing")
-    pub = zmq_bus.ZmqPublisher({"base_port": 5810})
-    sub = zmq_bus.ZmqSubscriber({"base_port": 5810})
+    b = broker_mod.Broker(port=0).start()
+    yield b
+    b.stop()
+
+
+def test_broker_roundtrip_via_factory(live_broker):
+    pub = create_publisher({"driver": "broker",
+                            "address": live_broker.address})
+    sub = create_subscriber({"driver": "broker",
+                             "address": live_broker.address})
     seen = []
     sub.subscribe(["archive.ingested"], lambda env: seen.append(env))
-    import time
-    time.sleep(0.2)  # let PULL connect
     pub.publish(ArchiveIngested(archive_id="z1"))
-    deadline = time.time() + 5
-    while not seen and time.time() < deadline:
-        sub.drain(max_messages=10)
+    sub.drain(max_messages=10)
     pub.close()
     sub.close()
     assert seen and seen[0]["data"]["archive_id"] == "z1"
+
+
+def test_broker_all_routing_keys_concurrently(live_broker):
+    """Every routing key in the contract multiplexes over ONE broker socket
+    with publishers in multiple threads — the round-1 port-hash design
+    collided keys onto shared ports; this is its regression test."""
+    import threading
+
+    from copilot_for_consensus_tpu.core.events import EVENT_TYPES
+
+    keys = sorted({cls.routing_key for cls in EVENT_TYPES.values()})
+    assert len(keys) >= 17
+    pub = broker_mod.BrokerPublisher({"address": live_broker.address})
+    sub = broker_mod.BrokerSubscriber({"address": live_broker.address})
+    seen: dict[str, list] = {k: [] for k in keys}
+    for k in keys:
+        sub.subscribe([k], lambda env, k=k: seen[k].append(env))
+
+    def blast(key):
+        for i in range(5):
+            pub.publish_envelope({"event_type": key, "n": i},
+                                 routing_key=key)
+
+    threads = [threading.Thread(target=blast, args=(k,)) for k in keys]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sub.drain()
+    pub.close()
+    sub.close()
+    assert all(len(v) == 5 for v in seen.values()), {
+        k: len(v) for k, v in seen.items() if len(v) != 5}
+
+
+def test_broker_nack_requeues_then_dead_letters(live_broker):
+    pub = broker_mod.BrokerPublisher({"address": live_broker.address})
+    sub = broker_mod.BrokerSubscriber({"address": live_broker.address})
+    attempts = []
+
+    def explode(env):
+        attempts.append(env)
+        raise RuntimeError("boom")
+
+    sub.subscribe(["archive.ingested"], explode)
+    pub.publish_envelope({"event_type": "archive.ingested"},
+                         routing_key="archive.ingested")
+    for _ in range(5):
+        sub.drain()
+    assert len(attempts) == 3  # max_redeliveries
+    dead = live_broker.store.dead_letters("archive.ingested")
+    assert len(dead) == 1
+    # Operator requeue (the failed-queues CLI path) revives it.
+    assert live_broker.store.requeue_dead("archive.ingested") == 1
+    sub.close()
+    pub.close()
+
+
+def test_broker_lease_expiry_redelivers_crashed_consumer_work(live_broker):
+    """A consumer that fetches then dies mid-message must not strand it."""
+    live_broker.lease_s = 0.05
+    pub = broker_mod.BrokerPublisher({"address": live_broker.address})
+    pub.publish_envelope({"event_type": "archive.ingested"},
+                         routing_key="archive.ingested")
+    crashed = broker_mod.BrokerSubscriber({"address": live_broker.address})
+    crashed.subscribe(["archive.ingested"], lambda env: None)
+    # Simulate the crash: fetch (message goes inflight) but never ack.
+    reply = crashed._client.request(
+        {"op": "fetch", "rks": ["archive.ingested"], "max": 1})
+    assert len(reply["msgs"]) == 1
+    crashed.close()
+    import time
+    time.sleep(0.1)  # lease expires
+    survivor = broker_mod.BrokerSubscriber({"address": live_broker.address})
+    seen = []
+    survivor.subscribe(["archive.ingested"], lambda env: seen.append(env))
+    survivor.drain()
+    survivor.close()
+    pub.close()
+    assert len(seen) == 1
+
+
+def test_broker_kill_and_resume_no_message_loss(tmp_path):
+    """VERDICT r1 item 4's 'kill-and-resume' case: the broker process is
+    killed with messages queued and in flight; a restart on the same sqlite
+    file delivers every message."""
+    if not broker_mod.HAS_ZMQ:
+        pytest.skip("pyzmq missing")
+    import subprocess
+    import sys
+    import time
+
+    db = str(tmp_path / "queues.sqlite3")
+    port = 5741
+    cmd = [sys.executable, "-m", "copilot_for_consensus_tpu.bus.broker",
+           "--port", str(port), "--db", db]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE)
+    try:
+        proc.stdout.readline()  # "broker listening" → bound
+        addr = f"tcp://127.0.0.1:{port}"
+        pub = broker_mod.BrokerPublisher({"address": addr})
+        for i in range(20):
+            pub.publish_envelope({"event_type": "archive.ingested", "n": i},
+                                 routing_key="archive.ingested")
+        # One message inflight (fetched, never acked) at kill time.
+        probe = broker_mod.BrokerSubscriber({"address": addr})
+        probe.subscribe(["archive.ingested"], lambda env: None)
+        probe._client.request(
+            {"op": "fetch", "rks": ["archive.ingested"], "max": 1})
+        probe.close()
+        proc.kill()
+        proc.wait(timeout=10)
+        # Restart on the same durable db (inflight requeues on open).
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE)
+        proc.stdout.readline()
+        sub = broker_mod.BrokerSubscriber({"address": addr})
+        seen = []
+        sub.subscribe(["archive.ingested"], lambda env: seen.append(env))
+        deadline = time.time() + 10
+        while len(seen) < 20 and time.time() < deadline:
+            sub.drain()
+        sub.close()
+        pub.close()
+        assert sorted(e["n"] for e in seen) == list(range(20))
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)

@@ -113,3 +113,128 @@ def test_matches_filter_edge_cases():
     assert not matches_filter(doc, {"missing": {"$exists": True}})
     assert matches_filter(doc, {"missing": {"$exists": False}})
     assert matches_filter(doc, {"missing": {"$ne": 5}})
+
+
+# ---- SQL pushdown parity + indexing (VERDICT r1 weak #6) -----------------
+
+FIXTURE_DOCS = [
+    {"chunk_id": "p1", "thread_id": "ta", "seq": 2,
+     "embedding_generated": False, "token_count": 10},
+    {"chunk_id": "p2", "thread_id": "ta", "seq": 1,
+     "embedding_generated": True, "token_count": 250},
+    {"chunk_id": "p3", "thread_id": "tb", "seq": 1,
+     "embedding_generated": False, "token_count": 120, "status": None},
+    {"chunk_id": "p4", "thread_id": "tb", "seq": 3,
+     "token_count": 90, "status": "failed"},
+    {"chunk_id": "p5", "thread_id": "tc", "seq": 2,
+     "embedding_generated": False, "status": "ok",
+     "meta": {"lang": "en"}},
+]
+
+PARITY_FILTERS = [
+    None,
+    {},
+    {"thread_id": "ta"},
+    {"embedding_generated": False},
+    {"thread_id": {"$in": ["ta", "tc"]}},
+    {"thread_id": {"$nin": ["ta", "tc"]}},
+    {"chunk_id": {"$in": []}},
+    {"status": {"$nin": []}},
+    {"token_count": {"$gte": 100}},
+    {"token_count": {"$lt": 100}},
+    {"token_count": {"$gt": 10, "$lte": 250}},
+    {"status": {"$exists": True}},
+    {"status": {"$exists": False}},
+    {"status": None},
+    {"status": {"$ne": None}},
+    {"status": {"$ne": "failed"}},
+    {"meta.lang": "en"},
+    {"$or": [{"thread_id": "ta"}, {"status": "ok"}]},
+    {"$and": [{"thread_id": "tb"}, {"seq": {"$gte": 2}}]},
+    {"thread_id": "ta", "embedding_generated": True},
+    {"chunk_id": {"$regex": "p[12]"}},  # exercises the Python fallback
+    {"thread_id": {"$ne": []}},         # non-scalar arg → fallback too
+]
+
+PARITY_SORTS = [None, [("seq", 1)], [("seq", -1)],
+                [("thread_id", 1), ("seq", -1)], [("status", 1)]]
+
+
+def _loaded_stores(tmp_path):
+    mem = InMemoryDocumentStore()
+    sql = SQLiteDocumentStore({"path": str(tmp_path / "parity.sqlite3")})
+    for s in (mem, sql):
+        for d in FIXTURE_DOCS:
+            s.insert_document("chunks", d)
+    return mem, sql
+
+
+def test_sql_pushdown_parity_with_matcher(tmp_path):
+    """The compiled WHERE/ORDER BY path must agree with the shared Python
+    matcher on every operator the filter language documents."""
+    mem, sql = _loaded_stores(tmp_path)
+    for flt in PARITY_FILTERS:
+        for sort in PARITY_SORTS:
+            want = [d["chunk_id"] for d in mem.query_documents(
+                "chunks", flt, sort=sort)]
+            got = [d["chunk_id"] for d in sql.query_documents(
+                "chunks", flt, sort=sort)]
+            assert got == want, (flt, sort)
+        assert sql.count_documents("chunks", flt) == \
+            mem.count_documents("chunks", flt), flt
+    sql.close()
+
+
+def test_sql_pushdown_limit_skip_parity(tmp_path):
+    mem, sql = _loaded_stores(tmp_path)
+    for kwargs in ({"limit": 2}, {"skip": 2}, {"limit": 2, "skip": 1}):
+        want = [d["chunk_id"] for d in mem.query_documents(
+            "chunks", {"embedding_generated": False},
+            sort=[("seq", 1)], **kwargs)]
+        got = [d["chunk_id"] for d in sql.query_documents(
+            "chunks", {"embedding_generated": False},
+            sort=[("seq", 1)], **kwargs)]
+        assert got == want, kwargs
+    sql.close()
+
+
+def test_sql_pushdown_delete_parity(tmp_path):
+    mem, sql = _loaded_stores(tmp_path)
+    for s in (mem, sql):
+        assert s.delete_documents("chunks", {"thread_id": "tb"}) == 2
+        assert s.count_documents("chunks") == 3
+    sql.close()
+
+
+def test_sqlite_uses_expression_index(tmp_path):
+    """Hot-field queries must hit the expression index, not scan."""
+    s = SQLiteDocumentStore({"path": str(tmp_path / "idx.sqlite3")})
+    s.insert_document("chunks", FIXTURE_DOCS[0])
+    plan = " ".join(r[-1] for r in s._conn().execute(
+        "EXPLAIN QUERY PLAN SELECT doc FROM docs_chunks "
+        "WHERE json_extract(doc, '$.thread_id') = ?", ("ta",)))
+    assert "idx_chunks_thread_id" in plan, plan
+    s.close()
+
+
+def test_sqlite_indexed_query_scales(tmp_path):
+    """O(result) not O(corpus): a needle query over a 20k-row collection
+    must run orders of magnitude faster than the full-scan fallback."""
+    import time as _t
+    s = SQLiteDocumentStore({"path": str(tmp_path / "scale.sqlite3")})
+    rows = [{"chunk_id": f"c{i}", "thread_id": f"t{i % 2000}",
+             "embedding_generated": i % 7 == 0,
+             "text": "x" * 200, "seq": i % 5} for i in range(20_000)]
+    s.insert_many("chunks", rows)
+    t0 = _t.perf_counter()
+    hits = s.query_documents("chunks", {"thread_id": "t123"},
+                             sort=[("seq", 1)])
+    dt_indexed = _t.perf_counter() - t0
+    assert len(hits) == 10
+    t0 = _t.perf_counter()
+    hits2 = s.query_documents(
+        "chunks", {"thread_id": {"$regex": "^t123$"}})  # fallback path
+    dt_scan = _t.perf_counter() - t0
+    assert {d["chunk_id"] for d in hits2} == {d["chunk_id"] for d in hits}
+    assert dt_indexed < dt_scan / 5, (dt_indexed, dt_scan)
+    s.close()

@@ -252,12 +252,19 @@ class GenerationEngine:
         return cls(cfg, params, dtype=dtype,
                    quantize=bool(meta.get("quantized")), **engine_kw)
 
+    @property
+    def prompt_limit(self) -> int:
+        """Longest prompt served without tail-truncation (one decode
+        window of cache headroom, capped by the largest prefill bucket).
+        Callers with longer prompts should route to the long-context
+        engine (``engine/longctx.py``)."""
+        return min(self.max_len - self.decode_window, self.buckets[-1])
+
     def submit(self, prompt: list[int], max_new_tokens: int = 256) -> int:
         """Enqueue a tokenized prompt; returns a request id."""
         if not prompt:
             raise ValueError("empty prompt")
-        # Leave one decode window of cache headroom past the prompt.
-        limit = min(self.max_len - self.decode_window, self.buckets[-1])
+        limit = self.prompt_limit
         if len(prompt) > limit:
             # Keep the tail: instructions/questions sit at the end of RAG
             # prompts. The orchestrator budgets context to avoid this.

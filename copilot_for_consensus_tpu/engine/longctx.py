@@ -1,0 +1,346 @@
+"""Long-context serving: sequence-parallel prefill + distributed-cache decode.
+
+The capability SURVEY.md §5 flags as the flagship TPU-native addition:
+summarize a WHOLE thread/archive in one context instead of the
+reference's top-k truncation to a 3000-token budget
+(``orchestrator/app/context_selectors.py:94-107``). The continuous-batching
+engine (``engine/generation.py``) serves many short requests; this engine
+serves one long request whose context exceeds a single chip's comfortable
+KV footprint, by sharding the *sequence* axis over the ``sp`` mesh axis:
+
+* **Prefill** runs ring attention (``parallel/ring.py``): each device
+  holds S/n positions, KV blocks rotate over ICI via ``ppermute``, and
+  the resulting per-layer KV cache [L, 1, Hkv, S, D] stays sharded over
+  ``sp`` — it is never gathered.
+* **Decode** treats that cache as a frozen, distributed prefix. The new
+  token's query attends to it with plain masked attention written over
+  the GLOBAL sequence — the cache's NamedSharding makes XLA partition the
+  einsum and turn the softmax max/sum into ``sp`` collectives (GSPMD);
+  no hand-written ring is needed for a 1-token query. Generated tokens'
+  KV land in a small replicated suffix buffer, and the two attention
+  pieces merge by online softmax in fp32.
+
+Both phases honor sliding-window attention (Mistral) and right-padded
+prompts; decode fuses ``decode_window`` steps per dispatch like the main
+engine.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from copilot_for_consensus_tpu.engine.generation import Completion
+from copilot_for_consensus_tpu.engine.sampling import SamplingConfig, sample
+from copilot_for_consensus_tpu.models import decoder, layers as L, quant
+from copilot_for_consensus_tpu.models.configs import DecoderConfig
+from copilot_for_consensus_tpu.parallel.ring import make_ring_attention
+from copilot_for_consensus_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    shard_pytree,
+)
+
+NEG_INF = -1e30
+
+
+def _round_up(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+class LongContextEngine:
+    """One long-context generation at a time, sequence-sharded over
+    ``axis``. Use ``GenerationEngine`` for short-prompt throughput."""
+
+    def __init__(
+        self,
+        cfg: DecoderConfig,
+        params: Any | None = None,
+        *,
+        mesh: Mesh,
+        axis: str = "sp",
+        sampling: SamplingConfig = SamplingConfig(),
+        eos_id: int | list[int] = 2,
+        seed: int = 0,
+        dtype=jnp.bfloat16,
+        max_new_tokens: int = 512,
+        decode_window: int = 8,
+        ctx_block: int = 64,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shards = mesh.shape[axis]
+        self.sampling = sampling
+        eos_list = list(eos_id) if isinstance(eos_id, (list, tuple)) \
+            else [int(eos_id)]
+        self._eos_set = frozenset(int(e) for e in eos_list)
+        self.dtype = dtype
+        self.decode_window = max(1, decode_window)
+        # Context lengths bucket to multiples of (shards × ctx_block) so a
+        # handful of prefill programs cover every prompt length.
+        self.ctx_quantum = self.n_shards * ctx_block
+        self.suffix_len = _round_up(max_new_tokens + 1, 64)
+        self._key = jax.random.PRNGKey(seed)
+
+        axes = decoder.logical_axes(cfg)
+        if params is None:
+            params = decoder.init_params(jax.random.PRNGKey(seed), cfg,
+                                         dtype=dtype)
+        if quant.is_quantized(
+                (params.get("layers", {}) or {}).get("wq")):
+            axes = quant.quantize_logical_axes(axes)
+            quant.set_pallas_qmatmul(False)   # GSPMD path under the mesh
+        self.params = shard_pytree(params, axes, mesh, self._param_rules())
+
+        self._ring = make_ring_attention(mesh, axis)
+        self._prefill_cache_spec = P(None, None, None, axis, None)
+        self._prefill_jits: dict[int, Any] = {}
+        self._decode_jit = None
+        self._sample_fn = jax.jit(
+            lambda logits, key: sample(logits, key, self.sampling))
+
+    def _param_rules(self):
+        # tp/ep shard as usual when those axes exist on the mesh; any rule
+        # naming a mesh axis this mesh lacks falls back to replication.
+        rules = dict(DEFAULT_RULES)
+        present = set(self.mesh.axis_names)
+        for k, v in rules.items():
+            if isinstance(v, str) and v not in present:
+                rules[k] = None
+        return rules
+
+    # ------------------------------------------------------------------
+    # prefill
+    # ------------------------------------------------------------------
+
+    def _build_prefill(self, s_ctx: int):
+        cfg, ring = self.cfg, self._ring
+        mesh, dtype = self.mesh, self.dtype
+
+        def _prefill(params, tokens, length):
+            """tokens [1, s_ctx] right-padded; length [1]. Returns
+            (last-valid-position logits [1, V], prefix cache
+            [L, 1, Hkv, s_ctx, D] sharded over the sequence axis)."""
+            x = params["tok_emb"][tokens]
+
+            def body(x, layer):
+                h, k, v = L.attn_prefill(
+                    L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+                    layer, cfg, lengths=length, impl=ring)
+                x = x + h
+                x = x + (decoder._ffn(
+                    L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                    layer, cfg))
+                return x, (k.astype(dtype), v.astype(dtype))
+
+            x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+            last = jnp.take_along_axis(
+                x, (length - 1)[:, None, None], axis=1)      # [1, 1, D]
+            logits = decoder._unembed(last, params, cfg)[:, 0]
+            return logits, {"k": ks, "v": vs}
+
+        cache_sh = NamedSharding(mesh, self._prefill_cache_spec)
+        return jax.jit(
+            _prefill,
+            in_shardings=(None, NamedSharding(mesh, P(None, self.axis)),
+                          None),
+            out_shardings=(None, {"k": cache_sh, "v": cache_sh}),
+        )
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+
+    def _build_decode(self):
+        cfg = self.cfg
+        window = cfg.sliding_window
+        dw = self.decode_window
+
+        def attend(q, k_pre, v_pre, k_suf, v_suf, prefix_len, suf_len,
+                   gpos):
+            """q [1, Hq, D]; prefix k/v [1, Hkv, S, D] (sp-sharded);
+            suffix k/v [1, Hkv, W, D] replicated. Online-softmax merge of
+            the two attention pieces, fp32."""
+            b, hq, d = q.shape
+            hkv = k_pre.shape[1]
+            g = hq // hkv
+            qg = (q.reshape(b, hkv, g, d).astype(jnp.float32)
+                  * d ** -0.5)
+            s_ctx, w = k_pre.shape[2], k_suf.shape[2]
+
+            s1 = jnp.einsum("bhgd,bhsd->bhgs", qg,
+                            k_pre.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+            pos1 = jnp.arange(s_ctx)[None, None, None, :]
+            m1 = pos1 < prefix_len
+            if window > 0:
+                m1 &= pos1 > gpos - window
+            s1 = jnp.where(m1, s1, NEG_INF)
+
+            s2 = jnp.einsum("bhgd,bhwd->bhgw", qg,
+                            k_suf.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+            kpos2 = prefix_len + jnp.arange(w)[None, None, None, :]
+            m2 = jnp.arange(w)[None, None, None, :] <= suf_len
+            if window > 0:
+                m2 &= kpos2 > gpos - window
+            s2 = jnp.where(m2, s2, NEG_INF)
+
+            m = jnp.maximum(jnp.max(s1, -1, keepdims=True),
+                            jnp.max(s2, -1, keepdims=True))
+            p1 = jnp.where(m1, jnp.exp(s1 - m), 0.0)
+            p2 = jnp.where(m2, jnp.exp(s2 - m), 0.0)
+            l = (jnp.sum(p1, -1, keepdims=True)
+                 + jnp.sum(p2, -1, keepdims=True))
+            acc = (jnp.einsum("bhgs,bhsd->bhgd", p1,
+                              v_pre.astype(jnp.float32))
+                   + jnp.einsum("bhgw,bhwd->bhgd", p2,
+                                v_suf.astype(jnp.float32)))
+            out = acc / jnp.where(l == 0.0, 1.0, l)
+            return out.reshape(b, hq, d)
+
+        def one_token(params, tok, gpos, prefix, prefix_len,
+                      suffix, suf_len):
+            """tok [1]; gpos scalar global position of this token."""
+            x = params["tok_emb"][tok][:, None, :]            # [1, 1, D]
+            positions = gpos[None, None]                      # [1, 1]
+
+            def layer_body(carry, scanned):
+                x, k_suf_all, v_suf_all = carry
+                layer, k_pre, v_pre, li = scanned
+                xn = L.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+                q, k, v = L._project_qkv(xn, layer, cfg, positions)
+                # Append this token's kv to the suffix buffer, layer li.
+                k_suf_all = k_suf_all.at[li, :, :, suf_len, :].set(
+                    k[:, :, 0, :].astype(k_suf_all.dtype))
+                v_suf_all = v_suf_all.at[li, :, :, suf_len, :].set(
+                    v[:, :, 0, :].astype(v_suf_all.dtype))
+                o = attend(q[:, :, 0, :], k_pre, v_pre,
+                           k_suf_all[li], v_suf_all[li],
+                           prefix_len, suf_len, gpos)
+                o = o.reshape(1, 1, cfg.n_heads * cfg.head_dim
+                              ).astype(x.dtype)
+                x = x + L.qmatmul(o, layer["wo"])
+                x = x + decoder._ffn(
+                    L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                    layer, cfg)
+                return (x, k_suf_all, v_suf_all), None
+
+            (x, k_suf, v_suf), _ = jax.lax.scan(
+                layer_body, (x, suffix["k"], suffix["v"]),
+                (params["layers"], prefix["k"], prefix["v"],
+                 jnp.arange(cfg.n_layers)))
+            logits = decoder._unembed(x, params, cfg)[:, 0]   # [1, V]
+            return logits, {"k": k_suf, "v": v_suf}
+
+        def _decode(params, tok, gpos, prefix, prefix_len, suffix,
+                    suf_len, key):
+            """``decode_window`` decode→sample→feed-back steps fused in
+            one dispatch."""
+
+            def step(carry, _):
+                tok, gpos, suffix, suf_len, key = carry
+                key, sub = jax.random.split(key)
+                logits, suffix = one_token(params, tok, gpos, prefix,
+                                           prefix_len, suffix, suf_len)
+                nxt = sample(logits, sub, self.sampling)
+                return (nxt, gpos + 1, suffix, suf_len + 1, key), nxt
+
+            (tok, gpos, suffix, suf_len, _), toks = jax.lax.scan(
+                step, (tok, gpos, suffix, suf_len, key), None, length=dw)
+            return toks, suffix                      # toks [dw, 1]
+
+        cache_sh = NamedSharding(self.mesh, self._prefill_cache_spec)
+        return jax.jit(
+            _decode,
+            in_shardings=(None, None, None,
+                          {"k": cache_sh, "v": cache_sh},
+                          None, None, None, None),
+            donate_argnums=(5,),
+        )
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def generate(self, prompt: list[int],
+                 max_new_tokens: int = 256) -> Completion:
+        """Generate against the FULL prompt, however long — no truncation.
+        Returns the same Completion record as the batch engine."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        max_new_tokens = min(max_new_tokens, self.suffix_len - 1)
+        t0 = time.monotonic()
+        s_ctx = _round_up(len(prompt), self.ctx_quantum)
+        if s_ctx not in self._prefill_jits:
+            self._prefill_jits[s_ctx] = self._build_prefill(s_ctx)
+        tokens = np.zeros((1, s_ctx), dtype=np.int32)
+        tokens[0, :len(prompt)] = prompt
+        length = jnp.asarray([len(prompt)], dtype=jnp.int32)
+        logits, prefix = self._prefill_jits[s_ctx](
+            self.params, jnp.asarray(tokens), length)
+        self._key, sub = jax.random.split(self._key)
+        first = int(jax.device_get(self._sample_fn(logits, sub))[0])
+        prefill_s = time.monotonic() - t0
+
+        t1 = time.monotonic()
+        generated = [first]
+        if first in self._eos_set or max_new_tokens <= 1:
+            return Completion(
+                request_id=0, prompt_len=len(prompt),
+                tokens=[] if first in self._eos_set else [first],
+                finish_reason=("eos" if first in self._eos_set
+                               else "length"),
+                prefill_s=prefill_s, decode_s=0.0)
+
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+        hkv, dh = self.cfg.n_kv_heads, self.cfg.head_dim
+        suffix = {
+            "k": jnp.zeros((self.cfg.n_layers, 1, hkv, self.suffix_len,
+                            dh), self.dtype),
+            "v": jnp.zeros((self.cfg.n_layers, 1, hkv, self.suffix_len,
+                            dh), self.dtype),
+        }
+        tok = jnp.asarray([first], dtype=jnp.int32)
+        gpos = jnp.asarray(len(prompt), dtype=jnp.int32)
+        suf_len = jnp.asarray(0, dtype=jnp.int32)
+        prefix_len = jnp.asarray(len(prompt), dtype=jnp.int32)
+        finish = "length"
+        while len(generated) < max_new_tokens:
+            self._key, sub = jax.random.split(self._key)
+            toks, suffix = self._decode_jit(
+                self.params, tok, gpos, prefix, prefix_len, suffix,
+                suf_len, sub)
+            host = np.asarray(jax.device_get(toks))[:, 0]
+            done = False
+            for t in host:
+                generated.append(int(t))
+                if int(t) in self._eos_set:
+                    finish, done = "eos", True
+                    break
+                if len(generated) >= max_new_tokens:
+                    done = True
+                    break
+            if done:
+                break
+            tok = jnp.asarray([int(host[-1])], dtype=jnp.int32)
+            gpos = gpos + self.decode_window
+            suf_len = suf_len + self.decode_window
+        if generated and generated[-1] in self._eos_set:
+            generated = generated[:-1]
+        return Completion(
+            request_id=0, prompt_len=len(prompt), tokens=generated,
+            finish_reason=finish, prefill_s=prefill_s,
+            decode_s=time.monotonic() - t1)
+
+    def generate_text(self, prompt: str, tokenizer,
+                      max_new_tokens: int = 256) -> str:
+        comp = self.generate(tokenizer.encode(prompt, add_bos=True),
+                             max_new_tokens)
+        return tokenizer.decode(comp.tokens)

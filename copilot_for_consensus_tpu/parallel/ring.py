@@ -30,8 +30,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _ring_shard(q, k, v, *, axis_name: str, causal: bool, scale: float):
-    """Per-shard body. q/k/v: [B, H, S_loc, D] (this shard's blocks)."""
+def _ring_shard(q, k, v, kv_lengths, *, axis_name: str, causal: bool,
+                scale: float, window: int):
+    """Per-shard body. q/k/v: [B, H, S_loc, D] (this shard's blocks);
+    kv_lengths: [B] valid-length mask (replicated), or None."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
@@ -55,13 +57,21 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool, scale: float):
         k_pos = src * s_loc + jnp.arange(s_loc)
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32),
                        preferred_element_type=jnp.float32) * scale
+        # Build the [B?, s_loc, s_loc] validity mask exactly as the
+        # non-ring paths do (ops.attention.make_attention_mask), with
+        # k_pos expressed in global coordinates so rotation is invisible.
+        mask = jnp.ones((s_loc, s_loc), dtype=bool)
         if causal:
-            mask = k_pos[None, :] <= q_pos[:, None]      # [s_loc, s_loc]
-            s = jnp.where(mask[None, None], s, NEG_INF)
+            mask &= k_pos[None, :] <= q_pos[:, None]
+        if window > 0:
+            mask &= k_pos[None, :] > q_pos[:, None] - window
+        mask = mask[None, None]                          # [1, 1, q, k]
+        if kv_lengths is not None:
+            mask = mask & (k_pos[None, None, None, :]
+                           < kv_lengths[:, None, None, None])
+        s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        if causal:
-            p = jnp.where(mask[None, None], p, 0.0)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         corr = jnp.exp(m - m_new)
         l = corr * l + jnp.sum(p, axis=-1, keepdims=True)
         acc = corr * acc + jnp.einsum(
@@ -90,12 +100,10 @@ def ring_attention(
     """Drop-in attention impl (same [B, H, S, D] contract as
     ``ops.attention.attention``) with the sequence axis sharded over
     ``axis``. GQA kv heads are expanded before sharding (kv replication
-    across the ring would defeat the rotation). Sliding window and padded
-    kv are not yet supported on this path."""
-    if window:
-        raise NotImplementedError("ring attention with sliding window")
-    if kv_lengths is not None:
-        raise NotImplementedError("ring attention with padded kv")
+    across the ring would defeat the rotation). ``window`` applies
+    Mistral-style sliding-window masking and ``kv_lengths`` masks padded
+    kv positions — both in global coordinates, matching
+    ``ops.attention.make_attention_mask``."""
     from copilot_for_consensus_tpu.ops.attention import _gqa_expand
 
     hq = q.shape[1]
@@ -108,10 +116,12 @@ def ring_attention(
     spec = P(None, None, axis, None)
     fn = shard_map(
         functools.partial(_ring_shard, axis_name=axis, causal=causal,
-                          scale=q.shape[-1] ** -0.5),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                          scale=q.shape[-1] ** -0.5, window=int(window)),
+        # kv_lengths rides replicated (P()); a None is an empty pytree and
+        # its spec is simply unused.
+        mesh=mesh, in_specs=(spec, spec, spec, P()), out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, kv_lengths)
 
 
 def make_ring_attention(mesh: Mesh, axis: str = "sp"):

@@ -52,7 +52,8 @@ class TPUSummarizer(Summarizer):
                  template: str = DEFAULT_TEMPLATE,
                  system: str = DEFAULT_SYSTEM, num_slots: int = 4,
                  max_len: int = 4096, params=None, mesh=None, dtype=None,
-                 checkpoint: str | None = None):
+                 checkpoint: str | None = None, long_engine=None,
+                 long_context: bool = False):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
             ByteTokenizer,
@@ -98,23 +99,66 @@ class TPUSummarizer(Summarizer):
                     max_len=min(max_len, cfg.max_seq_len),
                     dtype=dtype if dtype is not None else jnp.bfloat16)
         self.engine = engine
+        if long_engine is None and long_context:
+            from copilot_for_consensus_tpu.engine.longctx import (
+                LongContextEngine,
+            )
+            if mesh is None:
+                # Config-driven default: shard the sequence over every
+                # local device (the short engine holds its own mesh or
+                # none; the long engine's parallelism is sp by design).
+                import jax as _jax
+
+                from copilot_for_consensus_tpu.parallel import (
+                    MeshConfig,
+                    build_mesh,
+                )
+                mesh = build_mesh(
+                    MeshConfig(dp=1, sp=len(_jax.devices()), ep=1, tp=1))
+            long_engine = LongContextEngine(
+                engine.cfg, engine.params, mesh=mesh,
+                eos_id=sorted(engine._eos_set),
+                max_new_tokens=max_new_tokens)
+        # Whole-thread contexts beyond the batch engine's window route to
+        # the sequence-parallel long-context engine instead of being
+        # tail-truncated (the reference's only strategy is top-k
+        # truncation to a token budget, ``context_selectors.py:94-107``).
+        self.long_engine = long_engine
         self.tokenizer: Tokenizer = tokenizer or ByteTokenizer(
             max(259, self.engine.cfg.vocab_size))
         if self.tokenizer.vocab_size > self.engine.cfg.vocab_size:
             raise ValueError("tokenizer vocab exceeds model vocab")
 
+    @property
+    def _short_limit(self) -> int:
+        return self.engine.prompt_limit
+
     def summarize(self, thread: ThreadContext) -> Summary:
         return self.summarize_batch([thread])[0]
 
     def summarize_batch(self, threads: list[ThreadContext]) -> list[Summary]:
-        """Continuous batching: all threads share the decode batch."""
+        """Continuous batching: all threads share the decode batch; any
+        prompt exceeding the batch window runs on the long-context path."""
         prompts = [
             self.tokenizer.encode(
                 build_prompt(t, self.template, self.system), add_bos=True)
             for t in threads
         ]
-        comps = self.engine.generate(prompts,
-                                     max_new_tokens=self.max_new_tokens)
+        comps: list = [None] * len(threads)
+        short_idx = list(range(len(threads)))
+        if self.long_engine is not None:
+            long_set = {i for i in short_idx
+                        if len(prompts[i]) > self._short_limit}
+            short_idx = [i for i in short_idx if i not in long_set]
+            long_idx = sorted(long_set)
+            for i in long_idx:
+                comps[i] = self.long_engine.generate(
+                    prompts[i], max_new_tokens=self.max_new_tokens)
+        if short_idx:
+            for i, c in zip(short_idx, self.engine.generate(
+                    [prompts[i] for i in short_idx],
+                    max_new_tokens=self.max_new_tokens)):
+                comps[i] = c
         out = []
         for thread, comp in zip(threads, comps):
             out.append(Summary(

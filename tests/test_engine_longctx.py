@@ -1,0 +1,126 @@
+# Long-context serving: sp-sharded prefill + distributed-cache decode
+# (VERDICT r1 item 5) vs an unsharded full-forward oracle on the 8-dev mesh.
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.engine.longctx import LongContextEngine
+from copilot_for_consensus_tpu.engine.sampling import SamplingConfig
+from copilot_for_consensus_tpu.models import decoder
+from copilot_for_consensus_tpu.models.configs import decoder_config
+from copilot_for_consensus_tpu.parallel import MeshConfig, build_mesh
+
+
+def _greedy_oracle(params, cfg, prompt, n_steps):
+    """Grow the sequence one token at a time with the plain unsharded
+    forward pass — the slow-but-obviously-right reference."""
+    seq = list(prompt)
+    out = []
+    for _ in range(n_steps):
+        toks = jnp.asarray([seq], dtype=jnp.int32)
+        logits = decoder.forward(params, toks, cfg)
+        nxt = int(jnp.argmax(logits[0, len(seq) - 1]))
+        out.append(nxt)
+        seq.append(nxt)
+    return out
+
+
+@pytest.mark.parametrize("cfg_name", ["tiny", "tiny-swa"])
+def test_longctx_matches_unsharded_greedy(cfg_name):
+    """A prompt LONGER than cfg.max_seq_len serves correctly: greedy
+    tokens from the sequence-parallel engine equal the unsharded oracle
+    (dense + sliding-window configs)."""
+    cfg = decoder_config(cfg_name)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg,
+                                 dtype=jnp.float32)
+    mesh = build_mesh(MeshConfig(sp=8, tp=0))
+    eng = LongContextEngine(cfg, params, mesh=mesh, dtype=jnp.float32,
+                            sampling=SamplingConfig(temperature=0.0),
+                            eos_id=-1, decode_window=4, ctx_block=16,
+                            max_new_tokens=64)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(3, cfg.vocab_size, size=600).tolist()
+    assert len(prompt) > cfg.max_seq_len     # longer than the model window
+    comp = eng.generate(prompt, max_new_tokens=10)
+    want = _greedy_oracle(params, cfg, prompt, 10)
+    assert comp.tokens == want
+    assert comp.prompt_len == 600
+    assert comp.finish_reason == "length"
+
+
+def test_longctx_prefill_cache_is_sequence_sharded():
+    """The prefix cache must stay sharded over sp — gathering it would
+    defeat the whole design."""
+    cfg = decoder_config("tiny")
+    mesh = build_mesh(MeshConfig(sp=8, tp=0))
+    eng = LongContextEngine(cfg, mesh=mesh, dtype=jnp.float32,
+                            ctx_block=16)
+    s_ctx = eng.ctx_quantum
+    fn = eng._build_prefill(s_ctx)
+    tokens = jnp.zeros((1, s_ctx), dtype=jnp.int32)
+    _, prefix = fn(eng.params, tokens, jnp.asarray([s_ctx - 3]))
+    spec = prefix["k"].sharding.spec
+    assert spec[3] == "sp", spec
+    # Each device holds 1/8 of the sequence axis.
+    shard_shape = prefix["k"].addressable_shards[0].data.shape
+    assert shard_shape[3] == s_ctx // 8
+
+
+def test_longctx_eos_stops_decode():
+    cfg = decoder_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(1), cfg,
+                                 dtype=jnp.float32)
+    mesh = build_mesh(MeshConfig(sp=8, tp=0))
+    eng = LongContextEngine(cfg, params, mesh=mesh, dtype=jnp.float32,
+                            decode_window=4, ctx_block=16)
+    prompt = list(range(3, 40))
+    oracle = _greedy_oracle(params, cfg, prompt, 12)
+    # Declare the 3rd greedy token as EOS: generation must stop there.
+    eng2 = LongContextEngine(cfg, params, mesh=mesh, dtype=jnp.float32,
+                             eos_id=oracle[2], decode_window=4,
+                             ctx_block=16)
+    comp = eng2.generate(prompt, max_new_tokens=12)
+    assert comp.finish_reason == "eos"
+    assert comp.tokens == oracle[:2]
+
+
+def test_summarizer_routes_long_threads_to_longctx_engine():
+    """Serving-level: a thread whose prompt exceeds the batch engine's
+    window is summarized via the sequence-parallel path — not truncated."""
+    from copilot_for_consensus_tpu.engine.generation import GenerationEngine
+    from copilot_for_consensus_tpu.summarization.base import ThreadContext
+    from copilot_for_consensus_tpu.summarization.tpu_summarizer import (
+        TPUSummarizer,
+    )
+
+    cfg = decoder_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(2), cfg,
+                                 dtype=jnp.float32)
+    mesh = build_mesh(MeshConfig(sp=8, tp=0))
+    short = GenerationEngine(cfg, params, num_slots=2, max_len=128,
+                             dtype=jnp.float32)
+    long_eng = LongContextEngine(cfg, params, mesh=mesh,
+                                 dtype=jnp.float32, eos_id=-1,
+                                 ctx_block=16, decode_window=4)
+    summ = TPUSummarizer(engine=short, long_engine=long_eng,
+                         max_new_tokens=8)
+    # ~8 chunks of dense text → a ByteTokenizer prompt far beyond 128.
+    chunks = [{"chunk_id": f"c{i}", "text": "consensus " * 40}
+              for i in range(8)]
+    thread = ThreadContext(thread_id="t-long", subject="big thread",
+                           participants=["a@x", "b@y"], message_count=8,
+                           chunks=chunks)
+    calls = {}
+    orig = long_eng.generate
+
+    def spy(prompt, max_new_tokens=256):
+        calls["len"] = len(prompt)
+        return orig(prompt, max_new_tokens)
+
+    long_eng.generate = spy
+    s = summ.summarize(thread)
+    assert calls["len"] > summ._short_limit      # long path actually ran
+    assert s.prompt_tokens == calls["len"]       # and was NOT truncated
+    assert s.thread_id == "t-long"
+    assert len(s.citations) == 8

@@ -129,6 +129,20 @@ def _unembed(x: jax.Array, params: Params, cfg: DecoderConfig) -> jax.Array:
     return L.qmatmul(x, params["lm_head"]).astype(jnp.float32)
 
 
+def block(x: jax.Array, layer: Params, cfg: DecoderConfig,
+          lengths: jax.Array | None = None,
+          attn_impl: str = "auto") -> jax.Array:
+    """One transformer block: [B, S, D] → [B, S, D]. The single source of
+    the block body — forward and the pp pipeline both run this, so model
+    changes cannot drift between them."""
+    h, _, _ = L.attn_prefill(
+        L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+        layer, cfg, lengths=lengths, impl=attn_impl)
+    x = x + h
+    return x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                    layer, cfg)
+
+
 def forward(params: Params, tokens: jax.Array, cfg: DecoderConfig,
             lengths: jax.Array | None = None,
             attn_impl: str = "auto") -> jax.Array:
@@ -136,13 +150,7 @@ def forward(params: Params, tokens: jax.Array, cfg: DecoderConfig,
     x = params["tok_emb"][tokens]
 
     def body(x, layer):
-        h, _, _ = L.attn_prefill(
-            L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
-            layer, cfg, lengths=lengths, impl=attn_impl)
-        x = x + h
-        x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
-                     layer, cfg)
-        return x, None
+        return block(x, layer, cfg, lengths, attn_impl), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     return _unembed(x, params, cfg)

@@ -20,6 +20,11 @@ from copilot_for_consensus_tpu.parallel.mesh import (
     build_mesh,
     local_mesh,
 )
+from copilot_for_consensus_tpu.parallel.pipeline import (
+    make_pipeline_train_step,
+    pipeline_forward,
+    shard_params_for_pipeline,
+)
 from copilot_for_consensus_tpu.parallel.sharding import (
     LogicalAxisRules,
     DEFAULT_RULES,
@@ -35,4 +40,7 @@ __all__ = [
     "DEFAULT_RULES",
     "logical_to_spec",
     "shard_pytree",
+    "pipeline_forward",
+    "make_pipeline_train_step",
+    "shard_params_for_pipeline",
 ]

@@ -8,7 +8,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("dp", "sp", "ep", "tp")
+MESH_AXES = ("dp", "pp", "sp", "ep", "tp")
 
 
 @dataclass(frozen=True)
@@ -21,38 +21,41 @@ class MeshConfig:
     """
 
     dp: int = 1
+    pp: int = 1
     sp: int = 1
     ep: int = 1
     tp: int = 0
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        dp, sp, ep, tp = self.dp, self.sp, self.ep, self.tp
+        dp, pp, sp, ep, tp = self.dp, self.pp, self.sp, self.ep, self.tp
         if tp == 0:
-            fixed = max(1, dp) * max(1, sp) * max(1, ep)
+            fixed = max(1, dp) * max(1, pp) * max(1, sp) * max(1, ep)
             if n_devices % fixed:
                 raise ValueError(
-                    f"mesh axes dp={dp} sp={sp} ep={ep} do not divide "
-                    f"{n_devices} devices"
+                    f"mesh axes dp={dp} pp={pp} sp={sp} ep={ep} do not "
+                    f"divide {n_devices} devices"
                 )
             tp = n_devices // fixed
         elif dp == 0:
-            fixed = max(1, sp) * max(1, ep) * tp
+            fixed = max(1, pp) * max(1, sp) * max(1, ep) * tp
             if n_devices % fixed:
                 raise ValueError(
-                    f"mesh axes sp={sp} ep={ep} tp={tp} do not divide "
-                    f"{n_devices} devices"
+                    f"mesh axes pp={pp} sp={sp} ep={ep} tp={tp} do not "
+                    f"divide {n_devices} devices"
                 )
             dp = n_devices // fixed
-        total = max(1, dp) * max(1, sp) * max(1, ep) * tp
+        total = max(1, dp) * max(1, pp) * max(1, sp) * max(1, ep) * tp
         if total != n_devices:
             raise ValueError(
-                f"mesh {dp}x{sp}x{ep}x{tp}={total} != {n_devices} devices"
+                f"mesh {dp}x{pp}x{sp}x{ep}x{tp}={total} != "
+                f"{n_devices} devices"
             )
-        return MeshConfig(max(1, dp), max(1, sp), max(1, ep), tp)
+        return MeshConfig(max(1, dp), max(1, pp), max(1, sp), max(1, ep),
+                          tp)
 
     @property
-    def shape(self) -> tuple[int, int, int, int]:
-        return (self.dp, self.sp, self.ep, self.tp)
+    def shape(self) -> tuple[int, int, int, int, int]:
+        return (self.dp, self.pp, self.sp, self.ep, self.tp)
 
 
 def build_mesh(config: MeshConfig | None = None,

@@ -1,0 +1,160 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (SPMD GPipe).
+
+SURVEY.md §2.3 lists layer-pipeline parallelism as the TPU-native
+equivalent of multi-slice scaling: when a model's layer stack exceeds one
+slice's HBM, stages hold contiguous layer spans and microbatches stream
+through. Built the SPMD way — NOT a per-stage program: every device runs
+the SAME jitted program under ``shard_map``; ``lax.axis_index('pp')``
+selects the stage's behavior, activations hop stage→stage over ICI via
+``ppermute``, and bubble steps compute-and-discard (masking is cheaper
+than idling inside one traced program). This is the schedule jax/praxis
+use for TPU pipelining, and gradients flow through ``ppermute``
+automatically, so the same function trains.
+
+Schedule: M microbatches over P stages take M + P - 1 steps; each step
+every stage runs its local L/P layers once. The last stage's outputs are
+masked-psum'd back to all devices (cheap at [B, S, D] test scale; a
+multi-slice deployment would leave them stage-local for the loss).
+
+Layer weights shard their leading (layer-stack) axis over ``pp`` — the
+``layers`` logical axis below. Parallelism here is pp-only: the explicit
+shard_map specs replicate weights/activations over every other mesh axis,
+so meshes with tp/dp > 1 are correct but redundant inside the pipeline
+(intra-stage tp would need manual collectives in the stage body — a
+follow-up, not a property of this module yet).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from copilot_for_consensus_tpu.models import decoder
+from copilot_for_consensus_tpu.models.configs import DecoderConfig
+from copilot_for_consensus_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    shard_pytree,
+)
+
+PIPELINE_RULES = dict(DEFAULT_RULES, layers="pp")
+
+
+def pipeline_logical_axes(cfg: DecoderConfig) -> Any:
+    """decoder.logical_axes with the layer-stack axis named ``layers`` so
+    it shards over pp (the serving tables leave it None = replicated)."""
+    axes = decoder.logical_axes(cfg)
+    axes["layers"] = {
+        k: ("layers",) + tuple(v[1:]) for k, v in axes["layers"].items()
+    }
+    return axes
+
+
+def shard_params_for_pipeline(params: Any, cfg: DecoderConfig,
+                              mesh: Mesh) -> Any:
+    return shard_pytree(params, pipeline_logical_axes(cfg), mesh,
+                        PIPELINE_RULES)
+
+
+def _pp_shard(layers_local, x_mb, lengths, *, axis, cfg, impl):
+    """Per-device body. layers_local: this stage's layer span (leading dim
+    L/P); x_mb: [M, mb, S, D] microbatched embeddings (replicated);
+    lengths: [M, mb] (replicated)."""
+    pp = jax.lax.psum(1, axis)
+    stage = jax.lax.axis_index(axis)
+    m = x_mb.shape[0]
+    steps = m + pp - 1
+    perm = [(i, i + 1) for i in range(pp - 1)]       # no wraparound
+
+    vary = lambda t: jax.lax.pcast(t, (axis,), to="varying")  # noqa: E731
+
+    def run_stage(x, mb_lengths):
+        def body(x, layer):
+            return decoder.block(x, layer, cfg, mb_lengths, impl), None
+        x, _ = jax.lax.scan(body, x, layers_local)
+        return x
+
+    def body(t, carry):
+        recv, out_buf = carry
+        # Stage 0 pulls the next microbatch from the queue; later stages
+        # consume what the previous stage sent last step.
+        mb_idx = jnp.clip(t, 0, m - 1)
+        inp = jnp.where(stage == 0, vary(x_mb)[mb_idx], recv)
+        mb_lengths = vary(lengths)[jnp.clip(t - stage, 0, m - 1)]
+        y = run_stage(inp, mb_lengths)
+        # The last stage finished microbatch t-(pp-1) this step.
+        w = t - (pp - 1)
+        valid = (stage == pp - 1) & (w >= 0)
+        upd = jax.lax.dynamic_update_slice_in_dim(
+            out_buf, y[None], jnp.clip(w, 0, m - 1), axis=0)
+        out_buf = jnp.where(valid, upd, out_buf)
+        recv = jax.lax.ppermute(y, axis, perm)
+        return recv, out_buf
+
+    recv0 = vary(jnp.zeros(x_mb.shape[1:], x_mb.dtype))
+    out0 = vary(jnp.zeros_like(x_mb))
+    _, out_buf = jax.lax.fori_loop(0, steps, body, (recv0, out0))
+    # Only the last stage's buffer is real; psum broadcasts it.
+    return jax.lax.psum(
+        jnp.where(stage == pp - 1, out_buf, jnp.zeros_like(out_buf)),
+        axis)
+
+
+def pipeline_forward(params: Any, tokens: jax.Array, cfg: DecoderConfig,
+                     mesh: Mesh, *, n_microbatches: int,
+                     lengths: jax.Array | None = None,
+                     axis: str = "pp", attn_impl: str = "auto"
+                     ) -> jax.Array:
+    """[B, S] tokens → [B, S, V] fp32 logits with the layer stack
+    pipelined over ``axis``. Embed/unembed run replicated outside the
+    pipeline (they are one matmul each; the stack dominates)."""
+    b, s = tokens.shape
+    m = n_microbatches
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    if cfg.n_layers % mesh.shape[axis]:
+        raise ValueError(
+            f"{cfg.n_layers} layers not divisible by {axis}="
+            f"{mesh.shape[axis]} stages")
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+
+    x = params["tok_emb"][tokens]                     # [B, S, D]
+    x_mb = x.reshape(m, b // m, s, x.shape[-1])
+    len_mb = lengths.reshape(m, b // m)
+
+    layer_specs = jax.tree.map(
+        lambda leaf: P(axis, *([None] * (leaf.ndim - 1))),
+        params["layers"])
+    fn = shard_map(
+        functools.partial(_pp_shard, axis=axis, cfg=cfg, impl=attn_impl),
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P()),
+        out_specs=P(),
+    )
+    y = fn(params["layers"], x_mb, len_mb)
+    y = y.reshape(b, s, -1)
+    return decoder._unembed(y, params, cfg)
+
+
+def make_pipeline_train_step(cfg: DecoderConfig, optimizer, mesh: Mesh,
+                             *, n_microbatches: int,
+                             attn_impl: str = "auto"):
+    """Training step with the layer stack pipelined — the pp counterpart
+    of ``train.make_train_step`` (which supplies the loss and optimizer
+    wiring; only the forward pass is swapped). Gradients flow through
+    ppermute; jit it with params sharded by
+    ``shard_params_for_pipeline``."""
+    from copilot_for_consensus_tpu import train
+
+    def fwd(params, tokens, cfg, lengths=None, attn_impl=attn_impl):
+        return pipeline_forward(params, tokens, cfg, mesh,
+                                n_microbatches=n_microbatches,
+                                lengths=lengths, attn_impl=attn_impl)
+
+    return train.make_train_step(cfg, optimizer, attn_impl=attn_impl,
+                                 forward_fn=fwd)

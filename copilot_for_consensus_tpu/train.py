@@ -20,13 +20,16 @@ from copilot_for_consensus_tpu.models.configs import DecoderConfig
 
 
 def next_token_loss(params: Any, tokens: jax.Array, lengths: jax.Array,
-                    cfg: DecoderConfig, attn_impl: str = "auto"
-                    ) -> jax.Array:
+                    cfg: DecoderConfig, attn_impl: str = "auto",
+                    forward_fn: Callable | None = None) -> jax.Array:
     """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1],
-    masked to valid (non-pad) positions."""
-    logits = decoder.forward(params, tokens[:, :-1], cfg,
-                             lengths=jnp.minimum(lengths, tokens.shape[1] - 1),
-                             attn_impl=attn_impl)
+    masked to valid (non-pad) positions. ``forward_fn`` (same signature as
+    ``decoder.forward``) swaps the forward pass — e.g. the pp pipeline —
+    without duplicating the loss."""
+    fwd = forward_fn or decoder.forward
+    logits = fwd(params, tokens[:, :-1], cfg,
+                 lengths=jnp.minimum(lengths, tokens.shape[1] - 1),
+                 attn_impl=attn_impl)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -36,13 +39,14 @@ def next_token_loss(params: Any, tokens: jax.Array, lengths: jax.Array,
 
 
 def make_train_step(cfg: DecoderConfig, optimizer: optax.GradientTransformation,
-                    attn_impl: str = "auto") -> Callable:
+                    attn_impl: str = "auto",
+                    forward_fn: Callable | None = None) -> Callable:
     """Returns ``step(params, opt_state, tokens, lengths) ->
     (params, opt_state, loss)``; jit/pjit it with sharded params."""
 
     def step(params, opt_state, tokens, lengths):
         loss, grads = jax.value_and_grad(next_token_loss)(
-            params, tokens, lengths, cfg, attn_impl)
+            params, tokens, lengths, cfg, attn_impl, forward_fn)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -22,8 +22,8 @@ def test_virtual_device_count():
 
 def test_mesh_axes_and_resolution():
     mesh = build_mesh(MeshConfig(dp=2, tp=0))  # tp auto-fills to 4
-    assert mesh.axis_names == ("dp", "sp", "ep", "tp")
-    assert mesh.shape == {"dp": 2, "sp": 1, "ep": 1, "tp": 4}
+    assert mesh.axis_names == ("dp", "pp", "sp", "ep", "tp")
+    assert mesh.shape == {"dp": 2, "pp": 1, "sp": 1, "ep": 1, "tp": 4}
     assert auto_mesh_for_serving().shape["tp"] == 8
 
 

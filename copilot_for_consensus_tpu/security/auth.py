@@ -277,7 +277,18 @@ class AuthService:
 # ---------------------------------------------------------------------------
 
 PUBLIC_PATHS = ("/health", "/readyz", "/metrics", "/auth/login",
-                "/auth/callback", "/.well-known/jwks.json")
+                "/auth/callback", "/.well-known/jwks.json",
+                # The SPA shell and its assets are public; every API call
+                # the SPA makes still carries the bearer token.
+                "/", "/ui", "/api/openapi.json")
+
+
+def is_public_path(path: str, public_paths=PUBLIC_PATHS) -> bool:
+    """Exact or path-segment-boundary match only: /metrics is public, a
+    hypothetical /metrics-private must not be. The ONE definition shared
+    by the enforcing middleware and the OpenAPI generator, so the spec
+    cannot drift from behavior."""
+    return any(path == p or path.startswith(p + "/") for p in public_paths)
 
 
 def create_jwt_middleware(jwt_manager: JWTManager,
@@ -289,10 +300,7 @@ def create_jwt_middleware(jwt_manager: JWTManager,
     required_roles = required_roles or {}
 
     def middleware(req: Request) -> None:
-        # Exact or path-segment-boundary match only: /metrics is public,
-        # a hypothetical /metrics-private must not be.
-        if any(req.path == p or req.path.startswith(p + "/")
-               for p in public_paths):
+        if is_public_path(req.path, public_paths):
             return
         header = req.headers.get("Authorization") or req.headers.get(
             "authorization") or ""

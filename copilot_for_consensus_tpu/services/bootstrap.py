@@ -130,6 +130,9 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
     )
     from copilot_for_consensus_tpu.services.runner import build_pipeline
 
+    from copilot_for_consensus_tpu.services.openapi import generate_openapi
+    from copilot_for_consensus_tpu.services.ui import ui_router
+
     cfg = dict(config or {})
     pipeline = build_pipeline(cfg)
 
@@ -143,6 +146,21 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
     # copy exists for standalone reporting-only deployments.
     router.merge(reporting_router(pipeline.reporting,
                                   include_sources=False))
+    if cfg.get("serve_ui", True):
+        router.merge(ui_router())
+
+    @router.get("/api/openapi.json")
+    def openapi(req):
+        """OpenAPI 3.1 spec generated from the live route table."""
+        from copilot_for_consensus_tpu.security.auth import PUBLIC_PATHS
+
+        # Advertise bearer security only when the JWT middleware is
+        # actually enforcing it (mirrors the require_auth gate below).
+        a = cfg.get("auth")
+        return generate_openapi(
+            router, title="CoPilot for Consensus (TPU)",
+            public_paths=PUBLIC_PATHS,
+            auth_enabled=a is not None and a.get("require_auth", True))
 
     auth_service = None
     auth_cfg = cfg.get("auth")

@@ -68,6 +68,9 @@ class Router:
 
     def __init__(self):
         self._routes: list[tuple[str, re.Pattern, Handler]] = []
+        # (method, pattern, handler) with the ORIGINAL '{param}' pattern —
+        # the OpenAPI generator reads this table.
+        self.route_table: list[tuple[str, str, Handler]] = []
         self.middleware: list[Middleware] = []
 
     def route(self, method: str, pattern: str):
@@ -76,6 +79,7 @@ class Router:
 
         def deco(fn: Handler) -> Handler:
             self._routes.append((method.upper(), regex, fn))
+            self.route_table.append((method.upper(), pattern, fn))
             return fn
         return deco
 
@@ -96,6 +100,8 @@ class Router:
             pattern = prefix + regex.pattern.strip("^$")
             self._routes.append((method, re.compile("^" + pattern + "$"),
                                  fn))
+        for method, pattern, fn in other.route_table:
+            self.route_table.append((method, prefix + pattern, fn))
 
     def dispatch(self, method: str, raw_path: str,
                  headers: dict[str, str], body: bytes) -> Response:

@@ -1,0 +1,88 @@
+"""OpenAPI 3.1 spec generated from the live Router table.
+
+The reference maintains a hand-written spec-first gateway
+(``infra/gateway/openapi.yaml`` + ``generate_gateway_config.py``); here
+the direction inverts — the Router IS the source of truth and the spec is
+derived from it, so spec and behavior cannot drift (the same inversion
+the event schemas use, ``scripts/generate_event_schemas.py``). Handler
+docstrings become operation summaries/descriptions; ``{param}`` path
+segments become path parameters; auth-guarded paths get the bearer
+security requirement.
+
+Regenerate the committed copy with ``scripts/generate_openapi.py``;
+``tests/test_openapi.py`` keeps it in sync. Served live at
+``/api/openapi.json``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from copilot_for_consensus_tpu.services.http import Router
+
+_PARAM_RE = re.compile(r"\{(\w+)\}")
+
+VERSION = "3.1.0"
+
+
+def _operation(method: str, pattern: str, fn) -> dict[str, Any]:
+    doc = (fn.__doc__ or "").strip()
+    summary, _, rest = doc.partition("\n")
+    op_id = f"{method.lower()}_{re.sub(r'[^a-zA-Z0-9]+', '_', pattern).strip('_')}"
+    op: dict[str, Any] = {
+        "operationId": op_id,
+        "summary": summary or f"{method} {pattern}",
+        "responses": {
+            "200": {"description": "Success",
+                    "content": {"application/json": {"schema": {}}}},
+        },
+    }
+    if rest.strip():
+        op["description"] = " ".join(rest.split())
+    params = [{
+        "name": name,
+        "in": "path",
+        "required": True,
+        "schema": {"type": "string"},
+    } for name in _PARAM_RE.findall(pattern)]
+    if params:
+        op["parameters"] = params
+    if method in ("POST", "PUT"):
+        op["requestBody"] = {
+            "content": {"application/json": {"schema": {}}},
+            "required": False,
+        }
+    return op
+
+
+def generate_openapi(router: Router, *, title: str, version: str = "0.2.0",
+                     public_paths: tuple[str, ...] = (),
+                     auth_enabled: bool = False) -> dict[str, Any]:
+    """Build the spec dict from ``router.route_table``."""
+    from copilot_for_consensus_tpu.security.auth import is_public_path
+
+    paths: dict[str, dict[str, Any]] = {}
+    for method, pattern, fn in router.route_table:
+        op = _operation(method, pattern, fn)
+        if auth_enabled and not is_public_path(pattern, public_paths):
+            op["security"] = [{"bearerAuth": []}]
+        paths.setdefault(pattern, {})[method.lower()] = op
+    spec: dict[str, Any] = {
+        "openapi": VERSION,
+        "info": {
+            "title": title,
+            "version": version,
+            "description": (
+                "TPU-native consensus-summarization pipeline API. "
+                "Generated from the live router — regenerate with "
+                "scripts/generate_openapi.py."),
+        },
+        "paths": dict(sorted(paths.items())),
+    }
+    if auth_enabled:
+        spec["components"] = {"securitySchemes": {
+            "bearerAuth": {"type": "http", "scheme": "bearer",
+                           "bearerFormat": "JWT"},
+        }}
+    return spec

@@ -1,0 +1,56 @@
+"""Gateway-served SPA: static asset routes for the UI.
+
+The reference ships a React SPA behind nginx (``ui/src/routes/``,
+``infra/nginx/nginx.conf``); here the UI is build-free static assets
+(``copilot_for_consensus_tpu/ui/``) served by the same unified router as
+the API — one process, one port, zero extra infra, consistent with the
+single-host deployment mode.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from copilot_for_consensus_tpu.services.http import (
+    HTTPError,
+    Response,
+    Router,
+)
+
+UI_ROOT = pathlib.Path(__file__).resolve().parent.parent / "ui"
+
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".js": "application/javascript; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
+    ".json": "application/json",
+    ".svg": "image/svg+xml",
+    ".png": "image/png",
+    ".ico": "image/x-icon",
+}
+
+
+def _serve_asset(name: str) -> Response:
+    # resolve() + containment check: path traversal cannot escape UI_ROOT.
+    path = (UI_ROOT / name).resolve()
+    if not path.is_relative_to(UI_ROOT) or not path.is_file():
+        raise HTTPError(404, "asset not found")
+    ctype = _CONTENT_TYPES.get(path.suffix, "application/octet-stream")
+    return Response(path.read_bytes(), content_type=ctype,
+                    headers={"Cache-Control": "no-cache"})
+
+
+def ui_router() -> Router:
+    router = Router()
+
+    @router.get("/")
+    def index(req):
+        """Serve the single-page UI shell."""
+        return _serve_asset("index.html")
+
+    @router.get("/ui/{asset}")
+    def asset(req):
+        """Serve a static UI asset (js/css)."""
+        return _serve_asset(req.params["asset"])
+
+    return router

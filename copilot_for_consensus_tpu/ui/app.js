@@ -1,0 +1,326 @@
+/* CoPilot-for-Consensus SPA: hash routing + fetch against the gateway
+   API (services/api.py, security/auth.py). Feature parity targets the
+   reference React routes (ui/src/routes/). */
+"use strict";
+
+const $ = (sel, el) => (el || document).querySelector(sel);
+const view = $("#view");
+
+/* ---------- auth ---------- */
+const token = {
+  get: () => localStorage.getItem("cfc_token") || "",
+  set: (t) => localStorage.setItem("cfc_token", t),
+  clear: () => localStorage.removeItem("cfc_token"),
+};
+
+async function api(path, opts = {}) {
+  opts.headers = Object.assign({}, opts.headers);
+  if (token.get()) opts.headers["Authorization"] = "Bearer " + token.get();
+  if (opts.body && typeof opts.body !== "string") {
+    opts.body = JSON.stringify(opts.body);
+    opts.headers["Content-Type"] = "application/json";
+  }
+  const res = await fetch(path, opts);
+  if (res.status === 401) { location.hash = "#/login"; throw new Error("unauthorized"); }
+  const text = await res.text();
+  let data = null;
+  try { data = text ? JSON.parse(text) : null; } catch { data = { raw: text }; }
+  if (!res.ok) throw new Error((data && data.error) || res.status + "");
+  return data;
+}
+
+function esc(s) {
+  return String(s == null ? "" : s).replace(/[&<>"']/g,
+    (c) => ({ "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;" }[c]));
+}
+function fmtDate(s) { return s ? new Date(s).toLocaleString() : "—"; }
+function render(html) { view.innerHTML = html; }
+function err(e) {
+  render(`<div class="card error"><h2>Something went wrong</h2><p>${esc(e.message || e)}</p></div>`);
+}
+
+async function refreshUserBox() {
+  const box = $("#user-box");
+  if (!token.get()) { box.innerHTML = `<a href="#/login" class="btn">Sign in</a>`; return; }
+  try {
+    const me = await api("/auth/userinfo");
+    box.innerHTML = `<div class="who"><b>${esc(me.email || me.sub)}</b>` +
+      `<small>${(me.roles || []).map(esc).join(", ")}</small></div>` +
+      `<button class="btn ghost" id="logout">Sign out</button>`;
+    $("#logout").onclick = () => { token.clear(); location.hash = "#/login"; refreshUserBox(); };
+  } catch { box.innerHTML = `<a href="#/login" class="btn">Sign in</a>`; }
+}
+
+/* ---------- pages ---------- */
+
+async function pageLogin() {
+  render(`<div class="card narrow">
+    <h2>Sign in</h2>
+    <p>Authenticate with an identity provider to browse reports and manage sources.</p>
+    <div id="providers" class="stack"></div>
+    <details><summary>Developer sign-in (mock provider)</summary>
+      <form id="mock-form" class="stack">
+        <input name="email" type="email" placeholder="you@example.org" required>
+        <button class="btn">Sign in as developer</button>
+      </form>
+    </details>
+  </div>`);
+  // /auth/login initiates the PKCE flow and returns {state, authorize_url};
+  // the callback only accepts a server-issued state.
+  const initiate = (provider) =>
+    api(`/auth/login?provider=${provider}&redirect_uri=` +
+        encodeURIComponent(location.origin + "/?from=oidc"));
+  $("#mock-form").onsubmit = async (ev) => {
+    ev.preventDefault();
+    const email = new FormData(ev.target).get("email");
+    try {
+      const login = await initiate("mock");
+      const out = await api(`/auth/callback?code=${encodeURIComponent("mock:" + email)}` +
+        `&state=${encodeURIComponent(login.state)}`);
+      token.set(out.access_token); await refreshUserBox(); location.hash = "#/reports";
+    } catch (e) { err(e); }
+  };
+  const provBox = $("#providers");
+  ["github", "google", "microsoft", "datatracker"].forEach((p) => {
+    const b = document.createElement("button");
+    b.className = "btn"; b.textContent = "Continue with " + p[0].toUpperCase() + p.slice(1);
+    b.onclick = async () => {
+      try { location.href = (await initiate(p)).authorize_url; }
+      catch (e) { err(e); }
+    };
+    provBox.appendChild(b);
+  });
+}
+
+async function pageCallback() {
+  // OIDC redirect lands here with ?code=&state= in the query string.
+  const q = new URLSearchParams(location.search || location.hash.split("?")[1] || "");
+  const code = q.get("code"), state = q.get("state");
+  if (!code) { render(`<div class="card">No authorization code in URL.</div>`); return; }
+  try {
+    const out = await api(`/auth/callback?code=${encodeURIComponent(code)}&state=${encodeURIComponent(state)}`);
+    token.set(out.access_token); await refreshUserBox();
+    history.replaceState(null, "", location.pathname); location.hash = "#/reports";
+  } catch (e) { err(e); }
+}
+
+async function pageReports() {
+  render(`<div class="toolbar"><h2>Reports</h2>
+    <form id="search" class="inline"><input name="topic" placeholder="Search topics…">
+    <label class="check"><input type="checkbox" name="semantic" checked> semantic</label>
+    <button class="btn">Search</button></form></div><div id="list" class="stack"></div>`);
+  const list = $("#list");
+  const show = (reports) => {
+    list.innerHTML = reports.length ? reports.map((r) => `
+      <a class="card row" href="#/reports/${esc(r.report_id)}">
+        <div><h3>${esc(r.subject || r.thread_id)}</h3>
+        <p class="muted">${esc((r.summary_text || r.summary || "").slice(0, 220))}</p></div>
+        <div class="meta"><span>${fmtDate(r.published_at)}</span>
+        ${r.consensus ? `<span class="tag ok">consensus: ${esc(r.consensus.level || r.consensus)}</span>` : ""}
+        </div></a>`).join("") : `<div class="card muted">No reports yet — trigger a source to run the pipeline.</div>`;
+  };
+  $("#search").onsubmit = async (ev) => {
+    ev.preventDefault();
+    const fd = new FormData(ev.target);
+    const topic = fd.get("topic");
+    try {
+      if (!topic) { show((await api("/api/reports")).reports); return; }
+      show((await api(`/api/reports/search?topic=${encodeURIComponent(topic)}&semantic=${fd.get("semantic") ? "true" : "false"}`)).reports);
+    } catch (e) { err(e); }
+  };
+  try { show((await api("/api/reports")).reports); } catch (e) { err(e); }
+}
+
+async function pageReportDetail(id) {
+  try {
+    const r = await api(`/api/reports/${encodeURIComponent(id)}`);
+    render(`<article class="card">
+      <h2>${esc(r.subject || r.thread_id)}</h2>
+      <p class="muted">published ${fmtDate(r.published_at)} · model ${esc(r.model || "n/a")}
+        · <a href="#/threads/${esc(r.thread_id)}">view discussion</a></p>
+      <section class="summary">${esc(r.summary_text || r.summary || "")}</section>
+      ${r.consensus ? `<p><span class="tag ok">consensus: ${esc(r.consensus.level || r.consensus)}</span></p>` : ""}
+      <h3>Citations</h3>
+      <ul class="citations">${(r.citations || []).map((c) => `
+        <li><a href="#/messages/${esc(c.message_doc_id || "")}">
+          ${esc(c.chunk_id || c.message_doc_id || "chunk")}</a>
+          ${c.snippet ? `<blockquote>${esc(c.snippet)}</blockquote>` : ""}</li>`).join("") || "<li class='muted'>none</li>"}
+      </ul></article>`);
+  } catch (e) { err(e); }
+}
+
+async function pageThreads() {
+  try {
+    const t = (await api("/api/threads")).threads;
+    render(`<div class="toolbar"><h2>Discussions</h2></div><div class="stack">` +
+      (t.length ? t.map((x) => `
+        <a class="card row" href="#/threads/${esc(x.thread_id)}">
+          <div><h3>${esc(x.subject || x.thread_id)}</h3>
+          <p class="muted">${(x.participants || []).slice(0, 5).map(esc).join(", ")}</p></div>
+          <div class="meta"><span>${esc(x.message_count || 0)} messages</span></div></a>`).join("")
+        : `<div class="card muted">No discussions parsed yet.</div>`) + `</div>`);
+  } catch (e) { err(e); }
+}
+
+async function pageThreadDetail(id) {
+  try {
+    const [t, msgs] = await Promise.all([
+      api(`/api/threads/${encodeURIComponent(id)}`),
+      api(`/api/threads/${encodeURIComponent(id)}/messages`),
+    ]);
+    render(`<article class="card">
+      <h2>${esc(t.subject || t.thread_id)}</h2>
+      <p class="muted">${esc(t.message_count || (msgs.messages || []).length)} messages ·
+        participants: ${(t.participants || []).map(esc).join(", ") || "—"}</p>
+      <div class="stack">${(msgs.messages || []).map((m) => `
+        <div class="msg"><div class="msg-head">
+          <b>${esc(m.from_name || m.from_addr || "unknown")}</b>
+          <span class="muted">${fmtDate(m.date)}</span>
+          <a href="#/messages/${esc(m.message_doc_id)}">detail</a></div>
+          <pre>${esc((m.body || "").slice(0, 1200))}</pre></div>`).join("")}
+      </div></article>`);
+  } catch (e) { err(e); }
+}
+
+async function pageMessageDetail(id) {
+  try {
+    const [m, ch] = await Promise.all([
+      api(`/api/messages/${encodeURIComponent(id)}`),
+      api(`/api/messages/${encodeURIComponent(id)}/chunks`),
+    ]);
+    render(`<article class="card">
+      <h2>${esc(m.subject || m.message_doc_id)}</h2>
+      <p class="muted">from <b>${esc(m.from_name || m.from_addr || "?")}</b> · ${fmtDate(m.date)}
+        · <a href="#/threads/${esc(m.thread_id)}">thread</a></p>
+      <pre>${esc(m.body || "")}</pre>
+      <h3>Chunks (${(ch.chunks || []).length})</h3>
+      <div class="stack">${(ch.chunks || []).map((c) => `
+        <div class="msg"><div class="msg-head"><code>${esc(c.chunk_id)}</code>
+          <span class="tag ${c.embedding_generated ? "ok" : ""}">${c.embedding_generated ? "embedded" : "pending"}</span></div>
+          <pre>${esc((c.text || "").slice(0, 600))}</pre></div>`).join("")}
+      </div></article>`);
+  } catch (e) { err(e); }
+}
+
+async function pageSources() {
+  render(`<div class="toolbar"><h2>Sources</h2>
+    <button class="btn" id="new-src">Add source</button></div>
+    <div id="form-slot"></div><div id="list" class="stack"></div>`);
+  const reload = async () => {
+    try {
+      const s = (await api("/api/sources")).sources;
+      $("#list").innerHTML = s.length ? s.map((x) => `
+        <div class="card row"><div>
+          <h3>${esc(x.name || x.source_id)}</h3>
+          <p class="muted"><code>${esc(x.fetcher)}</code> ${esc(x.location || x.url || "")}</p></div>
+          <div class="meta actions">
+            <button class="btn sm" data-act="trigger" data-id="${esc(x.source_id)}">Trigger</button>
+            <button class="btn sm ghost" data-act="delete" data-id="${esc(x.source_id)}">Delete</button>
+          </div></div>`).join("") : `<div class="card muted">No sources configured.</div>`;
+      $("#list").querySelectorAll("button[data-act]").forEach((b) => {
+        b.onclick = async () => {
+          try {
+            if (b.dataset.act === "trigger") {
+              const out = await api(`/api/sources/${b.dataset.id}/trigger`, { method: "POST" });
+              b.textContent = `Ingested ${out.ingested_archives}`;
+              setTimeout(() => (b.textContent = "Trigger"), 2500);
+            } else if (confirm(`Delete source ${b.dataset.id} and all derived documents?`)) {
+              await api(`/api/sources/${b.dataset.id}`, { method: "DELETE" }); reload();
+            }
+          } catch (e) { err(e); }
+        };
+      });
+    } catch (e) { err(e); }
+  };
+  $("#new-src").onclick = () => {
+    $("#form-slot").innerHTML = `<form id="src-form" class="card stack">
+      <h3>New source</h3>
+      <input name="name" placeholder="name" required>
+      <select name="fetcher"><option>local</option><option>http</option>
+        <option>imap</option><option>rsync</option><option>mock</option></select>
+      <input name="location" placeholder="path / url">
+      <div class="inline"><button class="btn">Create</button>
+      <button type="button" class="btn ghost" id="cancel">Cancel</button></div></form>`;
+    $("#cancel").onclick = () => ($("#form-slot").innerHTML = "");
+    $("#src-form").onsubmit = async (ev) => {
+      ev.preventDefault();
+      const fd = new FormData(ev.target);
+      try {
+        await api("/api/sources", { method: "POST", body: {
+          name: fd.get("name"), fetcher: fd.get("fetcher"), location: fd.get("location") } });
+        $("#form-slot").innerHTML = ""; reload();
+      } catch (e) { err(e); }
+    };
+  };
+  reload();
+}
+
+async function pageAdmin() {
+  render(`<div class="toolbar"><h2>Admin</h2></div>
+    <div class="grid"><div class="card"><h3>Pipeline</h3><dl id="stats" class="stats"></dl></div>
+    <div class="card"><h3>Users &amp; roles</h3><div id="users" class="stack"></div>
+      <form id="role-form" class="inline">
+        <input name="email" placeholder="email" required>
+        <input name="roles" placeholder="roles (comma-sep)" required>
+        <button class="btn sm">Set roles</button></form></div></div>`);
+  try {
+    const s = await api("/stats");
+    $("#stats").innerHTML = Object.entries(s).map(([k, v]) =>
+      `<dt>${esc(k)}</dt><dd>${esc(v)}</dd>`).join("");
+  } catch (e) { $("#stats").innerHTML = `<dd class="muted">${esc(e.message)}</dd>`; }
+  const loadUsers = async () => {
+    try {
+      const u = await api("/auth/admin/users");
+      $("#users").innerHTML = (u.users || []).map((x) => `
+        <div class="row"><b>${esc(x.email)}</b>
+          <span>${(x.roles || []).map((r) => `<span class="tag">${esc(r)}</span>`).join(" ")}</span>
+          <button class="btn sm ghost" data-email="${esc(x.email)}">Remove</button></div>`).join("")
+        || `<p class="muted">No explicit role assignments.</p>`;
+      $("#users").querySelectorAll("button[data-email]").forEach((b) => {
+        b.onclick = async () => {
+          await api(`/auth/admin/users/${encodeURIComponent(b.dataset.email)}`, { method: "DELETE" });
+          loadUsers();
+        };
+      });
+    } catch (e) { $("#users").innerHTML = `<p class="muted">${esc(e.message)} (admin role required)</p>`; }
+  };
+  $("#role-form").onsubmit = async (ev) => {
+    ev.preventDefault();
+    const fd = new FormData(ev.target);
+    try {
+      await api(`/auth/admin/users/${encodeURIComponent(fd.get("email"))}`, {
+        method: "PUT", body: { roles: fd.get("roles").split(",").map((r) => r.trim()).filter(Boolean) } });
+      ev.target.reset(); loadUsers();
+    } catch (e) { err(e); }
+  };
+  loadUsers();
+}
+
+/* ---------- router ---------- */
+const routes = [
+  [/^#\/login$/, pageLogin],
+  [/^#\/callback/, pageCallback],
+  [/^#\/reports$/, pageReports],
+  [/^#\/reports\/(.+)$/, (m) => pageReportDetail(m[1])],
+  [/^#\/threads$/, pageThreads],
+  [/^#\/threads\/([^/]+)$/, (m) => pageThreadDetail(m[1])],
+  [/^#\/messages\/([^/]+)$/, (m) => pageMessageDetail(m[1])],
+  [/^#\/sources$/, pageSources],
+  [/^#\/admin$/, pageAdmin],
+];
+
+function route() {
+  const h = location.hash || "#/reports";
+  document.querySelectorAll("#nav a[data-nav]").forEach((a) =>
+    a.classList.toggle("active", h.startsWith("#/" + a.dataset.nav)));
+  for (const [re, fn] of routes) {
+    const m = h.match(re);
+    if (m) { Promise.resolve(fn(m)).catch(err); return; }
+  }
+  location.hash = "#/reports";
+}
+
+window.addEventListener("hashchange", route);
+if (location.search.includes("code=")) location.hash = "#/callback" + location.search;
+refreshUserBox();
+route();

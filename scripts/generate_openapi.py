@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Write the committed OpenAPI spec from the live unified router.
+
+Run: python scripts/generate_openapi.py
+Output: copilot_for_consensus_tpu/schemas/openapi.json
+
+The spec is derived from the route table the gateway actually serves
+(capability parity with the reference's ``infra/gateway/openapi.yaml``,
+direction inverted: router is the source of truth).
+``tests/test_openapi.py`` fails if this file goes stale.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+OUT = REPO / "copilot_for_consensus_tpu" / "schemas" / "openapi.json"
+
+
+def build_spec() -> dict:
+    from copilot_for_consensus_tpu.security.auth import PUBLIC_PATHS
+    from copilot_for_consensus_tpu.services.bootstrap import serve_pipeline
+    from copilot_for_consensus_tpu.services.openapi import generate_openapi
+
+    server = serve_pipeline({
+        "auth": {"require_auth": True, "allow_insecure_mock": True},
+    })
+    return generate_openapi(
+        server.http.router, title="CoPilot for Consensus (TPU)",
+        public_paths=PUBLIC_PATHS, auth_enabled=True)
+
+
+def main() -> int:
+    spec = build_spec()
+    OUT.write_text(json.dumps(spec, indent=2, sort_keys=True) + "\n")
+    print(f"wrote {OUT} ({len(spec['paths'])} paths)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -121,6 +121,16 @@ class InProcBroker:
             return total + sum(len(q.items)
                                for q in self._group_queues(routing_key))
 
+    def routing_key_depths(self) -> dict[str, int]:
+        """Snapshot of every known routing key's depth (bound queues plus
+        parked pre-bind messages) — the metrics/ops introspection surface,
+        so callers never reach into broker internals."""
+        with self._lock:
+            keys = {rk for rk, _ in self._queues} | set(self._pending)
+            return {rk: len(self._pending.get(rk, ()))
+                    + sum(len(q.items) for q in self._group_queues(rk))
+                    for rk in sorted(keys)}
+
     def _pop_ready(self) -> tuple[_Queue, Mapping[str, Any], int, EventCallback] | None:
         with self._lock:
             for q in self._queues.values():

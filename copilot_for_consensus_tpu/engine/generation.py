@@ -94,7 +94,9 @@ class GenerationEngine:
         attn_impl: str = "auto",
         quantize: bool = False,
         decode_window: int = 8,
+        profile_dir: str | None = None,
     ):
+        self.profile_dir = profile_dir
         self.cfg = cfg
         self.mesh = mesh
         self.num_slots = num_slots
@@ -285,12 +287,16 @@ class GenerationEngine:
     def generate(self, prompts: list[list[int]],
                  max_new_tokens: int = 256) -> list[Completion]:
         """Batch convenience: submit all, run to completion, return in
-        submission order."""
+        submission order. Captures a jax.profiler trace when the engine
+        was built with ``profile_dir``."""
+        from copilot_for_consensus_tpu.obs.profile import maybe_profile
+
         ids = [self.submit(p, max_new_tokens) for p in prompts]
         results: dict[int, Completion] = {}
-        while len(results) < len(ids):
-            for c in self.step():
-                results[c.request_id] = c
+        with maybe_profile(self.profile_dir):
+            while len(results) < len(ids):
+                for c in self.step():
+                    results[c.request_id] = c
         return [results[i] for i in ids]
 
     def generate_text(self, prompts: list[str], tokenizer: Tokenizer,

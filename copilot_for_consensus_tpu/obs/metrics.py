@@ -13,8 +13,11 @@ import abc
 import threading
 from typing import Any, Iterable
 
+# Top bound must exceed every latency SLO threshold the alert pack uses
+# (histogram_quantile caps at the largest finite bucket, so a threshold
+# at/above it could never fire).
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
-                   10.0, 30.0, 60.0, 120.0)
+                   10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
 
 def _label_key(labels: dict[str, str] | None) -> tuple:

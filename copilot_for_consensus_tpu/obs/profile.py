@@ -1,0 +1,35 @@
+"""jax.profiler integration: flag-gated trace capture on the engines.
+
+SURVEY.md §5 assigns the tracing/profiling subsystem to the TPU build
+(the reference's per-event correlation_id covers the host side; device
+time needs the XLA profiler). Usage:
+
+    with maybe_profile("var/traces"):            # or None → no-op
+        engine.generate(...)
+
+Traces are Perfetto/TensorBoard-compatible (``jax.profiler.trace``).
+Enable on the serving engines via config ``llm.profile_dir``
+(``GenerationEngine(profile_dir=...)``); the flag defaults off so
+production pays zero overhead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: str | None, *, create_perfetto_link=False):
+    """Capture a jax.profiler trace into ``trace_dir`` when set; plain
+    no-op when None/empty — callers never branch."""
+    if not trace_dir:
+        yield None
+        return
+    import jax
+
+    path = pathlib.Path(trace_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(path),
+                            create_perfetto_link=create_perfetto_link):
+        yield str(path)

@@ -79,6 +79,26 @@ def build_service_router(service, *, metrics=None, extra: Router | None
     return router
 
 
+class _BusGaugeMetrics:
+    """Proxy that refreshes bus queue-depth / dead-letter gauges right
+    before Prometheus exposition — the series the alert pack
+    (infra/prometheus/alerts/queues.yml) fires on."""
+
+    def __init__(self, inner, broker):
+        self._inner = inner
+        self._broker = broker
+
+    def render_prometheus(self) -> str:
+        for rk, depth in self._broker.routing_key_depths().items():
+            name = ("bus_dead_letters" if rk.endswith(".dlq")
+                    else "bus_queue_depth")
+            self._inner.gauge(name, depth, labels={"queue": rk})
+        return self._inner.render_prometheus()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 @dataclass
 class PipelineServer:
     """Single-process deployment: full pipeline + gateway-style router."""
@@ -140,7 +160,7 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
     router.merge(health_router(
         "pipeline",
         stats=pipeline.reporting.stats,
-        metrics=pipeline.metrics))
+        metrics=_BusGaugeMetrics(pipeline.metrics, pipeline.broker)))
     router.merge(ingestion_router(pipeline.ingestion))
     # ingestion owns GET /api/sources on the unified surface; reporting's
     # copy exists for standalone reporting-only deployments.

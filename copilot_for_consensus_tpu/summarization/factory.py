@@ -37,6 +37,7 @@ def create_summarizer(config: Any = None, **kwargs: Any) -> Summarizer:
             max_len=int(_cfg_get(config, "max_len", 4096)),
             checkpoint=_cfg_get(config, "checkpoint"),
             long_context=bool(_cfg_get(config, "long_context", False)),
+            profile_dir=_cfg_get(config, "profile_dir"),
             **kwargs,
         )
     raise ValueError(f"unknown llm_backend driver {driver!r}")

@@ -53,7 +53,8 @@ class TPUSummarizer(Summarizer):
                  system: str = DEFAULT_SYSTEM, num_slots: int = 4,
                  max_len: int = 4096, params=None, mesh=None, dtype=None,
                  checkpoint: str | None = None, long_engine=None,
-                 long_context: bool = False):
+                 long_context: bool = False,
+                 profile_dir: str | None = None):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
             ByteTokenizer,
@@ -78,7 +79,7 @@ class TPUSummarizer(Summarizer):
                 # ``factory.py:89-94``).
                 engine = GenerationEngine.from_checkpoint(
                     checkpoint, mesh=mesh, num_slots=num_slots,
-                    max_len=max_len,
+                    max_len=max_len, profile_dir=profile_dir,
                     dtype=dtype if dtype is not None else jnp.bfloat16)
                 self._model = f"checkpoint:{checkpoint}"
                 if tokenizer is None:
@@ -97,6 +98,7 @@ class TPUSummarizer(Summarizer):
                 engine = GenerationEngine(
                     cfg, params, mesh=mesh, num_slots=num_slots,
                     max_len=min(max_len, cfg.max_seq_len),
+                    profile_dir=profile_dir,
                     dtype=dtype if dtype is not None else jnp.bfloat16)
         self.engine = engine
         if long_engine is None and long_context:

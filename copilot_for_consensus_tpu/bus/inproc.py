@@ -127,9 +127,7 @@ class InProcBroker:
         so callers never reach into broker internals."""
         with self._lock:
             keys = {rk for rk, _ in self._queues} | set(self._pending)
-            return {rk: len(self._pending.get(rk, ()))
-                    + sum(len(q.items) for q in self._group_queues(rk))
-                    for rk in sorted(keys)}
+            return {rk: self.queue_depth(rk) for rk in sorted(keys)}
 
     def _pop_ready(self) -> tuple[_Queue, Mapping[str, Any], int, EventCallback] | None:
         with self._lock:

@@ -70,8 +70,10 @@ class LongContextEngine:
         max_new_tokens: int = 512,
         decode_window: int = 8,
         ctx_block: int = 64,
+        profile_dir: str | None = None,
     ):
         self.cfg = cfg
+        self.profile_dir = profile_dir
         self.mesh = mesh
         self.axis = axis
         self.n_shards = mesh.shape[axis]
@@ -271,7 +273,15 @@ class LongContextEngine:
     def generate(self, prompt: list[int],
                  max_new_tokens: int = 256) -> Completion:
         """Generate against the FULL prompt, however long — no truncation.
-        Returns the same Completion record as the batch engine."""
+        Returns the same Completion record as the batch engine. Captures
+        a jax.profiler trace when built with ``profile_dir``."""
+        from copilot_for_consensus_tpu.obs.profile import maybe_profile
+
+        with maybe_profile(self.profile_dir):
+            return self._generate(prompt, max_new_tokens)
+
+    def _generate(self, prompt: list[int],
+                  max_new_tokens: int) -> Completion:
         if not prompt:
             raise ValueError("empty prompt")
         max_new_tokens = min(max_new_tokens, self.suffix_len - 1)

@@ -11,6 +11,12 @@ Mongo-style filter subset compiles to SQL ``WHERE`` clauses that use them.
 Queries the compiler can't express exactly (``$regex``, ``None`` inside
 ``$in`` lists, exotic paths) fall back to the shared Python matcher, so
 semantics never change — only the plan does.
+
+Known divergences from the Python matcher, both outside the pipeline's
+data contract: (a) mixed-type range comparisons raise TypeError in Python
+but exclude the row in SQL; (b) strings containing U+0000 are truncated
+at the NUL by SQLite's json_extract (C-string semantics), so ``"a\\x00b"``
+compares as ``"a"`` in SQL — no pipeline stage writes NULs into documents.
 """
 
 from __future__ import annotations

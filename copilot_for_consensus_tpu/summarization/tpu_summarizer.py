@@ -120,7 +120,8 @@ class TPUSummarizer(Summarizer):
             long_engine = LongContextEngine(
                 engine.cfg, engine.params, mesh=mesh,
                 eos_id=sorted(engine._eos_set),
-                max_new_tokens=max_new_tokens)
+                max_new_tokens=max_new_tokens,
+                profile_dir=profile_dir)
         # Whole-thread contexts beyond the batch engine's window route to
         # the sequence-parallel long-context engine instead of being
         # tail-truncated (the reference's only strategy is top-k

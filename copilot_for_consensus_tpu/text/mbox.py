@@ -135,8 +135,12 @@ def parse_mbox_bytes(raw: bytes) -> Iterator[tuple[ParsedMessage, bool]]:
 def parse_mbox_file(path: str | pathlib.Path) -> Iterator[tuple[ParsedMessage, bool]]:
     box = mailbox.mbox(str(path), create=False)
     try:
-        for index, msg in enumerate(box):
+        # Fetch inside the guard: stdlib mbox decodes each From_ separator
+        # as ascii at access time, so a corrupt separator must skip that
+        # one message, not abort the whole archive walk.
+        for index, key in enumerate(box.keys()):
             try:
+                msg = box.get_message(key)
                 body, is_html = extract_body(msg)
                 to_raw = decode_header_value(msg.get("To"))
                 cc_raw = decode_header_value(msg.get("Cc"))

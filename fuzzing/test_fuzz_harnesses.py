@@ -1,0 +1,321 @@
+# Property/fuzz harnesses (Hypothesis) — the role of the reference's
+# fuzzing/ suite (Atheris + Hypothesis harnesses for jwt, parsing,
+# schemas, ids; /root/reference/fuzzing/tests/). Run them all via
+# ``python fuzzing/run_fuzz.py`` (more examples) or plain pytest.
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import HealthCheck, given, settings, strategies as st  # noqa: E402
+
+COMMON = dict(suppress_health_check=[HealthCheck.too_slow], deadline=None)
+# run_fuzz.py deepens the example budget via this env var; @settings
+# pins would silently override a Hypothesis profile, so scale here.
+_MULT = max(1, int(os.environ.get("FUZZ_EXAMPLES_MULT", "1")))
+
+
+def fuzz_settings(max_examples):
+    return settings(max_examples=max_examples * _MULT, **COMMON)
+
+
+# ---------------------------------------------------------------------------
+# 1. mbox parsing: arbitrary bytes never crash; every yielded message has
+#    the invariants downstream stages rely on. Plain st.binary() almost
+#    never emits a valid "From " separator, so splice real mbox framing
+#    into the garbage to actually reach the per-message path.
+# ---------------------------------------------------------------------------
+
+_MBOX_FRAGMENTS = st.sampled_from([
+    b"From a@b Thu Jan  1 00:00:00 2026\n",
+    b"From ", b"\nFrom ", b"Subject: x\n", b"Message-ID: <i@d>\n",
+    b"Content-Type: text/html\n", b"\n\n", b"=?utf-8?b?////?=\n",
+])
+_GARBAGE_MBOX = st.lists(
+    st.one_of(st.binary(max_size=256), _MBOX_FRAGMENTS),
+    max_size=12).map(b"".join)
+
+
+@fuzz_settings(200)
+@given(raw=_GARBAGE_MBOX)
+def test_mbox_parse_never_crashes_on_garbage(raw):
+    from copilot_for_consensus_tpu.text.mbox import parse_mbox_bytes
+
+    for msg, is_draft in parse_mbox_bytes(raw):
+        assert isinstance(msg.subject, str)
+        assert isinstance(msg.body_raw, str)
+        assert isinstance(msg.references, list)
+        assert isinstance(is_draft, bool)
+
+
+@fuzz_settings(100)
+@given(
+    subject=st.text(
+        alphabet=st.characters(blacklist_categories=("Cs", "Cc")),
+        max_size=80),
+    body=st.text(max_size=500),
+    n=st.integers(1, 5),
+)
+def test_mbox_structured_messages_all_parse(subject, body, n):
+    """A well-formed mbox with n messages yields exactly n parses."""
+    from copilot_for_consensus_tpu.text.mbox import parse_mbox_bytes
+
+    # mboxo escaping: a separator line is "From " at the start of ANY
+    # line, including the body's first line (it directly follows the
+    # blank header/body divider).
+    body = body.replace(chr(10) + "From ", chr(10) + ">From ")
+    if body.startswith("From "):
+        body = ">" + body
+    parts = []
+    for i in range(n):
+        parts.append(
+            f"From sender@example.org Thu Jan  1 00:00:0{i} 2026\n"
+            f"From: s{i}@example.org\n"
+            f"Message-ID: <m{i}@example.org>\n"
+            f"Subject: {subject.replace(chr(10), ' ')}\n"
+            f"\n{body}\n")
+    out = list(parse_mbox_bytes("\n".join(parts).encode(
+        "utf-8", "surrogatepass")))
+    assert len(out) == n
+    for msg, _ in out:
+        assert msg.message_id
+
+
+# ---------------------------------------------------------------------------
+# 2. Event envelope round-trip: every registered event type survives
+#    to_envelope → JSON → from_envelope with its data intact.
+# ---------------------------------------------------------------------------
+
+_JSON_SCALARS = st.one_of(
+    st.text(max_size=60), st.integers(-2**31, 2**31), st.booleans(),
+    st.floats(allow_nan=False, allow_infinity=False, width=32))
+
+
+def _value_for(ftype):
+    if ftype in ("str", str):
+        return st.text(max_size=60)
+    if ftype in ("int", int):
+        return st.integers(0, 2**31)
+    if ftype in ("float", float):
+        return st.floats(allow_nan=False, allow_infinity=False, width=32)
+    if ftype in ("bool", bool):
+        return st.booleans()
+    if "list" in str(ftype):
+        return st.lists(st.text(max_size=20), max_size=4)
+    if "dict" in str(ftype):
+        return st.dictionaries(st.text(max_size=10), _JSON_SCALARS,
+                               max_size=4)
+    return st.text(max_size=20)
+
+
+@fuzz_settings(150)
+@given(data=st.data())
+def test_event_envelope_roundtrip_all_types(data):
+    import dataclasses
+
+    from copilot_for_consensus_tpu.core.events import EVENT_TYPES
+
+    cls = data.draw(st.sampled_from(sorted(
+        EVENT_TYPES.values(), key=lambda c: c.event_type)))
+    kwargs = {f.name: data.draw(_value_for(f.type), label=f.name)
+              for f in dataclasses.fields(cls)}
+    evt = cls(**kwargs)
+    env = json.loads(json.dumps(evt.to_envelope()))
+    back = type(evt).from_envelope(env)
+    for f in dataclasses.fields(cls):
+        got, want = getattr(back, f.name), kwargs[f.name]
+        assert got == want or (
+            isinstance(want, float) and abs(got - want) < 1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 3. Deterministic ids + chunker coverage: same input → same ids; chunks
+#    reassemble to the full text with no gaps.
+# ---------------------------------------------------------------------------
+
+@fuzz_settings(200)
+@given(archive=st.binary(max_size=2048), mid=st.text(max_size=40),
+       idx=st.integers(0, 1000), seq=st.integers(0, 1000))
+def test_ids_deterministic_and_distinct(archive, mid, idx, seq):
+    from copilot_for_consensus_tpu.core import ids
+
+    a1 = ids.generate_archive_id_from_bytes(archive)
+    assert a1 == ids.generate_archive_id_from_bytes(archive)
+    m1 = ids.generate_message_doc_id(a1, mid, idx)
+    assert m1 == ids.generate_message_doc_id(a1, mid, idx)
+    assert m1 != ids.generate_message_doc_id(a1, mid, idx + 1)
+    c1 = ids.generate_chunk_id(m1, seq)
+    assert c1 == ids.generate_chunk_id(m1, seq)
+    assert c1 != ids.generate_chunk_id(m1, seq + 1)
+
+
+@fuzz_settings(150)
+@given(text=st.text(min_size=1, max_size=3000),
+       chunk_size=st.integers(8, 256), overlap=st.integers(0, 7))
+def test_token_window_chunker_covers_text(text, chunk_size, overlap):
+    """Every chunk is non-empty, seqs are dense from 0, and every word
+    (by the chunker's own tokenization) lands in some chunk."""
+    from copilot_for_consensus_tpu.text.chunkers import (
+        _WORD_RE,
+        TokenWindowChunker,
+    )
+
+    chunks = TokenWindowChunker(chunk_size=chunk_size,
+                                overlap=overlap).chunk(text)
+    assert [c.seq for c in chunks] == list(range(len(chunks)))
+    words = _WORD_RE.findall(text)
+    if words:
+        assert chunks, "wordful text must chunk"
+        joined_words = [w for c in chunks for w in _WORD_RE.findall(c.text)]
+        # Overlap duplicates words but never drops them: the multiset of
+        # chunk words must contain every input word.
+        for w in set(words):
+            assert words.count(w) <= joined_words.count(w), w
+
+
+# ---------------------------------------------------------------------------
+# 4. JWT: round-trip verifies; any single-char tamper of any token
+#    section is rejected; garbage never crashes the verifier.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def jwt_manager():
+    from copilot_for_consensus_tpu.security.jwt import (
+        JWTManager,
+        LocalRS256Signer,
+    )
+
+    return JWTManager(LocalRS256Signer(), issuer="fuzz", audience="fuzz")
+
+
+@fuzz_settings(50)
+@given(subject=st.text(min_size=1, max_size=60),
+       roles=st.lists(st.sampled_from(["admin", "reader", "processor"]),
+                      max_size=3))
+def test_jwt_mint_verify_roundtrip(jwt_manager, subject, roles):
+    token = jwt_manager.mint(subject, roles=roles)
+    claims = jwt_manager.verify(token)
+    assert claims["sub"] == subject
+    assert claims.get("roles", []) == roles
+
+
+@fuzz_settings(150)
+@given(subject=st.text(min_size=1, max_size=20),
+       pos=st.integers(0, 10_000), repl=st.characters(
+           whitelist_categories=("Ll", "Lu", "Nd")))
+def test_jwt_tampering_always_rejected(jwt_manager, subject, pos, repl):
+    from copilot_for_consensus_tpu.security.jwt import JWTError
+
+    token = jwt_manager.mint(subject)
+    i = pos % len(token)
+    if token[i] == repl or token[i] == ".":
+        return  # no-op edit or structural dot: not a tamper case
+    tampered = token[:i] + repl + token[i + 1:]
+    try:
+        claims = jwt_manager.verify(tampered)
+    except JWTError:
+        return
+    # Header/payload b64 can be malleable only if it decodes to the SAME
+    # canonical bytes — anything else must fail signature verification.
+    assert claims["sub"] == subject
+
+
+@fuzz_settings(200)
+@given(garbage=st.text(max_size=200))
+def test_jwt_garbage_never_crashes(jwt_manager, garbage):
+    from copilot_for_consensus_tpu.security.jwt import JWTError
+
+    try:
+        jwt_manager.verify(garbage)
+    except JWTError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# 5. Normalizer: arbitrary (possibly broken) HTML never crashes and never
+#    leaks markup into the normalized text.
+# ---------------------------------------------------------------------------
+
+@fuzz_settings(200)
+@given(body=st.text(max_size=2000), is_html=st.booleans())
+def test_normalizer_never_crashes_never_leaks_tags(body, is_html):
+    from copilot_for_consensus_tpu.text.normalizer import TextNormalizer
+
+    out = TextNormalizer().normalize(body, is_html=is_html)
+    assert isinstance(out, str)
+    if is_html:
+        assert "<script" not in out.lower()
+        assert "<style" not in out.lower()
+
+
+# ---------------------------------------------------------------------------
+# 6. Storage filter pushdown: the SQL-compiled path agrees with the
+#    Python matcher on arbitrary documents and filters (the parity
+#    contract of storage/sqlite.py, explored randomly).
+# ---------------------------------------------------------------------------
+
+# U+0000 excluded: sqlite json_extract truncates strings at NUL — a
+# documented divergence outside the parity contract (storage/sqlite.py).
+_NUL_FREE_TEXT = st.text(
+    alphabet=st.characters(blacklist_characters="\x00"), max_size=12)
+_DOC_VALUES = st.one_of(
+    st.none(), st.booleans(), st.integers(-1000, 1000), _NUL_FREE_TEXT)
+_FIELDS = ("alpha", "beta", "gamma")
+
+
+def _docs_strategy():
+    return st.lists(
+        st.builds(
+            lambda i, extra: {"chunk_id": f"d{i}", **extra},
+            st.integers(0, 10**6),
+            st.dictionaries(st.sampled_from(_FIELDS), _DOC_VALUES,
+                            max_size=3)),
+        min_size=1, max_size=8,
+        unique_by=lambda d: d["chunk_id"])
+
+
+def _filters_strategy():
+    field = st.sampled_from(_FIELDS)
+    scalar = st.one_of(st.booleans(), st.integers(-1000, 1000),
+                       _NUL_FREE_TEXT)
+    cond = st.one_of(
+        st.none(), scalar,
+        st.fixed_dictionaries({"$ne": st.one_of(st.none(), scalar)}),
+        st.fixed_dictionaries({"$in": st.lists(scalar, max_size=3)}),
+        st.fixed_dictionaries({"$nin": st.lists(scalar, max_size=3)}),
+        st.fixed_dictionaries({"$exists": st.booleans()}),
+        st.fixed_dictionaries({"$gte": st.integers(-1000, 1000)}),
+        st.fixed_dictionaries({"$lt": st.integers(-1000, 1000)}),
+    )
+    return st.dictionaries(field, cond, max_size=2)
+
+
+@fuzz_settings(200)
+@given(docs=_docs_strategy(), flt=_filters_strategy())
+def test_sqlite_pushdown_matches_python_matcher(tmp_path_factory, docs,
+                                                flt):
+    from copilot_for_consensus_tpu.storage.base import matches_filter
+    from copilot_for_consensus_tpu.storage.sqlite import SQLiteDocumentStore
+
+    store = SQLiteDocumentStore({"path": ":memory:"})
+    for d in docs:
+        store.insert_document("chunks", d)
+
+    def matches(d):
+        # Documented divergence (storage/sqlite.py): on mixed-type range
+        # comparisons the Python matcher raises TypeError while SQL
+        # excludes the row — treat raise-as-exclude for the oracle.
+        try:
+            return matches_filter(d, flt)
+        except TypeError:
+            return False
+
+    want = sorted(d["chunk_id"] for d in docs if matches(d))
+    got = sorted(d["chunk_id"]
+                 for d in store.query_documents("chunks", flt))
+    assert got == want, flt
+    assert store.count_documents("chunks", flt) == len(want)
+    store.close()

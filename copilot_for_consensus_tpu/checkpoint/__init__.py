@@ -9,7 +9,10 @@ offline int8 quantization for serving.
 from copilot_for_consensus_tpu.checkpoint.hf import (
     CheckpointError,
     config_from_hf,
+    encoder_config_from_hf,
     load_hf_checkpoint,
+    load_hf_encoder_checkpoint,
+    load_hf_encoder_params,
     load_hf_params,
     read_hf_config,
 )
@@ -25,8 +28,9 @@ from copilot_for_consensus_tpu.checkpoint.native import (
 )
 
 __all__ = [
-    "CheckpointError", "FORMAT", "config_from_hf", "convert", "is_native",
-    "load_checkpoint", "load_hf_checkpoint", "load_hf_params",
-    "load_native", "load_tokenizer", "quantize_tree", "read_hf_config",
-    "save_native",
+    "CheckpointError", "FORMAT", "config_from_hf", "convert",
+    "encoder_config_from_hf", "is_native", "load_checkpoint",
+    "load_hf_checkpoint", "load_hf_encoder_checkpoint",
+    "load_hf_encoder_params", "load_hf_params", "load_native",
+    "load_tokenizer", "quantize_tree", "read_hf_config", "save_native",
 ]

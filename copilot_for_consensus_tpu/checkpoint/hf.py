@@ -219,3 +219,107 @@ def load_hf_checkpoint(path: str | pathlib.Path, dtype: str = "bfloat16"
                        ) -> tuple[DecoderConfig, dict[str, Any]]:
     cfg = config_from_hf(read_hf_config(path))
     return cfg, load_hf_params(path, cfg, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Encoder (BERT/MiniLM family) import — the weights behind the reference's
+# default embedder all-MiniLM-L6-v2 (``adapters/copilot_embedding/
+# copilot_embedding/sentence_transformer_provider.py:19-51``); loading
+# them first-party replaces the sentence-transformers dependency.
+# ---------------------------------------------------------------------------
+
+
+def encoder_config_from_hf(hf: dict) -> "EncoderConfig":
+    from copilot_for_consensus_tpu.models.configs import EncoderConfig
+
+    if hf.get("model_type") != "bert":
+        raise CheckpointError(
+            f"unsupported encoder model_type {hf.get('model_type')!r} "
+            "(bert family only)")
+    act = hf.get("hidden_act", "gelu")
+    if act != "gelu":
+        raise CheckpointError(f"unsupported hidden_act {act!r}")
+    pos_type = hf.get("position_embedding_type", "absolute")
+    if pos_type != "absolute":
+        # Loading a relative-position BERT as absolute would serve
+        # silently-wrong vectors; fail loudly like the decoder loader
+        # does for rope_scaling.
+        raise CheckpointError(
+            f"unsupported position_embedding_type {pos_type!r}")
+    return EncoderConfig(
+        name=hf.get("_name_or_path") or "bert",
+        vocab_size=hf["vocab_size"],
+        d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        d_ff=hf["intermediate_size"],
+        max_positions=hf.get("max_position_embeddings", 512),
+        norm_eps=float(hf.get("layer_norm_eps", 1e-12)),
+    )
+
+
+def load_hf_encoder_params(path: str | pathlib.Path, cfg: "EncoderConfig",
+                           dtype: str = "float32") -> dict[str, Any]:
+    """BERT-family safetensors → encoder pytree. Single-segment serving:
+    the type-0 segment embedding is a constant addend at every position,
+    so it folds into ``pos_emb`` and token_type_ids disappear."""
+    np_dtype = _DTYPES.get(dtype)
+    if np_dtype is None:
+        raise CheckpointError(f"unsupported dtype {dtype!r}")
+    reader = _LazyReader(pathlib.Path(path))
+    # BertModel saves bare names; BertFor* task models prefix "bert.".
+    prefix = "" if "embeddings.word_embeddings.weight" in reader else "bert."
+    if f"{prefix}embeddings.word_embeddings.weight" not in reader:
+        raise CheckpointError("no BERT embedding tensors in checkpoint")
+
+    def g(name: str) -> np.ndarray:
+        return reader.get(prefix + name)
+
+    n = cfg.n_layers
+    T = np.ascontiguousarray
+
+    def t(w: np.ndarray) -> np.ndarray:       # torch [out,in] → [in,out]
+        return T(w.T)
+
+    def lname(stem: str, leaf: str = "weight") -> Callable[[int], str]:
+        return lambda i: f"{prefix}encoder.layer.{i}.{stem}.{leaf}"
+
+    def stack(stem: str, leaf: str = "weight",
+              transform: Callable[[np.ndarray], np.ndarray] = lambda x: x
+              ) -> np.ndarray:
+        return _stacked(reader, n, np_dtype, lname(stem, leaf), transform)
+
+    pos = g("embeddings.position_embeddings.weight").astype(np.float32)
+    pos = pos + g("embeddings.token_type_embeddings.weight")[0].astype(
+        np.float32)
+    return {
+        "tok_emb": g("embeddings.word_embeddings.weight").astype(np_dtype),
+        "pos_emb": pos.astype(np_dtype),
+        "emb_norm_w": g("embeddings.LayerNorm.weight").astype(np_dtype),
+        "emb_norm_b": g("embeddings.LayerNorm.bias").astype(np_dtype),
+        "layers": {
+            "wq": stack("attention.self.query", transform=t),
+            "wk": stack("attention.self.key", transform=t),
+            "wv": stack("attention.self.value", transform=t),
+            "wo": stack("attention.output.dense", transform=t),
+            "wq_b": stack("attention.self.query", "bias"),
+            "wk_b": stack("attention.self.key", "bias"),
+            "wv_b": stack("attention.self.value", "bias"),
+            "wo_b": stack("attention.output.dense", "bias"),
+            "attn_norm_w": stack("attention.output.LayerNorm"),
+            "attn_norm_b": stack("attention.output.LayerNorm", "bias"),
+            "w_in": stack("intermediate.dense", transform=t),
+            "b_in": stack("intermediate.dense", "bias"),
+            "w_out": stack("output.dense", transform=t),
+            "b_out": stack("output.dense", "bias"),
+            "ffn_norm_w": stack("output.LayerNorm"),
+            "ffn_norm_b": stack("output.LayerNorm", "bias"),
+        },
+    }
+
+
+def load_hf_encoder_checkpoint(path: str | pathlib.Path,
+                               dtype: str = "float32"
+                               ) -> tuple["EncoderConfig", dict[str, Any]]:
+    cfg = encoder_config_from_hf(read_hf_config(path))
+    return cfg, load_hf_encoder_params(path, cfg, dtype)

@@ -66,19 +66,27 @@ class TPUEmbeddingProvider(EmbeddingProvider):
 
     def __init__(self, model: str = "minilm-l6", *, params=None, mesh=None,
                  tokenizer=None, batch_size: int = 64, dtype=None,
-                 attn_impl: str = "auto"):
+                 checkpoint: str | None = None, attn_impl: str = "auto"):
         # Heavy imports deferred so host-only processes never load jax.
         import jax.numpy as jnp
 
         from copilot_for_consensus_tpu.engine.embedding import EmbeddingEngine
         from copilot_for_consensus_tpu.models import encoder_config
 
-        cfg = encoder_config(model)
-        self._engine = EmbeddingEngine(
-            cfg, params, mesh=mesh, tokenizer=tokenizer,
-            batch_size=batch_size, dtype=dtype or jnp.bfloat16,
-            attn_impl=attn_impl)
-        self._model = model
+        if checkpoint is not None:
+            # Real weights (BERT/MiniLM-family HF dir) — the serving
+            # default for production retrieval quality.
+            self._engine = EmbeddingEngine.from_checkpoint(
+                checkpoint, mesh=mesh, tokenizer=tokenizer,
+                batch_size=batch_size, attn_impl=attn_impl)
+            self._model = f"checkpoint:{checkpoint}"
+        else:
+            cfg = encoder_config(model)
+            self._engine = EmbeddingEngine(
+                cfg, params, mesh=mesh, tokenizer=tokenizer,
+                batch_size=batch_size, dtype=dtype or jnp.bfloat16,
+                attn_impl=attn_impl)
+            self._model = model
 
     @property
     def dimension(self) -> int:

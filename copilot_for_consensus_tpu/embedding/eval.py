@@ -1,0 +1,166 @@
+"""Retrieval-quality evaluation: recall@k over a labeled fixture.
+
+The reference's semantic-search quality rests on pretrained
+sentence-transformers weights (``sentence_transformer_provider.py:19-51``)
+and is never measured in-repo. Here retrieval quality is a first-class,
+testable number: embed a labeled corpus, query through the on-device
+vector store, and report recall@k — so the random-weight hashed-BoW
+fallback can never silently masquerade as semantic retrieval again.
+
+The synthetic fixture is built for exactly that distinction: every topic
+has two *disjoint* vocabularies — documents draw from one, queries from
+the other — so lexical/hash overlap carries zero signal and only an
+encoder that has learned the topic structure (contrastively tuned or
+pretrained) can score.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+@dataclass
+class RetrievalFixture:
+    """Labeled corpus: docs, queries, and relevance sets (qrels)."""
+
+    docs: list[dict] = field(default_factory=list)       # {id, text, topic}
+    queries: list[dict] = field(default_factory=list)    # {id, text, relevant}
+
+    def training_pairs(self, n: int, seed: int = 0,
+                       batch: int | None = None) -> list[tuple[str, str]]:
+        """(query-style, doc-style) same-topic pairs, freshly sampled —
+        never the eval queries themselves. With ``batch`` set, topics
+        within each batch-sized block are drawn without replacement, so
+        in-batch InfoNCE negatives are never same-topic false negatives."""
+        rng = random.Random(seed + 7)
+        topics = sorted({d["topic"] for d in self.docs})
+        out: list[tuple[str, str]] = []
+        if batch is None:
+            return [_pair_for_topic(rng.choice(topics), rng)
+                    for _ in range(n)]
+        while len(out) < n:
+            # Without replacement per block; when batch > n_topics,
+            # cycle fresh permutations (collisions then unavoidable but
+            # minimized).
+            block: list[int] = []
+            while len(block) < batch:
+                block.extend(rng.sample(topics, len(topics)))
+            out.extend(_pair_for_topic(t, rng) for t in block[:batch])
+        return out[:n]
+
+
+def _topic_vocab(topic: int, style: str, size: int = 16) -> list[str]:
+    return [f"{style}{topic}w{i}" for i in range(size)]
+
+
+def _sample_text(topic: int, style: str, rng: random.Random,
+                 n_words: int) -> str:
+    vocab = _topic_vocab(topic, style)
+    return " ".join(rng.choice(vocab) for _ in range(n_words))
+
+
+def _pair_for_topic(topic: int, rng: random.Random) -> tuple[str, str]:
+    return (_sample_text(topic, "q", rng, 6),
+            _sample_text(topic, "d", rng, 12))
+
+
+def synthetic_fixture(n_topics: int = 8, docs_per_topic: int = 8,
+                      queries_per_topic: int = 4,
+                      seed: int = 0) -> RetrievalFixture:
+    """Deterministic labeled fixture with doc/query vocabulary disjointness
+    (see module docstring)."""
+    rng = random.Random(seed)
+    fx = RetrievalFixture()
+    for t in range(n_topics):
+        doc_ids = []
+        for i in range(docs_per_topic):
+            doc_id = f"t{t}d{i}"
+            doc_ids.append(doc_id)
+            fx.docs.append({"id": doc_id, "topic": t,
+                            "text": _sample_text(t, "d", rng, 12)})
+        for i in range(queries_per_topic):
+            fx.queries.append({"id": f"t{t}q{i}", "topic": t,
+                               "text": _sample_text(t, "q", rng, 6),
+                               "relevant": list(doc_ids)})
+    return fx
+
+
+def recall_at_k(embed_fn: Callable[[Sequence[str]], np.ndarray],
+                fixture: RetrievalFixture,
+                ks: Sequence[int] = (1, 5, 10)) -> dict[str, float]:
+    """Embed docs+queries with ``embed_fn`` ([N texts] → [N, dim]), rank
+    by cosine, and report mean recall@k = |top-k ∩ relevant| / min(k, R).
+    Retrieval runs through the on-device vector store — the same ANN
+    path production queries take."""
+    from copilot_for_consensus_tpu.vectorstore.tpu import TPUVectorStore
+
+    doc_vecs = np.asarray(embed_fn([d["text"] for d in fixture.docs]),
+                          dtype=np.float32)
+    q_vecs = np.asarray(embed_fn([q["text"] for q in fixture.queries]),
+                        dtype=np.float32)
+    store = TPUVectorStore({"dimension": int(doc_vecs.shape[1]),
+                            "dtype": "float32"})
+    store.add_embeddings([(d["id"], v.tolist(), None)
+                          for d, v in zip(fixture.docs, doc_vecs)])
+    out: dict[str, float] = {}
+    max_k = max(ks)
+    hits_per_q = []
+    for q, vec in zip(fixture.queries, q_vecs):
+        got = store.query(vec.tolist(), top_k=max_k)
+        hits_per_q.append(([g.id for g in got], set(q["relevant"])))
+    for k in ks:
+        vals = [len(set(ids[:k]) & rel) / min(k, len(rel))
+                for ids, rel in hits_per_q]
+        out[f"recall@{k}"] = float(np.mean(vals))
+    return out
+
+
+def train_encoder_on_fixture(fixture: RetrievalFixture, *, cfg=None,
+                             steps: int = 60, batch: int = 16,
+                             lr: float = 3e-3, seed: int = 0,
+                             max_len: int = 16):
+    """Contrastively tune a small encoder on fixture-style pairs; returns
+    (cfg, params, tokenizer) ready for an EmbeddingEngine. The proof-of-
+    loop behind ``scripts/eval_retrieval.py --backend trained``."""
+    import jax
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu import train
+    from copilot_for_consensus_tpu.engine.tokenizer import HashWordTokenizer
+    from copilot_for_consensus_tpu.models import encoder
+    from copilot_for_consensus_tpu.models.configs import EncoderConfig
+
+    cfg = cfg or EncoderConfig(name="tiny-retrieval", vocab_size=2048,
+                               d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                               max_positions=max_len)
+    tok = HashWordTokenizer(cfg.vocab_size)
+    params = encoder.init_params(jax.random.PRNGKey(seed), cfg,
+                                 dtype=jnp.float32)
+    optimizer = train.default_optimizer(lr)
+    step = jax.jit(train.make_contrastive_step(cfg, optimizer))
+    opt_state = optimizer.init(params)
+
+    rng = random.Random(seed + 1)
+    pairs = fixture.training_pairs(steps * batch, seed=seed, batch=batch)
+
+    def batch_tokens(texts: list[str]):
+        toks = np.zeros((len(texts), max_len), dtype=np.int32)
+        lens = np.ones(len(texts), dtype=np.int32)
+        for i, t in enumerate(texts):
+            ids = tok.encode(t)[:max_len]
+            toks[i, :len(ids)] = ids
+            lens[i] = max(1, len(ids))
+        return jnp.asarray(toks), jnp.asarray(lens)
+
+    loss = None
+    for s in range(steps):
+        chunk = pairs[s * batch:(s + 1) * batch]
+        rng.shuffle(chunk)
+        qt, ql = batch_tokens([q for q, _ in chunk])
+        pt, pl = batch_tokens([p for _, p in chunk])
+        params, opt_state, loss = step(params, opt_state, qt, ql, pt, pl)
+    return cfg, params, tok, (float(loss) if loss is not None else None)

@@ -29,6 +29,7 @@ def create_embedding_provider(config: Any = None) -> EmbeddingProvider:
     if driver == "tpu":
         return TPUEmbeddingProvider(
             model=_cfg_get(config, "model", "minilm-l6"),
+            checkpoint=_cfg_get(config, "checkpoint"),
             batch_size=int(_cfg_get(config, "batch_size", 64)))
     raise ValueError(f"unknown embedding driver {driver!r}")
 

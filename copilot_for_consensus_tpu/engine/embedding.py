@@ -62,6 +62,46 @@ class EmbeddingEngine:
             lambda p, t, l: encoder.encode(p, t, l, cfg,
                                            attn_impl=attn_impl))
 
+    @classmethod
+    def from_checkpoint(cls, path: str, *, mesh=None, tokenizer=None,
+                        batch_size: int = 64,
+                        buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
+                        dtype: str = "float32",
+                        attn_impl: str = "auto") -> "EmbeddingEngine":
+        """Serve real encoder weights: a BERT/MiniLM-family HF checkpoint
+        dir (config.json + model.safetensors [+ tokenizer.json]) → a
+        ready engine. The weights role of the reference's
+        ``sentence_transformer_provider.py:19-51`` without the
+        sentence-transformers/torch dependency."""
+        import pathlib
+
+        from copilot_for_consensus_tpu.checkpoint import (
+            load_hf_encoder_checkpoint,
+        )
+
+        cfg, params = load_hf_encoder_checkpoint(path, dtype)
+        if tokenizer is None:
+            tok_file = pathlib.Path(path) / "tokenizer.json"
+            if tok_file.exists():
+                from copilot_for_consensus_tpu.engine.tokenizer import (
+                    HFTokenizer,
+                )
+                tokenizer = HFTokenizer(str(tok_file), bos_id=0, eos_id=0)
+                pad = tokenizer._tok.token_to_id("[PAD]")
+                tokenizer.pad_id = 0 if pad is None else int(pad)
+            else:
+                # WordPiece ids are meaningless to any fallback tokenizer;
+                # refuse instead of silently serving garbage vectors.
+                raise ValueError(
+                    f"checkpoint {path} has no tokenizer.json; pass "
+                    "tokenizer= explicitly")
+        params = {k: (jnp.asarray(v) if not isinstance(v, dict) else
+                      {kk: jnp.asarray(vv) for kk, vv in v.items()})
+                  for k, v in params.items()}
+        return cls(cfg, params, mesh=mesh, tokenizer=tokenizer,
+                   batch_size=batch_size, buckets=buckets,
+                   dtype=params["tok_emb"].dtype, attn_impl=attn_impl)
+
     @property
     def dimension(self) -> int:
         return self.cfg.d_model

@@ -42,6 +42,12 @@ def init_params(rng: jax.Array, cfg: EncoderConfig,
             "wk": dense(next(keys), (n, d, d), d),
             "wv": dense(next(keys), (n, d, d), d),
             "wo": dense(next(keys), (n, d, d), d),
+            # BERT-family projections carry biases; zero at init, real
+            # values under checkpoint load (checkpoint/hf.py).
+            "wq_b": jnp.zeros((n, d), dtype),
+            "wk_b": jnp.zeros((n, d), dtype),
+            "wv_b": jnp.zeros((n, d), dtype),
+            "wo_b": jnp.zeros((n, d), dtype),
             "attn_norm_w": jnp.ones((n, d), dtype),
             "attn_norm_b": jnp.zeros((n, d), dtype),
             "w_in": dense(next(keys), (n, d, f), d),
@@ -65,6 +71,10 @@ def logical_axes(cfg: EncoderConfig) -> Params:
             "wk": (None, "embed", "heads"),
             "wv": (None, "embed", "heads"),
             "wo": (None, "heads", "embed"),
+            "wq_b": (None, "heads"),
+            "wk_b": (None, "heads"),
+            "wv_b": (None, "heads"),
+            "wo_b": (None, "norm"),
             "attn_norm_w": (None, "norm"),
             "attn_norm_b": (None, "norm"),
             "w_in": (None, "embed", "ffn"),
@@ -94,13 +104,17 @@ def encode(params: Params, tokens: jax.Array, lengths: jax.Array,
                      cfg.norm_eps)
 
     def body(x, layer):
-        q = (x @ layer["wq"]).reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
-        k = (x @ layer["wk"]).reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
-        v = (x @ layer["wv"]).reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+        q = (x @ layer["wq"] + layer["wq_b"]).reshape(
+            b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+        k = (x @ layer["wk"] + layer["wk_b"]).reshape(
+            b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+        v = (x @ layer["wv"] + layer["wv_b"]).reshape(
+            b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
         o = attention(q, k, v, causal=False, kv_lengths=lengths,
                       impl=attn_impl)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-        x = L.layer_norm(x + o @ layer["wo"], layer["attn_norm_w"],
+        x = L.layer_norm(x + o @ layer["wo"] + layer["wo_b"],
+                         layer["attn_norm_w"],
                          layer["attn_norm_b"], cfg.norm_eps)
         h = L.gelu_mlp(x, layer)
         x = L.layer_norm(x + h, layer["ffn_norm_w"], layer["ffn_norm_b"],

@@ -163,6 +163,9 @@ def swiglu(x: jax.Array, layer: dict) -> jax.Array:
 
 
 def gelu_mlp(x: jax.Array, layer: dict) -> jax.Array:
-    """BERT-style 2-layer GELU MLP (encoder FFN)."""
-    h = jax.nn.gelu((x @ layer["w_in"] + layer["b_in"]).astype(jnp.float32))
+    """BERT-style 2-layer GELU MLP (encoder FFN). Exact (erf) GELU —
+    the BERT family's ``hidden_act="gelu"``; tanh-approximate would
+    break checkpoint parity."""
+    h = jax.nn.gelu((x @ layer["w_in"] + layer["b_in"]).astype(jnp.float32),
+                    approximate=False)
     return h.astype(x.dtype) @ layer["w_out"] + layer["b_out"]

@@ -143,12 +143,13 @@ def pipeline_forward(params: Any, tokens: jax.Array, cfg: DecoderConfig,
 
 def make_pipeline_train_step(cfg: DecoderConfig, optimizer, mesh: Mesh,
                              *, n_microbatches: int,
-                             attn_impl: str = "auto"):
+                             attn_impl: str = "xla"):
     """Training step with the layer stack pipelined — the pp counterpart
     of ``train.make_train_step`` (which supplies the loss and optimizer
     wiring; only the forward pass is swapped). Gradients flow through
     ppermute; jit it with params sharded by
-    ``shard_params_for_pipeline``."""
+    ``shard_params_for_pipeline``. Defaults to XLA attention: the Pallas
+    flash kernel is forward-only (no JVP), see train.py."""
     from copilot_for_consensus_tpu import train
 
     def fwd(params, tokens, cfg, lengths=None, attn_impl=attn_impl):

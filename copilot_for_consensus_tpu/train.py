@@ -1,10 +1,16 @@
-"""Training step for the decoder family (fine-tuning path).
+"""Training steps: decoder fine-tuning and encoder contrastive tuning.
 
 The reference trains nothing (inference is delegated; SURVEY.md §0), but a
 TPU-native framework that owns its models needs the fine-tuning loop:
-next-token cross-entropy, optax optimizer, and a jit-able ``train_step``
-whose params/opt-state shard over the mesh exactly like serving params do
-— the same logical-axis tables drive both.
+next-token cross-entropy for the decoder, in-batch-negative InfoNCE for
+the retrieval encoder (the training recipe behind the reference's
+sentence-transformers models), optax optimizers, and jit-able
+``train_step`` functions whose params/opt-state shard over the mesh
+exactly like serving params do — the same logical-axis tables drive both.
+
+Training defaults to the XLA attention path: the Pallas flash kernel is
+forward-only (no JVP rule), so ``attn_impl="auto"``'s TPU choice would
+fail under ``value_and_grad``.
 """
 
 from __future__ import annotations
@@ -15,12 +21,12 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from copilot_for_consensus_tpu.models import decoder
-from copilot_for_consensus_tpu.models.configs import DecoderConfig
+from copilot_for_consensus_tpu.models import decoder, encoder
+from copilot_for_consensus_tpu.models.configs import DecoderConfig, EncoderConfig
 
 
 def next_token_loss(params: Any, tokens: jax.Array, lengths: jax.Array,
-                    cfg: DecoderConfig, attn_impl: str = "auto",
+                    cfg: DecoderConfig, attn_impl: str = "xla",
                     forward_fn: Callable | None = None) -> jax.Array:
     """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1],
     masked to valid (non-pad) positions. ``forward_fn`` (same signature as
@@ -39,7 +45,7 @@ def next_token_loss(params: Any, tokens: jax.Array, lengths: jax.Array,
 
 
 def make_train_step(cfg: DecoderConfig, optimizer: optax.GradientTransformation,
-                    attn_impl: str = "auto",
+                    attn_impl: str = "xla",
                     forward_fn: Callable | None = None) -> Callable:
     """Returns ``step(params, opt_state, tokens, lengths) ->
     (params, opt_state, loss)``; jit/pjit it with sharded params."""
@@ -59,3 +65,44 @@ def default_optimizer(lr: float = 1e-4) -> optax.GradientTransformation:
         optax.clip_by_global_norm(1.0),
         optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01),
     )
+
+
+# ---------------------------------------------------------------------------
+# Encoder: contrastive retrieval tuning (in-batch negatives)
+# ---------------------------------------------------------------------------
+
+
+def contrastive_loss(params: Any, q_tokens: jax.Array, q_lengths: jax.Array,
+                     p_tokens: jax.Array, p_lengths: jax.Array,
+                     cfg: EncoderConfig, temperature: float = 0.05,
+                     attn_impl: str = "xla") -> jax.Array:
+    """Symmetric InfoNCE over (query, positive) pairs with every other
+    in-batch positive as a negative — the MultipleNegativesRanking
+    recipe the reference's all-MiniLM embedder was trained with.
+    Embeddings are already L2-normalized, so q @ p.T is cosine."""
+    q = encoder.encode(params, q_tokens, q_lengths, cfg, attn_impl=attn_impl)
+    p = encoder.encode(params, p_tokens, p_lengths, cfg, attn_impl=attn_impl)
+    logits = (q @ p.T) / temperature
+    labels = jnp.arange(q.shape[0])
+    loss_qp = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    loss_pq = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels)
+    return 0.5 * (jnp.mean(loss_qp) + jnp.mean(loss_pq))
+
+
+def make_contrastive_step(cfg: EncoderConfig,
+                          optimizer: optax.GradientTransformation,
+                          temperature: float = 0.05,
+                          attn_impl: str = "xla") -> Callable:
+    """Returns ``step(params, opt_state, q_tokens, q_lengths, p_tokens,
+    p_lengths) -> (params, opt_state, loss)``; jit/pjit it with sharded
+    params (dp-shard the batch: negatives stay in-shard)."""
+
+    def step(params, opt_state, q_tokens, q_lengths, p_tokens, p_lengths):
+        loss, grads = jax.value_and_grad(contrastive_loss)(
+            params, q_tokens, q_lengths, p_tokens, p_lengths, cfg,
+            temperature, attn_impl)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

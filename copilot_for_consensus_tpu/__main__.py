@@ -1,0 +1,118 @@
+"""Operator CLI: the deployment entry points, one per process role.
+
+The reference deploys via docker-compose with one container per service
+(``docker-compose.services.yml``); here the roles are subcommands of one
+package CLI (used by ``deploy/docker-compose.yml``):
+
+    python -m copilot_for_consensus_tpu serve        # pipeline + gateway
+    python -m copilot_for_consensus_tpu broker       # durable bus broker
+    python -m copilot_for_consensus_tpu retry-job    # stuck-doc requeue
+    python -m copilot_for_consensus_tpu failed-queues list ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import signal
+import sys
+import threading
+
+
+def _load_config(path: str | None) -> dict:
+    if not path:
+        return {}
+    text = pathlib.Path(path).read_text()
+    if path.endswith((".yml", ".yaml")):
+        import yaml
+
+        return yaml.safe_load(text) or {}
+    return json.loads(text)
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    from copilot_for_consensus_tpu.services.bootstrap import serve_pipeline
+
+    server = serve_pipeline(_load_config(args.config),
+                            host=args.host, port=args.port)
+    server.start()
+    print(json.dumps({"event": "serving", "host": args.host,
+                      "port": server.port}), flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+def _cmd_retry_job(args: argparse.Namespace) -> int:
+    from copilot_for_consensus_tpu.bus.factory import create_publisher
+    from copilot_for_consensus_tpu.storage.factory import (
+        create_document_store,
+    )
+    from copilot_for_consensus_tpu.tools.retry_job import (
+        RetryStuckDocumentsJob,
+    )
+
+    cfg = _load_config(args.config)
+    store = create_document_store(cfg.get("document_store",
+                                          {"driver": "sqlite"}))
+    store.connect()
+    pub = create_publisher(cfg.get("bus", {"driver": "broker"}))
+    pub.connect()
+    job = RetryStuckDocumentsJob(store, pub)
+    if args.once:
+        print(json.dumps({"event": "retry_sweep", **job.run_once()}),
+              flush=True)
+        return 0
+    job.run_loop(interval_seconds=args.interval)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    ap = argparse.ArgumentParser(prog="copilot_for_consensus_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    serve = sub.add_parser("serve", help="pipeline + unified gateway")
+    serve.add_argument("--config", default=None,
+                       help="JSON/YAML pipeline config")
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--port", type=int, default=8080)
+
+    sub.add_parser("broker", help="durable bus broker",
+                   add_help=False)
+
+    retry = sub.add_parser("retry-job", help="stuck-document requeue")
+    retry.add_argument("--config", default=None)
+    retry.add_argument("--interval", type=float, default=300.0)
+    retry.add_argument("--once", action="store_true")
+
+    sub.add_parser("failed-queues", help="failed-queue operator CLI",
+                   add_help=False)
+
+    # Delegating subcommands keep their own argparsers: split argv at the
+    # subcommand and hand the rest through untouched.
+    if argv and argv[0] == "broker":
+        from copilot_for_consensus_tpu.bus.broker import main as broker_main
+
+        return broker_main(argv[1:])
+    if argv and argv[0] == "failed-queues":
+        from copilot_for_consensus_tpu.tools.failed_queues import (
+            main as fq_main,
+        )
+
+        return fq_main(argv[1:])
+
+    args = ap.parse_args(argv)
+    if args.cmd == "serve":
+        return _cmd_serve(args)
+    if args.cmd == "retry-job":
+        return _cmd_retry_job(args)
+    raise AssertionError(args.cmd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

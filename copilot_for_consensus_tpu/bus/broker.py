@@ -48,11 +48,23 @@ except ImportError:  # pragma: no cover - environment without pyzmq
 
 DEFAULT_PORT = 5700
 DEFAULT_LEASE_S = 30.0
+# Subscribers that don't set a group share one queue per routing key
+# (competing consumers) — the reference's one-durable-queue-per-key
+# topology. Distinct groups each get every message (service fan-out).
+DEFAULT_GROUP = "default"
 
 
 class _QueueStore:
     """sqlite-backed message queues. One table, state machine per row:
-    pending → inflight → (acked | pending | dead)."""
+    pending → inflight → (acked | pending | dead).
+
+    Consumer groups (the AMQP binding model, reference
+    ``infra/rabbitmq/definitions.json``): a binding is (routing_key,
+    group); publish inserts one row per bound group so distinct groups
+    each see every message (service fan-out) while consumers sharing a
+    group compete (replicas). Messages published before any binding
+    exists are parked (``grp=''``) and handed to the first group that
+    binds the key."""
 
     def __init__(self, path: str = ":memory:"):
         self._db = sqlite3.connect(path, check_same_thread=False)
@@ -64,38 +76,69 @@ class _QueueStore:
                 CREATE TABLE IF NOT EXISTS messages (
                     id INTEGER PRIMARY KEY AUTOINCREMENT,
                     rk TEXT NOT NULL,
+                    grp TEXT NOT NULL DEFAULT '',
                     envelope TEXT NOT NULL,
                     state TEXT NOT NULL DEFAULT 'pending',
                     attempts INTEGER NOT NULL DEFAULT 0,
                     lease_expires REAL,
                     enqueued_at REAL NOT NULL
                 )""")
+            try:  # pre-group db files: add the column in place
+                self._db.execute(
+                    "ALTER TABLE messages ADD COLUMN grp TEXT "
+                    "NOT NULL DEFAULT ''")
+            except sqlite3.OperationalError:
+                pass
+            self._db.execute("""
+                CREATE TABLE IF NOT EXISTS bindings (
+                    rk TEXT NOT NULL,
+                    grp TEXT NOT NULL,
+                    UNIQUE (rk, grp)
+                )""")
             self._db.execute(
-                "CREATE INDEX IF NOT EXISTS idx_rk_state "
-                "ON messages (rk, state, id)")
+                "CREATE INDEX IF NOT EXISTS idx_rk_grp_state "
+                "ON messages (rk, grp, state, id)")
             # Broker (re)start: whatever was in flight requeues.
             self._db.execute(
                 "UPDATE messages SET state='pending', lease_expires=NULL "
                 "WHERE state='inflight'")
 
-    def enqueue(self, rk: str, envelope: str) -> int:
+    def bind(self, rks: list[str], grp: str) -> None:
         with self._lock, self._db:
-            cur = self._db.execute(
-                "INSERT INTO messages (rk, envelope, enqueued_at) "
-                "VALUES (?, ?, ?)", (rk, envelope, time.time()))
-            return cur.lastrowid
+            for rk in rks:
+                self._db.execute(
+                    "INSERT OR IGNORE INTO bindings (rk, grp) VALUES (?, ?)",
+                    (rk, grp))
+                # Parked pre-bind messages go to the first binder.
+                self._db.execute(
+                    "UPDATE messages SET grp=? "
+                    "WHERE rk=? AND grp='' AND state='pending'", (grp, rk))
 
-    def fetch(self, rks: list[str], limit: int, lease_s: float
+    def enqueue(self, rk: str, envelope: str) -> int:
+        now = time.time()
+        with self._lock, self._db:
+            groups = [g for (g,) in self._db.execute(
+                "SELECT grp FROM bindings WHERE rk=?", (rk,))]
+            last = 0
+            for grp in (groups or [""]):
+                cur = self._db.execute(
+                    "INSERT INTO messages (rk, grp, envelope, enqueued_at) "
+                    "VALUES (?, ?, ?, ?)", (rk, grp, envelope, now))
+                last = cur.lastrowid
+            return last
+
+    def fetch(self, rks: list[str], grp: str, limit: int, lease_s: float
               ) -> list[tuple[int, str, str, int]]:
         """Atomically move up to ``limit`` pending messages (across the
-        given keys) to inflight. Returns (id, rk, envelope, attempts)."""
+        given keys, within one group) to inflight. Returns
+        (id, rk, envelope, attempts)."""
         now = time.time()
         qmarks = ",".join("?" for _ in rks)
         with self._lock, self._db:
             rows = self._db.execute(
                 f"SELECT id, rk, envelope, attempts FROM messages "
-                f"WHERE state='pending' AND rk IN ({qmarks}) "
-                f"ORDER BY id LIMIT ?", (*rks, limit)).fetchall()
+                f"WHERE state='pending' AND grp=? AND rk IN ({qmarks}) "
+                f"ORDER BY id LIMIT ?", (grp, *rks, limit)).fetchall()
             if rows:
                 ids = [r[0] for r in rows]
                 self._db.execute(
@@ -126,12 +169,20 @@ class _QueueStore:
                 f"WHERE id IN ({qmarks}) AND state='inflight'",
                 (max_redeliveries, *ids))
 
-    def expire_leases(self) -> int:
+    def expire_leases(self, parked_ttl_s: float = 300.0) -> int:
         with self._lock, self._db:
             cur = self._db.execute(
                 "UPDATE messages SET state='pending', lease_expires=NULL "
                 "WHERE state='inflight' AND lease_expires < ?",
                 (time.time(),))
+            # Parked rows (published with no binding) exist only to cover
+            # the startup race where a subscriber binds moments later; a
+            # key nothing ever binds (e.g. a terminal event with no
+            # consumer) must not grow the db forever — AMQP drops
+            # unroutable messages outright, we just do it on a delay.
+            self._db.execute(
+                "DELETE FROM messages WHERE grp='' AND state='pending' "
+                "AND enqueued_at < ?", (time.time() - parked_ttl_s,))
             return cur.rowcount
 
     def counts(self) -> dict[str, dict[str, int]]:
@@ -204,10 +255,15 @@ class Broker:
         if op == "pub":
             mid = self.store.enqueue(req["rk"], json.dumps(req["envelope"]))
             return {"ok": True, "id": mid}            # publisher confirm
+        if op == "bind":
+            self.store.bind(list(req.get("rks", [])),
+                            req.get("group", DEFAULT_GROUP))
+            return {"ok": True}
         if op == "fetch":
             self.store.expire_leases()
-            rows = self.store.fetch(req["rks"], int(req.get("max", 16)),
-                                    self.lease_s)
+            rows = self.store.fetch(req["rks"],
+                                    req.get("group", DEFAULT_GROUP),
+                                    int(req.get("max", 16)), self.lease_s)
             return {"ok": True, "msgs": [
                 {"id": i, "rk": rk, "envelope": json.loads(env),
                  "attempts": at} for i, rk, env, at in rows]}
@@ -369,23 +425,45 @@ class BrokerPublisher(EventPublisher):
 
 
 class BrokerSubscriber(EventSubscriber):
-    """Pull-based consumer: fetch → dispatch → ack/nack per message."""
+    """Pull-based consumer: fetch → dispatch → ack/nack per message.
+    ``group`` names this consumer's queue group: subscribers sharing a
+    group compete (replicas), distinct groups each see every message
+    (distinct services) — same contract as ``InProcSubscriber``."""
 
-    def __init__(self, config: Any = None):
+    def __init__(self, config: Any = None, group: str | None = None):
         cfg = dict(config or {})
         address = cfg.get("address") or (
             f"tcp://{cfg.get('host', '127.0.0.1')}:"
             f"{cfg.get('port', DEFAULT_PORT)}")
+        self._address = address
         self._client = _Client(address,
                                timeout_ms=int(cfg.get("timeout_ms", 5000)))
         self.poll_interval_s = float(cfg.get("poll_interval_s", 0.05))
         self.batch = int(cfg.get("batch", 16))
+        self.group = group or cfg.get("group") or DEFAULT_GROUP
         self._routes: dict[str, EventCallback] = {}
+        self._counts_client: _Client | None = None
         self._stop = threading.Event()
 
     def subscribe(self, routing_keys, callback):
         for rk in routing_keys:
             self._routes[rk] = callback
+        self._client.request({"op": "bind", "rks": list(routing_keys),
+                              "group": self.group})
+
+    def counts(self, timeout_ms: int | None = None
+               ) -> dict[str, dict[str, int]]:
+        """Broker-side per-key state counts (pending/inflight/dead) — the
+        ops introspection surface for gauges and the failed-queues CLI.
+        ``timeout_ms`` uses a dedicated single-try client so metric
+        scrapes fail fast during a broker outage instead of tying up the
+        HTTP worker for the full retry budget."""
+        if timeout_ms is None:
+            return self._client.request({"op": "counts"})["counts"]
+        if self._counts_client is None:
+            self._counts_client = _Client(self._address,
+                                          timeout_ms=timeout_ms, retries=1)
+        return self._counts_client.request({"op": "counts"})["counts"]
 
     def _dispatch(self, msg: dict) -> None:
         cb = self._routes.get(msg["rk"])
@@ -410,7 +488,8 @@ class BrokerSubscriber(EventSubscriber):
             want = self.batch if max_messages is None else min(
                 self.batch, max_messages - n)
             reply = self._client.request(
-                {"op": "fetch", "rks": sorted(self._routes), "max": want})
+                {"op": "fetch", "rks": sorted(self._routes),
+                 "group": self.group, "max": want})
             msgs = reply.get("msgs", [])
             if not msgs:
                 break
@@ -442,6 +521,8 @@ class BrokerSubscriber(EventSubscriber):
     def close(self):
         self.stop()
         self._client.close()
+        if self._counts_client is not None:
+            self._counts_client.close()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,5 +1,5 @@
-"""Driver registration for security adapters (secret providers now; JWT
-signers and OIDC providers register here as they land)."""
+"""Driver registration for security adapters: secret providers, JWT
+signers, OIDC providers."""
 
 from __future__ import annotations
 
@@ -27,3 +27,13 @@ def create_secret_provider(config: Any) -> Any:
 
 for _name in ("env", "local", "static"):
     register_driver("secret_provider", _name, create_secret_provider)
+
+for _name in ("local_rs256", "hs256"):
+    register_driver(
+        "jwt_signer", _name,
+        "copilot_for_consensus_tpu.security.jwt:create_jwt_signer")
+
+for _name in ("github", "google", "microsoft", "datatracker", "mock"):
+    register_driver(
+        "oidc_provider", _name,
+        "copilot_for_consensus_tpu.security.auth:create_oidc_provider")

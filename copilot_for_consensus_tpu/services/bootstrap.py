@@ -84,12 +84,19 @@ class _BusGaugeMetrics:
     before Prometheus exposition — the series the alert pack
     (infra/prometheus/alerts/queues.yml) fires on."""
 
-    def __init__(self, inner, broker):
+    def __init__(self, inner, pipeline):
         self._inner = inner
-        self._broker = broker
+        self._pipeline = pipeline
 
     def render_prometheus(self) -> str:
-        for rk, depth in self._broker.routing_key_depths().items():
+        try:
+            depths = self._pipeline.routing_key_depths()
+        except Exception:
+            # External broker unreachable: serve stale gauges rather than
+            # failing the whole /metrics scrape (its absence is what the
+            # alert pack's up/health alerts exist for).
+            depths = {}
+        for rk, depth in depths.items():
             name = ("bus_dead_letters" if rk.endswith(".dlq")
                     else "bus_queue_depth")
             self._inner.gauge(name, depth, labels={"queue": rk})
@@ -116,7 +123,7 @@ class PipelineServer:
     def start(self) -> "PipelineServer":
         self.pipeline.startup()
         self._pump = threading.Thread(
-            target=self.pipeline.broker.run_forever, args=(self._stop,),
+            target=self.pipeline.run_forever, args=(self._stop,),
             name="bus-pump", daemon=True)
         self._pump.start()
         self.http.start()
@@ -160,7 +167,7 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
     router.merge(health_router(
         "pipeline",
         stats=pipeline.reporting.stats,
-        metrics=_BusGaugeMetrics(pipeline.metrics, pipeline.broker)))
+        metrics=_BusGaugeMetrics(pipeline.metrics, pipeline)))
     router.merge(ingestion_router(pipeline.ingestion))
     # ingestion owns GET /api/sources on the unified surface; reporting's
     # copy exists for standalone reporting-only deployments.

@@ -58,6 +58,12 @@ class Pipeline:
     reporting: ReportingService
     metrics: InMemoryMetrics
     subscribers: list = field(default_factory=list)
+    # Populated when cfg["bus"] names an inter-process driver: one durable
+    # subscriber per service (group = service name), consuming the
+    # external broker directly so ack happens only after the handler
+    # returns — crash before ack ⇒ lease expiry ⇒ redelivery.
+    ext_subscribers: list = field(default_factory=list)
+    _seen_gauge_keys: set = field(default_factory=set)
 
     @property
     def services(self):
@@ -69,8 +75,61 @@ class Pipeline:
             svc.startup()
 
     def drain(self, max_messages: int | None = None) -> int:
-        """Dispatch queued events until quiescent (in-proc mode)."""
-        return self.broker.drain(max_messages)
+        """Dispatch up to ``max_messages`` queued events (unbounded when
+        None) until quiescent. With an external bus, round-robin the
+        per-service durable subscribers against one shared budget."""
+        if not self.ext_subscribers:
+            return self.broker.drain(max_messages)
+        n = 0
+        while max_messages is None or n < max_messages:
+            budget = None if max_messages is None else max_messages - n
+            handled = 0
+            for sub in self.ext_subscribers:
+                handled += sub.drain(budget if budget is None
+                                     else budget - handled)
+                if budget is not None and handled >= budget:
+                    break
+            n += handled
+            if not handled:
+                break
+        return n
+
+    def routing_key_depths(self) -> dict[str, int]:
+        """Per-key backlog for the bus gauges — from the external broker
+        when one is configured (that's where the real queues live),
+        in-proc otherwise. Dead letters surface as ``<rk>.dlq``. Keys
+        previously reported but now fully drained (acked rows delete, so
+        counts() omits them) are re-emitted as 0 so gauges don't stick
+        at their last backlog value."""
+        if not self.ext_subscribers:
+            return self.broker.routing_key_depths()
+        out: dict[str, int] = dict.fromkeys(self._seen_gauge_keys, 0)
+        for rk, states in self.ext_subscribers[0].counts(
+                timeout_ms=1500).items():
+            out[rk] = states.get("pending", 0) + states.get("inflight", 0)
+            if states.get("dead"):
+                out[f"{rk}.dlq"] = states["dead"]
+        self._seen_gauge_keys.update(out)
+        return out
+
+    def run_forever(self, stop) -> None:
+        """Blocking pump for server mode: in-proc dispatch, or (external
+        bus) one consume loop per service — each already survives broker
+        outages with backoff-and-reconnect."""
+        import threading
+
+        if not self.ext_subscribers:
+            return self.broker.run_forever(stop)
+        threads = [threading.Thread(target=sub.start_consuming,
+                                    name=f"bus-consume-{i}", daemon=True)
+                   for i, sub in enumerate(self.ext_subscribers)]
+        for t in threads:
+            t.start()
+        try:
+            stop.wait()
+        finally:
+            for sub in self.ext_subscribers:
+                sub.stop()
 
     def ingest_and_run(self, source_id: str) -> dict[str, int]:
         """Trigger a source, run the pipeline to quiescence, return
@@ -106,7 +165,21 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
     retry = RetryPolicy(RetryConfig(max_attempts=3, base_delay=0.01,
                                     max_delay=0.05))
 
+    # With an inter-process bus configured, the external durable broker IS
+    # the bus: services publish to it and consume from it directly (one
+    # group per service), so competing pipeline replicas share work and a
+    # crash before ack redelivers (reference semantics:
+    # rabbitmq_publisher.py:146-149 / rabbitmq_subscriber.py:504-560).
+    bus_cfg = dict(cfg.get("bus") or {})
+    ext_bus = bus_cfg.get("driver", "inproc") in ("broker", "zmq")
+
     def publisher() -> ValidatingPublisher:
+        if ext_bus:
+            from copilot_for_consensus_tpu.bus.factory import (
+                create_publisher,
+            )
+
+            return create_publisher(bus_cfg)
         return ValidatingPublisher(InProcPublisher(broker=broker))
 
     common = dict(logger=logger, metrics=metrics, retry=retry)
@@ -149,7 +222,19 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
     for svc in pipeline.services:
         # One queue group per service: fan-out across services (every
         # stage sees SourceDeletionRequested), competition within one.
-        sub = InProcSubscriber(broker=broker, group=svc.name)
-        sub.subscribe(svc.routing_keys(), svc.handle_envelope)
-        pipeline.subscribers.append(sub)
+        # Same topology on either tier; validation wraps the edge so
+        # malformed foreign envelopes quarantine instead of crashing
+        # handlers into the DLQ.
+        if ext_bus:
+            from copilot_for_consensus_tpu.bus.factory import (
+                create_subscriber,
+            )
+
+            sub = create_subscriber({**bus_cfg, "group": svc.name})
+            sub.subscribe(svc.routing_keys(), svc.handle_envelope)
+            pipeline.ext_subscribers.append(sub)
+        else:
+            sub = InProcSubscriber(broker=broker, group=svc.name)
+            sub.subscribe(svc.routing_keys(), svc.handle_envelope)
+            pipeline.subscribers.append(sub)
     return pipeline

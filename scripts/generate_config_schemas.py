@@ -1,11 +1,17 @@
 #!/usr/bin/env python3
-"""Generate per-service config JSON schemas under schemas/configs/services/.
+"""Generate config JSON schemas: per-service under
+schemas/configs/services/, per-adapter-driver under
+schemas/configs/adapters/<kind>/<driver>.schema.json.
 
 Capability parity with the reference's schema-driven config layer
-(``docs/schemas/configs/services/*.json`` + ``generate_typed_configs.py``):
-each service gets a schema whose defaults make ``get_config(service)`` work
-with zero config files — every adapter defaults to its in-process/mock
-driver, mirroring the reference's fake-backend test strategy (SURVEY.md §4).
+(``docs/schemas/configs/services/*.json``,
+``docs/schemas/configs/adapters/drivers/*/*.json`` +
+``generate_typed_configs.py``): each service gets a schema whose defaults
+make ``get_config(service)`` work with zero config files — every adapter
+defaults to its in-process/mock driver, mirroring the reference's
+fake-backend test strategy (SURVEY.md §4) — and every registered driver
+of every adapter kind gets a driver schema documenting its config keys
+(coverage enforced by ``tests/test_schema_sync.py``).
 
 Run: python scripts/generate_config_schemas.py
 """
@@ -17,6 +23,7 @@ import pathlib
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 OUT = REPO / "copilot_for_consensus_tpu" / "schemas" / "configs" / "services"
+DRIVER_OUT = REPO / "copilot_for_consensus_tpu" / "schemas" / "configs" / "adapters"
 
 
 def adapter(default_driver: str, **extra_defaults) -> dict:
@@ -183,12 +190,126 @@ SERVICES: dict[str, dict] = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Per-adapter-driver schemas. Keys mirror what each driver's constructor/
+# factory actually reads (cited in each driver's source); the sync test
+# asserts every driver registered via core.factory has a schema here.
+# ---------------------------------------------------------------------------
+
+_BROKER_KEYS = dict(address="", host="127.0.0.1", port=5700,
+                    timeout_ms=5000, poll_interval_s=0.05, batch=16,
+                    group="")
+
+DRIVERS: dict[str, dict[str, dict]] = {
+    "message_bus": {
+        "inproc": dict(exchange="copilot.events", group=""),
+        "broker": dict(_BROKER_KEYS),
+        "zmq": dict(_BROKER_KEYS),          # config alias of broker
+        "noop": {},
+    },
+    "document_store": {
+        "memory": {},
+        "sqlite": dict(path="var/documents.sqlite3"),
+    },
+    "vector_store": {
+        "memory": dict(dimension=0, persist_path=""),
+        "tpu": dict(dimension=0, dtype="bfloat16", persist_path=""),
+        "native": dict(dimension=0, persist_path=""),
+    },
+    "embedding_backend": {
+        "mock": dict(dimension=32),
+        "tpu": dict(model="minilm-l6", checkpoint="", batch_size=64),
+    },
+    "llm_backend": {
+        "mock": dict(max_sentences=3),
+        "tpu": dict(model="mistral-7b", max_new_tokens=256, num_slots=4,
+                    max_len=4096, checkpoint="", long_context=False,
+                    profile_dir=""),
+    },
+    "chunker": {
+        "token_window": dict(chunk_size=384, overlap=50,
+                             min_chunk_tokens=100, max_chunk_tokens=512),
+        "fixed_size": dict(chunk_chars=1500, overlap_chars=200),
+        "semantic": dict(max_chunk_tokens=512, min_chunk_tokens=100),
+    },
+    "metrics": {
+        "noop": {},
+        "inmemory": dict(namespace="copilot"),
+        "prometheus": dict(namespace="copilot"),
+        "pushgateway": dict(gateway_url="http://localhost:9091",
+                            job="copilot", namespace="copilot"),
+    },
+    "logger": {
+        "stdout": dict(service="", level="info"),
+        "memory": dict(service="", level="info"),
+        "silent": {},
+    },
+    "error_reporter": {"console": {}, "silent": {}, "collecting": {}},
+    "archive_fetcher": {
+        "local": {}, "http": {}, "imap": {}, "rsync": {}, "mock": {},
+    },
+    "archive_store": {
+        "memory": {},
+        "local": dict(root="var/archives"),
+        "document": {},
+    },
+    "consensus_detector": {
+        "heuristic": {}, "mock": {}, "embedding": {},
+    },
+    "draft_diff_provider": {"mock": {}, "local": {}, "datatracker": {}},
+    "secret_provider": {
+        "env": {},
+        "local": dict(root="secrets"),
+        "static": dict(values={}),
+    },
+    "jwt_signer": {
+        "local_rs256": dict(private_pem=""),
+        "hs256": dict(secret=""),
+    },
+    "oidc_provider": {
+        name: dict(client_id="", client_secret="", redirect_uri="")
+        for name in ("github", "google", "microsoft", "datatracker", "mock")
+    },
+    "event_retry": {
+        "default": dict(max_attempts=8, base_delay=0.05, max_delay=5.0,
+                        jitter="full"),
+        "noop": {},
+    },
+}
+
+
+def driver_schema(kind: str, name: str, keys: dict) -> dict:
+    props: dict = {"driver": {"const": name}}
+    for key, value in keys.items():
+        tname = {str: "string", int: "integer", float: "number",
+                 bool: "boolean", list: "array", dict: "object"}[type(value)]
+        props[key] = {"type": tname, "default": value}
+    return {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "$id": ("copilot-for-consensus-tpu/schemas/configs/adapters/"
+                f"{kind}/{name}.schema.json"),
+        "title": f"{kind} driver: {name}",
+        "type": "object",
+        "properties": props,
+        "required": ["driver"],
+        "additionalProperties": True,
+    }
+
+
 def main() -> None:
     OUT.mkdir(parents=True, exist_ok=True)
     for name, extra in SERVICES.items():
         path = OUT / f"{name}.schema.json"
         path.write_text(json.dumps(service_schema(name, extra), indent=2) + "\n")
         print(f"wrote {path.relative_to(REPO)}")
+    for kind, drivers in DRIVERS.items():
+        kind_dir = DRIVER_OUT / kind
+        kind_dir.mkdir(parents=True, exist_ok=True)
+        for name, keys in drivers.items():
+            path = kind_dir / f"{name}.schema.json"
+            path.write_text(
+                json.dumps(driver_schema(kind, name, keys), indent=2) + "\n")
+            print(f"wrote {path.relative_to(REPO)}")
 
 
 if __name__ == "__main__":

@@ -15,7 +15,8 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 SCHEMAS = REPO / "copilot_for_consensus_tpu" / "schemas"
 
 
-def _regenerate_and_compare(script: str, subdir: str, tmp_path):
+def _regenerate_and_compare(script: str, subdir: str, tmp_path,
+                            glob: str = "*.json"):
     # Run the generator against a copied repo-layout so committed files are
     # untouched, then diff the schema trees.
     tmp_repo = tmp_path / "repo"
@@ -29,10 +30,10 @@ def _regenerate_and_compare(script: str, subdir: str, tmp_path):
                    check=True, env=env, capture_output=True)
     generated_root = pkg / "schemas" / subdir
     committed_root = SCHEMAS / subdir
-    gen = {p.name: json.loads(p.read_text())
-           for p in generated_root.glob("*.json")}
-    com = {p.name: json.loads(p.read_text())
-           for p in committed_root.glob("*.schema.json")}
+    gen = {str(p.relative_to(generated_root)): json.loads(p.read_text())
+           for p in generated_root.rglob(glob)}
+    com = {str(p.relative_to(committed_root)): json.loads(p.read_text())
+           for p in committed_root.rglob("*.schema.json")}
     assert set(gen) == set(com), (
         f"schema file set drift in {subdir}: generated-only="
         f"{sorted(set(gen) - set(com))} committed-only="
@@ -46,4 +47,24 @@ def test_event_schemas_in_sync(tmp_path):
 
 
 def test_config_schemas_in_sync(tmp_path):
-    _regenerate_and_compare("generate_config_schemas.py", "configs/services", tmp_path)
+    # Covers both trees the generator owns: configs/services and
+    # configs/adapters/<kind>/<driver>.
+    _regenerate_and_compare("generate_config_schemas.py", "configs", tmp_path)
+
+
+def test_every_registered_driver_has_schema():
+    """Registry ↔ schema coverage: each driver registered via
+    core.factory for each adapter kind must ship a driver schema
+    (the reference's per-driver config contract,
+    docs/schemas/configs/adapters/drivers/*/*.json)."""
+    from copilot_for_consensus_tpu.core import factory
+
+    missing = []
+    for kind in factory._KIND_MODULES:
+        for driver in factory.available_drivers(kind):
+            f = SCHEMAS / "configs" / "adapters" / kind / f"{driver}.schema.json"
+            if not f.exists():
+                missing.append(f"{kind}/{driver}")
+    assert not missing, (
+        f"drivers without schemas: {missing}; add to DRIVERS in "
+        "scripts/generate_config_schemas.py and regenerate")

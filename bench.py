@@ -32,13 +32,16 @@ def main() -> None:
     import jax
 
     model = os.environ.get("BENCH_MODEL", "mistral-7b")
-    slots = int(os.environ.get("BENCH_SLOTS", "32"))
-    # 256 covers prompt 128 + 64 new tokens + window slack; decode is
+    # 64 slots: decode is weight-bandwidth-bound, so throughput scales
+    # near-linearly with batch until the bf16 KV cache fills HBM
+    # (128 slots x 256 ctx OOMs a 16GB v5e next to 7GB int8 weights).
+    slots = int(os.environ.get("BENCH_SLOTS", "64"))
+    # 256 covers prompt 128 + 96 new tokens + window slack; decode is
     # HBM-bound so cache extent is throughput (with kv-bucketed decode
     # the extent adapts, but the allocation bound still matters).
     max_len = int(os.environ.get("BENCH_MAX_LEN", "256"))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
-    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "96"))
     window = int(os.environ.get("BENCH_DECODE_WINDOW", "32"))
 
     import jax.numpy as jnp

@@ -71,9 +71,11 @@ class LongContextEngine:
         decode_window: int = 8,
         ctx_block: int = 64,
         profile_dir: str | None = None,
+        sp_impl: str = "ring",
     ):
         self.cfg = cfg
         self.profile_dir = profile_dir
+        self.sp_impl = sp_impl
         self.mesh = mesh
         self.axis = axis
         self.n_shards = mesh.shape[axis]
@@ -99,7 +101,18 @@ class LongContextEngine:
             quant.set_pallas_qmatmul(False)   # GSPMD path under the mesh
         self.params = shard_pytree(params, axes, mesh, self._param_rules())
 
-        self._ring = make_ring_attention(mesh, axis)
+        # SP strategy is pluggable: ring (KV rotation, any head count)
+        # or Ulysses (one all-to-all each way; needs heads % sp == 0).
+        if sp_impl == "ring":
+            self._ring = make_ring_attention(mesh, axis)
+        elif sp_impl == "ulysses":
+            from copilot_for_consensus_tpu.parallel.ulysses import (
+                make_ulysses_attention,
+            )
+
+            self._ring = make_ulysses_attention(mesh, axis)
+        else:
+            raise ValueError(f"unknown sp_impl {sp_impl!r} (ring|ulysses)")
         self._prefill_cache_spec = P(None, None, None, axis, None)
         self._prefill_jits: dict[int, Any] = {}
         self._decode_jit = None

@@ -6,7 +6,8 @@ model parallelism (SURVEY.md §2.3). The TPU-native equivalent is a
 
 * ``dp`` — data parallel (batch sharding for embed/prefill fan-out; the
   analogue of the reference's N competing consumers per queue),
-* ``sp`` — sequence/context parallel (ring attention for long contexts),
+* ``sp`` — sequence/context parallel (ring attention or Ulysses
+  all-to-all for long contexts),
 * ``ep`` — expert parallel (Mixtral MoE experts),
 * ``tp`` — tensor parallel (weight sharding of the served LLM over ICI).
 
@@ -25,6 +26,10 @@ from copilot_for_consensus_tpu.parallel.pipeline import (
     pipeline_forward,
     shard_params_for_pipeline,
 )
+from copilot_for_consensus_tpu.parallel.ulysses import (
+    make_ulysses_attention,
+    ulysses_attention,
+)
 from copilot_for_consensus_tpu.parallel.sharding import (
     LogicalAxisRules,
     DEFAULT_RULES,
@@ -38,6 +43,8 @@ __all__ = [
     "local_mesh",
     "LogicalAxisRules",
     "DEFAULT_RULES",
+    "make_ulysses_attention",
+    "ulysses_attention",
     "logical_to_spec",
     "shard_pytree",
     "pipeline_forward",

@@ -97,9 +97,12 @@ class ReportingService(BaseService):
         flt: dict[str, Any] = {}
         if thread_id:
             flt["thread_id"] = thread_id
-        docs = self.store.query_documents(
-            "reports", flt, sort=[(sort_by, -1 if descending else 1)])
-        return docs[offset:offset + limit]
+        # limit/skip push down to the store (SQL LIMIT/OFFSET on the
+        # indexed driver) — materializing the whole collection breaks the
+        # reporting-API SLO at the 100k-message corpus.
+        return self.store.query_documents(
+            "reports", flt, sort=[(sort_by, -1 if descending else 1)],
+            limit=limit, skip=offset)
 
     def get_report(self, report_id: str) -> dict | None:
         return self.store.get_document("reports", report_id)
@@ -135,9 +138,9 @@ class ReportingService(BaseService):
     # browse endpoints (reference ``reporting/main.py:73-474``)
 
     def get_threads(self, *, offset: int = 0, limit: int = 50) -> list[dict]:
-        docs = self.store.query_documents(
-            "threads", {}, sort=[("message_count", -1)])
-        return docs[offset:offset + limit]
+        return self.store.query_documents(
+            "threads", {}, sort=[("message_count", -1)],
+            limit=limit, skip=offset)
 
     def get_thread(self, thread_id: str) -> dict | None:
         return self.store.get_document("threads", thread_id)
@@ -145,9 +148,9 @@ class ReportingService(BaseService):
     def get_messages(self, thread_id: str | None = None, *,
                      offset: int = 0, limit: int = 50) -> list[dict]:
         flt = {"thread_id": thread_id} if thread_id else {}
-        docs = self.store.query_documents("messages", flt,
-                                          sort=[("date", 1)])
-        return docs[offset:offset + limit]
+        return self.store.query_documents("messages", flt,
+                                          sort=[("date", 1)],
+                                          limit=limit, skip=offset)
 
     def get_message(self, message_doc_id: str) -> dict | None:
         return self.store.get_document("messages", message_doc_id)
@@ -155,9 +158,9 @@ class ReportingService(BaseService):
     def get_chunks(self, message_doc_id: str | None = None, *,
                    offset: int = 0, limit: int = 50) -> list[dict]:
         flt = {"message_doc_id": message_doc_id} if message_doc_id else {}
-        docs = self.store.query_documents("chunks", flt,
-                                          sort=[("seq", 1)])
-        return docs[offset:offset + limit]
+        return self.store.query_documents("chunks", flt,
+                                          sort=[("seq", 1)],
+                                          limit=limit, skip=offset)
 
     def get_sources(self) -> list[dict]:
         return self.store.query_documents("sources", {})

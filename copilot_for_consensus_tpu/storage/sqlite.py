@@ -51,12 +51,17 @@ INDEX_FIELDS: dict[str, tuple[str, ...]] = {
     "chunks": ("thread_id", "source_id", "message_doc_id",
                "embedding_generated", "seq"),
     "summaries": ("thread_id", "source_id", "status"),
-    "reports": ("thread_id", "summary_id", "status"),
+    "reports": ("thread_id", "summary_id", "status", "published_at"),
+    "sources": ("enabled",),
 }
 
 
 def _ex(path: str) -> str:
-    """The indexed extraction expression for a validated dotted path."""
+    """The indexed extraction expression for a validated dotted path.
+    (Primary-key paths never reach here: _compile_pk_condition maps them
+    to the ``id`` PRIMARY KEY column first — a B-tree lookup instead of
+    a full-table json_extract scan for the hot ``chunk_id: {"$in":
+    [...]}`` queries every pipeline stage issues.)"""
     return f"json_extract(doc, '$.{path}')"
 
 
@@ -68,7 +73,47 @@ class _Incompatible(Exception):
     """Filter/sort shape the SQL compiler can't express exactly."""
 
 
-def _compile_condition(path: str, cond: Any, params: list) -> str:
+def _compile_pk_condition(cond: Any, params: list) -> str | None:
+    """Primary-key fast path onto the ``id`` column (B-tree lookup).
+    Only string comparisons are safe — ``id`` holds ``str(doc_id)``
+    while the JSON copy keeps the original type — so anything else
+    returns None and takes the json_extract path."""
+    if isinstance(cond, str):
+        params.append(cond)
+        return "id = ?"
+    if isinstance(cond, Mapping) and cond and all(
+            k in ("$in", "$nin", "$ne") for k in cond):
+        clauses = []
+        local: list = []
+        for op, arg in cond.items():
+            if op == "$ne":
+                if not isinstance(arg, str):
+                    return None
+                local.append(arg)
+                clauses.append("id != ?")
+                continue
+            vals = list(arg)
+            if not all(isinstance(v, str) for v in vals):
+                return None
+            if not vals:
+                # $in []: never matches; $nin []: pk always exists.
+                clauses.append("0" if op == "$in" else "1")
+                continue
+            marks = ",".join("?" for _ in vals)
+            local.extend(vals)
+            clauses.append(f"id {'IN' if op == '$in' else 'NOT IN'} "
+                           f"({marks})")
+        params.extend(local)
+        return "(" + " AND ".join(clauses) + ")"
+    return None
+
+
+def _compile_condition(path: str, cond: Any, params: list,
+                       pk: str | None = None) -> str:
+    if pk is not None and path == pk:
+        fast = _compile_pk_condition(cond, params)
+        if fast is not None:
+            return fast
     if not _PATH_RE.match(path):
         raise _Incompatible(path)
     if isinstance(cond, Mapping) and any(k.startswith("$") for k in cond):
@@ -134,7 +179,8 @@ def _compile_condition(path: str, cond: Any, params: list) -> str:
     return f"{_ex(path)} = ?"
 
 
-def _compile_filter(flt: Mapping[str, Any] | None, params: list) -> str:
+def _compile_filter(flt: Mapping[str, Any] | None, params: list,
+                    pk: str | None = None) -> str:
     """Compile the Mongo-subset filter to a WHERE expression with exactly the
     semantics of :func:`matches_filter`; raises _Incompatible otherwise."""
     if not flt:
@@ -142,15 +188,15 @@ def _compile_filter(flt: Mapping[str, Any] | None, params: list) -> str:
     clauses = []
     for key, cond in flt.items():
         if key == "$or":
-            subs = [_compile_filter(sub, params) for sub in cond]
+            subs = [_compile_filter(sub, params, pk) for sub in cond]
             clauses.append("(" + " OR ".join(subs or ["0"]) + ")")
         elif key == "$and":
-            subs = [_compile_filter(sub, params) for sub in cond]
+            subs = [_compile_filter(sub, params, pk) for sub in cond]
             clauses.append("(" + " AND ".join(subs or ["1"]) + ")")
         elif key.startswith("$"):
             raise _Incompatible(key)
         else:
-            clauses.append(_compile_condition(key, cond, params))
+            clauses.append(_compile_condition(key, cond, params, pk))
     return "(" + " AND ".join(clauses) + ")"
 
 
@@ -268,7 +314,8 @@ class SQLiteDocumentStore(DocumentStore):
         table = self._table(collection)
         try:
             params: list = []
-            where = _compile_filter(flt, params)
+            where = _compile_filter(flt, params,
+                                    registry.primary_key(collection))
             order = _compile_sort(sort)
         except _Incompatible:
             docs = [d for d in self._iter_docs(collection)
@@ -315,7 +362,8 @@ class SQLiteDocumentStore(DocumentStore):
         table = self._table(collection)
         try:
             params: list = []
-            where = _compile_filter(flt, params)
+            where = _compile_filter(flt, params,
+                                    registry.primary_key(collection))
         except _Incompatible:
             ids = [str(d[registry.primary_key(collection)])
                    for d in self._iter_docs(collection)
@@ -334,7 +382,8 @@ class SQLiteDocumentStore(DocumentStore):
         table = self._table(collection)
         try:
             params: list = []
-            where = _compile_filter(flt, params)
+            where = _compile_filter(flt, params,
+                                    registry.primary_key(collection))
         except _Incompatible:
             return sum(1 for d in self._iter_docs(collection)
                        if matches_filter(d, flt))

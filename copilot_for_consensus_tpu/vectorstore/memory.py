@@ -2,6 +2,10 @@
 
 Keeps vectors L2-normalized in a contiguous matrix so query() is a single
 matvec + argpartition — the same math the TPU driver runs on-device.
+Scalar-equality metadata filters hit an inverted index (same design as
+the TPU driver), so per-thread context queries are O(candidates), not
+O(corpus); the vector buffer grows geometrically so adds are amortized
+O(1) instead of a full copy each.
 """
 
 from __future__ import annotations
@@ -9,11 +13,12 @@ from __future__ import annotations
 import json
 import pathlib
 import threading
-from typing import Any, Mapping, Sequence
+from typing import Any, Sequence
 
 import numpy as np
 
 from copilot_for_consensus_tpu.storage.base import matches_filter
+from copilot_for_consensus_tpu.vectorstore._inverted import InvertedIndexMixin
 from copilot_for_consensus_tpu.vectorstore.base import (
     QueryResult,
     VectorStore,
@@ -21,7 +26,7 @@ from copilot_for_consensus_tpu.vectorstore.base import (
 )
 
 
-class InMemoryVectorStore(VectorStore):
+class InMemoryVectorStore(InvertedIndexMixin, VectorStore):
     def __init__(self, config: Any = None):
         cfg = dict(config or {})
         self._dim: int | None = cfg.get("dimension") or None
@@ -29,6 +34,7 @@ class InMemoryVectorStore(VectorStore):
         self._index: dict[str, int] = {}
         self._vectors = np.zeros((0, self._dim or 1), dtype=np.float32)
         self._metadata: list[dict[str, Any]] = []
+        self._init_inverted()
         self._lock = threading.RLock()
         self.persist_path = cfg.get("persist_path")
 
@@ -42,6 +48,19 @@ class InMemoryVectorStore(VectorStore):
         norm = float(np.linalg.norm(arr))
         return arr / norm if norm > 0 else arr
 
+    @property
+    def _n(self) -> int:
+        return len(self._ids)
+
+    def _grow_to(self, rows: int) -> None:
+        cap = self._vectors.shape[0]
+        if rows <= cap:
+            return
+        new_cap = max(rows, cap * 2, 64)
+        grown = np.zeros((new_cap, self._dim), dtype=np.float32)
+        grown[:self._n] = self._vectors[:self._n]
+        self._vectors = grown
+
     def add_embedding(self, vec_id, vector, metadata=None):
         with self._lock:
             arr = self._normalize(vector)
@@ -51,34 +70,58 @@ class InMemoryVectorStore(VectorStore):
             if arr.shape[0] != self._dim:
                 raise VectorStoreError(
                     f"dimension mismatch: store={self._dim} got={arr.shape[0]}")
+            meta = dict(metadata or {})
             if vec_id in self._index:  # upsert
                 row = self._index[vec_id]
                 self._vectors[row] = arr
-                self._metadata[row] = dict(metadata or {})
+                self._index_meta(row, meta, remove=self._metadata[row])
+                self._metadata[row] = meta
             else:
-                self._index[vec_id] = len(self._ids)
+                row = self._n
+                self._grow_to(row + 1)
+                self._index[vec_id] = row
                 self._ids.append(vec_id)
-                self._vectors = np.vstack([self._vectors, arr[None, :]])
-                self._metadata.append(dict(metadata or {}))
+                self._vectors[row] = arr
+                self._metadata.append(meta)
+                self._index_meta(row, meta)
 
     def query(self, vector, top_k=10, flt=None):
         with self._lock:
             if not self._ids:
                 return []
             q = self._normalize(vector)
-            scores = self._vectors @ q
             if flt:
-                mask = np.array(
-                    [matches_filter(m, flt) for m in self._metadata])
-                scores = np.where(mask, scores, -np.inf)
-            k = min(top_k, len(self._ids))
+                cand = self._matching_rows(flt)
+                if not cand:
+                    return []
+                idx = np.asarray(cand)
+                scores = self._vectors[idx] @ q
+                k = min(top_k, len(cand))
+                top = np.argpartition(-scores, k - 1)[:k]
+                top = top[np.argsort(-scores[top])]
+                return [QueryResult(self._ids[idx[i]], float(scores[i]),
+                                    dict(self._metadata[idx[i]]))
+                        for i in top]
+            scores = self._vectors[:self._n] @ q
+            k = min(top_k, self._n)
             top = np.argpartition(-scores, k - 1)[:k]
             top = top[np.argsort(-scores[top])]
             return [
                 QueryResult(self._ids[i], float(scores[i]),
                             dict(self._metadata[i]))
-                for i in top if np.isfinite(scores[i])
+                for i in top
             ]
+
+    def _matching_rows(self, flt) -> list[int]:
+        """Rows whose metadata matches ``flt``: index candidates
+        re-verified with matches_filter (the index is a superset guess),
+        or a full scan when the index can't decide the filter."""
+        cand = self._filter_candidates(flt)
+        if cand is None:
+            return [i for i, m in enumerate(self._metadata)
+                    if matches_filter(m, flt)]
+        return [i for i in sorted(cand)
+                if matches_filter(self._metadata[i], flt)]
 
     def get(self, vec_id):
         with self._lock:
@@ -94,20 +137,25 @@ class InMemoryVectorStore(VectorStore):
         return self.delete(doomed)
 
     def delete(self, vec_ids):
+        doomed = set(vec_ids)
         with self._lock:
             keep = [i for i, vid in enumerate(self._ids)
-                    if vid not in set(vec_ids)]
-            removed = len(self._ids) - len(keep)
+                    if vid not in doomed]
+            removed = self._n - len(keep)
             self._ids = [self._ids[i] for i in keep]
-            self._vectors = self._vectors[keep] if keep else np.zeros(
-                (0, self._dim or 1), dtype=np.float32)
+            self._vectors = (self._vectors[keep] if keep
+                             else np.zeros((0, self._dim or 1),
+                                           dtype=np.float32))
             self._metadata = [self._metadata[i] for i in keep]
             self._index = {vid: i for i, vid in enumerate(self._ids)}
+            self._init_inverted()
+            for row, meta in enumerate(self._metadata):
+                self._index_meta(row, meta)
             return removed
 
     def count(self):
         with self._lock:
-            return len(self._ids)
+            return self._n
 
     def clear(self):
         with self._lock:
@@ -115,6 +163,7 @@ class InMemoryVectorStore(VectorStore):
             self._index = {}
             self._vectors = np.zeros((0, self._dim or 1), dtype=np.float32)
             self._metadata = []
+            self._init_inverted()
 
     # -- persistence -------------------------------------------------------
 
@@ -123,7 +172,7 @@ class InMemoryVectorStore(VectorStore):
         path.parent.mkdir(parents=True, exist_ok=True)
         with self._lock:
             np.savez_compressed(
-                path, vectors=self._vectors,
+                path, vectors=self._vectors[:self._n],
                 ids=np.array(self._ids, dtype=object),
                 metadata=np.array(
                     [json.dumps(m) for m in self._metadata], dtype=object),
@@ -138,3 +187,6 @@ class InMemoryVectorStore(VectorStore):
             self._metadata = [json.loads(str(m)) for m in data["metadata"]]
             self._index = {vid: i for i, vid in enumerate(self._ids)}
             self._dim = self._vectors.shape[1] if len(self._ids) else None
+            self._init_inverted()
+            for row, meta in enumerate(self._metadata):
+                self._index_meta(row, meta)

@@ -18,12 +18,12 @@ patched in place (jitted dynamic_update_slice) for small flushes.
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
 from typing import Any, Mapping, Sequence
 
 import numpy as np
 
 from copilot_for_consensus_tpu.storage.base import matches_filter
+from copilot_for_consensus_tpu.vectorstore._inverted import InvertedIndexMixin
 from copilot_for_consensus_tpu.vectorstore.base import (
     QueryResult,
     VectorStore,
@@ -33,7 +33,7 @@ from copilot_for_consensus_tpu.vectorstore.base import (
 _SELECTIVE_HOST_LIMIT = 4096     # filter hits below this → host-side scoring
 
 
-class TPUVectorStore(VectorStore):
+class TPUVectorStore(InvertedIndexMixin, VectorStore):
     def __init__(self, config: Any = None):
         cfg = dict(config or {})
         self._dim: int | None = cfg.get("dimension") or None
@@ -44,7 +44,7 @@ class TPUVectorStore(VectorStore):
         self._index: dict[str, int] = {}
         self._metadata: list[dict[str, Any]] = []
         self._host: np.ndarray | None = None        # [n, dim] fp32 master
-        self._inverted: dict[tuple[str, Any], set[int]] = defaultdict(set)
+        self._init_inverted()
         self._device = None                          # [capacity, dim]
         self._device_rows = 0                        # rows synced
         self._deleted_rows: set[int] = set()
@@ -119,11 +119,6 @@ class TPUVectorStore(VectorStore):
             self._host = grown
         self._host[len(self._ids) - 1] = arr
 
-    def _index_meta(self, row: int, meta: Mapping[str, Any]) -> None:
-        for k, v in meta.items():
-            if isinstance(v, (str, int, bool)):
-                self._inverted[(k, v)].add(row)
-
     def _unindex_meta(self, row: int) -> None:
         meta = self._metadata[row]
         for k, v in meta.items():
@@ -184,18 +179,12 @@ class TPUVectorStore(VectorStore):
             return self._device_query(q, top_k, flt)
 
     def _filter_rows(self, flt: Mapping[str, Any]) -> list[int] | None:
-        """Candidate rows via the inverted index (equality keys only);
-        None = filter not indexable."""
-        sets = []
-        for k, v in flt.items():
-            if isinstance(v, (str, int, bool)):
-                sets.append(self._inverted.get((k, v), set()))
-            else:
-                return None
-        if not sets:
+        """Candidate rows via the shared inverted index (superset guess;
+        callers re-verify with matches_filter); None = not decidable."""
+        cand = self._filter_candidates(flt)
+        if cand is None:
             return None
-        rows = set.intersection(*sets) - self._deleted_rows
-        return sorted(rows)
+        return sorted(cand - self._deleted_rows)
 
     def _host_query(self, q, rows: list[int], top_k: int, flt):
         if not rows:
@@ -273,6 +262,11 @@ class TPUVectorStore(VectorStore):
                 rows = [i for i, m in enumerate(self._metadata)
                         if i not in self._deleted_rows
                         and matches_filter(m, flt)]
+            else:
+                # Index candidates are a superset guess — re-verify
+                # before anything irreversible.
+                rows = [i for i in rows
+                        if matches_filter(self._metadata[i], flt)]
             return self.delete([self._ids[i] for i in rows])
 
     def clear(self):
@@ -280,7 +274,7 @@ class TPUVectorStore(VectorStore):
             self._ids.clear()
             self._index.clear()
             self._metadata.clear()
-            self._inverted.clear()
+            self._init_inverted()
             self._deleted_rows.clear()
             self._host = None
             self._device = None

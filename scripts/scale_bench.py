@@ -1,0 +1,187 @@
+#!/usr/bin/env python3
+"""Host-pipeline scale benchmark: synthetic archive → per-stage p95 vs
+the reference's SLO thresholds.
+
+The reference's north-star corpus is ≥100k messages (BASELINE.json); its
+SLOs are alert thresholds (``infra/prometheus/alerts/slo_latency.yml``):
+parsing p95 < 5s, chunking p95 < 2s, embedding batch p95 < 10s,
+summarization p95 < 30s, reporting API p95 < 0.5s. This bench generates
+a threaded synthetic mbox at any scale, runs the full pipeline on the
+indexed sqlite store, and prints one JSON line per stage with measured
+p95 against the SLO.
+
+  python scripts/scale_bench.py --messages 100000        # the north star
+  python scripts/scale_bench.py --messages 5000          # quick check
+
+Mock embedding/LLM drivers isolate host-pipeline throughput (the TPU
+engines are benchmarked by bench.py); --embedding tpu swaps in the real
+encoder.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# SLO thresholds (seconds): reference slo_latency.yml p95 rows.
+SLOS = {
+    "parsing": 5.0,
+    "chunking": 2.0,
+    "embedding": 10.0,
+    "summarization": 30.0,
+}
+REPORTING_API_SLO = 0.5
+
+_WORDS = ("consensus rough running code draft review thread mail archive "
+          "protocol header token budget window chunk merge split rfc "
+          "discussion agree disagree object support propose revise").split()
+
+
+def synthetic_mbox(path: pathlib.Path, n_messages: int,
+                   thread_size: int = 8, seed: int = 0,
+                   prefix: str = "a0") -> None:
+    """``prefix`` keeps message ids and subjects distinct across archives
+    so threads never merge between them."""
+    rng = random.Random(seed)
+    with path.open("w", encoding="utf-8") as f:
+        thread_root = None
+        for i in range(n_messages):
+            if i % thread_size == 0:
+                thread_root = f"<t{prefix}-{i}@bench>"
+                subject = f"Draft discussion {prefix}-{i // thread_size}"
+                refs = ""
+            else:
+                refs = (f"In-Reply-To: {thread_root}\n"
+                        f"References: {thread_root}\n")
+                subject = f"Re: Draft discussion {prefix}-{i // thread_size}"
+            body = " ".join(rng.choice(_WORDS) for _ in range(120))
+            f.write(
+                f"From m{i}@bench Thu Jan  1 00:00:00 2026\n"
+                f"From: Person {i % 37} <p{i % 37}@example.org>\n"
+                f"To: wg@example.org\n"
+                f"Message-ID: <m{prefix}-{i}@bench>\n"
+                f"{refs}"
+                f"Subject: {subject}\n"
+                f"Date: Thu, 1 Jan 2026 {i % 24:02d}:00:00 +0000\n"
+                f"\n{body}\n\n")
+
+
+class _SamplingMetrics:
+    """InMemoryMetrics plus raw samples, for exact percentiles."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.samples: dict[str, list[float]] = {}
+
+    def observe(self, name, value, labels=None):
+        self.samples.setdefault(name, []).append(float(value))
+        self._inner.observe(name, value, labels)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _p95(values: list[float]) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    return values[min(len(values) - 1, int(0.95 * len(values)))]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--messages", type=int, default=5000)
+    ap.add_argument("--archives", type=int, default=0,
+                    help="split into N archives (0 = ~2500 msgs each, "
+                         "the reference's monthly-mbox shape)")
+    ap.add_argument("--thread-size", type=int, default=8)
+    ap.add_argument("--embedding", default="mock", choices=["mock", "tpu"])
+    ap.add_argument("--keep-db", action="store_true")
+    args = ap.parse_args()
+
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="scale-bench-"))
+    n_arch = args.archives or max(1, args.messages // 2500)
+    per = args.messages // n_arch
+    t0 = time.monotonic()
+    for a in range(n_arch):
+        n = per if a < n_arch - 1 else args.messages - per * (n_arch - 1)
+        synthetic_mbox(tmp / f"archive-{a}.mbox", n, args.thread_size,
+                       seed=a, prefix=f"a{a}")
+    gen_s = time.monotonic() - t0
+
+    p = build_pipeline({
+        "document_store": {"driver": "sqlite",
+                           "path": str(tmp / "docs.sqlite3")},
+        # The production ANN driver: inverted-index metadata filters, so
+        # per-thread context queries stay O(candidates) not O(corpus).
+        "vector_store": {"driver": "tpu", "dtype": "float32"},
+        "embedding": ({"driver": "tpu"} if args.embedding == "tpu"
+                      else {"driver": "mock", "dimension": 384}),
+        "llm": {"driver": "mock"},
+    })
+    metrics = _SamplingMetrics(p.metrics)
+    for svc in p.services:
+        svc.metrics = metrics
+    for a in range(n_arch):
+        p.ingestion.create_source({
+            "source_id": f"bench-{a}", "name": f"bench-{a}",
+            "fetcher": "local", "location": str(tmp / f"archive-{a}.mbox")})
+
+    t1 = time.monotonic()
+    for a in range(n_arch):
+        p.ingestion.trigger_source(f"bench-{a}")
+    p.drain()
+    stats = p.reporting.stats()
+    run_s = time.monotonic() - t1
+
+    ok = True
+    for stage, slo in SLOS.items():
+        p95 = _p95(metrics.samples.get(f"{stage}_handle_seconds", []))
+        good = p95 < slo
+        ok &= good
+        print(json.dumps({"stage": stage, "p95_s": round(p95, 4),
+                          "slo_s": slo, "ok": good}))
+
+    # Reporting read path on the full corpus (reference SLO p95 < 0.5s).
+    # One warmup query first: the semantic search path jit-compiles the
+    # ANN scan on first use (one-time cost, not steady-state latency).
+    p.reporting.search_reports("warmup", limit=1)
+    api_samples = []
+    for _ in range(20):
+        t = time.monotonic()
+        p.reporting.get_reports(limit=20)
+        api_samples.append(time.monotonic() - t)
+    for _ in range(5):
+        t = time.monotonic()
+        p.reporting.search_reports("consensus draft", limit=10)
+        api_samples.append(time.monotonic() - t)
+    api_p95 = _p95(api_samples)
+    good = api_p95 < REPORTING_API_SLO
+    ok &= good
+    print(json.dumps({"stage": "reporting_api", "p95_s": round(api_p95, 4),
+                      "slo_s": REPORTING_API_SLO, "ok": good}))
+
+    print(json.dumps({
+        "stage": "total", "messages": args.messages,
+        "generate_s": round(gen_s, 1), "pipeline_s": round(run_s, 1),
+        "messages_per_s": round(args.messages / max(run_s, 1e-9), 1),
+        "stats": stats, "ok": ok,
+    }))
+    if not args.keep_db:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -124,3 +124,24 @@ def test_summarizer_routes_long_threads_to_longctx_engine():
     assert s.prompt_tokens == calls["len"]       # and was NOT truncated
     assert s.thread_id == "t-long"
     assert len(s.citations) == 8
+
+
+def test_longctx_ulysses_matches_ring():
+    """The engine's two SP strategies agree on the same prompt."""
+    import jax
+
+    from copilot_for_consensus_tpu.engine.longctx import LongContextEngine
+    from copilot_for_consensus_tpu.models import DecoderConfig
+    from copilot_for_consensus_tpu.parallel import MeshConfig, build_mesh
+
+    cfg = DecoderConfig(vocab_size=64, n_layers=2, d_model=32, n_heads=4,
+                        n_kv_heads=2, d_ff=64, max_seq_len=2048)
+    mesh = build_mesh(MeshConfig(dp=2, sp=4, ep=1, tp=1))
+    params = None
+    outs = {}
+    for impl in ("ring", "ulysses"):
+        eng = LongContextEngine(cfg, params, mesh=mesh, sp_impl=impl,
+                                max_new_tokens=8, seed=7)
+        params = eng.params  # share exact weights across impls
+        outs[impl] = eng.generate(list(range(1, 40)), max_new_tokens=6)
+    assert outs["ring"].tokens == outs["ulysses"].tokens

@@ -1,0 +1,76 @@
+# Ulysses all-to-all sequence parallelism vs the XLA oracle on the
+# virtual mesh — the alternative SP strategy to ring attention
+# (SURVEY.md §2.3 "Ring attention / Ulysses").
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.models import decoder
+from copilot_for_consensus_tpu.models.configs import decoder_config
+from copilot_for_consensus_tpu.ops.attention import attention_xla
+from copilot_for_consensus_tpu.parallel import MeshConfig, build_mesh
+from copilot_for_consensus_tpu.parallel.ulysses import (
+    make_ulysses_attention,
+    ulysses_attention,
+)
+
+
+def _qkv(seed, b=2, hq=4, hkv=2, s=64, d=16):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (jax.random.normal(kq, (b, hq, s, d)),
+            jax.random.normal(kk, (b, hkv, s, d)),
+            jax.random.normal(kv, (b, hkv, s, d)))
+
+
+@pytest.mark.parametrize("sp,causal", [(2, True), (4, True), (4, False)])
+def test_ulysses_matches_xla(sp, causal):
+    mesh = build_mesh(MeshConfig(sp=sp, tp=0))
+    q, k, v = _qkv(0)
+    ref = attention_xla(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2,
+                               atol=1e-2)
+
+
+def test_ulysses_under_jit():
+    mesh = build_mesh(MeshConfig(sp=4, tp=0))
+    q, k, v = _qkv(1)
+    ref = attention_xla(q, k, v, causal=True)
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2,
+                               atol=1e-2)
+
+
+def test_ulysses_sliding_window_and_padded_kv():
+    mesh = build_mesh(MeshConfig(sp=4, tp=0))
+    q, k, v = _qkv(3)
+    lengths = jnp.asarray([40, 64], dtype=jnp.int32)
+    ref = attention_xla(q, k, v, causal=True, window=16, kv_lengths=lengths)
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=True, window=16,
+                            kv_lengths=lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2,
+                               atol=1e-2)
+
+
+def test_ulysses_head_divisibility_rejected():
+    mesh = build_mesh(MeshConfig(sp=8, tp=0))
+    q, k, v = _qkv(2)  # 4 heads < 8 shards
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, mesh=mesh)
+
+
+def test_decoder_forward_with_ulysses_attention():
+    cfg = decoder_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg,
+                                 dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg.vocab_size)
+    ref = decoder.forward(params, tokens, cfg, attn_impl="xla")
+    mesh = build_mesh(MeshConfig(sp=4, tp=2))
+    uly = make_ulysses_attention(mesh)
+    out = decoder.forward(params, tokens, cfg, attn_impl=uly)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2,
+                               atol=2e-2)

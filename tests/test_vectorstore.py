@@ -76,3 +76,24 @@ def test_factory():
     assert vs.dimension == 4
     with pytest.raises(ValueError):
         create_vector_store({"driver": "qdrant"})
+
+
+def test_query_filters_beyond_the_inverted_index():
+    """Dotted-path keys and non-scalar metadata values can't be answered
+    by the inverted index; the store must fall back to the matcher scan
+    instead of treating an index miss as 'no results' (regression)."""
+    from copilot_for_consensus_tpu.vectorstore.memory import (
+        InMemoryVectorStore,
+    )
+
+    s = InMemoryVectorStore()
+    s.add_embedding("a", [1.0, 0.0], {"meta": {"lang": "en"}, "page": 1.0})
+    s.add_embedding("b", [0.0, 1.0], {"meta": {"lang": "de"}, "page": 2.0})
+    got = s.query([1.0, 0.0], top_k=2, flt={"meta.lang": "en"})
+    assert [g.id for g in got] == ["a"]
+    got = s.query([1.0, 0.0], top_k=2, flt={"page": 1})
+    assert [g.id for g in got] == ["a"]
+    # A key that was scalar everywhere still uses the index path.
+    s.add_embedding("c", [1.0, 1.0], {"thread_id": "t1"})
+    got = s.query([1.0, 0.0], top_k=3, flt={"thread_id": "t1"})
+    assert [g.id for g in got] == ["c"]

@@ -1,0 +1,74 @@
+# Native (C++ via ctypes) flat vector store: parity with the NumPy
+# driver, filters, and the compiled-core availability contract.
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.vectorstore.factory import create_vector_store
+from copilot_for_consensus_tpu.vectorstore.memory import InMemoryVectorStore
+from copilot_for_consensus_tpu.vectorstore.native import (
+    NativeFlatVectorStore,
+    load_native_lib,
+)
+
+
+def _fill(store, n=200, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    store.add_embeddings([
+        (f"v{i}", vecs[i].tolist(), {"thread_id": f"t{i % 7}"})
+        for i in range(n)])
+    return vecs
+
+
+def test_native_core_compiles():
+    """g++ is baked into the image; the core must actually build here
+    (the NumPy fallback is for toolchain-free installs, not this repo)."""
+    assert load_native_lib() is not None
+
+
+def test_native_matches_numpy_driver():
+    nat, mem = NativeFlatVectorStore(), InMemoryVectorStore()
+    _fill(nat)
+    _fill(mem)
+    rng = np.random.default_rng(1)
+    for _ in range(10):
+        q = rng.normal(size=16).tolist()
+        got = nat.query(q, top_k=9)
+        want = mem.query(q, top_k=9)
+        assert [g.id for g in got] == [w.id for w in want]
+        np.testing.assert_allclose([g.score for g in got],
+                                   [w.score for w in want], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_native_filtered_query_matches():
+    nat, mem = NativeFlatVectorStore(), InMemoryVectorStore()
+    _fill(nat)
+    _fill(mem)
+    q = np.random.default_rng(2).normal(size=16).tolist()
+    got = nat.query(q, top_k=5, flt={"thread_id": "t3"})
+    want = mem.query(q, top_k=5, flt={"thread_id": "t3"})
+    assert [g.id for g in got] == [w.id for w in want]
+    assert all(g.metadata["thread_id"] == "t3" for g in got)
+
+
+def test_native_upsert_delete_and_factory():
+    store = create_vector_store({"driver": "native"})
+    _fill(store, n=20)
+    store.add_embedding("v0", [9.0] + [0.0] * 15, {"thread_id": "tX"})
+    hit = store.query([1.0] + [0.0] * 15, top_k=1)[0]
+    assert hit.id == "v0" and hit.metadata["thread_id"] == "tX"
+    assert store.delete(["v0"]) == 1
+    assert store.count() == 19
+    assert all(r.id != "v0" for r in store.query([1.0] + [0.0] * 15,
+                                                 top_k=19))
+
+
+def test_native_lib_does_not_break_subnormals():
+    """Loading the compiled core must not flip FTZ/DAZ process-wide:
+    gcc links crtfastmath.o into -ffast-math shared objects and dlopen
+    then silently breaks IEEE subnormals for the whole host process
+    (JAX CPU numerics included). Regression for exactly that."""
+    assert load_native_lib() is not None
+    tiny = np.float32(1e-40) * np.float32(0.01)
+    assert tiny != 0.0

@@ -32,10 +32,16 @@ def main() -> None:
     import jax
 
     model = os.environ.get("BENCH_MODEL", "mistral-7b")
-    # 64 slots: decode is weight-bandwidth-bound, so throughput scales
-    # near-linearly with batch until the bf16 KV cache fills HBM
-    # (128 slots x 256 ctx OOMs a 16GB v5e next to 7GB int8 weights).
-    slots = int(os.environ.get("BENCH_SLOTS", "64"))
+    # fp8 KV cache (the default) halves cache HBM; 16-bit caches halve
+    # the slot ceiling with it (BENCH_KV_DTYPE=bfloat16 restores the
+    # full-precision cache).
+    kv_name = os.environ.get("BENCH_KV_DTYPE", "float8_e4m3fn")
+    # Decode is weight-bandwidth-bound, so throughput scales near-
+    # linearly with batch until the KV cache fills HBM: 128 slots x
+    # 256 ctx fit a 16GB v5e next to 7GB int8 weights with the fp8
+    # cache, 64 with bf16.
+    default_slots = 128 if kv_name.startswith("float8") else 64
+    slots = int(os.environ.get("BENCH_SLOTS", str(default_slots)))
     # 256 covers prompt 128 + 96 new tokens + window slack; decode is
     # HBM-bound so cache extent is throughput (with kv-bucketed decode
     # the extent adapts, but the allocation bound still matters).
@@ -63,6 +69,7 @@ def main() -> None:
         max_len=max_len,
         prefill_buckets=(prompt_len,),
         dtype=jnp.bfloat16,
+        kv_dtype=kv_name,
         seed=0,
         quantize=quantize,
         decode_window=window,

@@ -75,6 +75,31 @@ def _next_bucket(n: int, buckets: tuple[int, ...]) -> int:
     return buckets[-1]
 
 
+_KV_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float32": jnp.float32,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
+}
+
+
+def resolve_kv_dtype(kv_dtype, default):
+    """One place to accept/validate the kv cache dtype (config strings
+    included) — a typo'd config key must fail here with the valid set,
+    not as an opaque AttributeError deep in init_cache."""
+    if kv_dtype is None:
+        return default
+    if isinstance(kv_dtype, str):
+        try:
+            return _KV_DTYPES[kv_dtype]
+        except KeyError:
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r}; one of "
+                f"{sorted(_KV_DTYPES)}") from None
+    return kv_dtype
+
+
 class GenerationEngine:
     """Continuous-batching decoder serving. One instance per process/slice."""
 
@@ -91,6 +116,7 @@ class GenerationEngine:
         eos_id: int = 2,
         seed: int = 0,
         dtype=jnp.bfloat16,
+        kv_dtype=None,
         attn_impl: str = "auto",
         quantize: bool = False,
         decode_window: int = 8,
@@ -148,7 +174,13 @@ class GenerationEngine:
             params = jax.tree.map(jnp.asarray, params)
         self.params = params
 
-        cache = decoder.init_cache(cfg, num_slots, self.max_len, dtype=dtype)
+        # kv_dtype below activation dtype (float8_e4m3fn) halves cache
+        # HBM, doubling the slot count a chip fits — decode throughput is
+        # weight-bandwidth-bound so tokens/step scales with slots. e4m3's
+        # dynamic range covers KV activations; no per-tensor scales kept.
+        self.kv_dtype = resolve_kv_dtype(kv_dtype, dtype)
+        cache = decoder.init_cache(cfg, num_slots, self.max_len,
+                                   dtype=self.kv_dtype)
         if mesh is not None:
             # Replicate cache axes the mesh doesn't divide (e.g. tp larger
             # than the kv-head count — standard GQA serving replicates kv).

@@ -145,6 +145,11 @@ def decode_attention(
     if kv_len is not None and kv_len < k_cache.shape[2]:
         k_cache = k_cache[:, :, :kv_len]
         v_cache = v_cache[:, :, :kv_len]
+    if k_cache.dtype != q.dtype:
+        # float8 caches: 8-bit floats have no implicit promotion; the
+        # astype fuses into the einsum loads, so HBM traffic stays f8.
+        k_cache = k_cache.astype(q.dtype)
+        v_cache = v_cache.astype(q.dtype)
     b, hq, d = q.shape
     hkv, s_max = k_cache.shape[1], k_cache.shape[2]
     group = hq // hkv

@@ -36,6 +36,7 @@ def create_summarizer(config: Any = None, **kwargs: Any) -> Summarizer:
             num_slots=int(_cfg_get(config, "num_slots", 4)),
             max_len=int(_cfg_get(config, "max_len", 4096)),
             checkpoint=_cfg_get(config, "checkpoint"),
+            kv_dtype=_cfg_get(config, "kv_dtype"),
             long_context=bool(_cfg_get(config, "long_context", False)),
             profile_dir=_cfg_get(config, "profile_dir"),
             **kwargs,

@@ -53,7 +53,7 @@ class TPUSummarizer(Summarizer):
                  system: str = DEFAULT_SYSTEM, num_slots: int = 4,
                  max_len: int = 4096, params=None, mesh=None, dtype=None,
                  checkpoint: str | None = None, long_engine=None,
-                 long_context: bool = False,
+                 long_context: bool = False, kv_dtype: str | None = None,
                  profile_dir: str | None = None):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
@@ -80,6 +80,7 @@ class TPUSummarizer(Summarizer):
                 engine = GenerationEngine.from_checkpoint(
                     checkpoint, mesh=mesh, num_slots=num_slots,
                     max_len=max_len, profile_dir=profile_dir,
+                    kv_dtype=kv_dtype,
                     dtype=dtype if dtype is not None else jnp.bfloat16)
                 self._model = f"checkpoint:{checkpoint}"
                 if tokenizer is None:
@@ -98,7 +99,7 @@ class TPUSummarizer(Summarizer):
                 engine = GenerationEngine(
                     cfg, params, mesh=mesh, num_slots=num_slots,
                     max_len=min(max_len, cfg.max_seq_len),
-                    profile_dir=profile_dir,
+                    profile_dir=profile_dir, kv_dtype=kv_dtype,
                     dtype=dtype if dtype is not None else jnp.bfloat16)
         self.engine = engine
         if long_engine is None and long_context:

@@ -71,6 +71,52 @@ def _cmd_retry_job(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_migrate(args: argparse.Namespace) -> int:
+    from copilot_for_consensus_tpu.storage.factory import (
+        create_document_store,
+    )
+    from copilot_for_consensus_tpu.tools.data_migration import (
+        export_data,
+        import_data,
+    )
+    from copilot_for_consensus_tpu.vectorstore.factory import (
+        create_vector_store,
+    )
+
+    cfg = _load_config(args.config)
+    store = create_document_store(cfg.get("document_store",
+                                          {"driver": "sqlite"}))
+    store.connect()
+    # The vector leg only makes sense against a durable index: exporting
+    # a freshly-constructed empty store would clobber a previous dump
+    # while printing success, and an import that never save()s is lost
+    # at process exit — so both ends key off persist_path.
+    vs_cfg = dict(cfg.get("vector_store") or {})
+    persist = vs_cfg.get("persist_path")
+    vs = None
+    if vs_cfg and persist:
+        vs = create_vector_store(vs_cfg)
+        if args.cmd == "export-data":
+            if pathlib.Path(persist).exists():
+                vs.load(persist)
+            else:
+                print(json.dumps({"event": "vector_export_skipped",
+                                  "reason": f"no index at {persist}"}),
+                      flush=True)
+                vs = None
+    elif vs_cfg:
+        print(json.dumps({"event": "vector_leg_skipped",
+                          "reason": "vector_store.persist_path not set"}),
+              flush=True)
+    fn = export_data if args.cmd == "export-data" else import_data
+    counts = fn(store, args.dir, vector_store=vs)
+    if vs is not None and args.cmd == "import-data":
+        vs.save(persist)
+    print(json.dumps({"event": args.cmd.replace("-", "_"), **counts}),
+          flush=True)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     ap = argparse.ArgumentParser(prog="copilot_for_consensus_tpu")
@@ -93,6 +139,14 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("failed-queues", help="failed-queue operator CLI",
                    add_help=False)
 
+    for name, hlp in (("export-data", "dump all collections to JSONL"),
+                      ("import-data", "load a JSONL dump")):
+        mig = sub.add_parser(name, help=hlp)
+        mig.add_argument("--config", default=None)
+        mig.add_argument("--dir", required=True,
+                         help="dump directory (out for export, src for "
+                              "import)")
+
     # Delegating subcommands keep their own argparsers: split argv at the
     # subcommand and hand the rest through untouched.
     if argv and argv[0] == "broker":
@@ -111,6 +165,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_serve(args)
     if args.cmd == "retry-job":
         return _cmd_retry_job(args)
+    if args.cmd in ("export-data", "import-data"):
+        return _cmd_migrate(args)
     raise AssertionError(args.cmd)
 
 

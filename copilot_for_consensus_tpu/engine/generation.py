@@ -88,7 +88,7 @@ def resolve_kv_dtype(kv_dtype, default):
     """One place to accept/validate the kv cache dtype (config strings
     included) — a typo'd config key must fail here with the valid set,
     not as an opaque AttributeError deep in init_cache."""
-    if kv_dtype is None:
+    if not kv_dtype:          # None or "" (the schema default) = unset
         return default
     if isinstance(kv_dtype, str):
         try:

@@ -309,6 +309,20 @@ class SQLiteDocumentStore(DocumentStore):
         for (raw,) in self._conn().execute(f"SELECT doc FROM {table}"):
             yield json.loads(raw)
 
+    def _python_query(self, collection, flt, *, limit=None, skip=0,
+                      sort=None):
+        """Fallback path: full scan + the shared Python matcher — used
+        for filter shapes the compiler can't express and for parameter
+        values sqlite can't bind (lone surrogates in filter strings)."""
+        docs = [d for d in self._iter_docs(collection)
+                if matches_filter(d, flt)]
+        sort_documents(docs, sort)
+        if skip:
+            docs = docs[skip:]
+        if limit is not None:
+            docs = docs[:limit]
+        return docs
+
     def query_documents(self, collection, flt=None, *, limit=None, skip=0,
                         sort: Sequence[tuple[str, int]] | None = None):
         table = self._table(collection)
@@ -318,20 +332,18 @@ class SQLiteDocumentStore(DocumentStore):
                                     registry.primary_key(collection))
             order = _compile_sort(sort)
         except _Incompatible:
-            docs = [d for d in self._iter_docs(collection)
-                    if matches_filter(d, flt)]
-            sort_documents(docs, sort)
-            if skip:
-                docs = docs[skip:]
-            if limit is not None:
-                docs = docs[:limit]
-            return docs
+            return self._python_query(collection, flt, limit=limit,
+                                      skip=skip, sort=sort)
         sql = f"SELECT doc FROM {table} WHERE {where}{order}"
         if limit is not None or skip:
             sql += " LIMIT ? OFFSET ?"
             params.extend([-1 if limit is None else limit, skip])
-        return [json.loads(raw) for (raw,)
-                in self._conn().execute(sql, params)]
+        try:
+            return [json.loads(raw) for (raw,)
+                    in self._conn().execute(sql, params)]
+        except UnicodeEncodeError:
+            return self._python_query(collection, flt, limit=limit,
+                                      skip=skip, sort=sort)
 
     def update_document(self, collection, doc_id, updates):
         table = self._table(collection)
@@ -358,6 +370,17 @@ class SQLiteDocumentStore(DocumentStore):
         self._conn().commit()
         return cur.rowcount > 0
 
+    def _python_delete(self, collection, flt):
+        table = self._table(collection)
+        ids = [str(d[registry.primary_key(collection)])
+               for d in self._iter_docs(collection)
+               if matches_filter(d, flt)]
+        for doc_id in ids:
+            self._conn().execute(
+                f"DELETE FROM {table} WHERE id=?", (doc_id,))
+        self._conn().commit()
+        return len(ids)
+
     def delete_documents(self, collection, flt=None):
         table = self._table(collection)
         try:
@@ -365,16 +388,12 @@ class SQLiteDocumentStore(DocumentStore):
             where = _compile_filter(flt, params,
                                     registry.primary_key(collection))
         except _Incompatible:
-            ids = [str(d[registry.primary_key(collection)])
-                   for d in self._iter_docs(collection)
-                   if matches_filter(d, flt)]
-            for doc_id in ids:
-                self._conn().execute(
-                    f"DELETE FROM {table} WHERE id=?", (doc_id,))
-            self._conn().commit()
-            return len(ids)
-        cur = self._conn().execute(
-            f"DELETE FROM {table} WHERE {where}", params)
+            return self._python_delete(collection, flt)
+        try:
+            cur = self._conn().execute(
+                f"DELETE FROM {table} WHERE {where}", params)
+        except UnicodeEncodeError:
+            return self._python_delete(collection, flt)
         self._conn().commit()
         return cur.rowcount
 
@@ -387,6 +406,10 @@ class SQLiteDocumentStore(DocumentStore):
         except _Incompatible:
             return sum(1 for d in self._iter_docs(collection)
                        if matches_filter(d, flt))
-        return self._conn().execute(
-            f"SELECT COUNT(*) FROM {table} WHERE {where}",
-            params).fetchone()[0]
+        try:
+            return self._conn().execute(
+                f"SELECT COUNT(*) FROM {table} WHERE {where}",
+                params).fetchone()[0]
+        except UnicodeEncodeError:
+            return sum(1 for d in self._iter_docs(collection)
+                       if matches_filter(d, flt))

@@ -1,0 +1,77 @@
+"""Data portability: export/import every collection as JSONL.
+
+The role of the reference's ``scripts/data-migration-export.py`` /
+``-import.py`` pair — move a deployment's documents (and optionally the
+vector index) between stores/drivers/hosts. Formats:
+
+* one ``<collection>.jsonl`` per collection in a directory, one document
+  per line (stable field order for diff-ability);
+* ``vectors.npz`` for the vector store when included.
+
+Used by the package CLI: ``python -m copilot_for_consensus_tpu
+export-data --dir dump/`` and ``import-data --dir dump/``.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any
+
+from copilot_for_consensus_tpu.storage import registry
+
+# Registry-derived so a collection added to collections.config.json can
+# never be silently dropped from a migration; user_roles is the auth
+# store's collection, outside the pipeline registry.
+COLLECTIONS = tuple(registry.KNOWN_COLLECTIONS) + ("user_roles",)
+
+
+def export_data(store: Any, out_dir: str | pathlib.Path,
+                vector_store: Any = None) -> dict[str, int]:
+    """Dump every collection (and the vector index when given) to
+    ``out_dir``; returns per-collection document counts."""
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    counts: dict[str, int] = {}
+    for coll in COLLECTIONS:
+        docs = store.query_documents(coll, {})
+        with (out / f"{coll}.jsonl").open("w", encoding="utf-8") as f:
+            for d in docs:
+                f.write(json.dumps(d, sort_keys=True) + "\n")
+        counts[coll] = len(docs)
+    if vector_store is not None and hasattr(vector_store, "save"):
+        vector_store.save(out / "vectors.npz")
+        counts["vectors"] = vector_store.count()
+    return counts
+
+
+def import_data(store: Any, src_dir: str | pathlib.Path,
+                vector_store: Any = None,
+                upsert: bool = True) -> dict[str, int]:
+    """Load a dump produced by :func:`export_data`; upserts by default so
+    re-imports are idempotent (matching the pipeline's id discipline)."""
+    src = pathlib.Path(src_dir)
+    counts: dict[str, int] = {}
+    for coll in COLLECTIONS:
+        path = src / f"{coll}.jsonl"
+        if not path.exists():
+            continue
+        n = 0
+        with path.open(encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                if upsert:
+                    store.upsert_document(coll, doc)
+                else:
+                    store.insert_document(coll, doc)
+                n += 1
+        counts[coll] = n
+    vec_file = src / "vectors.npz"
+    if vector_store is not None and vec_file.exists() and hasattr(
+            vector_store, "load"):
+        vector_store.load(vec_file)
+        counts["vectors"] = vector_store.count()
+    return counts

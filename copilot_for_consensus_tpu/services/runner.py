@@ -34,6 +34,7 @@ from copilot_for_consensus_tpu.services.orchestrator import (
     ContextSelector,
     OrchestrationService,
 )
+from copilot_for_consensus_tpu.services.parsing import ParsingService
 from copilot_for_consensus_tpu.services.reporting import ReportingService
 from copilot_for_consensus_tpu.services.summarization import (
     SummarizationService,
@@ -63,6 +64,10 @@ class Pipeline:
     # external broker directly so ack happens only after the handler
     # returns — crash before ack ⇒ lease expiry ⇒ redelivery.
     ext_subscribers: list = field(default_factory=list)
+    # Service names this process consumes bus events for (cfg["roles"]);
+    # None = all. Other services still exist for their REST/read surface
+    # — their events flow to whichever process owns the role.
+    roles: frozenset | None = None
     _seen_gauge_keys: set = field(default_factory=set)
 
     @property
@@ -70,8 +75,16 @@ class Pipeline:
         return (self.ingestion, self.parsing, self.chunking, self.embedding,
                 self.orchestrator, self.summarization, self.reporting)
 
+    @property
+    def owned_services(self):
+        if self.roles is None:
+            return self.services
+        return tuple(s for s in self.services if s.name in self.roles)
+
     def startup(self) -> None:
-        for svc in self.services:
+        # Startup requeue stays role-scoped: each process re-publishes
+        # only the stuck documents of stages it consumes.
+        for svc in self.owned_services:
             svc.startup()
 
     def drain(self, max_messages: int | None = None) -> int:
@@ -140,13 +153,43 @@ class Pipeline:
 
 
 def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
-    """Wire every service onto one in-proc broker.
+    """Wire every service onto one bus.
 
     config keys (all optional): ``document_store``, ``vector_store``,
     ``embedding``, ``llm``, ``chunking``, ``orchestrator``,
-    ``summarization`` — each a driver-config mapping.
+    ``summarization`` — each a driver-config mapping; ``bus`` selects
+    the inter-process broker; ``roles`` (list of service names) scopes
+    which stages THIS process consumes — the role-per-container split of
+    the reference's docker-compose.services.yml, and the host/TPU-slice
+    split of SURVEY §7 (host stages with mock engine drivers in one
+    process, embedding+orchestrator+summarization with TPU drivers in
+    the engine process).
     """
     cfg = dict(config or {})
+    # Validate roles FIRST: a typo must fail before checkpoints load and
+    # stores connect, and role-scoping only works over an inter-process
+    # bus — on a private in-proc broker the unowned stages' events would
+    # park forever while drain() reports quiescent.
+    roles = cfg.get("roles")
+    if roles is not None:
+        known = {IngestionService.name, ParsingService.name,
+                 ChunkingService.name, EmbeddingService.name,
+                 OrchestrationService.name, SummarizationService.name,
+                 ReportingService.name}
+        bad = set(roles) - known
+        if bad:
+            raise ValueError(f"unknown roles {sorted(bad)}; "
+                             f"known: {sorted(known)}")
+        if not roles:
+            raise ValueError(
+                "roles=[] would consume nothing; omit the key to consume "
+                "every stage")
+        if dict(cfg.get("bus") or {}).get("driver", "inproc") not in (
+                "broker", "zmq"):
+            raise ValueError(
+                "roles requires an inter-process bus (bus.driver broker); "
+                "on the in-proc bus unowned stages' events would never "
+                "be consumed")
     broker = InProcBroker()
     store = create_document_store(cfg.get("document_store",
                                           {"driver": "memory"}))
@@ -188,7 +231,6 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
         fetchers={"local": LocalFetcher(),
                   "mock": cfg.get("mock_fetcher") or MockFetcher()},
         **common)
-    from copilot_for_consensus_tpu.services.parsing import ParsingService
     parsing = ParsingService(publisher(), store, archive_store, **common)
     chunking = ChunkingService(
         publisher(), store,
@@ -217,9 +259,10 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
         broker=broker, store=store, vector_store=vector_store,
         ingestion=ingestion, parsing=parsing, chunking=chunking,
         embedding=embedding, orchestrator=orchestrator,
-        summarization=summarization, reporting=reporting, metrics=metrics)
+        summarization=summarization, reporting=reporting, metrics=metrics,
+        roles=frozenset(roles) if roles is not None else None)
 
-    for svc in pipeline.services:
+    for svc in pipeline.owned_services:
         # One queue group per service: fan-out across services (every
         # stage sees SourceDeletionRequested), competition within one.
         # Same topology on either tier; validation wraps the edge so

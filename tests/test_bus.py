@@ -368,3 +368,49 @@ def test_gauge_depths_reset_after_drain(live_broker, fixtures_dir):
     foreign.close()
     for sub in p.ext_subscribers:
         sub.close()
+
+
+def test_role_split_processes_complete_pipeline(live_broker, fixtures_dir):
+    """Two role-scoped pipelines over one broker — host stages in one,
+    'TPU' stages in the other — jointly complete the pipeline: the
+    reference's service-per-container split plus SURVEY §7's host/engine
+    split, on the durable bus."""
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    bus = {"driver": "broker", "address": live_broker.address}
+    host = build_pipeline({
+        "bus": bus,
+        "roles": ["ingestion", "parsing", "chunking", "reporting"]})
+    engine = build_pipeline({
+        "bus": bus,
+        "roles": ["embedding", "orchestrator", "summarization"],
+        "document_store": {"driver": "memory"}})
+    # Shared store across "processes" for this in-test split: point the
+    # engine's services at the host's store objects.
+    for svc in engine.services:
+        svc.store = host.store
+    engine.embedding.vector_store = host.vector_store
+    engine.orchestrator.vector_store = host.vector_store
+
+    host.ingestion.create_source({
+        "source_id": "s", "name": "s", "fetcher": "local",
+        "location": str(fixtures_dir / "ietf-sample.mbox")})
+    host.ingestion.trigger_source("s")
+    # Alternate draining the two processes until both go quiet.
+    for _ in range(40):
+        moved = host.drain() + engine.drain()
+        if not moved:
+            break
+    stats = host.reporting.stats()
+    assert stats["reports"] == stats["threads"] > 0
+    assert stats["messages"] > 0
+    for p in (host, engine):
+        for sub in p.ext_subscribers:
+            sub.close()
+
+
+def test_unknown_role_rejected():
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    with pytest.raises(ValueError, match="unknown roles"):
+        build_pipeline({"roles": ["ingestion", "nonsense"]})

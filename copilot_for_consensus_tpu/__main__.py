@@ -62,7 +62,12 @@ def _cmd_retry_job(args: argparse.Namespace) -> int:
     store.connect()
     pub = create_publisher(cfg.get("bus", {"driver": "broker"}))
     pub.connect()
-    job = RetryStuckDocumentsJob(store, pub)
+    from copilot_for_consensus_tpu.obs.metrics import (
+        create_metrics_collector,
+    )
+    job = RetryStuckDocumentsJob(
+        store, pub,
+        metrics=create_metrics_collector(cfg.get("metrics")))
     if args.once:
         print(json.dumps({"event": "retry_sweep", **job.run_once()}),
               flush=True)
@@ -139,6 +144,12 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("failed-queues", help="failed-queue operator CLI",
                    add_help=False)
 
+    sub.add_parser("logmine", help="mine templates from JSON logs",
+                   add_help=False)
+
+    sub.add_parser("exporters", help="store/vector stats exporter",
+                   add_help=False)
+
     for name, hlp in (("export-data", "dump all collections to JSONL"),
                       ("import-data", "load a JSONL dump")):
         mig = sub.add_parser(name, help=hlp)
@@ -159,6 +170,14 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         return fq_main(argv[1:])
+    if argv and argv[0] == "logmine":
+        from copilot_for_consensus_tpu.tools.logmine import main as lm_main
+
+        return lm_main(argv[1:])
+    if argv and argv[0] == "exporters":
+        from copilot_for_consensus_tpu.tools.exporters import main as ex_main
+
+        return ex_main(argv[1:])
 
     args = ap.parse_args(argv)
     if args.cmd == "serve":

@@ -1,0 +1,44 @@
+"""Cloud-agnostic API gateway config generation.
+
+The role of the reference's spec-first gateway layer
+(``infra/gateway/generate_gateway_config.py`` + ``adapter_base.py`` /
+``azure_adapter.py`` / ``aws_adapter.py`` / ``gcp_adapter.py``): one
+OpenAPI document drives every deployment target's edge config, so the
+route table, auth boundary, and rate limits cannot drift between
+providers.
+
+Direction inverted vs the reference: there the hand-written
+``openapi.yaml`` is the source of truth; here the spec is *generated
+from the live router* (``services/openapi.py``), so the gateway configs
+are two derivation steps from the code that actually serves — a stale
+config is a failing test (``tests/test_gateway_config.py``), not a
+production surprise.
+
+Adapters emit plain ``{relative_filename: content}`` maps; the CLI
+(``scripts/generate_gateway_config.py``) writes them under
+``infra/gateway/<provider>/``.
+"""
+
+from copilot_for_consensus_tpu.gateway.base import (
+    GatewayAdapter,
+    RouteInfo,
+    routes_from_spec,
+)
+from copilot_for_consensus_tpu.gateway.providers import (
+    AwsApiGatewayAdapter,
+    AzureApimAdapter,
+    GcpApiGatewayAdapter,
+    NginxAdapter,
+    create_gateway_adapter,
+)
+
+__all__ = [
+    "GatewayAdapter",
+    "RouteInfo",
+    "routes_from_spec",
+    "NginxAdapter",
+    "AzureApimAdapter",
+    "AwsApiGatewayAdapter",
+    "GcpApiGatewayAdapter",
+    "create_gateway_adapter",
+]

@@ -1,0 +1,139 @@
+"""Gateway adapter ABC + the spec→route-table distillation.
+
+Capability parity with the reference's ``infra/gateway/adapter_base.py``
+(an ABC each cloud adapter subclasses, fed by the OpenAPI doc). The
+distilled ``RouteInfo`` view is what every provider actually needs:
+path, methods, auth-required, and a path-prefix group for routing.
+"""
+
+from __future__ import annotations
+
+import re
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+_HTTP_METHODS = ("get", "put", "post", "delete", "patch", "head", "options")
+
+# Paths that must never be reachable through a public edge. nginx 403s
+# them explicitly; the cloud adapters simply do not emit routes for them,
+# so the edge has nothing to forward.
+INTERNAL_PATHS = frozenset({"/metrics", "/health", "/readyz"})
+
+
+def path_regex(path: str) -> str:
+    """Anchored regex for an OpenAPI path template, with every literal
+    character escaped ('.' in /.well-known/jwks.json must not match any
+    byte — an unescaped allowlist regex would widen the edge's public
+    surface) and ``{param}`` segments matching one path segment."""
+    out: list[str] = []
+    for piece in re.split(r"(\{[^}]+\})", path):
+        if piece.startswith("{") and piece.endswith("}"):
+            out.append("[^/]+")
+        else:
+            out.append(re.escape(piece))
+    return "^" + "".join(out) + "$"
+
+
+@dataclass(frozen=True)
+class RouteInfo:
+    """One path of the spec, distilled for edge routing."""
+
+    path: str                       # OpenAPI template, e.g. /api/reports/{id}
+    methods: tuple[str, ...]        # upper-case, sorted
+    auth_required: bool             # any operation carries a security req
+    summary: str = ""
+
+    @property
+    def prefix_group(self) -> str:
+        """Routing group: first path segment ('' for the UI root)."""
+        seg = self.path.strip("/").split("/", 1)[0]
+        return seg
+
+    @property
+    def nginx_location(self) -> str:
+        """Exact-or-regex nginx location for this path template."""
+        if "{" not in self.path:
+            return f"location = {self.path}"
+        return f"location ~ {path_regex(self.path)}"
+
+    @property
+    def aws_path(self) -> str:
+        """API Gateway uses the same {param} syntax as OpenAPI."""
+        return self.path
+
+    @property
+    def gcp_path(self) -> str:
+        return self.path
+
+
+def routes_from_spec(spec: Mapping[str, Any]) -> list[RouteInfo]:
+    """Distill an OpenAPI 3.x document into sorted RouteInfo rows."""
+    routes: list[RouteInfo] = []
+    for path, ops in sorted(spec.get("paths", {}).items()):
+        methods = sorted(m.upper() for m in ops if m in _HTTP_METHODS)
+        if not methods:
+            continue
+        auth = any(ops[m.lower()].get("security")
+                   for m in (x.lower() for x in methods)
+                   if isinstance(ops.get(m), dict))
+        summary = next((ops[m.lower()].get("summary", "")
+                        for m in (x.lower() for x in methods)
+                        if isinstance(ops.get(m), dict)), "")
+        routes.append(RouteInfo(path=path, methods=tuple(methods),
+                                auth_required=bool(auth), summary=summary))
+    return routes
+
+
+@dataclass
+class GatewayAdapter(ABC):
+    """Turns the OpenAPI spec into provider-specific edge config files.
+
+    Subclasses implement :meth:`generate`; shared knobs live here so
+    every provider agrees on the upstream and auth endpoints.
+    """
+
+    upstream_host: str = "pipeline"
+    upstream_port: int = 8080
+    jwks_path: str = "/.well-known/jwks.json"
+    oidc_discovery_path: str = "/.well-known/openid-configuration"
+    # Must match the app's JWT defaults (services/bootstrap.py: JWTManager
+    # issuer="copilot", audience="copilot-api") or every edge-validated
+    # token fails with issuer/audience mismatch.
+    issuer: str = "copilot"
+    audience: str = "copilot-api"
+    rate_limit_rps: int = 50
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    name: str = "base"
+
+    @property
+    def upstream(self) -> str:
+        return f"{self.upstream_host}:{self.upstream_port}"
+
+    @abstractmethod
+    def generate(self, spec: Mapping[str, Any]) -> dict[str, str]:
+        """Return ``{relative_filename: file_content}`` for this provider."""
+
+    # Shared helpers -------------------------------------------------
+
+    def edge_routes(self, spec: Mapping[str, Any]) -> list[RouteInfo]:
+        """Routes the public edge should serve: everything except the
+        cluster-internal probe/scrape endpoints."""
+        return [r for r in routes_from_spec(spec)
+                if r.path not in INTERNAL_PATHS]
+
+    def public_routes(self, spec: Mapping[str, Any]) -> list[RouteInfo]:
+        return [r for r in self.edge_routes(spec) if not r.auth_required]
+
+    def guarded_routes(self, spec: Mapping[str, Any]) -> list[RouteInfo]:
+        return [r for r in self.edge_routes(spec) if r.auth_required]
+
+    def header_comment(self, spec: Mapping[str, Any], comment: str = "#") -> str:
+        info = spec.get("info", {})
+        return (
+            f"{comment} Generated by scripts/generate_gateway_config.py "
+            f"({self.name} adapter)\n"
+            f"{comment} API: {info.get('title', '?')} v{info.get('version', '?')}\n"
+            f"{comment} Do not edit: regenerate from the OpenAPI spec.\n"
+        )

@@ -1,0 +1,404 @@
+"""Provider adapters: nginx, Azure APIM, AWS API Gateway, GCP API Gateway.
+
+Role parity with the reference's ``infra/gateway/{azure,aws,gcp}_adapter.py``
+(ARM/CloudFormation/Cloud-Endpoints emission from one OpenAPI doc) and
+``infra/nginx/nginx.conf`` (the TLS edge actually deployed by compose).
+
+Every adapter consumes the same distilled route table
+(:func:`~copilot_for_consensus_tpu.gateway.base.routes_from_spec`), so
+the auth boundary — which paths require a bearer JWT — is decided once,
+in the router code the spec is generated from, and merely *projected*
+into each provider's native config language here.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+from copilot_for_consensus_tpu.gateway.base import (
+    INTERNAL_PATHS,
+    GatewayAdapter,
+    path_regex,
+    routes_from_spec,
+)
+
+
+@dataclass
+class NginxAdapter(GatewayAdapter):
+    """Emit an nginx reverse-proxy config for the compose deployment.
+
+    One server block, TLS-ready, rate-limited, routing everything to the
+    unified pipeline upstream (the repo runs one gateway surface rather
+    than the reference's five per-service proxies — see
+    ``services/bootstrap.py:serve_pipeline``). JWT enforcement happens
+    in the app's middleware; nginx adds the edge concerns: TLS, limits,
+    body caps, and security headers.
+    """
+
+    name: str = "nginx"
+
+    def generate(self, spec: Mapping[str, Any]) -> dict[str, str]:
+        routes = self.edge_routes(spec)
+        guarded = [r for r in routes if r.auth_required]
+        public = [r for r in routes if not r.auth_required]
+        internal_blocks = "\n".join(
+            f"    location = {p} {{ return 403; }}"
+            for p in sorted(INTERNAL_PATHS))
+        route_table = "\n".join(
+            f"    #   {','.join(r.methods):<11s} {r.path}"
+            f"  [{'jwt' if r.auth_required else 'public'}]"
+            for r in routes)
+        conf = f"""{self.header_comment(spec)}
+# Complete main nginx.conf: drop in as /etc/nginx/nginx.conf (or strip
+# the events/http wrappers to use as a conf.d include).
+#
+# Route table served by the upstream ({len(public)} public, {len(guarded)} jwt-guarded):
+{route_table}
+
+worker_processes auto;
+
+events {{
+    worker_connections 1024;
+}}
+
+http {{
+
+limit_req_zone $binary_remote_addr zone=api:10m rate={self.rate_limit_rps}r/s;
+
+upstream copilot_pipeline {{
+    server {self.upstream};
+    keepalive 32;
+}}
+
+server {{
+    listen 443 ssl;
+    http2 on;
+    server_name _;
+
+    ssl_certificate     /etc/nginx/certs/server.crt;
+    ssl_certificate_key /etc/nginx/certs/server.key;
+
+    client_max_body_size 64m;   # mbox archive uploads
+    add_header X-Content-Type-Options nosniff always;
+    add_header X-Frame-Options DENY always;
+    add_header Referrer-Policy no-referrer always;
+
+    location / {{
+        limit_req zone=api burst={self.rate_limit_rps * 2} nodelay;
+        proxy_pass http://copilot_pipeline;
+        proxy_http_version 1.1;
+        proxy_set_header Connection "";
+        proxy_set_header Host $host;
+        proxy_set_header X-Real-IP $remote_addr;
+        proxy_set_header X-Forwarded-For $proxy_add_x_forwarded_for;
+        proxy_set_header X-Forwarded-Proto $scheme;
+        proxy_read_timeout 300s;    # long-context summarization requests
+    }}
+
+    # Probe/scrape endpoints stay cluster-internal: Prometheus and the
+    # compose healthchecks hit the upstream directly, never this edge.
+{internal_blocks}
+}}
+
+server {{
+    listen 80;
+    return 301 https://$host$request_uri;
+}}
+
+}}
+"""
+        return {"nginx.conf": conf}
+
+
+@dataclass
+class AzureApimAdapter(GatewayAdapter):
+    """Emit Azure API Management artifacts: an ARM template importing the
+    spec plus a policy XML validating our locally-minted RS256 JWTs
+    against the pipeline's JWKS endpoint."""
+
+    name: str = "azure"
+
+    def generate(self, spec: Mapping[str, Any]) -> dict[str, str]:
+        info = spec.get("info", {})
+        api_name = "copilot-for-consensus"
+        template = {
+            "$schema": "https://schema.management.azure.com/schemas/"
+                       "2019-04-01/deploymentTemplate.json#",
+            "contentVersion": f"{info.get('version', '0.0.0')}.0",
+            "parameters": {
+                "apimServiceName": {"type": "string"},
+                "backendUrl": {
+                    "type": "string",
+                    "defaultValue": f"https://{self.upstream}",
+                },
+            },
+            "resources": [
+                {
+                    # The policy below references {{copilot-backend-url}}
+                    # so the discovery fetch targets the real deployed
+                    # backend, not a baked-in compose hostname.
+                    "type": "Microsoft.ApiManagement/service/namedValues",
+                    "apiVersion": "2022-08-01",
+                    "name": "[concat(parameters('apimServiceName'), "
+                            "'/copilot-backend-url')]",
+                    "properties": {
+                        "displayName": "copilot-backend-url",
+                        "value": "[parameters('backendUrl')]",
+                    },
+                },
+                {
+                    "type": "Microsoft.ApiManagement/service/apis",
+                    "apiVersion": "2022-08-01",
+                    "name": f"[concat(parameters('apimServiceName'), "
+                            f"'/{api_name}')]",
+                    "properties": {
+                        "displayName": info.get("title", api_name),
+                        "path": "",
+                        "protocols": ["https"],
+                        "format": "openapi+json",
+                        "value": json.dumps(spec, sort_keys=True),
+                        "serviceUrl": "[parameters('backendUrl')]",
+                        "subscriptionRequired": False,
+                    },
+                },
+                {
+                    "type": "Microsoft.ApiManagement/service/apis/policies",
+                    "apiVersion": "2022-08-01",
+                    "name": f"[concat(parameters('apimServiceName'), "
+                            f"'/{api_name}/policy')]",
+                    "dependsOn": [
+                        f"[resourceId('Microsoft.ApiManagement/service/apis', "
+                        f"parameters('apimServiceName'), '{api_name}')]",
+                        "[resourceId('Microsoft.ApiManagement/service/"
+                        "namedValues', parameters('apimServiceName'), "
+                        "'copilot-backend-url')]",
+                    ],
+                    "properties": {
+                        "format": "rawxml",
+                        "value": self._policy_xml(spec),
+                    },
+                },
+            ],
+        }
+        return {
+            "apim_template.json": json.dumps(template, indent=2,
+                                             sort_keys=True) + "\n",
+            "apim_policy.xml": self._policy_xml(spec),
+        }
+
+    def _policy_xml(self, spec: Mapping[str, Any]) -> str:
+        # APIM policy: skip JWT validation for the public allowlist,
+        # validate via OIDC discovery for everything else. Templated
+        # paths (/ui/{asset}) become anchored regexes so real requests
+        # (/ui/app.js) match; literal characters are regex-escaped so
+        # '.' in /.well-known/... cannot widen the public surface.
+        patterns = sorted(path_regex(r.path).strip("^$")
+                          for r in self.public_routes(spec))
+        alternation = "|".join(patterns)
+        return f"""<policies>
+  <inbound>
+    <base />
+    <rate-limit calls="{self.rate_limit_rps * 60}" renewal-period="60" />
+    <choose>
+      <when condition="@(!System.Text.RegularExpressions.Regex.IsMatch(
+          context.Request.OriginalUrl.Path,
+          @&quot;^({alternation})$&quot;))">
+        <validate-jwt header-name="Authorization" failed-validation-httpcode="401">
+          <openid-config url="{{{{copilot-backend-url}}}}{self.oidc_discovery_path}" />
+          <audiences><audience>{self.audience}</audience></audiences>
+          <issuers><issuer>{self.issuer}</issuer></issuers>
+        </validate-jwt>
+      </when>
+    </choose>
+  </inbound>
+  <backend><base /></backend>
+  <outbound><base /></outbound>
+  <on-error><base /></on-error>
+</policies>
+"""
+
+
+@dataclass
+class AwsApiGatewayAdapter(GatewayAdapter):
+    """Emit a CloudFormation template for an HTTP API (API Gateway v2)
+    with per-route JWT authorizers pointing at the pipeline's JWKS."""
+
+    name: str = "aws"
+
+    def generate(self, spec: Mapping[str, Any]) -> dict[str, str]:
+        info = spec.get("info", {})
+        resources: dict[str, Any] = {
+            "HttpApi": {
+                "Type": "AWS::ApiGatewayV2::Api",
+                "Properties": {
+                    "Name": info.get("title", "copilot-for-consensus"),
+                    "ProtocolType": "HTTP",
+                    "Version": info.get("version", "0.0.0"),
+                },
+            },
+            "Integration": {
+                "Type": "AWS::ApiGatewayV2::Integration",
+                "Properties": {
+                    "ApiId": {"Ref": "HttpApi"},
+                    "IntegrationType": "HTTP_PROXY",
+                    "IntegrationMethod": "ANY",
+                    "IntegrationUri": {"Fn::Sub": "https://${BackendHost}"},
+                    "PayloadFormatVersion": "1.0",
+                },
+            },
+            # API Gateway v2 JWT authorizers resolve signing keys via
+            # OIDC discovery at {Issuer}/.well-known/openid-configuration,
+            # so the issuer MUST be the public HTTPS URL of the pipeline
+            # — and the app must mint the same value (config
+            # auth.issuer), which it serves discovery under.
+            "JwtAuthorizer": {
+                "Type": "AWS::ApiGatewayV2::Authorizer",
+                "Properties": {
+                    "ApiId": {"Ref": "HttpApi"},
+                    "AuthorizerType": "JWT",
+                    "Name": "copilot-jwt",
+                    "IdentitySource": ["$request.header.Authorization"],
+                    "JwtConfiguration": {
+                        "Audience": [self.audience],
+                        "Issuer": {"Ref": "IssuerUrl"},
+                    },
+                },
+            },
+            "Stage": {
+                "Type": "AWS::ApiGatewayV2::Stage",
+                "Properties": {
+                    "ApiId": {"Ref": "HttpApi"},
+                    "StageName": "$default",
+                    "AutoDeploy": True,
+                    "DefaultRouteSettings": {
+                        "ThrottlingRateLimit": self.rate_limit_rps,
+                        "ThrottlingBurstLimit": self.rate_limit_rps * 2,
+                    },
+                },
+            },
+        }
+        for i, route in enumerate(self.edge_routes(spec)):
+            for method in route.methods:
+                logical = f"Route{i}{method.capitalize()}"
+                props: dict[str, Any] = {
+                    "ApiId": {"Ref": "HttpApi"},
+                    "RouteKey": f"{method} {route.aws_path}",
+                    "Target": {
+                        "Fn::Sub": "integrations/${Integration}",
+                    },
+                }
+                if route.auth_required:
+                    props["AuthorizationType"] = "JWT"
+                    props["AuthorizerId"] = {"Ref": "JwtAuthorizer"}
+                resources[logical] = {
+                    "Type": "AWS::ApiGatewayV2::Route",
+                    "Properties": props,
+                }
+        template = {
+            "AWSTemplateFormatVersion": "2010-09-09",
+            "Description": f"{info.get('title', '?')} edge "
+                           "(generated from the OpenAPI spec)",
+            "Parameters": {
+                "BackendHost": {
+                    "Type": "String",
+                    "Default": self.upstream,
+                },
+                "IssuerUrl": {
+                    "Type": "String",
+                    "Description":
+                        "Public HTTPS URL of the pipeline. Must equal the "
+                        "app's auth.issuer config; the app serves OIDC "
+                        "discovery at <IssuerUrl>/.well-known/"
+                        "openid-configuration.",
+                    "Default": "https://copilot.example.com",
+                },
+            },
+            "Resources": resources,
+        }
+        return {"cloudformation.json":
+                json.dumps(template, indent=2, sort_keys=True) + "\n"}
+
+
+@dataclass
+class GcpApiGatewayAdapter(GatewayAdapter):
+    """Emit a GCP API Gateway config: OpenAPI 2.0 (swagger) with
+    ``x-google-backend`` routing and JWT security definitions — the
+    dialect GCP API Gateway/Cloud Endpoints actually ingests."""
+
+    name: str = "gcp"
+
+    def generate(self, spec: Mapping[str, Any]) -> dict[str, str]:
+        info = spec.get("info", {})
+        paths: dict[str, Any] = {}
+        for route in self.edge_routes(spec):
+            ops: dict[str, Any] = {}
+            for method in route.methods:
+                op: dict[str, Any] = {
+                    "operationId": f"{method.lower()}_" + (
+                        route.path.strip("/").replace("/", "_")
+                        .replace("{", "").replace("}", "") or "root"),
+                    "responses": {"200": {"description": "OK"}},
+                }
+                if route.auth_required:
+                    op["security"] = [{"copilot_jwt": []}]
+                ops[method.lower()] = op
+            # Path params must be declared in swagger 2.0.
+            params = [seg[1:-1] for seg in route.path.split("/")
+                      if seg.startswith("{") and seg.endswith("}")]
+            if params:
+                ops["parameters"] = [
+                    {"name": p, "in": "path", "required": True,
+                     "type": "string"} for p in params]
+            paths[route.gcp_path] = ops
+        swagger = {
+            "swagger": "2.0",
+            "info": {
+                "title": info.get("title", "copilot-for-consensus"),
+                "version": info.get("version", "0.0.0"),
+            },
+            "schemes": ["https"],
+            "produces": ["application/json"],
+            "x-google-backend": {
+                "address": f"https://{self.upstream}",
+                "protocol": "h2",
+            },
+            "securityDefinitions": {
+                "copilot_jwt": {
+                    "authorizationUrl": "",
+                    "flow": "implicit",
+                    "type": "oauth2",
+                    "x-google-issuer": self.issuer,
+                    "x-google-jwks_uri":
+                        f"https://{self.upstream}{self.jwks_path}",
+                    "x-google-audiences": self.audience,
+                },
+            },
+            "paths": paths,
+        }
+        return {"api_gateway.json":
+                json.dumps(swagger, indent=2, sort_keys=True) + "\n"}
+
+
+_ADAPTERS = {
+    "nginx": NginxAdapter,
+    "azure": AzureApimAdapter,
+    "aws": AwsApiGatewayAdapter,
+    "gcp": GcpApiGatewayAdapter,
+}
+
+
+def create_gateway_adapter(provider: str, **kwargs: Any) -> GatewayAdapter:
+    """Factory, same dispatch shape as every other adapter package."""
+    try:
+        cls = _ADAPTERS[provider]
+    except KeyError:
+        raise ValueError(
+            f"unknown gateway provider {provider!r}; "
+            f"expected one of {sorted(_ADAPTERS)}") from None
+    return cls(**kwargs)
+
+
+def all_providers() -> list[str]:
+    return sorted(_ADAPTERS)

@@ -278,6 +278,7 @@ class AuthService:
 
 PUBLIC_PATHS = ("/health", "/readyz", "/metrics", "/auth/login",
                 "/auth/callback", "/.well-known/jwks.json",
+                "/.well-known/openid-configuration",
                 # The SPA shell and its assets are public; every API call
                 # the SPA makes still carries the bearer token.
                 "/", "/ui", "/api/openapi.json")
@@ -359,6 +360,31 @@ def auth_router(service: AuthService):
     @router.get("/.well-known/jwks.json")
     def jwks(req):
         return service.get_jwks()
+
+    @router.get("/.well-known/openid-configuration")
+    def openid_configuration(req):
+        """OIDC discovery document for edge-gateway JWT validation.
+
+        Gateways like Azure APIM's validate-jwt resolve signing keys via
+        discovery rather than a raw JWKS URL; strict consumers also
+        require the authorization/token endpoints and standard response
+        types, so the full REQUIRED metadata set is advertised."""
+        host = (req.headers.get("host") or req.headers.get("Host")
+                or "localhost")
+        # Behind the TLS edge the advertised URLs must be https — the
+        # generated nginx config forwards the original scheme.
+        proto = (req.headers.get("x-forwarded-proto")
+                 or req.headers.get("X-Forwarded-Proto") or "http")
+        base = f"{proto}://{host}"
+        return {
+            "issuer": service.jwt.issuer,
+            "authorization_endpoint": f"{base}/auth/login",
+            "token_endpoint": f"{base}/auth/callback",
+            "jwks_uri": f"{base}/.well-known/jwks.json",
+            "id_token_signing_alg_values_supported": ["RS256"],
+            "response_types_supported": ["code", "id_token"],
+            "subject_types_supported": ["public"],
+        }
 
     @router.get("/auth/admin/users")
     def list_users(req):

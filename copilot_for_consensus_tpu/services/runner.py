@@ -190,6 +190,25 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
                 "roles requires an inter-process bus (bus.driver broker); "
                 "on the in-proc bus unowned stages' events would never "
                 "be consumed")
+        # Same silent-split hazard for state: with a defaulted private
+        # in-memory store, the other role's process would look up ids in
+        # its own empty store and DLQ every event. Tests that rewire
+        # store objects across in-process "roles" opt out explicitly.
+        if not cfg.get("unsafe_private_stores"):
+            for section, default_driver in (("document_store", "memory"),
+                                            ("vector_store", "memory")):
+                sec = dict(cfg.get(section) or {})
+                driver = sec.get("driver", default_driver)
+                # sqlite ":memory:" is equally private (one db per
+                # connection — sqlite.py holds one per thread).
+                if driver == "memory" or (driver == "sqlite" and
+                                          sec.get("path") == ":memory:"):
+                    raise ValueError(
+                        f"roles requires a shared {section} (e.g. sqlite "
+                        f"on a shared volume): a private in-memory "
+                        f"{section} would leave the peer process reading "
+                        f"empty state (set unsafe_private_stores to "
+                        f"override in tests)")
     broker = InProcBroker()
     store = create_document_store(cfg.get("document_store",
                                           {"driver": "memory"}))

@@ -1,0 +1,168 @@
+"""Infra stats exporters: store / vector-store / processing-status gauges.
+
+The roles of the reference's three exporter scripts —
+``scripts/mongo_collstats_exporter.py`` (per-collection document
+counts/sizes), ``scripts/qdrant_exporter.py`` (vector count/dimension),
+and ``scripts/document_processing_exporter.py`` (how many documents sit
+unprocessed at each pipeline stage) — folded into one exporter because
+this framework's stores are first-party drivers, not external servers
+with their own stats protocols.
+
+The exporter computes gauges on demand (each scrape re-queries the
+store, like the originals), renders Prometheus text exposition, and can
+run standalone via the CLI::
+
+    python -m copilot_for_consensus_tpu exporters --config cfg.json --port 9105
+    python -m copilot_for_consensus_tpu exporters --config cfg.json --once
+
+The pending-stage gauges reuse the *same* stuck-document filters the
+retry job acts on (``tools/retry_job.py:default_rules``), so the alert
+pack (``infra/prometheus/alerts/``) watches exactly what the recovery
+machinery will requeue.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+from copilot_for_consensus_tpu.storage.registry import KNOWN_COLLECTIONS
+from copilot_for_consensus_tpu.tools.retry_job import default_rules
+
+
+@dataclass
+class StatsExporter:
+    """Scrape-time gauge computation over first-party stores."""
+
+    store: Any                      # DocumentStore
+    vector_store: Any = None        # VectorStore | None
+    namespace: str = "copilot"
+    collections: tuple[str, ...] = KNOWN_COLLECTIONS
+
+    def collect(self) -> InMemoryMetrics:
+        """Recompute every gauge from live store state.
+
+        A fresh metrics object per scrape: carrying state across
+        scrapes would leave stale series (e.g. a healthy-looking
+        dimension gauge) standing next to an error sentinel after a
+        partial failure."""
+        m = InMemoryMetrics(namespace=self.namespace)
+        t0 = time.monotonic()
+        for coll in self.collections:
+            try:
+                n = self.store.count_documents(coll)
+            except Exception:
+                n = -1  # collection unreadable: surface as -1, not absence
+            m.gauge("collection_documents", float(n),
+                    labels={"collection": coll})
+        for rule in default_rules():
+            try:
+                pending = self.store.count_documents(rule.collection,
+                                                     rule.stuck_filter)
+            except Exception:
+                pending = -1
+            m.gauge("documents_pending", float(pending),
+                    labels={"collection": rule.collection,
+                            "stage": _stage_name(rule.collection)})
+        if self.vector_store is not None:
+            try:
+                m.gauge("vectorstore_vectors",
+                        float(self.vector_store.count()))
+                dim = self.vector_store.dimension
+                if dim:
+                    m.gauge("vectorstore_dimension", float(dim))
+            except Exception:
+                m.gauge("vectorstore_vectors", -1.0)
+        m.gauge("exporter_scrape_seconds", time.monotonic() - t0)
+        return m
+
+    def render(self) -> str:
+        return self.collect().render_prometheus()
+
+
+def _stage_name(collection: str) -> str:
+    return {
+        "archives": "parsing",
+        "messages": "chunking",
+        "chunks": "embedding",
+    }.get(collection, collection)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="exporters",
+        description="Prometheus stats exporter for the document/vector "
+                    "stores")
+    ap.add_argument("--config", default=None,
+                    help="pipeline JSON config (storage + vector_store "
+                         "sections)")
+    ap.add_argument("--port", type=int, default=9105)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--once", action="store_true",
+                    help="print one exposition to stdout and exit")
+    args = ap.parse_args(argv)
+
+    from copilot_for_consensus_tpu.storage import create_document_store
+    from copilot_for_consensus_tpu.vectorstore import create_vector_store
+
+    cfg: dict[str, Any] = {}
+    if args.config:
+        with open(args.config) as fh:
+            cfg = json.load(fh)
+    # Same config section and default the other operator tools use
+    # (__main__.py retry-job / export-data): "document_store", falling
+    # back to the sqlite driver — an accidental in-memory store would
+    # export 0 for every gauge forever without erroring.
+    store = create_document_store(cfg.get("document_store")
+                                  or cfg.get("storage")
+                                  or {"driver": "sqlite"})
+    store.connect()
+    vs = None
+    if cfg.get("vector_store"):
+        vs = create_vector_store(cfg["vector_store"])
+        vs.connect()
+        persist = cfg["vector_store"].get("persist_path")
+        if persist:
+            import pathlib
+            if pathlib.Path(persist).exists():
+                vs.load(persist)
+
+    exporter = StatsExporter(store=store, vector_store=vs)
+    if args.once:
+        print(exporter.render(), end="")
+        return 0
+
+    from copilot_for_consensus_tpu.services.http import (
+        HTTPServer,
+        Response,
+        Router,
+    )
+
+    router = Router()
+
+    @router.get("/metrics")
+    def _metrics(req):
+        return Response(exporter.render(),
+                        content_type="text/plain; version=0.0.4")
+
+    @router.get("/health")
+    def _health(req):
+        return Response({"status": "ok"})
+    server = HTTPServer(router, args.host, args.port)
+    server.start()
+    print(json.dumps({"event": "exporter_listening", "port": server.port}),
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

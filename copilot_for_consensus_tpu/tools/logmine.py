@@ -1,0 +1,224 @@
+"""Offline log template mining over the structured JSON log stream.
+
+The role of the reference's Drain3 log mining
+(``scripts/log_mining/mining.py``): cluster raw log messages into
+templates with ``<*>`` wildcards so an operator can see *what kinds* of
+lines a noisy incident produced, which templates are new/rare, and which
+carry the errors — without grepping megabytes of JSON.
+
+Independent implementation of the fixed-depth-parse-tree idea (Drain,
+He et al. 2017): messages are tokenized on whitespace, routed through a
+small prefix tree keyed on token count and the first ``depth`` tokens
+(number-bearing tokens wildcarded at routing time so ids don't explode
+the tree), then greedily merged into the best-matching cluster above a
+similarity threshold. Clusters keep per-level counts and one example.
+
+CLI (matching the repo's other operator tools in ``tools/``):
+
+    python -m copilot_for_consensus_tpu logmine pipeline.log [...]
+    ... logmine --min-count 5 --json < merged.log
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Iterable, TextIO
+
+WILDCARD = "<*>"
+_NUMBERY = re.compile(r"\d")
+_HEXISH = re.compile(r"^[0-9a-fA-F]{8,}$")
+
+
+def _route_token(tok: str) -> str:
+    """Tree-routing view of a token: anything id-like becomes a wildcard
+    so the prefix tree stays small and ids never split clusters."""
+    if _NUMBERY.search(tok) or _HEXISH.match(tok):
+        return WILDCARD
+    return tok
+
+
+@dataclass
+class Cluster:
+    """One mined template and its occurrence statistics."""
+
+    template: list[str]
+    count: int = 0
+    by_level: dict[str, int] = field(default_factory=dict)
+    example: str = ""
+
+    def similarity(self, tokens: list[str]) -> float:
+        """Fraction of positions matching (wildcards always match)."""
+        if len(tokens) != len(self.template):
+            return 0.0
+        if not tokens:
+            return 1.0
+        same = sum(1 for a, b in zip(self.template, tokens)
+                   if a == b or a == WILDCARD)
+        return same / len(tokens)
+
+    def absorb(self, tokens: list[str], level: str, raw: str) -> None:
+        self.template = [a if a == b else WILDCARD
+                         for a, b in zip(self.template, tokens)]
+        self.count += 1
+        self.by_level[level] = self.by_level.get(level, 0) + 1
+        if not self.example:
+            self.example = raw
+
+    @property
+    def text(self) -> str:
+        return " ".join(self.template)
+
+    @property
+    def error_count(self) -> int:
+        return (self.by_level.get("error", 0)
+                + self.by_level.get("critical", 0))
+
+
+class LogMiner:
+    """Fixed-depth parse tree → greedy cluster merge (Drain-style)."""
+
+    def __init__(self, depth: int = 3, sim_threshold: float = 0.5,
+                 max_children: int = 64):
+        self.depth = depth
+        self.sim_threshold = sim_threshold
+        self.max_children = max_children
+        # tree: token_count -> routing-token path -> list[Cluster]
+        self._tree: dict[int, dict[tuple[str, ...], list[Cluster]]] = {}
+        self.total = 0
+        self.skipped = 0
+
+    # Ingestion -----------------------------------------------------
+
+    def add_line(self, line: str) -> None:
+        """Accept one raw line: JSON log records preferred, plain text
+        tolerated (message = whole line, level = unknown)."""
+        line = line.strip()
+        if not line:
+            return
+        message, level = line, "unknown"
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+                message = str(rec.get("message", line))
+                level = str(rec.get("level", "unknown")).lower()
+            except (json.JSONDecodeError, AttributeError):
+                self.skipped += 1
+                return
+        self.add_message(message, level)
+
+    def add_message(self, message: str, level: str = "unknown") -> None:
+        tokens = message.split()
+        key = tuple(_route_token(t) for t in tokens[:self.depth])
+        leaves = self._tree.setdefault(len(tokens), {})
+        bucket = leaves.get(key)
+        if bucket is None:
+            if len(leaves) >= self.max_children:
+                # Route overflow into a catch-all leaf rather than
+                # growing without bound on adversarial token soup.
+                key = (WILDCARD,) * min(len(tokens), self.depth)
+            bucket = leaves.setdefault(key, [])
+        best, best_sim = None, 0.0
+        for cluster in bucket:
+            sim = cluster.similarity(tokens)
+            if sim > best_sim:
+                best, best_sim = cluster, sim
+        if best is not None and best_sim >= self.sim_threshold:
+            best.absorb(tokens, level, message)
+        else:
+            fresh = Cluster(template=list(tokens))
+            fresh.absorb(tokens, level, message)
+            bucket.append(fresh)
+        self.total += 1
+
+    def add_stream(self, lines: Iterable[str]) -> None:
+        for line in lines:
+            self.add_line(line)
+
+    # Reporting -----------------------------------------------------
+
+    @property
+    def clusters(self) -> list[Cluster]:
+        out = [c for leaves in self._tree.values()
+               for bucket in leaves.values() for c in bucket]
+        return sorted(out, key=lambda c: (-c.count, c.text))
+
+    def report(self, min_count: int = 1) -> dict:
+        clusters = [c for c in self.clusters if c.count >= min_count]
+        return {
+            "total_lines": self.total,
+            "skipped_lines": self.skipped,
+            "n_templates": len(clusters),
+            "templates": [
+                {
+                    "template": c.text,
+                    "count": c.count,
+                    "by_level": dict(sorted(c.by_level.items())),
+                    "errors": c.error_count,
+                    "example": c.example,
+                }
+                for c in clusters
+            ],
+            # The operator shortlists: noisy errors and rare one-offs.
+            # Rare templates come from the UNfiltered cluster list —
+            # min_count hides them from the main table, but a one-off
+            # is precisely what the rare shortlist exists to surface.
+            "top_error_templates": [
+                c.text for c in sorted(clusters,
+                                       key=lambda c: -c.error_count)
+                if c.error_count][:10],
+            "rare_templates": [c.text for c in self.clusters
+                               if c.count == 1][:20],
+        }
+
+
+def _render_text(report: dict, out: TextIO) -> None:
+    out.write(f"{report['total_lines']} lines -> "
+              f"{report['n_templates']} templates "
+              f"({report['skipped_lines']} unparseable)\n\n")
+    width = max((len(str(t["count"])) for t in report["templates"]),
+                default=1)
+    for t in report["templates"]:
+        levels = ",".join(f"{k}:{v}" for k, v in t["by_level"].items())
+        out.write(f"{t['count']:>{width}}  [{levels}]  {t['template']}\n")
+    if report["top_error_templates"]:
+        out.write("\nerror-bearing templates:\n")
+        for text in report["top_error_templates"]:
+            out.write(f"  ! {text}\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="logmine", description=__doc__.split("\n\n")[0])
+    ap.add_argument("files", nargs="*",
+                    help="JSON-lines log files (default: stdin)")
+    ap.add_argument("--min-count", type=int, default=1,
+                    help="hide templates seen fewer times than this")
+    ap.add_argument("--sim-threshold", type=float, default=0.5)
+    ap.add_argument("--depth", type=int, default=3)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full JSON report")
+    args = ap.parse_args(argv)
+
+    miner = LogMiner(depth=args.depth, sim_threshold=args.sim_threshold)
+    if args.files:
+        for name in args.files:
+            with open(name, "r", encoding="utf-8", errors="replace") as fh:
+                miner.add_stream(fh)
+    else:
+        miner.add_stream(sys.stdin)
+
+    report = miner.report(min_count=args.min_count)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        _render_text(report, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

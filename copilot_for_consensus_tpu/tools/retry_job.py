@@ -74,18 +74,24 @@ class RetryStuckDocumentsJob:
     publisher: Any
     rules: list[RetryRule] = field(default_factory=default_rules)
     min_stuck_seconds: float = 300.0
+    # Batch jobs can't be scraped, so the sweep pushes its counters on
+    # completion (reference: every pipeline service safe_push()es after
+    # each event; its retry job is the canonical pushgateway client).
+    metrics: Any = None
 
     def run_once(self, now: float | None = None) -> dict[str, int]:
         """One sweep; returns per-collection requeue counts."""
         now = time.time() if now is None else now
+        t0 = time.monotonic()
         counts: dict[str, int] = {}
         for rule in self.rules:
             pk = self._primary_key(rule.collection)
-            n = 0
+            n = exhausted = 0
             for doc in self.store.query_documents(rule.collection,
                                                   rule.stuck_filter):
                 attempts = int(doc.get("attempt_count", 0))
                 if attempts >= rule.max_attempts:
+                    exhausted += 1
                     continue
                 ref_ts = doc.get("last_attempt_at") or doc.get(
                     "ingested_at") or doc.get("parsed_at")
@@ -101,6 +107,19 @@ class RetryStuckDocumentsJob:
                 })
                 n += 1
             counts[rule.collection] = n
+            if self.metrics is not None:
+                labels = {"collection": rule.collection}
+                self.metrics.increment("retry_requeued_total", n,
+                                       labels=labels)
+                # Documents past max_attempts need operator attention —
+                # the sweep will never touch them again.
+                self.metrics.gauge("retry_exhausted_documents",
+                                   float(exhausted), labels=labels)
+        if self.metrics is not None:
+            self.metrics.observe("retry_sweep_seconds",
+                                 time.monotonic() - t0)
+            self.metrics.gauge("retry_last_sweep_timestamp", time.time())
+            self.metrics.safe_push()
         return counts
 
     @staticmethod

@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Generate per-provider gateway configs from the committed OpenAPI spec.
+
+    python scripts/generate_gateway_config.py                 # all providers
+    python scripts/generate_gateway_config.py --provider nginx
+    python scripts/generate_gateway_config.py --output /tmp/gw
+
+Capability parity with the reference's
+``infra/gateway/generate_gateway_config.py`` CLI. Outputs land under
+``infra/gateway/<provider>/`` and are kept fresh by
+``tests/test_gateway_config.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SPEC = REPO / "copilot_for_consensus_tpu" / "schemas" / "openapi.json"
+DEFAULT_OUT = REPO / "infra" / "gateway"
+
+
+def generate(providers: list[str], out_dir: pathlib.Path,
+             **adapter_kwargs) -> list[pathlib.Path]:
+    from copilot_for_consensus_tpu.gateway import create_gateway_adapter
+
+    spec = json.loads(SPEC.read_text())
+    written: list[pathlib.Path] = []
+    for provider in providers:
+        adapter = create_gateway_adapter(provider, **adapter_kwargs)
+        target = out_dir / provider
+        target.mkdir(parents=True, exist_ok=True)
+        for rel, content in sorted(adapter.generate(spec).items()):
+            path = target / rel
+            path.write_text(content)
+            written.append(path)
+    return written
+
+
+def main() -> int:
+    from copilot_for_consensus_tpu.gateway.providers import all_providers
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--provider", default="all",
+                    choices=["all", *all_providers()])
+    ap.add_argument("--output", type=pathlib.Path, default=DEFAULT_OUT)
+    ap.add_argument("--upstream-host", default="pipeline",
+                    help="backend host the edge forwards to")
+    ap.add_argument("--upstream-port", type=int, default=8080)
+    ap.add_argument("--issuer", default="copilot",
+                    help="must equal the app's auth.issuer config")
+    ap.add_argument("--audience", default="copilot-api")
+    args = ap.parse_args()
+
+    providers = all_providers() if args.provider == "all" else [args.provider]
+    for path in generate(providers, args.output,
+                         upstream_host=args.upstream_host,
+                         upstream_port=args.upstream_port,
+                         issuer=args.issuer,
+                         audience=args.audience):
+        print(path.relative_to(pathlib.Path.cwd())
+              if path.is_relative_to(pathlib.Path.cwd()) else path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -380,11 +380,13 @@ def test_role_split_processes_complete_pipeline(live_broker, fixtures_dir):
     bus = {"driver": "broker", "address": live_broker.address}
     host = build_pipeline({
         "bus": bus,
-        "roles": ["ingestion", "parsing", "chunking", "reporting"]})
+        "roles": ["ingestion", "parsing", "chunking", "reporting"],
+        "unsafe_private_stores": True})
     engine = build_pipeline({
         "bus": bus,
         "roles": ["embedding", "orchestrator", "summarization"],
-        "document_store": {"driver": "memory"}})
+        "document_store": {"driver": "memory"},
+        "unsafe_private_stores": True})
     # Shared store across "processes" for this in-test split: point the
     # engine's services at the host's store objects.
     for svc in engine.services:
@@ -414,3 +416,24 @@ def test_unknown_role_rejected():
 
     with pytest.raises(ValueError, match="unknown roles"):
         build_pipeline({"roles": ["ingestion", "nonsense"]})
+
+
+def test_role_split_with_private_store_rejected(live_broker, tmp_path):
+    """A role-scoped process with a defaulted in-memory store would
+    silently read empty state while its peer writes elsewhere — that
+    misconfiguration must fail at build time, not DLQ every event."""
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    bus = {"driver": "broker", "address": live_broker.address}
+    with pytest.raises(ValueError, match="shared document_store"):
+        build_pipeline({"bus": bus, "roles": ["ingestion", "parsing"]})
+    # sqlite ":memory:" is just as private as the memory driver.
+    with pytest.raises(ValueError, match="shared document_store"):
+        build_pipeline({
+            "bus": bus, "roles": ["ingestion", "parsing"],
+            "document_store": {"driver": "sqlite", "path": ":memory:"}})
+    with pytest.raises(ValueError, match="shared vector_store"):
+        build_pipeline({
+            "bus": bus, "roles": ["ingestion", "parsing"],
+            "document_store": {"driver": "sqlite",
+                               "path": str(tmp_path / "docs.sqlite3")}})

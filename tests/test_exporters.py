@@ -1,0 +1,105 @@
+"""Stats exporters (tools/exporters.py) — roles of the reference's
+mongo_collstats / qdrant / document_processing exporter scripts."""
+
+from __future__ import annotations
+
+from copilot_for_consensus_tpu.storage import create_document_store
+from copilot_for_consensus_tpu.storage.registry import KNOWN_COLLECTIONS
+from copilot_for_consensus_tpu.tools.exporters import StatsExporter
+from copilot_for_consensus_tpu.vectorstore import create_vector_store
+
+
+def _store_with_docs():
+    store = create_document_store({"driver": "memory"})
+    store.connect()
+    store.insert_document("archives", {"archive_id": "a1", "sha256": "0" * 64,
+                                       "parsed": True})
+    store.insert_document("archives", {"archive_id": "a2", "sha256": "1" * 64,
+                                       "parsed": False})
+    for i in range(3):
+        store.insert_document("chunks", {
+            "chunk_id": f"c{i}", "message_doc_id": f"m{i}", "thread_id": "t",
+            "text": "body", "embedding_generated": i == 0})
+    return store
+
+
+def test_collection_counts_exported():
+    exporter = StatsExporter(store=_store_with_docs())
+    metrics = exporter.collect()
+    assert metrics.gauge_value("collection_documents",
+                               {"collection": "archives"}) == 2
+    assert metrics.gauge_value("collection_documents",
+                               {"collection": "chunks"}) == 3
+    # every known collection is present, even empty ones
+    for coll in KNOWN_COLLECTIONS:
+        assert metrics.gauge_value("collection_documents",
+                                   {"collection": coll}) >= 0
+
+
+def test_pending_stage_gauges_match_retry_filters():
+    exporter = StatsExporter(store=_store_with_docs())
+    metrics = exporter.collect()
+    assert metrics.gauge_value("documents_pending",
+                               {"collection": "archives",
+                                "stage": "parsing"}) == 1
+    assert metrics.gauge_value("documents_pending",
+                               {"collection": "chunks",
+                                "stage": "embedding"}) == 2
+
+
+def test_vectorstore_gauges():
+    vs = create_vector_store({"driver": "memory"})
+    vs.connect()
+    vs.add_embedding("v1", [0.1, 0.2, 0.3], {})
+    vs.add_embedding("v2", [0.4, 0.5, 0.6], {})
+    exporter = StatsExporter(store=_store_with_docs(), vector_store=vs)
+    metrics = exporter.collect()
+    assert metrics.gauge_value("vectorstore_vectors") == 2
+    assert metrics.gauge_value("vectorstore_dimension") == 3
+
+
+def test_render_is_prometheus_text():
+    exporter = StatsExporter(store=_store_with_docs())
+    text = exporter.render()
+    assert 'copilot_collection_documents{collection="archives"} 2' in text
+    assert "copilot_exporter_scrape_seconds" in text
+
+
+def test_unreadable_store_surfaces_minus_one():
+    class Broken:
+        def count_documents(self, *a, **k):
+            raise RuntimeError("down")
+
+    exporter = StatsExporter(store=Broken())
+    metrics = exporter.collect()
+    assert metrics.gauge_value("collection_documents",
+                               {"collection": "archives"}) == -1
+
+
+def test_partial_failure_leaves_no_stale_series():
+    """A vector store that dies between scrapes must not leave last
+    scrape's dimension gauge standing next to the -1 error sentinel."""
+    vs = create_vector_store({"driver": "memory"})
+    vs.connect()
+    vs.add_embedding("v1", [0.1, 0.2], {})
+    exporter = StatsExporter(store=_store_with_docs(), vector_store=vs)
+    assert exporter.collect().gauge_value("vectorstore_dimension") == 2
+
+    def _boom():
+        raise RuntimeError("down")
+
+    vs.count = _boom
+    metrics = exporter.collect()
+    assert metrics.gauge_value("vectorstore_vectors") == -1
+    assert "vectorstore_dimension" not in metrics.render_prometheus()
+
+
+def test_scrape_reflects_live_changes():
+    store = _store_with_docs()
+    exporter = StatsExporter(store=store)
+    assert exporter.collect().gauge_value(
+        "collection_documents", {"collection": "archives"}) == 2
+    store.insert_document("archives", {"archive_id": "a3", "sha256": "2" * 64,
+                                       "parsed": True})
+    assert exporter.collect().gauge_value(
+        "collection_documents", {"collection": "archives"}) == 3

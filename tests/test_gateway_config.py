@@ -1,0 +1,169 @@
+"""Gateway config generation: spec → nginx/Azure/AWS/GCP edge configs.
+
+Covers the role of the reference's ``infra/gateway/`` adapter layer:
+one OpenAPI doc drives every provider, the auth boundary is projected
+consistently, and the committed artifacts cannot go stale.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+from copilot_for_consensus_tpu.gateway import (
+    create_gateway_adapter,
+    routes_from_spec,
+)
+from copilot_for_consensus_tpu.gateway.providers import all_providers
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SPEC_PATH = REPO / "copilot_for_consensus_tpu" / "schemas" / "openapi.json"
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return json.loads(SPEC_PATH.read_text())
+
+
+def test_routes_from_spec_distills_auth_boundary(spec):
+    routes = routes_from_spec(spec)
+    assert len(routes) == len(spec["paths"])
+    by_path = {r.path: r for r in routes}
+    # The JWKS endpoint must be public (every provider fetches it to
+    # validate tokens) and the reports API must be guarded.
+    assert not by_path["/.well-known/jwks.json"].auth_required
+    assert by_path["/api/reports"].auth_required
+    assert "GET" in by_path["/api/reports"].methods
+
+
+def test_unknown_provider_rejected():
+    with pytest.raises(ValueError, match="unknown gateway provider"):
+        create_gateway_adapter("heroku")
+
+
+def test_nginx_config_routes_and_protects(spec):
+    adapter = create_gateway_adapter("nginx")
+    conf = adapter.generate(spec)["nginx.conf"]
+    assert "upstream copilot_pipeline" in conf
+    assert "proxy_pass http://copilot_pipeline;" in conf
+    assert "listen 443 ssl" in conf
+    # Probe/scrape endpoints must not be exposed at the public edge.
+    for path in ("/metrics", "/health", "/readyz"):
+        assert f"location = {path} {{ return 403; }}" in conf
+    # Every edge route appears in the embedded route table.
+    for route in adapter.edge_routes(spec):
+        assert route.path in conf
+    assert "limit_req_zone" in conf
+
+
+def test_internal_paths_absent_from_cloud_edges(spec):
+    """Cloud adapters must not forward /metrics, /health, /readyz."""
+    aws = json.loads(create_gateway_adapter("aws").generate(spec)
+                     ["cloudformation.json"])
+    route_keys = {r["Properties"]["RouteKey"]
+                  for r in aws["Resources"].values()
+                  if r["Type"] == "AWS::ApiGatewayV2::Route"}
+    gcp = json.loads(create_gateway_adapter("gcp").generate(spec)
+                     ["api_gateway.json"])
+    for path in ("/metrics", "/health", "/readyz"):
+        assert not any(key.endswith(f" {path}") for key in route_keys)
+        assert path not in gcp["paths"]
+
+
+def test_edge_issuer_matches_app_default(spec):
+    """The generated configs must validate the issuer the app actually
+    mints (services/bootstrap.py: JWTManager issuer='copilot')."""
+    policy = create_gateway_adapter("azure").generate(spec)["apim_policy.xml"]
+    assert "<issuer>copilot</issuer>" in policy
+    # AWS JWT authorizers require an HTTPS URL issuer (discovery-based),
+    # so the issuer is a deploy-time parameter, not the bare app issuer.
+    aws = json.loads(create_gateway_adapter("aws").generate(spec)
+                     ["cloudformation.json"])
+    auth = aws["Resources"]["JwtAuthorizer"]["Properties"]
+    assert auth["JwtConfiguration"]["Issuer"] == {"Ref": "IssuerUrl"}
+    assert "IssuerUrl" in aws["Parameters"]
+    gcp = json.loads(create_gateway_adapter("gcp").generate(spec)
+                     ["api_gateway.json"])
+    assert gcp["securityDefinitions"]["copilot_jwt"][
+        "x-google-issuer"] == "copilot"
+
+
+def test_apim_public_allowlist_matches_templated_paths(spec):
+    """The policy's public-path check is a regex, so templated public
+    routes (/ui/{asset}) admit real asset requests (/ui/app.js)."""
+    import re as _re
+
+    policy = create_gateway_adapter("azure").generate(spec)["apim_policy.xml"]
+    m = _re.search(r'IsMatch\(\s*context\.Request\.OriginalUrl\.Path,\s*'
+                   r'@?&quot;(.+?)&quot;\)', policy, _re.S)
+    assert m, "policy must embed a regex allowlist"
+    pattern = _re.compile(m.group(1))
+    assert pattern.match("/ui/app.js")
+    assert pattern.match("/.well-known/jwks.json")
+    assert not pattern.match("/api/reports")
+    # Literal '.' is escaped: lookalike paths must NOT skip validation.
+    assert not pattern.match("/Xwell-known/jwksXjson")
+    # Discovery URL comes from the deploy-time named value, not a
+    # baked-in compose hostname APIM could never resolve.
+    assert ("{{copilot-backend-url}}/.well-known/openid-configuration"
+            in policy)
+
+
+def test_azure_template_embeds_spec_and_policy(spec):
+    files = create_gateway_adapter("azure").generate(spec)
+    template = json.loads(files["apim_template.json"])
+    api = next(r for r in template["resources"]
+               if r["type"] == "Microsoft.ApiManagement/service/apis")
+    embedded = json.loads(api["properties"]["value"])
+    assert embedded["paths"].keys() == spec["paths"].keys()
+    assert "validate-jwt" in files["apim_policy.xml"]
+
+
+def test_aws_template_one_route_per_method(spec):
+    adapter = create_gateway_adapter("aws")
+    template = json.loads(adapter.generate(spec)["cloudformation.json"])
+    route_resources = [r for r in template["Resources"].values()
+                       if r["Type"] == "AWS::ApiGatewayV2::Route"]
+    expected = sum(len(r.methods) for r in adapter.edge_routes(spec))
+    assert len(route_resources) == expected
+    # Guarded routes carry the JWT authorizer; public routes do not.
+    keys_with_auth = {r["Properties"]["RouteKey"] for r in route_resources
+                      if r["Properties"].get("AuthorizationType") == "JWT"}
+    for route in adapter.edge_routes(spec):
+        for method in route.methods:
+            key = f"{method} {route.path}"
+            assert (key in keys_with_auth) == route.auth_required
+
+
+def test_gcp_swagger_is_valid_and_guarded(spec):
+    adapter = create_gateway_adapter("gcp")
+    swagger = json.loads(adapter.generate(spec)["api_gateway.json"])
+    assert swagger["swagger"] == "2.0"
+    assert "x-google-backend" in swagger
+    assert "copilot_jwt" in swagger["securityDefinitions"]
+    for route in adapter.edge_routes(spec):
+        ops = swagger["paths"][route.path]
+        for method in route.methods:
+            op = ops[method.lower()]
+            assert (op.get("security") == [{"copilot_jwt": []}]) \
+                == route.auth_required
+        # Path params must be declared for swagger 2.0 validity.
+        if "{" in route.path:
+            declared = {p["name"] for p in ops["parameters"]}
+            templated = {seg[1:-1] for seg in route.path.split("/")
+                         if seg.startswith("{")}
+            assert declared == templated
+
+
+def test_committed_configs_are_fresh(spec):
+    """The files under infra/gateway/ must match regeneration output."""
+    for provider in all_providers():
+        adapter = create_gateway_adapter(provider)
+        for rel, content in adapter.generate(spec).items():
+            committed = REPO / "infra" / "gateway" / provider / rel
+            assert committed.exists(), (
+                f"missing {committed}; run scripts/generate_gateway_config.py")
+            assert committed.read_text() == content, (
+                f"{committed} is stale; run scripts/generate_gateway_config.py")

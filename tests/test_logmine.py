@@ -1,0 +1,102 @@
+"""Log template mining (tools/logmine.py) — the Drain3 log-mining role
+(reference ``scripts/log_mining/mining.py``)."""
+
+from __future__ import annotations
+
+import json
+
+from copilot_for_consensus_tpu.tools.logmine import LogMiner, main
+
+
+def _json_line(message: str, level: str = "info") -> str:
+    return json.dumps({"ts": "2026-07-30T00:00:00+0000", "level": level,
+                       "service": "parsing", "message": message})
+
+
+def test_id_bearing_messages_collapse_to_one_template():
+    miner = LogMiner()
+    for i in range(50):
+        miner.add_line(_json_line(f"processed archive {i:08x} with {i} messages"))
+    clusters = miner.clusters
+    assert len(clusters) == 1
+    assert clusters[0].count == 50
+    assert "<*>" in clusters[0].text
+    assert clusters[0].text.startswith("processed archive")
+
+
+def test_distinct_shapes_stay_separate():
+    miner = LogMiner()
+    for _ in range(5):
+        miner.add_line(_json_line("subscriber connected to broker"))
+        miner.add_line(_json_line("fetch failed after 3 attempts", "error"))
+    texts = {c.text for c in miner.clusters}
+    assert "subscriber connected to broker" in texts
+    assert any(t.startswith("fetch failed") for t in texts)
+    assert len(texts) == 2
+
+
+def test_levels_counted_and_error_shortlist():
+    miner = LogMiner()
+    miner.add_line(_json_line("upsert ok for chunk 11"))
+    for i in range(3):
+        miner.add_line(_json_line(f"embed failed for chunk {i}", "error"))
+    report = miner.report()
+    err = next(t for t in report["templates"] if t["errors"])
+    assert err["by_level"] == {"error": 3}
+    assert report["top_error_templates"] == [err["template"]]
+
+
+def test_plain_text_and_garbage_lines_tolerated():
+    miner = LogMiner()
+    miner.add_line("not json at all")
+    miner.add_line("{broken json")
+    miner.add_line("")
+    assert miner.total == 1          # plain text mined, garbage skipped
+    assert miner.skipped == 1
+
+
+def test_rare_templates_surface():
+    miner = LogMiner()
+    for i in range(10):
+        miner.add_line(_json_line(f"heartbeat tick {i}"))
+    miner.add_line(_json_line("unexpected wedge in scheduler state"))
+    report = miner.report()
+    assert "unexpected wedge in scheduler state" in report["rare_templates"]
+    # min_count hides rare lines from the main table but must NOT
+    # empty the rare shortlist — one-offs are its whole point.
+    filtered = miner.report(min_count=5)
+    assert all(t["count"] >= 5 for t in filtered["templates"])
+    assert ("unexpected wedge in scheduler state"
+            in filtered["rare_templates"])
+
+
+def test_adversarial_token_soup_bounded():
+    """Unique-token floods route into a catch-all leaf, not an unbounded
+    tree (max_children cap)."""
+    miner = LogMiner(max_children=8)
+    for i in range(200):
+        miner.add_line(_json_line(f"xk{i}q zz{i} blorp{i}"))
+    leaves = miner._tree[3]
+    assert len(leaves) <= 9  # 8 distinct + the catch-all
+
+
+def test_cli_json_report(tmp_path, capsys):
+    log = tmp_path / "svc.log"
+    log.write_text("\n".join(
+        _json_line(f"stored message {i:04d}") for i in range(7)) + "\n")
+    rc = main([str(log), "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["total_lines"] == 7
+    assert report["n_templates"] == 1
+
+
+def test_cli_text_report_min_count(tmp_path, capsys):
+    log = tmp_path / "svc.log"
+    lines = [_json_line("common event 1")] * 5 + [_json_line("one-off oddity")]
+    log.write_text("\n".join(lines) + "\n")
+    rc = main([str(log), "--min-count", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "common event" in out
+    assert "one-off oddity" not in out

@@ -38,6 +38,13 @@ KNOWN_SERIES = {
     "copilot_summarization_latency_seconds",
     "copilot_reporting_reports_total",
     "copilot_bus_queue_depth", "copilot_bus_dead_letters",
+    # stats exporter gauges (tools/exporters.py)
+    "copilot_collection_documents", "copilot_documents_pending",
+    "copilot_vectorstore_vectors", "copilot_vectorstore_dimension",
+    "copilot_exporter_scrape_seconds",
+    # retry-job pushed metrics (tools/retry_job.py)
+    "copilot_retry_requeued_total", "copilot_retry_exhausted_documents",
+    "copilot_retry_last_sweep_timestamp", "copilot_retry_sweep_seconds",
     "up", "push_time_seconds", "time", "vector", "absent",
 }
 _SERIES_RE = re.compile(r"\b(copilot_[a-z_]+|up|push_time_seconds)\b")

@@ -76,6 +76,39 @@ def test_retry_job_respects_backoff_and_max_attempts(fixtures_dir):
     assert doc["attempt_count"] == 3     # archives rule max_attempts
 
 
+def test_retry_job_pushes_sweep_metrics(fixtures_dir):
+    """Each sweep records requeue counters + exhaustion gauges and
+    pushes them (the reference's retry job is a pushgateway client —
+    batch jobs can't be scraped)."""
+    from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+
+    class PushCounting(InMemoryMetrics):
+        pushes = 0
+
+        def safe_push(self):
+            self.pushes += 1
+
+    p = _broken_pipeline(fixtures_dir)
+    p.store.insert_or_ignore("archives", {
+        "archive_id": "stuck-a", "sha256": "3" * 64,
+        "parsed": False, "source_id": "s",
+    })
+    p.store.insert_or_ignore("archives", {
+        "archive_id": "dead-a", "sha256": "4" * 64,
+        "parsed": False, "source_id": "s", "attempt_count": 99,
+    })
+    metrics = PushCounting()
+    job = RetryStuckDocumentsJob(p.store, p.ingestion.publisher,
+                                 min_stuck_seconds=0.0, metrics=metrics)
+    job.run_once(now=time.time() + 1e6)
+    assert metrics.counter_value("retry_requeued_total",
+                                 {"collection": "archives"}) == 1
+    assert metrics.gauge_value("retry_exhausted_documents",
+                               {"collection": "archives"}) == 1
+    assert metrics.gauge_value("retry_last_sweep_timestamp") > 0
+    assert metrics.pushes == 1
+
+
 def test_data_export_import_roundtrip(fixtures_dir, tmp_path):
     """Data portability (reference scripts/data-migration-export.py):
     run the pipeline, dump everything, import into a fresh store pair,

@@ -68,17 +68,41 @@ def _quantize_np(w: np.ndarray) -> dict[str, np.ndarray]:
     return {"q": q, "scale": scale}
 
 
+def _quantize_np_int4(w: np.ndarray, group: int = 256
+                      ) -> dict[str, np.ndarray]:
+    """Host-side mirror of ``models.quant.quantize_tensor_int4``:
+    group-wise signed nibbles packed two per int8 byte along the
+    contraction axis (layout: ``ops.quant_matmul.pack_int4``)."""
+    *lead, d, f = w.shape
+    group = min(group, d)          # small models: one group spans D
+    if d % group:
+        raise ValueError(f"contraction dim {d} not divisible by "
+                         f"group {group}")
+    wf = w.astype(np.float32).reshape(*lead, d // group, group, f)
+    amax = np.max(np.abs(wf), axis=-2, keepdims=True)
+    scale = np.where(amax > 0, amax / 7.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(wf / scale), -8, 7).astype(np.int32)
+    q = q.reshape(*lead, d, f)
+    lo = q[..., 0::2, :] & 0xF
+    hi = q[..., 1::2, :] & 0xF
+    return {"q4": ((hi << 4) | lo).astype(np.int8),
+            "scale": scale.reshape(*lead, d // group, f)}
+
+
 def quantize_tree(params: dict,
-                  leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES
-                  ) -> dict:
-    """Int8-ize the given leaves of a numpy pytree, in place per leaf."""
+                  leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES,
+                  mode: str = "int8") -> dict:
+    """Quantize the given leaves of a numpy pytree (int8 per-channel or
+    int4 group-wise packed), in place per leaf."""
     out = {k: (quantize_tree(v, tuple(
-        rest[1:] for rest in leaves if rest and rest[0] == k))
+        rest[1:] for rest in leaves if rest and rest[0] == k), mode)
         if isinstance(v, dict) else v) for k, v in params.items()}
     for path in leaves:
         if len(path) == 1 and path[0] in params and not isinstance(
                 params[path[0]], dict):
-            out[path[0]] = _quantize_np(np.asarray(params[path[0]]))
+            w = np.asarray(params[path[0]])
+            out[path[0]] = (_quantize_np(w) if mode == "int8"
+                            else _quantize_np_int4(w))
     return out
 
 
@@ -107,7 +131,12 @@ def save_native(path: str | pathlib.Path, cfg: DecoderConfig, params: dict,
     meta = {
         "format": FORMAT,
         "config": dataclasses.asdict(cfg),
-        "quantized": any(k.endswith("/q") for k in flat),
+        # "int8" / "int4" / False — engines pass this straight through
+        # as the quantize mode (older checkpoints stored a bool; True
+        # meant int8 and still does).
+        "quantized": ("int4" if any(k.endswith("/q4") for k in flat)
+                      else "int8" if any(k.endswith("/q") for k in flat)
+                      else False),
         "bos_id": bos,
         "eos_id": eos,
         "eos_ids": eos_ids,
@@ -163,16 +192,18 @@ def load_checkpoint(path: str | pathlib.Path, dtype: str = "bfloat16"
 
 
 def convert(src: str | pathlib.Path, dst: str | pathlib.Path, *,
-            quantize: bool = True, dtype: str = "bfloat16") -> dict:
+            quantize: bool | str = True, dtype: str = "bfloat16") -> dict:
     """Offline converter: HF checkpoint → native serving checkpoint.
 
     The role of ``ollama pull`` + GGUF quantization in the reference
-    stack, first-party. Returns the written meta dict.
+    stack, first-party. ``quantize``: False | True/"int8" | "int4".
+    Returns the written meta dict.
     """
     src, dst = pathlib.Path(src), pathlib.Path(dst)
     cfg, params = load_hf_checkpoint(src, dtype)
     if quantize:
-        params = quantize_tree(params)
+        params = quantize_tree(
+            params, mode="int8" if quantize is True else quantize)
     hf_cfg = json.loads((src / "config.json").read_text())
     # Raw values straight through — save_native's _norm_token_id handles
     # None and list forms; coalescing here would corrupt a real id 0.

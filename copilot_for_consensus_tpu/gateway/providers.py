@@ -123,6 +123,12 @@ class AzureApimAdapter(GatewayAdapter):
     def generate(self, spec: Mapping[str, Any]) -> dict[str, str]:
         info = spec.get("info", {})
         api_name = "copilot-for-consensus"
+        # Embed only the edge-facing paths: importing the raw spec would
+        # create APIM operations for the cluster-internal probe/scrape
+        # endpoints (see INTERNAL_PATHS).
+        edge_paths = {r.path for r in self.edge_routes(spec)}
+        spec = {**spec, "paths": {p: ops for p, ops in spec["paths"].items()
+                                  if p in edge_paths}}
         template = {
             "$schema": "https://schema.management.azure.com/schemas/"
                        "2019-04-01/deploymentTemplate.json#",

@@ -21,23 +21,33 @@ from copilot_for_consensus_tpu.ops.attention import attention, decode_attention
 
 
 def qmatmul(x: jax.Array, w) -> jax.Array:
-    """``x @ w`` where ``w`` is a plain array or an int8 quantized leaf
-    (``models.quant``). Dequant scale applies after the matmul — exact,
-    since scales are per output channel. On TPU the quantized path runs
-    the fused Pallas kernel (``ops/quant_matmul.py``) so the bf16
-    dequantized weight never touches HBM."""
+    """``x @ w`` where ``w`` is a plain array or a quantized leaf
+    (``models.quant``: int8 per-channel or packed-int4 group-wise).
+    On TPU the quantized paths run the fused Pallas kernels
+    (``ops/quant_matmul.py``) so the bf16 dequantized weight never
+    touches HBM — decode streams the int8/int4 bytes, once."""
     from copilot_for_consensus_tpu.models.quant import (
-        is_quantized,
         pallas_qmatmul_enabled,
+        quant_kind,
     )
 
-    if is_quantized(w):
-        if (w["q"].ndim == 2 and pallas_qmatmul_enabled()
-                and jax.default_backend() == "tpu"):
-            from copilot_for_consensus_tpu.ops.quant_matmul import (
-                int8_matmul,
-            )
-            return int8_matmul(x, w["q"], w["scale"])
+    kind = quant_kind(w)
+    on_tpu = jax.default_backend() == "tpu"
+    if kind == "int4":
+        from copilot_for_consensus_tpu.ops.quant_matmul import (
+            int4_matmul,
+            int4_matmul_xla,
+        )
+        if w["q4"].ndim == 2 and pallas_qmatmul_enabled() and on_tpu:
+            return int4_matmul(x, w["q4"], w["scale"])
+        return int4_matmul_xla(x, w["q4"], w["scale"])
+    if kind == "int8":
+        # Measured on v5e: XLA's own dequant-fused matmul streams int8
+        # weights faster than the Pallas kernel at serving shapes
+        # (engine decode 2778 vs 2146 tok/s), and it partitions under
+        # GSPMD — so the XLA expression is the int8 path, always. The
+        # Pallas int8 kernel stays for reference/experiments
+        # (ops/quant_matmul.int8_matmul).
         return (x @ w["q"].astype(x.dtype)) * w["scale"].astype(x.dtype)
     return x @ w
 
@@ -148,6 +158,38 @@ def attn_decode_stacked(x: jax.Array, layer: dict, cfg: DecoderConfig,
                          kv_len=kv_len)                   # [B, Hq, Dh]
     o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim)
     return qmatmul(o, layer["wo"]), k_cache, v_cache
+
+
+def attn_decode_windowed(x: jax.Array, layer: dict, cfg: DecoderConfig,
+                         positions0: jax.Array, w: jax.Array,
+                         k_pref_l: jax.Array, v_pref_l: jax.Array,
+                         k_win_l: jax.Array, v_win_l: jax.Array,
+                         kv_len: int | None = None):
+    """Decode attention for one layer against (read-only prefix cache,
+    window buffer, self). Returns (out, k_cur, v_cur) — the caller
+    stacks the per-layer k/v columns into the window buffer; nothing
+    here writes the big cache, which is what keeps it out of the decode
+    scan carry (see ``decoder.decode_step_windowed``).
+
+    positions0: [B] window-START positions; ``w``: traced step index
+    within the window (absolute position = positions0 + w, used for
+    RoPE and sliding-window masking).
+    """
+    from copilot_for_consensus_tpu.ops.attention import (
+        decode_attention_prefix_window,
+    )
+
+    b = x.shape[0]
+    pos = (positions0 + w)[:, None]
+    q, k, v = _project_qkv(x, layer, cfg, pos)
+    k_cur = k[:, :, 0, :]
+    v_cur = v[:, :, 0, :]
+    o = decode_attention_prefix_window(
+        q[:, :, 0, :], k_pref_l, v_pref_l, k_win_l, v_win_l,
+        k_cur, v_cur, prefix_lengths=positions0, w=w,
+        window=cfg.sliding_window, kv_len=kv_len)           # [B, Hq, Dh]
+    o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim)
+    return qmatmul(o, layer["wo"]), k_cur, v_cur
 
 
 # ---------------------------------------------------------------------------

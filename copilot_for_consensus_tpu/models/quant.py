@@ -32,7 +32,19 @@ DECODER_QUANT_LEAVES = (
 
 
 def is_quantized(leaf: Any) -> bool:
-    return isinstance(leaf, dict) and "q" in leaf and "scale" in leaf
+    return (isinstance(leaf, dict) and "scale" in leaf
+            and ("q" in leaf or "q4" in leaf))
+
+
+def quant_kind(leaf: Any) -> str | None:
+    """None for plain arrays, else "int8" / "int4"."""
+    if not isinstance(leaf, dict) or "scale" not in leaf:
+        return None
+    if "q4" in leaf:
+        return "int4"
+    if "q" in leaf:
+        return "int8"
+    return None
 
 
 # Process-wide switch for the fused Pallas int8 matmul. Sharded engines
@@ -60,6 +72,35 @@ def quantize_tensor(w: jax.Array) -> dict[str, jax.Array]:
     return {"q": q, "scale": scale}
 
 
+INT4_GROUP = 256   # rows per scale group; multiple of 256 (TPU lane tiling)
+
+
+def quantize_tensor_int4(w: jax.Array,
+                         group: int = INT4_GROUP) -> dict[str, jax.Array]:
+    """Symmetric int4 with group-wise scales over the contraction axis.
+
+    Four bits is too coarse for one scale per output channel, so each
+    ``group`` rows of the contraction axis get their own scale row —
+    the standard accuracy recovery for 4-bit weight-only quantization.
+    Nibbles are packed two-per-int8-byte (``ops.quant_matmul.pack_int4``)
+    so the serving dtype works around this JAX build's broken int4
+    arrays and halves weight HBM again over int8."""
+    from copilot_for_consensus_tpu.ops.quant_matmul import pack_int4
+
+    *lead, d, f = w.shape
+    group = min(group, d)          # small models: one group spans D
+    if d % group:
+        raise ValueError(f"contraction dim {d} not divisible by "
+                         f"group {group}")
+    wf = w.astype(jnp.float32).reshape(*lead, d // group, group, f)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -8, 7)
+    q = q.reshape(*lead, d, f).astype(jnp.int8)
+    return {"q4": pack_int4(q),
+            "scale": scale.reshape(*lead, d // group, f)}
+
+
 def _get_path(tree: dict, path: tuple[str, ...]):
     node = tree
     for p in path:
@@ -77,20 +118,27 @@ def _set_path(tree: dict, path: tuple[str, ...], value) -> None:
 
 
 def quantize_params(params: dict,
-                    leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES
-                    ) -> dict:
-    """Returns a copy of the param tree with the given leaves int8-ized."""
+                    leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES,
+                    mode: str = "int8",
+                    group: int = INT4_GROUP) -> dict:
+    """Returns a copy of the param tree with the given leaves quantized
+    (``mode``: "int8" per-channel or "int4" group-wise packed)."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
     out = jax.tree.map(lambda x: x, params)  # shallow-ish structural copy
     for path in leaves:
         w = _get_path(params, path)
         if w is not None:
-            _set_path(out, path, quantize_tensor(w))
+            _set_path(out, path,
+                      quantize_tensor(w) if mode == "int8"
+                      else quantize_tensor_int4(w, group))
     return out
 
 
 def init_random_quantized(rng: jax.Array, cfg, dtype=jnp.bfloat16,
-                          leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES
-                          ) -> dict:
+                          leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES,
+                          mode: str = "int8",
+                          group: int = INT4_GROUP) -> dict:
     """Random decoder params with quantized leaves born int8 on-device.
 
     Serving benches need weights with the right shapes/dtypes, not trained
@@ -116,9 +164,20 @@ def init_random_quantized(rng: jax.Array, cfg, dtype=jnp.bfloat16,
         names = tuple(p.key for p in path)
         shape = aval.shape
         if names in quant_set:
+            fan_in = shape[-2]
+            if mode == "int4":
+                # Random packed bytes: each nibble uniform in [-8, 7],
+                # std ≈ 4.61; scale to ~1/sqrt(fan_in).
+                g = min(group, fan_in)
+                packed_shape = shape[:-2] + (shape[-2] // 2,) + shape[-1:]
+                q4 = jax.random.randint(key, packed_shape, -128, 128,
+                                        dtype=jnp.int32).astype(jnp.int8)
+                scale_shape = shape[:-2] + (fan_in // g,) + shape[-1:]
+                scale = jnp.full(scale_shape, fan_in ** -0.5 / 4.61,
+                                 jnp.float32)
+                return {"q4": q4, "scale": scale}
             q = jax.random.randint(key, shape, -127, 128, dtype=jnp.int8)
             # uniform int8 has std ≈ 73.3; scale to ~1/sqrt(fan_in)
-            fan_in = shape[-2]
             scale_shape = shape[:-2] + (1,) + shape[-1:]
             scale = jnp.full(scale_shape, fan_in ** -0.5 / 73.3,
                              jnp.float32)
@@ -148,17 +207,23 @@ def init_random_quantized(rng: jax.Array, cfg, dtype=jnp.bfloat16,
 
 
 def quantize_logical_axes(axes: dict,
-                          leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES
-                          ) -> dict:
+                          leaves: tuple[tuple[str, ...], ...] = DECODER_QUANT_LEAVES,
+                          mode: str = "int8") -> dict:
     """Transform the logical-axes tree to match a quantized param tree.
-    The scale tensor keeps every axis except the (size-1) contraction
-    axis, which becomes None/replicated."""
+
+    int8: the scale keeps every axis except the (size-1) contraction
+    axis, which becomes None/replicated. int4: the packed q4 keeps the
+    original axes (packed rows shard like the rows they encode) and the
+    group axis of the scale inherits the contraction axis name."""
     out = {k: (dict(v) if isinstance(v, dict) else v)
            for k, v in axes.items()}
     for path in leaves:
         t = _get_path(axes, path)
         if t is not None:
-            scale_axes = tuple(
-                None if i == len(t) - 2 else a for i, a in enumerate(t))
-            _set_path(out, path, {"q": t, "scale": scale_axes})
+            if mode == "int4":
+                _set_path(out, path, {"q4": t, "scale": t})
+            else:
+                scale_axes = tuple(
+                    None if i == len(t) - 2 else a for i, a in enumerate(t))
+                _set_path(out, path, {"q": t, "scale": scale_axes})
     return out

@@ -166,3 +166,74 @@ def decode_attention(
     probs = jnp.where(jnp.isnan(probs), 0.0, probs)
     out = jnp.einsum("bhgs,bhsd->bhgd", probs.astype(v_cache.dtype), v_cache)
     return out.reshape(b, hq, d)
+
+
+def decode_attention_prefix_window(
+    q: jax.Array,
+    k_pref: jax.Array,
+    v_pref: jax.Array,
+    k_win: jax.Array,
+    v_win: jax.Array,
+    k_cur: jax.Array,
+    v_cur: jax.Array,
+    prefix_lengths: jax.Array,
+    w: jax.Array,
+    window: int = 0,
+    kv_len: int | None = None,
+) -> jax.Array:
+    """Decode attention over three KV pieces with one joint softmax.
+
+    The pieces: the big prefix cache (read-only — keeping it OUT of the
+    decode scan carry is the whole point: a carried cache is
+    re-materialized every step, ~2× the cache bytes per token), the
+    current dispatch window's fresh KV (``k_win`` [B, Hkv, W, D], valid
+    columns [0, w)), and the current token's own KV. Scores are
+    concatenated (tiny), softmaxed jointly — numerically identical to
+    attention over one contiguous cache.
+
+    q: [B, Hq, D]; k_pref/v_pref: [B, Hkv, S_max, D]; k_cur/v_cur:
+    [B, Hkv, D]. prefix_lengths: [B] — valid prefix per slot (the
+    window-START position). ``w``: traced scan counter — window columns
+    at index ≥ w are garbage and masked. ``window``: sliding-window
+    size (0 = full).
+    """
+    if kv_len is not None and kv_len < k_pref.shape[2]:
+        k_pref = k_pref[:, :, :kv_len]
+        v_pref = v_pref[:, :, :kv_len]
+    dt = q.dtype
+    k_pref, v_pref = k_pref.astype(dt), v_pref.astype(dt)
+    k_win, v_win = k_win.astype(dt), v_win.astype(dt)
+    k_cur, v_cur = k_cur.astype(dt), v_cur.astype(dt)
+    b, hq, d = q.shape
+    hkv = k_pref.shape[1]
+    s_max = k_pref.shape[2]
+    n_win = k_win.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, hkv, group, d)
+    scl = d ** -0.5
+
+    lp = jnp.einsum("bhgd,bhsd->bhgs", qg, k_pref,
+                    preferred_element_type=jnp.float32) * scl
+    lw = jnp.einsum("bhgd,bhwd->bhgw", qg, k_win,
+                    preferred_element_type=jnp.float32) * scl
+    lc = jnp.einsum("bhgd,bhd->bhg", qg, k_cur,
+                    preferred_element_type=jnp.float32)[..., None] * scl
+
+    cur_pos = prefix_lengths + w                  # absolute position [B]
+    pos_p = jnp.arange(s_max)[None, None, None, :]
+    mask_p = pos_p < prefix_lengths[:, None, None, None]
+    if window > 0:
+        mask_p &= pos_p > (cur_pos - window)[:, None, None, None]
+    iw = jnp.arange(n_win)[None, None, None, :]
+    mask_w = iw < w                               # strictly earlier steps
+    lp = jnp.where(mask_p, lp, -jnp.inf)
+    lw = jnp.where(mask_w, lw, -jnp.inf)
+
+    logits = jnp.concatenate([lp, lw, lc], axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    pp, pw, pc = jnp.split(probs, [s_max, s_max + n_win], axis=-1)
+    out = jnp.einsum("bhgs,bhsd->bhgd", pp.astype(dt), v_pref)
+    out += jnp.einsum("bhgw,bhwd->bhgd", pw.astype(dt), v_win)
+    out += pc.astype(dt) * v_cur[:, :, None, :]
+    return out.reshape(b, hq, d)

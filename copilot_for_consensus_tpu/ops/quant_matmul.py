@@ -95,3 +95,173 @@ def int8_matmul(
         interpret=interpret,
     )(xm, q, scale)
     return out[:m, :f].reshape(*lead, f)
+
+
+# ---------------------------------------------------------------------------
+# Packed int4 with group-wise scales
+# ---------------------------------------------------------------------------
+#
+# Decode reads every weight byte once per step, so int4 halves the step's
+# weight traffic again over int8 — IF the packed bytes stream straight
+# from HBM into the kernel. (The native jnp.int4 dtype can't be used: as
+# of this JAX build, passing an int4 array into jit crashes in
+# device_put, and XLA's own int4 lowering widens through HBM anyway.)
+#
+# Layout: two signed nibbles per int8 byte along the CONTRACTION axis —
+# byte row i of ``q4`` holds original rows 2i (low nibble) and 2i+1
+# (high nibble). The kernel never interleaves: the caller splits x into
+# even/odd columns once (cheap, activations are tiny next to weights),
+# and each grid step computes  x_even·lo + x_odd·hi .
+#
+# Scales are per (row-group, output-channel): int4 is too coarse for one
+# scale per column, so each contraction block of ``2*bdp`` original rows
+# carries its own scale row, applied to the partial product BEFORE
+# accumulation — mathematically exact, zero extra HBM traffic.
+
+
+def _kernel4(xe_ref, xo_ref, w_ref, s_ref, o_ref, acc_ref, *,
+             groups_per_block: int, gdp: int):
+    """One grid step covers ``groups_per_block`` scale groups of ``gdp``
+    packed rows each — big DMA tiles (DMA setup cost amortizes), with
+    the group scale applied to each group's partial product before
+    accumulation (exact)."""
+    di = pl.program_id(2)
+    nd = pl.num_programs(2)
+
+    @pl.when(di == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    packed = w_ref[:].astype(jnp.int32)
+    lo = (((packed & 0xF) ^ 8) - 8).astype(xe_ref.dtype)   # sign-extend
+    hi = (packed >> 4).astype(xe_ref.dtype)                # arithmetic
+    part = jnp.zeros_like(acc_ref)
+    for g in range(groups_per_block):                      # static unroll
+        sl = slice(g * gdp, (g + 1) * gdp)
+        pg = jax.lax.dot(xe_ref[:, sl], lo[sl],
+                         preferred_element_type=jnp.float32)
+        pg += jax.lax.dot(xo_ref[:, sl], hi[sl],
+                          preferred_element_type=jnp.float32)
+        part += pg * s_ref[g].astype(jnp.float32)
+    acc_ref[:] += part
+
+    @pl.when(di == nd - 1)
+    def _finalize():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """[D, F] signed nibble values in [-8, 7] → [D//2, F] packed int8."""
+    d = q.shape[-2]
+    if d % 2:
+        raise ValueError(f"contraction dim must be even, got {d}")
+    q = q.astype(jnp.int32)
+    lo = q[..., 0::2, :] & 0xF
+    hi = q[..., 1::2, :] & 0xF
+    return ((hi << 4) | lo).astype(jnp.int8)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4` (numerics oracle / XLA fallback)."""
+    p = packed.astype(jnp.int32)
+    lo = ((p & 0xF) ^ 8) - 8
+    hi = p >> 4
+    stacked = jnp.stack([lo, hi], axis=-2)         # [..., D/2, 2, F]
+    return stacked.reshape(*packed.shape[:-2],
+                           packed.shape[-2] * 2, packed.shape[-1])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_m", "block_f", "block_d", "interpret"),
+)
+def int4_matmul(
+    x: jax.Array,
+    q4: jax.Array,
+    scale: jax.Array,
+    *,
+    block_m: int = 256,
+    block_f: int = 512,
+    block_d: int = 2048,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``x @ dequant(q4, scale)`` with q4 nibble-packed int8 [D//2, F]
+    and scale [G, F] group-wise over the contraction axis (group size
+    ``D // G``, must be even). x: [..., D]; returns [..., F] in x.dtype.
+
+    ``block_d`` is the UNPACKED contraction rows per grid step; it is
+    rounded to a whole number of scale groups so each step covers
+    ``block_d // group`` groups with one big DMA."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    *lead, d = x.shape
+    dp, f = q4.shape
+    g = scale.shape[0]
+    if d != 2 * dp:
+        raise ValueError(f"x depth {d} != 2x packed rows {dp}")
+    if d % g:
+        raise ValueError(f"group count {g} must divide D {d}")
+    group = d // g
+    gdp = group // 2               # packed rows per scale group
+    # Mosaic requires lane-dim blocks in multiples of 128 (or the full
+    # array extent), so the quantization group must be a multiple of 256
+    # unless one group spans the whole contraction axis.
+    if gdp != dp and (gdp % 128 or dp % gdp):
+        raise ValueError(
+            f"group size {group} must be a multiple of 256 (TPU lane "
+            f"tiling) or span the full contraction axis {d}")
+    groups_per_block = max(1, min(g, block_d // group))
+    while g % groups_per_block:    # grid needs equal blocks
+        groups_per_block -= 1
+    bdp = gdp * groups_per_block
+    n_dblk = g // groups_per_block
+    xm = x.reshape(-1, d)
+    m = xm.shape[0]
+    # Split x once into the columns matching the low/high nibble rows.
+    xe = xm[:, 0::2]
+    xo = xm[:, 1::2]
+
+    bm = min(block_m, max(8, -(-m // 8) * 8))
+    bf = min(block_f, f)
+    pad_m = (-m) % bm
+    pad_f = (-f) % bf
+    if pad_m:
+        xe = jnp.pad(xe, ((0, pad_m), (0, 0)))
+        xo = jnp.pad(xo, ((0, pad_m), (0, 0)))
+    if pad_f:
+        q4 = jnp.pad(q4, ((0, 0), (0, pad_f)))
+        scale = jnp.pad(scale, ((0, 0), (0, pad_f)))
+    m_pad, f_pad = m + pad_m, f + pad_f
+    # (G, 1, F): the unit sublane dim satisfies Mosaic's block-tiling
+    # constraint for any group count.
+    scale3 = scale.reshape(g, 1, f_pad)
+
+    kernel = functools.partial(_kernel4,
+                               groups_per_block=groups_per_block, gdp=gdp)
+    out = pl.pallas_call(
+        kernel,
+        grid=(m_pad // bm, f_pad // bf, n_dblk),
+        in_specs=[
+            pl.BlockSpec((bm, bdp), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bm, bdp), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bdp, bf), lambda i, j, k: (k, j)),
+            pl.BlockSpec((groups_per_block, 1, bf),
+                         lambda i, j, k: (k, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bf), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, f_pad), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bf), jnp.float32)],
+        interpret=interpret,
+    )(xe, xo, q4, scale3)
+    return out[:m, :f].reshape(*lead, f)
+
+
+def int4_matmul_xla(x: jax.Array, q4: jax.Array,
+                    scale: jax.Array) -> jax.Array:
+    """Plain-XLA reference/fallback (materializes the dequantized
+    weight — correct everywhere, slow on the HBM-bound decode path)."""
+    d = x.shape[-1]
+    g = scale.shape[0]
+    w = unpack_int4(q4).astype(x.dtype)            # [D, F]
+    s = jnp.repeat(scale.astype(x.dtype), d // g, axis=0)
+    return x @ (w * s)

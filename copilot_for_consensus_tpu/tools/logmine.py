@@ -162,12 +162,12 @@ class LogMiner:
                 }
                 for c in clusters
             ],
-            # The operator shortlists: noisy errors and rare one-offs.
-            # Rare templates come from the UNfiltered cluster list —
-            # min_count hides them from the main table, but a one-off
-            # is precisely what the rare shortlist exists to surface.
+            # The operator shortlists come from the UNfiltered cluster
+            # list — min_count trims the main table, but a rare one-off
+            # or a 3-occurrence error template is precisely what these
+            # shortlists exist to surface.
             "top_error_templates": [
-                c.text for c in sorted(clusters,
+                c.text for c in sorted(self.clusters,
                                        key=lambda c: -c.error_count)
                 if c.error_count][:10],
             "rare_templates": [c.text for c in self.clusters

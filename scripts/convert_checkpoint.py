@@ -26,13 +26,18 @@ def main() -> int:
     ap.add_argument("dst", help="output native checkpoint dir")
     ap.add_argument("--no-quantize", action="store_true",
                     help="keep full-precision weights")
+    ap.add_argument("--weight-dtype", default="int8",
+                    choices=("int8", "int4"),
+                    help="quantized serving dtype (int4 = group-wise "
+                         "packed nibbles, half the HBM of int8)")
     ap.add_argument("--dtype", default="bfloat16",
                     choices=("bfloat16", "float32", "float16"))
     args = ap.parse_args()
 
     from copilot_for_consensus_tpu.checkpoint import convert
 
-    meta = convert(args.src, args.dst, quantize=not args.no_quantize,
+    meta = convert(args.src, args.dst,
+                   quantize=False if args.no_quantize else args.weight_dtype,
                    dtype=args.dtype)
     print(json.dumps(meta, indent=2))
     return 0

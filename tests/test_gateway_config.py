@@ -112,12 +112,18 @@ def test_apim_public_allowlist_matches_templated_paths(spec):
 
 
 def test_azure_template_embeds_spec_and_policy(spec):
-    files = create_gateway_adapter("azure").generate(spec)
+    adapter = create_gateway_adapter("azure")
+    files = adapter.generate(spec)
     template = json.loads(files["apim_template.json"])
     api = next(r for r in template["resources"]
                if r["type"] == "Microsoft.ApiManagement/service/apis")
     embedded = json.loads(api["properties"]["value"])
-    assert embedded["paths"].keys() == spec["paths"].keys()
+    # Only edge routes are imported — an APIM operation for /metrics
+    # would let any valid-JWT holder scrape internals at the edge.
+    assert embedded["paths"].keys() == {r.path
+                                       for r in adapter.edge_routes(spec)}
+    for path in ("/metrics", "/health", "/readyz"):
+        assert path not in embedded["paths"]
     assert "validate-jwt" in files["apim_policy.xml"]
 
 

@@ -70,6 +70,20 @@ def test_rare_templates_surface():
             in filtered["rare_templates"])
 
 
+def test_error_shortlist_survives_min_count():
+    """An error template seen fewer than min_count times still appears
+    on the error shortlist — hiding it is how incidents get missed."""
+    miner = LogMiner()
+    for i in range(10):
+        miner.add_line(_json_line(f"heartbeat tick {i}"))
+    for i in range(3):
+        miner.add_line(_json_line(f"bus write failed attempt {i}", "error"))
+    report = miner.report(min_count=5)
+    assert all(t["count"] >= 5 for t in report["templates"])
+    assert any(t.startswith("bus write failed")
+               for t in report["top_error_templates"])
+
+
 def test_adversarial_token_soup_bounded():
     """Unique-token floods route into a catch-all leaf, not an unbounded
     tree (max_children cap)."""

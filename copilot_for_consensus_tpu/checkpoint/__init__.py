@@ -26,10 +26,13 @@ from copilot_for_consensus_tpu.checkpoint.native import (
     quantize_tree,
     save_native,
 )
+from copilot_for_consensus_tpu.checkpoint.train_state import (
+    TrainCheckpointer,
+)
 
 __all__ = [
-    "CheckpointError", "FORMAT", "config_from_hf", "convert",
-    "encoder_config_from_hf", "is_native", "load_checkpoint",
+    "CheckpointError", "FORMAT", "TrainCheckpointer", "config_from_hf",
+    "convert", "encoder_config_from_hf", "is_native", "load_checkpoint",
     "load_hf_checkpoint", "load_hf_encoder_checkpoint",
     "load_hf_encoder_params", "load_hf_params", "load_native",
     "load_tokenizer", "quantize_tree", "read_hf_config", "save_native",

@@ -1,0 +1,101 @@
+"""Training-state checkpointing: Orbax save/restore of (step, params,
+opt_state) with retention.
+
+SURVEY §5 ("Checkpoint / resume") assigns the TPU build Orbax
+checkpoints for model state plus slice-level preemption checkpointing
+for long batch jobs — the role MongoDB's durable doc-status state
+machine plays for the *pipeline*, applied to the *training loop*
+(``train.py``). A preempted fine-tuning job resumes from the last kept
+step with bit-identical state: params, optimizer moments, and the step
+counter all round-trip.
+
+Sharded pytrees work transparently: Orbax records and restores each
+array's sharding, so a ``pjit``-trained state saved from an N-device
+mesh restores onto the same mesh layout without gathering to one host.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any
+
+import jax
+
+
+class TrainCheckpointer:
+    """Step-numbered checkpoints with retention, atomic finalization,
+    and latest-step resume."""
+
+    def __init__(self, directory: str | pathlib.Path,
+                 max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = pathlib.Path(directory).absolute()
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    # ------------------------------------------------------------------
+
+    def save(self, step: int, params: Any, opt_state: Any,
+             force: bool = False) -> bool:
+        """Persist one training state. Returns False if the manager's
+        save policy skipped it (never skips with default options)."""
+        import orbax.checkpoint as ocp
+
+        saved = self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+            force=force,
+        )
+        # Block until the async write is durable: a preemption right
+        # after save() returning must not lose the step.
+        self._mgr.wait_until_finished()
+        return saved
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int | None = None,
+                like: tuple[Any, Any] | None = None
+                ) -> tuple[int, Any, Any]:
+        """Restore (step, params, opt_state). ``like`` provides abstract
+        target trees (e.g. from ``jax.eval_shape`` or a freshly-built
+        state) so arrays restore with the right dtype/sharding."""
+        import orbax.checkpoint as ocp
+
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        if like is not None:
+            p_like = jax.tree.map(ocp.utils.to_shape_dtype_struct, like[0])
+            o_like = jax.tree.map(ocp.utils.to_shape_dtype_struct, like[1])
+            args = ocp.args.Composite(
+                params=ocp.args.StandardRestore(p_like),
+                opt_state=ocp.args.StandardRestore(o_like),
+            )
+        else:
+            args = ocp.args.Composite(
+                params=ocp.args.StandardRestore(),
+                opt_state=ocp.args.StandardRestore(),
+            )
+        out = self._mgr.restore(step, args=args)
+        return step, out["params"], out["opt_state"]
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

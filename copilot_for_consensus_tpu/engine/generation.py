@@ -204,8 +204,14 @@ class GenerationEngine:
         impl = attn_impl
 
         def _prefill(params, tokens, lengths):
+            # Scratch straight in the serving cache dtype: prefill
+            # attention uses the fresh bf16 k/v, the scratch only
+            # ferries them to the insert — a bf16 scratch at full
+            # admission width was the largest admission-path transient
+            # (4.3 GB for 256×128 tokens).
             scratch = decoder.init_cache(cfg, tokens.shape[0],
-                                         tokens.shape[1], dtype=dtype)
+                                         tokens.shape[1],
+                                         dtype=self.kv_dtype)
             logits, scratch = decoder.prefill(params, tokens, lengths, cfg,
                                               scratch, attn_impl=impl)
             return logits, scratch
@@ -392,7 +398,12 @@ class GenerationEngine:
             return
         t0 = time.monotonic()
         batch: list[tuple[int, Request]] = []
-        while self._queue and self._free:
+        # Cap one admission wave at 128 rows: prefill scratch +
+        # activations scale with the wave width (the pow-2 padding can
+        # double it again), and each extra wave costs a full weight
+        # pass — 128 is where the fp8 scratch stays ~1 GB while the
+        # bench's all-at-once arrival still admits in one wave.
+        while self._queue and self._free and len(batch) < 128:
             batch.append((self._free.pop(0), self._queue.pop(0)))
         plens = [len(req.prompt) for _, req in batch]
         bucket = _next_bucket(max(plens), self.buckets)

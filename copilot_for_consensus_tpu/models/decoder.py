@@ -192,10 +192,12 @@ def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
 
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
-    logits = _unembed(x, params, cfg)                       # [B, S, V]
-    last = jnp.take_along_axis(
-        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-    return last, {"k": k_new, "v": v_new}
+    # Select each row's last valid hidden state BEFORE the lm_head:
+    # unembedding all S positions materializes [B, S, V] fp32 logits
+    # (1 GB at 128×128×32k — the admission-path OOM driver) and burns
+    # S× the lm_head FLOPs for rows where only the last token samples.
+    x_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    return _unembed(x_last, params, cfg)[:, 0], {"k": k_new, "v": v_new}
 
 
 def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,

@@ -21,12 +21,21 @@ from copilot_for_consensus_tpu.models.configs import DecoderConfig
 
 def _q_einsum(spec: str, x: jax.Array, w, prefer_f32: bool = False
               ) -> jax.Array:
-    """Expert einsum with transparent int8 weight dequantization (scales
-    are per output channel, so they apply after the contraction).
-    ``prefer_f32`` keeps fp32 accumulation on the full-precision path."""
-    from copilot_for_consensus_tpu.models.quant import is_quantized
+    """Expert einsum with transparent weight dequantization. int8 scales
+    are per output channel, so they apply after the contraction; int4's
+    group-wise scales do not commute with an einsum contraction, so the
+    int4 path materializes the dequantized expert weight (experts are
+    small relative to the dense stack). ``prefer_f32`` keeps fp32
+    accumulation on the full-precision path."""
+    from copilot_for_consensus_tpu.models.quant import (
+        dequant_int4,
+        quant_kind,
+    )
 
-    if is_quantized(w):
+    kind = quant_kind(w)
+    if kind == "int4":
+        return jnp.einsum(spec, x, dequant_int4(w, x.dtype))
+    if kind == "int8":
         return (jnp.einsum(spec, x, w["q"].astype(x.dtype))
                 * w["scale"].astype(x.dtype))
     if prefer_f32:

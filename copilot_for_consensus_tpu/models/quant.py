@@ -75,6 +75,20 @@ def quantize_tensor(w: jax.Array) -> dict[str, jax.Array]:
 INT4_GROUP = 256   # rows per scale group; multiple of 256 (TPU lane tiling)
 
 
+def dequant_int4(leaf: dict, dtype) -> jax.Array:
+    """Materialize an int4 leaf back to ``dtype`` — the XLA fallback and
+    einsum (MoE) path. Handles any leading batch/layer/expert dims:
+    the contraction axis is -2 of the unpacked tensor and the group
+    axis is -2 of the scale."""
+    from copilot_for_consensus_tpu.ops.quant_matmul import unpack_int4
+
+    q = unpack_int4(leaf["q4"])                     # [..., D, F]
+    scale = leaf["scale"]                           # [..., G, F]
+    d, g = q.shape[-2], scale.shape[-2]
+    s = jnp.repeat(scale, d // g, axis=-2)
+    return q.astype(dtype) * s.astype(dtype)
+
+
 def quantize_tensor_int4(w: jax.Array,
                          group: int = INT4_GROUP) -> dict[str, jax.Array]:
     """Symmetric int4 with group-wise scales over the contraction axis.

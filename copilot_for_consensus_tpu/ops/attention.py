@@ -226,6 +226,12 @@ def decode_attention_prefix_window(
         mask_p &= pos_p > (cur_pos - window)[:, None, None, None]
     iw = jnp.arange(n_win)[None, None, None, :]
     mask_w = iw < w                               # strictly earlier steps
+    if window > 0:
+        # Window-buffer column i sits at absolute position
+        # prefix_lengths + i — it too falls out of a sliding window
+        # smaller than the decode window (same rule as the prefix).
+        pos_w = prefix_lengths[:, None, None, None] + iw
+        mask_w &= pos_w > (cur_pos - window)[:, None, None, None]
     lp = jnp.where(mask_p, lp, -jnp.inf)
     lw = jnp.where(mask_w, lw, -jnp.inf)
 

@@ -259,9 +259,8 @@ def int4_matmul(
 def int4_matmul_xla(x: jax.Array, q4: jax.Array,
                     scale: jax.Array) -> jax.Array:
     """Plain-XLA reference/fallback (materializes the dequantized
-    weight — correct everywhere, slow on the HBM-bound decode path)."""
-    d = x.shape[-1]
-    g = scale.shape[0]
-    w = unpack_int4(q4).astype(x.dtype)            # [D, F]
-    s = jnp.repeat(scale.astype(x.dtype), d // g, axis=0)
-    return x @ (w * s)
+    weight — correct everywhere, including stacked leading dims; slow
+    on the HBM-bound decode path)."""
+    from copilot_for_consensus_tpu.models.quant import dequant_int4
+
+    return x @ dequant_int4({"q4": q4, "scale": scale}, x.dtype)

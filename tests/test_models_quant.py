@@ -92,3 +92,23 @@ def test_quantized_engine_on_mesh():
                            attn_impl="xla", quantize=True)
     comps = eng.generate([[5, 6, 7], [9, 10, 11]], max_new_tokens=4)
     assert all(len(c.tokens) == 4 for c in comps)
+
+
+def test_moe_int4_forward_runs():
+    """int4-quantized MoE experts forward without error and stay close
+    to the full-precision logits (the einsum path materializes the
+    dequantized experts — group scales don't commute with einsum)."""
+    cfg = decoder_config("tiny-moe")
+    params = decoder.init_params(jax.random.PRNGKey(5), cfg,
+                                 dtype=jnp.float32)
+    qparams = quant.quantize_params(params, mode="int4")
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0,
+                                cfg.vocab_size)
+    full = decoder.forward(params, tokens, cfg, attn_impl="xla")
+    out = decoder.forward(qparams, tokens, cfg, attn_impl="xla")
+    assert bool(jnp.all(jnp.isfinite(out)))
+    f = np.asarray(full).reshape(-1, cfg.vocab_size)
+    q = np.asarray(out).reshape(-1, cfg.vocab_size)
+    cos = (f * q).sum(-1) / (np.linalg.norm(f, axis=-1)
+                             * np.linalg.norm(q, axis=-1) + 1e-9)
+    assert cos.min() > 0.9

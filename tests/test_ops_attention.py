@@ -80,3 +80,39 @@ def test_decode_sliding_window_matches_windowed_attention():
                            jnp.array([40]), window=16)
     np.testing.assert_allclose(np.asarray(out), np.asarray(full[:, :, -1]),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [0, 8])
+def test_prefix_window_decode_matches_contiguous(window):
+    """decode_attention_prefix_window over (prefix ⊕ window-buffer ⊕
+    self) must equal decode_attention over one contiguous cache holding
+    the same tokens — including when the sliding window is SMALLER than
+    the decode window, where buffer columns must fall out of range
+    exactly like prefix columns."""
+    from copilot_for_consensus_tpu.ops.attention import (
+        decode_attention_prefix_window,
+    )
+
+    rng = jax.random.PRNGKey(9)
+    b, hkv, d, s = 2, 2, 32, 28          # 28 total tokens per slot
+    q, k, v = _rand_qkv(rng, b=b, s=s)
+    prefix_len, w = 16, 11               # window step 11 (12th token)
+    # contiguous reference: all 28 tokens in one cache
+    s_max = 32
+    k_cache = jnp.zeros((b, hkv, s_max, d)).at[:, :, :s].set(k)
+    v_cache = jnp.zeros((b, hkv, s_max, d)).at[:, :, :s].set(v)
+    ref = decode_attention(q[:, :, -1], k_cache, v_cache,
+                           jnp.array([s, s]), window=window)
+    # split view: prefix [0,16), window buffer holds [16, 27), self = 27
+    n_win = 16
+    k_win = jnp.zeros((b, hkv, n_win, d)).at[:, :, :w].set(
+        k[:, :, prefix_len:prefix_len + w])
+    v_win = jnp.zeros((b, hkv, n_win, d)).at[:, :, :w].set(
+        v[:, :, prefix_len:prefix_len + w])
+    out = decode_attention_prefix_window(
+        q[:, :, -1], k_cache, v_cache, k_win, v_win,
+        k[:, :, -1], v[:, :, -1],
+        prefix_lengths=jnp.array([prefix_len, prefix_len]),
+        w=jnp.int32(w), window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

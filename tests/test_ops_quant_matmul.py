@@ -107,3 +107,30 @@ def test_int4_rejects_bad_group():
     with pytest.raises(ValueError, match="divide"):
         int4_matmul(jnp.ones((4, 512)), qw["q4"], bad_scale,
                     interpret=True)
+
+
+def test_int4_dequant_with_stacked_leading_dims():
+    """Stacked (layer/expert) int4 leaves dequantize correctly: the
+    group axis is -2 of the scale, not axis 0 — the bug here was
+    ``int4_matmul_xla`` reading ``scale.shape[0]`` as the group count,
+    which broke every stacked leaf."""
+    from copilot_for_consensus_tpu.models.quant import dequant_int4
+
+    w = jax.random.normal(jax.random.PRNGKey(7), (3, 256, 16)) * 0.1
+    qw = quantize_tensor_int4(w, group=128)
+    assert qw["scale"].shape == (3, 2, 16)
+
+    wd = dequant_int4(qw, jnp.float32)
+    assert wd.shape == w.shape
+    assert float(jnp.abs(wd - w).mean() / jnp.abs(w).mean()) < 0.2
+    # stacked dequant matches slicing each layer out first
+    for i in range(3):
+        per_slice = dequant_int4(
+            {"q4": qw["q4"][i], "scale": qw["scale"][i]}, jnp.float32)
+        np.testing.assert_array_equal(np.asarray(wd[i]),
+                                      np.asarray(per_slice))
+    # and the 2D XLA fallback stays consistent with the stacked dequant
+    x = jax.random.normal(jax.random.PRNGKey(8), (4, 256))
+    np.testing.assert_allclose(
+        np.asarray(int4_matmul_xla(x, qw["q4"][0], qw["scale"][0])),
+        np.asarray(x @ wd[0]), rtol=1e-5, atol=1e-5)

@@ -1,0 +1,120 @@
+"""Training checkpoint/resume (checkpoint/train_state.py): Orbax-backed
+preemption recovery for the fine-tuning loop (SURVEY §5 checkpoint/
+resume item for the TPU build)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from copilot_for_consensus_tpu import train
+from copilot_for_consensus_tpu.checkpoint import TrainCheckpointer
+from copilot_for_consensus_tpu.models import decoder
+from copilot_for_consensus_tpu.models.configs import decoder_config
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = decoder_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg,
+                                 dtype=jnp.float32)
+    opt = optax.adam(1e-3)
+    step_fn = train.make_train_step(cfg, opt)
+    rng = np.random.default_rng(0)
+    batches = [
+        (jnp.asarray(rng.integers(3, cfg.vocab_size, (4, 16)),
+                     jnp.int32),
+         jnp.asarray(rng.integers(8, 17, (4,)), jnp.int32))
+        for _ in range(6)
+    ]
+    return cfg, params, opt, step_fn, batches
+
+
+def _run(step_fn, params, opt_state, batches):
+    loss = None
+    for tokens, lengths in batches:
+        params, opt_state, loss = step_fn(params, opt_state, tokens,
+                                          lengths)
+    return params, opt_state, loss
+
+
+def test_save_restore_roundtrip(setup, tmp_path):
+    cfg, params, opt, step_fn, batches = setup
+    opt_state = opt.init(params)
+    params2, opt_state2, _ = _run(step_fn, params, opt_state, batches[:2])
+
+    with TrainCheckpointer(tmp_path / "ckpt") as ckpt:
+        ckpt.save(2, params2, opt_state2)
+        assert ckpt.latest_step() == 2
+        step, p, o = ckpt.restore(like=(params2, opt_state2))
+    assert step == 2
+    for a, b in zip(jax.tree.leaves(params2), jax.tree.leaves(p)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(opt_state2), jax.tree.leaves(o)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_equals_uninterrupted(setup, tmp_path):
+    """Preemption at step 3 then resume must reproduce the exact state
+    an uninterrupted 6-step run reaches — optimizer moments included."""
+    cfg, params, opt, step_fn, batches = setup
+    straight_p, straight_o, straight_loss = _run(
+        step_fn, params, opt.init(params), batches)
+
+    p, o = params, opt.init(params)
+    p, o, _ = _run(step_fn, p, o, batches[:3])
+    with TrainCheckpointer(tmp_path / "ckpt2") as ckpt:
+        ckpt.save(3, p, o)
+    del p, o                                    # the "preemption"
+    with TrainCheckpointer(tmp_path / "ckpt2") as ckpt:
+        step, p, o = ckpt.restore(like=(params, opt.init(params)))
+    assert step == 3
+    p, o, resumed_loss = _run(step_fn, p, o, batches[3:])
+
+    np.testing.assert_allclose(float(resumed_loss), float(straight_loss),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(straight_p), jax.tree.leaves(p)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_retention_keeps_last_n(setup, tmp_path):
+    cfg, params, opt, step_fn, batches = setup
+    opt_state = opt.init(params)
+    with TrainCheckpointer(tmp_path / "ckpt3", max_to_keep=2) as ckpt:
+        for s in (1, 2, 3, 4):
+            ckpt.save(s, params, opt_state)
+        assert ckpt.all_steps() == [3, 4]
+        assert ckpt.latest_step() == 4
+
+
+def test_restore_empty_dir_raises(tmp_path):
+    with TrainCheckpointer(tmp_path / "none") as ckpt:
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore()
+
+
+def test_sharded_state_roundtrip(tmp_path):
+    """A pjit-style sharded pytree restores with its sharding intact on
+    the 8-device virtual mesh (slice-preemption recovery without
+    gathering to one host)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    sh = NamedSharding(mesh, P("tp", None))
+    w = jax.device_put(jnp.arange(64.0).reshape(8, 8), sh)
+    state = {"w": w, "step_scale": jnp.float32(0.5)}
+
+    with TrainCheckpointer(tmp_path / "sharded") as ckpt:
+        ckpt.save(1, state, {"m": w * 2})
+        _, p, o = ckpt.restore(like=(state, {"m": w}))
+    assert p["w"].sharding.is_equivalent_to(sh, ndim=2)
+    np.testing.assert_array_equal(np.asarray(p["w"]), np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(o["m"]), np.asarray(w) * 2)

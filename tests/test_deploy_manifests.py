@@ -1,0 +1,115 @@
+"""Deployment manifests (deploy/k8s, deploy/docker-compose.yml) stay
+consistent with the CLI they invoke and the config files they mount —
+the role of the reference's compose/CI manifest checks."""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+K8S = REPO / "deploy" / "k8s"
+
+# Subcommands the package CLI actually exposes (__main__.py).
+CLI_SUBCOMMANDS = {"serve", "broker", "retry-job", "failed-queues",
+                   "logmine", "exporters", "export-data", "import-data"}
+
+
+def _docs():
+    out = []
+    for f in sorted(K8S.glob("*.yaml")):
+        if f.name == "kustomization.yaml":
+            continue
+        for doc in yaml.safe_load_all(f.read_text()):
+            if doc:
+                out.append((f.name, doc))
+    assert out, "no k8s manifests found"
+    return out
+
+
+def _pod_specs():
+    for name, doc in _docs():
+        kind = doc.get("kind")
+        spec = doc.get("spec", {})
+        if kind in ("Deployment", "StatefulSet"):
+            yield name, doc, spec["template"]["spec"]
+        elif kind == "CronJob":
+            yield name, doc, (spec["jobTemplate"]["spec"]["template"]
+                              ["spec"])
+
+
+def test_manifests_parse_and_have_core_kinds():
+    kinds = {doc["kind"] for _, doc in _docs()}
+    assert {"StatefulSet", "Deployment", "CronJob", "Service",
+            "PersistentVolumeClaim"} <= kinds
+
+
+def test_container_args_are_real_cli_subcommands():
+    for name, _, pod in _pod_specs():
+        for c in pod["containers"]:
+            sub = c["args"][0]
+            assert sub in CLI_SUBCOMMANDS, (name, sub)
+
+
+def test_mounted_configs_exist_in_repo():
+    """Every --config path a container passes must be provided by the
+    kustomize configMap, which must map to a real file."""
+    kust = yaml.safe_load((K8S / "kustomization.yaml").read_text())
+    cm_files = {pathlib.Path(p).name
+                for gen in kust["configMapGenerator"]
+                for p in gen["files"]}
+    for p in cm_files:
+        assert (REPO / "deploy" / "config" / p).exists(), p
+    for name, _, pod in _pod_specs():
+        for c in pod["containers"]:
+            args = c["args"]
+            if "--config" in args:
+                cfg = pathlib.Path(args[args.index("--config") + 1])
+                assert cfg.name in cm_files, (name, cfg)
+
+
+def test_bus_host_resolves_to_a_k8s_service():
+    """The bus host the shipped configs dial must be a Service name in
+    the manifests, or every non-broker pod fails DNS and the stack
+    comes up with zero message flow."""
+    import json
+
+    services = {doc["metadata"]["name"] for _, doc in _docs()
+                if doc["kind"] == "Service"}
+    for cfg_name in ("pipeline.json", "retry-job.json"):
+        cfg = json.loads(
+            (REPO / "deploy" / "config" / cfg_name).read_text())
+        host = cfg.get("bus", {}).get("host")
+        if host:
+            assert host in services, (cfg_name, host, services)
+
+
+def test_probes_hit_real_endpoints():
+    """Liveness/readiness paths must be routes the server serves
+    (/health, /readyz on the pipeline; /health on the exporter)."""
+    for name, _, pod in _pod_specs():
+        for c in pod["containers"]:
+            for probe in ("readinessProbe", "livenessProbe"):
+                http = c.get(probe, {}).get("httpGet")
+                if http:
+                    assert http["path"] in ("/health", "/readyz"), (
+                        name, http["path"])
+
+
+def test_stateful_roles_mount_the_shared_volume():
+    """Role-split contract (deploy/README.md): every store-touching role
+    mounts the shared data volume."""
+    for name, doc, pod in _pod_specs():
+        mounts = {m["mountPath"] for c in pod["containers"]
+                  for m in c.get("volumeMounts", [])}
+        assert "/data" in mounts, name
+
+
+def test_compose_services_restart():
+    compose = yaml.safe_load(
+        (REPO / "deploy" / "docker-compose.yml").read_text())
+    for name, svc in compose["services"].items():
+        assert svc.get("restart") == "unless-stopped", name

@@ -176,6 +176,33 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
     if cfg.get("serve_ui", True):
         router.merge(ui_router())
 
+    @router.get("/api/ops")
+    def ops(req):
+        """Operator snapshot powering the UI's Ops page.
+
+        Collection counts, per-routing-key bus depths, dead letters,
+        and per-stage pending backlogs (the same stuck filters the
+        retry job requeues — ``tools.retry_job.pending_counts``).
+        Prometheus scrapes the equivalent gauges from /metrics."""
+        from copilot_for_consensus_tpu.tools.retry_job import (
+            pending_counts,
+        )
+
+        try:
+            depths = pipeline.routing_key_depths()
+        except Exception:
+            depths = {}
+        queues = {k: v for k, v in sorted(depths.items())
+                  if not k.endswith(".dlq")}
+        dead = {k: v for k, v in sorted(depths.items())
+                if k.endswith(".dlq") and v}
+        return {
+            "collections": pipeline.reporting.stats(),
+            "queues": queues,
+            "dead_letters": dead,
+            "pending": pending_counts(pipeline.store),
+        }
+
     @router.get("/api/openapi.json")
     def openapi(req):
         """OpenAPI 3.1 spec generated from the live route table."""

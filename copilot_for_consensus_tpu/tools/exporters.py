@@ -29,7 +29,7 @@ from typing import Any
 
 from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
 from copilot_for_consensus_tpu.storage.registry import KNOWN_COLLECTIONS
-from copilot_for_consensus_tpu.tools.retry_job import default_rules
+from copilot_for_consensus_tpu.tools.retry_job import pending_counts
 
 
 @dataclass
@@ -57,15 +57,10 @@ class StatsExporter:
                 n = -1  # collection unreadable: surface as -1, not absence
             m.gauge("collection_documents", float(n),
                     labels={"collection": coll})
-        for rule in default_rules():
-            try:
-                pending = self.store.count_documents(rule.collection,
-                                                     rule.stuck_filter)
-            except Exception:
-                pending = -1
+        for coll, pending in pending_counts(self.store).items():
             m.gauge("documents_pending", float(pending),
-                    labels={"collection": rule.collection,
-                            "stage": _stage_name(rule.collection)})
+                    labels={"collection": coll,
+                            "stage": _stage_name(coll)})
         if self.vector_store is not None:
             try:
                 m.gauge("vectorstore_vectors",

@@ -68,6 +68,23 @@ def default_rules() -> list[RetryRule]:
     ]
 
 
+def pending_counts(store: Any,
+                   rules: list[RetryRule] | None = None) -> dict[str, int]:
+    """Per-collection count of documents matching the retry rules' stuck
+    filters (-1 = the store query raised). The single definition of
+    "pending by stage" shared by the stats exporter's gauges and the
+    gateway's /api/ops snapshot — if a stuck filter changes, both views
+    move together."""
+    out: dict[str, int] = {}
+    for rule in rules or default_rules():
+        try:
+            out[rule.collection] = store.count_documents(
+                rule.collection, rule.stuck_filter)
+        except Exception:
+            out[rule.collection] = -1
+    return out
+
+
 @dataclass
 class RetryStuckDocumentsJob:
     store: Any

@@ -104,11 +104,34 @@ async function pageCallback() {
   } catch (e) { err(e); }
 }
 
+const PAGE = 25;
+
+function pager(offset, got, onMove) {
+  // got < PAGE ⇒ last page. Renders into #pager, wires prev/next.
+  const el = $("#pager");
+  if (!el) return;
+  el.innerHTML = `
+    <button class="btn sm ghost" id="pg-prev" ${offset ? "" : "disabled"}>← Newer</button>
+    <span class="muted">${got ? `${offset + 1}–${offset + got}` : "end of list"}</span>
+    <button class="btn sm ghost" id="pg-next" ${got < PAGE ? "disabled" : ""}>Older →</button>`;
+  $("#pg-prev").onclick = () => onMove(Math.max(0, offset - PAGE));
+  $("#pg-next").onclick = () => onMove(offset + PAGE);
+}
+
+function emptyPage(offset, firstRunMsg) {
+  // Past the last page, the empty state must not masquerade as a
+  // first-run "nothing ingested yet" message.
+  return offset
+    ? `<div class="card muted">No more items — use “Newer” to go back.</div>`
+    : `<div class="card muted">${firstRunMsg}</div>`;
+}
+
 async function pageReports() {
   render(`<div class="toolbar"><h2>Reports</h2>
     <form id="search" class="inline"><input name="topic" placeholder="Search topics…">
     <label class="check"><input type="checkbox" name="semantic" checked> semantic</label>
-    <button class="btn">Search</button></form></div><div id="list" class="stack"></div>`);
+    <button class="btn">Search</button></form></div>
+    <div id="list" class="stack"></div><div id="pager" class="pager"></div>`);
   const list = $("#list");
   const show = (reports) => {
     list.innerHTML = reports.length ? reports.map((r) => `
@@ -117,18 +140,27 @@ async function pageReports() {
         <p class="muted">${esc((r.summary_text || r.summary || "").slice(0, 220))}</p></div>
         <div class="meta"><span>${fmtDate(r.published_at)}</span>
         ${r.consensus ? `<span class="tag ok">consensus: ${esc(r.consensus.level || r.consensus)}</span>` : ""}
-        </div></a>`).join("") : `<div class="card muted">No reports yet — trigger a source to run the pipeline.</div>`;
+        </div></a>`).join("") : emptyPage(curOffset, "No reports yet — trigger a source to run the pipeline.");
+  };
+  let curOffset = 0;
+  const load = async (offset) => {
+    try {
+      const rs = (await api(`/api/reports?limit=${PAGE}&offset=${offset}`)).reports;
+      curOffset = offset;
+      show(rs); pager(offset, rs.length, load);
+    } catch (e) { err(e); }
   };
   $("#search").onsubmit = async (ev) => {
     ev.preventDefault();
     const fd = new FormData(ev.target);
     const topic = fd.get("topic");
     try {
-      if (!topic) { show((await api("/api/reports")).reports); return; }
+      if (!topic) { load(0); return; }
       show((await api(`/api/reports/search?topic=${encodeURIComponent(topic)}&semantic=${fd.get("semantic") ? "true" : "false"}`)).reports);
+      $("#pager").innerHTML = "";
     } catch (e) { err(e); }
   };
-  try { show((await api("/api/reports")).reports); } catch (e) { err(e); }
+  load(0);
 }
 
 async function pageReportDetail(id) {
@@ -150,16 +182,53 @@ async function pageReportDetail(id) {
 }
 
 async function pageThreads() {
-  try {
-    const t = (await api("/api/threads")).threads;
-    render(`<div class="toolbar"><h2>Discussions</h2></div><div class="stack">` +
-      (t.length ? t.map((x) => `
+  render(`<div class="toolbar"><h2>Discussions</h2></div>
+    <div id="list" class="stack"></div><div id="pager" class="pager"></div>`);
+  const load = async (offset) => {
+    try {
+      const t = (await api(`/api/threads?limit=${PAGE}&offset=${offset}`)).threads;
+      $("#list").innerHTML = t.length ? t.map((x) => `
         <a class="card row" href="#/threads/${esc(x.thread_id)}">
           <div><h3>${esc(x.subject || x.thread_id)}</h3>
           <p class="muted">${(x.participants || []).slice(0, 5).map(esc).join(", ")}</p></div>
           <div class="meta"><span>${esc(x.message_count || 0)} messages</span></div></a>`).join("")
-        : `<div class="card muted">No discussions parsed yet.</div>`) + `</div>`);
-  } catch (e) { err(e); }
+        : emptyPage(offset, "No discussions parsed yet.");
+      pager(offset, t.length, load);
+    } catch (e) { err(e); }
+  };
+  load(0);
+}
+
+async function pageOps() {
+  render(`<div class="toolbar"><h2>Pipeline operations</h2>
+    <label class="check"><input type="checkbox" id="auto" checked> auto-refresh</label></div>
+    <div class="grid">
+      <div class="card"><h3>Documents</h3><dl id="colls" class="stats"></dl></div>
+      <div class="card"><h3>Pending by stage</h3><dl id="pending" class="stats"></dl></div>
+      <div class="card"><h3>Bus queues</h3><dl id="queues" class="stats"></dl></div>
+      <div class="card"><h3>Dead letters</h3><dl id="dlq" class="stats"></dl></div>
+    </div>`);
+  const dl = (obj, warnAt) => Object.entries(obj).map(([k, v]) =>
+    `<dt>${esc(k)}</dt><dd${warnAt != null && v >= warnAt ? ' class="warn"' : ""}>${esc(v)}</dd>`)
+    .join("") || `<dd class="muted">—</dd>`;
+  const refresh = async () => {
+    try {
+      const o = await api("/api/ops");
+      $("#colls").innerHTML = dl(o.collections);
+      $("#pending").innerHTML = dl(o.pending, 50);   // alert-tier threshold
+      $("#queues").innerHTML = dl(o.queues, 1000);
+      $("#dlq").innerHTML = dl(o.dead_letters, 1);
+    } catch (e) { err(e); }
+  };
+  await refresh();
+  // Capture THIS page's checkbox: re-querying #auto would find a fresh
+  // Ops page's element after navigating away and back, so the old
+  // timer would never clear and polls would stack.
+  const auto = $("#auto");
+  const timer = setInterval(() => {
+    if (!document.body.contains(auto)) { clearInterval(timer); return; }
+    if (auto.checked) refresh();
+  }, 5000);
 }
 
 async function pageThreadDetail(id) {
@@ -306,6 +375,7 @@ const routes = [
   [/^#\/threads\/([^/]+)$/, (m) => pageThreadDetail(m[1])],
   [/^#\/messages\/([^/]+)$/, (m) => pageMessageDetail(m[1])],
   [/^#\/sources$/, pageSources],
+  [/^#\/ops$/, pageOps],
   [/^#\/admin$/, pageAdmin],
 ];
 

@@ -108,6 +108,28 @@ def test_stateful_roles_mount_the_shared_volume():
         assert "/data" in mounts, name
 
 
+def test_rwo_volume_mounters_coschedule_with_pipeline():
+    """The shared data PVC is RWO block storage: every OTHER pod that
+    mounts it must carry a hard podAffinity to the pipeline pod's node,
+    or it deadlocks in Multi-Attach on any multi-node cluster."""
+    for name, doc, pod in _pod_specs():
+        labels = (doc.get("spec", {}).get("template", {})
+                  .get("metadata", {}).get("labels", {})
+                  or doc.get("spec", {}).get("jobTemplate", {})
+                  .get("spec", {}).get("template", {})
+                  .get("metadata", {}).get("labels", {}))
+        mounts_data = any(
+            v.get("persistentVolumeClaim", {}).get("claimName")
+            == "copilot-data" for v in pod.get("volumes", []))
+        if not mounts_data or labels.get("role") == "pipeline":
+            continue
+        rules = (pod.get("affinity", {}).get("podAffinity", {})
+                 .get("requiredDuringSchedulingIgnoredDuringExecution"))
+        assert rules, f"{name}: missing podAffinity to the pipeline pod"
+        assert any(r["labelSelector"]["matchLabels"].get("role")
+                   == "pipeline" for r in rules), name
+
+
 def test_compose_services_restart():
     compose = yaml.safe_load(
         (REPO / "deploy" / "docker-compose.yml").read_text())

@@ -214,3 +214,16 @@ def test_pending_login_cap():
     for _ in range(64):
         svc.initiate_login("mock")
     assert len(svc._pending) <= 16
+
+
+def test_ops_snapshot(server, tokens):
+    """/api/ops: operator snapshot behind auth — collections, queue
+    depths, dead letters, per-stage pending (the UI Ops page's data)."""
+    status, _ = _call(server.port, "/api/ops")
+    assert status == 401                       # guarded
+    status, ops = _call(server.port, "/api/ops",
+                        token=tokens["reader@example.org"])
+    assert status == 200
+    assert set(ops) == {"collections", "queues", "dead_letters", "pending"}
+    assert "reports" in ops["collections"]
+    assert set(ops["pending"]) == {"archives", "messages", "chunks"}

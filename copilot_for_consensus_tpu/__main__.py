@@ -34,8 +34,17 @@ def _load_config(path: str | None) -> dict:
 def _cmd_serve(args: argparse.Namespace) -> int:
     from copilot_for_consensus_tpu.services.bootstrap import serve_pipeline
 
-    server = serve_pipeline(_load_config(args.config),
-                            host=args.host, port=args.port)
+    cfg = _load_config(args.config)
+    # Presence of the key opts in — an EMPTY section means TPU-pod
+    # auto-discovery (deploy/README.md), so truthiness is the wrong gate.
+    if "multihost" in cfg:
+        # Must join the distributed runtime BEFORE any engine triggers a
+        # device query — jax.devices() then spans the whole slice/pod.
+        from copilot_for_consensus_tpu.parallel.multihost import (
+            initialize_multihost,
+        )
+        initialize_multihost(cfg["multihost"])
+    server = serve_pipeline(cfg, host=args.host, port=args.port)
     server.start()
     print(json.dumps({"event": "serving", "host": args.host,
                       "port": server.port}), flush=True)

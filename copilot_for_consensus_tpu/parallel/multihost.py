@@ -1,0 +1,132 @@
+"""Multi-host (multi-process) JAX runtime initialization.
+
+The reference scales across machines with NCCL-less infrastructure —
+RabbitMQ fan-out between service replicas (SURVEY §2.3). The TPU-native
+equivalent is two-tier (SURVEY §5 "distributed communication backend"):
+XLA collectives over ICI within a slice and DCN between hosts, which
+requires every process in the job to join one JAX distributed runtime
+before any device query. This module is that join, config-driven like
+everything else (geometry comes from the config file, never from raw
+environment reads — the repo's env-var policy test enforces this).
+
+On Cloud TPU pods ``jax.distributed.initialize()`` auto-discovers the
+coordinator and process ids from the TPU metadata; explicit settings
+exist for CPU/GPU clusters, tests, and non-standard launchers. After
+initialization, ``jax.devices()`` spans all hosts and
+``parallel.mesh.build_mesh`` lays any dp/tp/pp/sp/ep mesh over the
+global device set — collectives ride ICI within a host's chips and DCN
+across hosts, inserted by XLA from the same shardings used everywhere
+else (no separate code path).
+
+Usage (engine-role process on each host of a slice):
+
+    from copilot_for_consensus_tpu.parallel.multihost import (
+        MultiHostConfig, initialize_multihost)
+    initialize_multihost(MultiHostConfig(
+        coordinator_address="host0:8476", num_processes=4, process_id=i))
+    mesh = build_mesh(MeshConfig(dp=4, tp=4))   # global devices
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+_INITIALIZED = False
+
+
+@dataclass(frozen=True)
+class MultiHostConfig:
+    """Explicit job geometry; every field None = TPU-pod auto-discovery.
+
+    coordinator_address: "host:port" of process 0's coordinator service.
+    num_processes: total processes in the job.
+    process_id: this process's rank in [0, num_processes).
+    local_device_ids: restrict this process to a subset of its local
+        devices (rarely needed outside tests).
+    """
+
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+    local_device_ids: tuple[int, ...] | None = None
+
+    @classmethod
+    def from_config(cls, cfg: Mapping[str, Any] | bool | None
+                    ) -> "MultiHostConfig":
+        # `multihost: true` in a config file means "auto-discover",
+        # same as an empty section.
+        c = dict(cfg) if isinstance(cfg, Mapping) else {}
+        ids = c.get("local_device_ids")
+        return cls(
+            coordinator_address=c.get("coordinator_address"),
+            num_processes=c.get("num_processes"),
+            process_id=c.get("process_id"),
+            local_device_ids=tuple(ids) if ids is not None else None,
+        )
+
+    @property
+    def is_explicit(self) -> bool:
+        return self.coordinator_address is not None
+
+    def validate(self) -> None:
+        if not self.is_explicit:
+            return
+        if self.num_processes is None or self.process_id is None:
+            raise ValueError(
+                "explicit multihost config needs num_processes and "
+                "process_id alongside coordinator_address")
+        if not (0 <= self.process_id < self.num_processes):
+            raise ValueError(
+                f"process_id {self.process_id} out of range for "
+                f"{self.num_processes} processes")
+
+
+def initialize_multihost(cfg: MultiHostConfig | Mapping[str, Any] | None
+                         = None) -> bool:
+    """Join the JAX distributed runtime. Returns True if this call
+    initialized it, False if it was a no-op (already initialized, or a
+    single-process config). MUST run before the first device query."""
+    global _INITIALIZED
+    import jax
+
+    if not isinstance(cfg, MultiHostConfig):
+        cfg = MultiHostConfig.from_config(cfg)
+    cfg.validate()
+    if _INITIALIZED:
+        return False
+    if cfg.is_explicit and cfg.num_processes == 1:
+        return False                       # nothing to coordinate
+    kwargs: dict[str, Any] = {}
+    if cfg.is_explicit:
+        kwargs = {
+            "coordinator_address": cfg.coordinator_address,
+            "num_processes": cfg.num_processes,
+            "process_id": cfg.process_id,
+        }
+        if cfg.local_device_ids is not None:
+            kwargs["local_device_ids"] = list(cfg.local_device_ids)
+        jax.distributed.initialize(**kwargs)
+    else:
+        # TPU-pod auto-discovery; harmless single-process no-op is NOT
+        # guaranteed here, so only auto-init when a pod environment is
+        # plausible — callers on one host simply skip the call.
+        jax.distributed.initialize()
+    _INITIALIZED = True
+    return True
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def is_multihost() -> bool:
+    return process_count() > 1

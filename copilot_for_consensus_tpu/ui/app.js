@@ -156,7 +156,12 @@ async function pageReports() {
     const topic = fd.get("topic");
     try {
       if (!topic) { load(0); return; }
-      show((await api(`/api/reports/search?topic=${encodeURIComponent(topic)}&semantic=${fd.get("semantic") ? "true" : "false"}`)).reports);
+      const rs = (await api(`/api/reports/search?topic=${encodeURIComponent(topic)}&semantic=${fd.get("semantic") ? "true" : "false"}`)).reports;
+      // Search has its own empty state — reusing the pagination-aware
+      // one would misreport "no matches" as "past the last page".
+      if (rs.length) show(rs);
+      else list.innerHTML =
+        `<div class="card muted">No reports match “${esc(topic)}”.</div>`;
       $("#pager").innerHTML = "";
     } catch (e) { err(e); }
   };

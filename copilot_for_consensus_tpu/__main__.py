@@ -35,15 +35,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     from copilot_for_consensus_tpu.services.bootstrap import serve_pipeline
 
     cfg = _load_config(args.config)
-    # Presence of the key opts in — an EMPTY section means TPU-pod
-    # auto-discovery (deploy/README.md), so truthiness is the wrong gate.
-    if "multihost" in cfg:
+    # An EMPTY multihost section (or `true`) means TPU-pod
+    # auto-discovery, so plain truthiness is the wrong gate; `false` /
+    # `null` explicitly disable.
+    mh = cfg.get("multihost")
+    if mh is not None and mh is not False:
         # Must join the distributed runtime BEFORE any engine triggers a
         # device query — jax.devices() then spans the whole slice/pod.
         from copilot_for_consensus_tpu.parallel.multihost import (
             initialize_multihost,
         )
-        initialize_multihost(cfg["multihost"])
+        initialize_multihost(mh)
     server = serve_pipeline(cfg, host=args.host, port=args.port)
     server.start()
     print(json.dumps({"event": "serving", "host": args.host,

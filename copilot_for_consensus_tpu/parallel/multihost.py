@@ -71,6 +71,11 @@ class MultiHostConfig:
 
     def validate(self) -> None:
         if not self.is_explicit:
+            if self.num_processes is not None or self.process_id is not None:
+                raise ValueError(
+                    "num_processes/process_id given without "
+                    "coordinator_address — explicit geometry needs all "
+                    "three (or omit all for TPU-pod auto-discovery)")
             return
         if self.num_processes is None or self.process_id is None:
             raise ValueError(

@@ -37,6 +37,7 @@ def create_summarizer(config: Any = None, **kwargs: Any) -> Summarizer:
             max_len=int(_cfg_get(config, "max_len", 4096)),
             checkpoint=_cfg_get(config, "checkpoint"),
             kv_dtype=_cfg_get(config, "kv_dtype"),
+            quantize=_cfg_get(config, "quantize", "int8"),
             long_context=bool(_cfg_get(config, "long_context", False)),
             profile_dir=_cfg_get(config, "profile_dir"),
             **kwargs,

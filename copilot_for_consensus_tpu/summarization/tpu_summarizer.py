@@ -54,6 +54,7 @@ class TPUSummarizer(Summarizer):
                  max_len: int = 4096, params=None, mesh=None, dtype=None,
                  checkpoint: str | None = None, long_engine=None,
                  long_context: bool = False, kv_dtype: str | None = None,
+                 quantize: bool | str = "int8",
                  profile_dir: str | None = None):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
@@ -95,11 +96,16 @@ class TPUSummarizer(Summarizer):
                             f"checkpoint {checkpoint} has no "
                             "tokenizer.json; pass tokenizer= explicitly")
             else:
+                # No checkpoint: random weights (bench/dev). Serving
+                # dtypes still matter — a 7B bf16 init would not fit one
+                # chip, so weights default to int8 (checkpoints carry
+                # their own quantization mode in metadata instead).
                 cfg = decoder_config(model)
                 engine = GenerationEngine(
                     cfg, params, mesh=mesh, num_slots=num_slots,
                     max_len=min(max_len, cfg.max_seq_len),
                     profile_dir=profile_dir, kv_dtype=kv_dtype,
+                    quantize=quantize,
                     dtype=dtype if dtype is not None else jnp.bfloat16)
         self.engine = engine
         if long_engine is None and long_context:

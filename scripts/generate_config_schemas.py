@@ -224,7 +224,7 @@ DRIVERS: dict[str, dict[str, dict]] = {
         "mock": dict(max_sentences=3),
         "tpu": dict(model="mistral-7b", max_new_tokens=256, num_slots=4,
                     max_len=4096, checkpoint="", long_context=False,
-                    kv_dtype="", profile_dir=""),
+                    kv_dtype="", quantize="int8", profile_dir=""),
     },
     "chunker": {
         "token_window": dict(chunk_size=384, overlap=50,

@@ -36,6 +36,10 @@ def test_config_parsing_and_validation():
     MultiHostConfig().validate()
     assert not MultiHostConfig.from_config(True).is_explicit
     assert not MultiHostConfig.from_config({}).is_explicit
+    # stray geometry without a coordinator is a config error, not a
+    # silent fall-through into auto-discovery
+    with pytest.raises(ValueError, match="without"):
+        MultiHostConfig(num_processes=4, process_id=2).validate()
 
 
 def test_single_process_explicit_is_noop():

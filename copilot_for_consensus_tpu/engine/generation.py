@@ -120,6 +120,7 @@ class GenerationEngine:
         attn_impl: str = "auto",
         quantize: bool | str = False,
         decode_window: int = 8,
+        windows_per_dispatch: int = 1,
         profile_dir: str | None = None,
     ):
         self.profile_dir = profile_dir
@@ -138,10 +139,17 @@ class GenerationEngine:
         self._eos_set = frozenset(int(e) for e in eos_list)
         self.attn_impl = attn_impl
         self.decode_window = max(1, decode_window)
-        if self.max_len - self.decode_window < 1:
+        # How many windows one dispatch chains in-program. >1 amortizes
+        # the host↔device sync (expensive over the tunnel) at the cost
+        # of coarser retirement/admission granularity — right for batch
+        # workloads, 1 for latency-sensitive serving.
+        self.windows_per_dispatch = max(1, windows_per_dispatch)
+        self._dispatch_steps = self.decode_window * self.windows_per_dispatch
+        if self.max_len - self._dispatch_steps < 1:
             raise ValueError(
-                f"decode_window {self.decode_window} leaves no prompt room "
-                f"in max_len {self.max_len}")
+                f"decode_window {self.decode_window} x "
+                f"{self.windows_per_dispatch} windows/dispatch leaves no "
+                f"prompt room in max_len {self.max_len}")
         self._key = jax.random.PRNGKey(seed)
 
         # quantize: False | True ("int8") | "int8" | "int4". int4 packs
@@ -231,53 +239,75 @@ class GenerationEngine:
 
         self._insert_fn = jax.jit(_insert_batch, donate_argnums=(0,))
 
-        def _decode(params, tokens, positions, cache, key, *, kv_len):
-            """``decode_window`` steps fused in one program: decode →
-            sample → feed back, all on-device. One dispatch and one host
-            sync per window instead of per token — the difference between
+        def _decode(params, tokens, positions, cache, key, *, kv_len,
+                    n_windows=1):
+            """``n_windows × decode_window`` steps fused in one program:
+            decode → sample → feed back, all on-device. One dispatch and
+            one host sync per program — the difference between
             dispatch-bound and HBM-bound decode (per-step dispatch
-            measured 839 tok/s vs 2778 here; the axon tunnel makes
-            dispatches expensive).
+            measured 839 tok/s vs 2778 fused; the axon tunnel makes
+            every dispatch/sync expensive, which is also why n_windows
+            exists: chaining windows IN-program amortizes the sync
+            without growing the window buffers).
 
-            The big KV cache stays OUT of the scan carry: a carried
-            cache is re-materialized by XLA every step (~2× cache bytes
-            per token — measured 2778→1841 tok/s going max_len 256→512
-            with identical attended work, before this design). Fresh KV
-            accumulates in small [B, W, L, Hkv, Dh] window buffers and
-            merges into the cache once per window. ``kv_len`` (static,
-            bucketed by the caller) bounds the cache prefix attention
-            reads."""
+            The big KV cache stays OUT of the inner scan carry: a
+            per-step carried cache is re-materialized by XLA every token
+            (~2× cache bytes — measured 2778→1841 tok/s going max_len
+            256→512 with identical attended work, before this design).
+            Fresh KV accumulates in small [L, B, Hkv, W, Dh] window
+            buffers and merges into the cache once per window; only the
+            OUTER per-window scan carries the cache, so its
+            re-materialization amortizes over ``decode_window`` steps.
+            ``kv_len`` (static, bucketed by the caller) bounds the cache
+            prefix attention reads and must cover all n_windows."""
             w_sz = self.decode_window
             n_l = cfg.n_layers
             b = tokens.shape[0]
             shape = (n_l, b, cfg.n_kv_heads, w_sz, cfg.head_dim)
-            k_win = jnp.zeros(shape, self.kv_dtype)
-            v_win = jnp.zeros(shape, self.kv_dtype)
 
-            def body(carry, w):
-                tok, k_win, v_win, key = carry
-                key, sub = jax.random.split(key)
-                logits, k_cols, v_cols = decoder.decode_step_windowed(
-                    params, tok, positions, w, cfg, cache, k_win, v_win,
-                    kv_len=kv_len)
-                # k_cols: [L, B, H, D] → window column [L, B, H, 1, D]
-                k_win = jax.lax.dynamic_update_slice_in_dim(
-                    k_win, k_cols[:, :, :, None].astype(k_win.dtype),
-                    w, axis=3)
-                v_win = jax.lax.dynamic_update_slice_in_dim(
-                    v_win, v_cols[:, :, :, None].astype(v_win.dtype),
-                    w, axis=3)
-                nxt = sample(logits, sub, self.sampling)
-                return (nxt, k_win, v_win, key), nxt
+            def run_window(tok, cache, key, pos_w):
+                k_win = jnp.zeros(shape, self.kv_dtype)
+                v_win = jnp.zeros(shape, self.kv_dtype)
 
-            (tok, k_win, v_win, _), toks = jax.lax.scan(
-                body, (tokens, k_win, v_win, key), jnp.arange(w_sz))
-            cache = decoder.merge_window(cache, k_win, v_win, positions,
-                                         steps=w_sz)
-            return toks, cache          # toks: [window, slots]
+                def body(carry, w):
+                    tok, k_win, v_win, key = carry
+                    key, sub = jax.random.split(key)
+                    logits, k_cols, v_cols = decoder.decode_step_windowed(
+                        params, tok, pos_w, w, cfg, cache, k_win, v_win,
+                        kv_len=kv_len)
+                    # k_cols: [L, B, H, D] → window col [L, B, H, 1, D]
+                    k_win = jax.lax.dynamic_update_slice_in_dim(
+                        k_win, k_cols[:, :, :, None].astype(k_win.dtype),
+                        w, axis=3)
+                    v_win = jax.lax.dynamic_update_slice_in_dim(
+                        v_win, v_cols[:, :, :, None].astype(v_win.dtype),
+                        w, axis=3)
+                    nxt = sample(logits, sub, self.sampling)
+                    return (nxt, k_win, v_win, key), nxt
+
+                (tok, k_win, v_win, key), toks = jax.lax.scan(
+                    body, (tok, k_win, v_win, key), jnp.arange(w_sz))
+                cache = decoder.merge_window(cache, k_win, v_win, pos_w,
+                                             steps=w_sz)
+                return tok, cache, key, toks
+
+            if n_windows == 1:
+                _, cache, _, toks = run_window(tokens, cache, key,
+                                               positions)
+                return toks, cache      # toks: [window, slots]
+
+            def outer(carry, widx):
+                tok, cache, key = carry
+                tok, cache, key, toks = run_window(
+                    tok, cache, key, positions + widx * w_sz)
+                return (tok, cache, key), toks
+
+            (_, cache, _), toks = jax.lax.scan(
+                outer, (tokens, cache, key), jnp.arange(n_windows))
+            return toks.reshape(n_windows * w_sz, b), cache
 
         self._decode_fn = jax.jit(_decode, donate_argnums=(3,),
-                                  static_argnames=("kv_len",))
+                                  static_argnames=("kv_len", "n_windows"))
 
         def _sample_only(logits, key):
             return sample(logits, key, self.sampling)
@@ -328,7 +358,7 @@ class GenerationEngine:
         window of cache headroom, capped by the largest prefill bucket).
         Callers with longer prompts should route to the long-context
         engine (``engine/longctx.py``)."""
-        return min(self.max_len - self.decode_window, self.buckets[-1])
+        return min(self.max_len - self._dispatch_steps, self.buckets[-1])
 
     def submit(self, prompt: list[int], max_new_tokens: int = 256) -> int:
         """Enqueue a tokenized prompt; returns a request id."""
@@ -445,13 +475,13 @@ class GenerationEngine:
         occupied cache prefix rounded up to 128, so only a handful of
         decode programs ever compile."""
         if not self._active:
-            return min(128 + self.decode_window, self.max_len)
+            return min(128 + self._dispatch_steps, self.max_len)
         hi = max(int(self._positions[s]) for s in self._active)
-        need = hi + self.decode_window + 1
+        need = hi + self._dispatch_steps + 1
         return min(-(-need // 128) * 128, self.max_len)
 
     def _decode_once(self) -> None:
-        window = self.decode_window
+        window = self._dispatch_steps
         self._key, sub = jax.random.split(self._key)
         toks, self._cache = self._decode_fn(
             self.params,
@@ -460,8 +490,9 @@ class GenerationEngine:
             self._cache,
             sub,
             kv_len=self._kv_bucket(),
+            n_windows=self.windows_per_dispatch,
         )
-        toks = np.asarray(jax.device_get(toks))      # [window, slots]
+        toks = np.asarray(jax.device_get(toks))  # [dispatch_steps, slots]
         for slot, req in list(self._active.items()):
             gen = self._generated[slot]
             finished = None

@@ -71,11 +71,14 @@ class MultiHostConfig:
 
     def validate(self) -> None:
         if not self.is_explicit:
-            if self.num_processes is not None or self.process_id is not None:
+            if (self.num_processes is not None
+                    or self.process_id is not None
+                    or self.local_device_ids is not None):
                 raise ValueError(
-                    "num_processes/process_id given without "
-                    "coordinator_address — explicit geometry needs all "
-                    "three (or omit all for TPU-pod auto-discovery)")
+                    "num_processes/process_id/local_device_ids given "
+                    "without coordinator_address — explicit geometry "
+                    "needs the coordinator (or omit everything for "
+                    "TPU-pod auto-discovery)")
             return
         if self.num_processes is None or self.process_id is None:
             raise ValueError(

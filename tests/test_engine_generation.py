@@ -37,9 +37,10 @@ def _engine(**kw):
     return GenerationEngine(CFG, PARAMS, **kw)
 
 
-@pytest.mark.parametrize("window", [1, 4])
-def test_greedy_matches_naive_forward(window):
-    eng = _engine(decode_window=window)
+@pytest.mark.parametrize("window,n_windows", [(1, 1), (4, 1),
+                                              (4, 2), (2, 3)])
+def test_greedy_matches_naive_forward(window, n_windows):
+    eng = _engine(decode_window=window, windows_per_dispatch=n_windows)
     prompts = [[5, 9, 13], [40, 41, 42, 43, 44, 45, 46]]
     comps = eng.generate(prompts, max_new_tokens=6)
     for p, c in zip(prompts, comps):

@@ -46,6 +46,16 @@ class VectorStore(abc.ABC):
     def query(self, vector: Sequence[float], top_k: int = 10,
               flt: Mapping[str, Any] | None = None) -> list[QueryResult]: ...
 
+    def query_batch(self, vectors: Sequence[Sequence[float]],
+                    top_k: int = 10,
+                    flt: Mapping[str, Any] | None = None
+                    ) -> list[list[QueryResult]]:
+        """Many queries at once. The base implementation loops; device
+        drivers override it with one fused dispatch — on hardware where
+        each dispatch costs a host↔device round trip, this is the
+        difference between latency-bound and compute-bound search."""
+        return [self.query(v, top_k, flt) for v in vectors]
+
     @abc.abstractmethod
     def get(self, vec_id: str) -> tuple[list[float], dict[str, Any]] | None: ...
 

@@ -48,7 +48,7 @@ class TPUVectorStore(InvertedIndexMixin, VectorStore):
         self._device = None                          # [capacity, dim]
         self._device_rows = 0                        # rows synced
         self._deleted_rows: set[int] = set()
-        self._query_fn = None
+        self._batch_query_fn = None
         self._patch_fn = None
 
     # -- lazy jax ------------------------------------------------------
@@ -178,6 +178,25 @@ class TPUVectorStore(InvertedIndexMixin, VectorStore):
                     return self._host_query(q, cand, top_k, flt)
             return self._device_query(q, top_k, flt)
 
+    def query_batch(self, vectors, top_k: int = 10, flt=None):
+        """B queries in ONE device dispatch: [B, D] @ HBM matrixᵀ with a
+        per-row top-k. Single queries over the tunnel are round-trip
+        latency-bound (~5 QPS measured at 100k×384); batching moves the
+        store to compute-bound territory (~1000 QPS at batch 256)."""
+        with self._lock:
+            n = len(self._ids)
+            if n == 0 or self._dim is None:
+                return [[] for _ in vectors]
+            qs = np.asarray(list(vectors), dtype=np.float32)
+            norms = np.linalg.norm(qs, axis=1, keepdims=True)
+            qs = np.where(norms > 0, qs / np.maximum(norms, 1e-30), qs)
+            if flt:
+                cand = self._filter_rows(flt)
+                if cand is not None and len(cand) <= _SELECTIVE_HOST_LIMIT:
+                    return [self._host_query(q, cand, top_k, flt)
+                            for q in qs]
+            return self._device_query_many(qs, top_k, flt)
+
     def _filter_rows(self, flt: Mapping[str, Any]) -> list[int] | None:
         """Candidate rows via the shared inverted index (superset guess;
         callers re-verify with matches_filter); None = not decidable."""
@@ -200,37 +219,56 @@ class TPUVectorStore(InvertedIndexMixin, VectorStore):
         ]
 
     def _device_query(self, q, top_k: int, flt):
+        return self._device_query_many(np.asarray(q, np.float32)[None],
+                                       top_k, flt)[0]
+
+    def _device_query_many(self, qs: np.ndarray, top_k: int, flt
+                           ) -> list[list[QueryResult]]:
+        """ONE implementation for single and batched device search:
+        fused [B, D] @ matrixᵀ + per-row top-k, with top-k oversampling
+        escalation for filtered/deleted rows. Escalation rounds rescore
+        only the still-pending queries, and stop once k covers every
+        live-or-dead row ever added (``len(self._ids)`` — deletes keep
+        their id slot, so that IS the row count)."""
         jaxmod, jnp = self._jax()
-        if self._query_fn is None:
+        if self._batch_query_fn is None:
             def run(matrix, qv, k):
-                scores = (matrix @ qv.astype(matrix.dtype)).astype(
-                    jnp.float32)
-                return jaxmod.lax.top_k(scores, k)
-            self._query_fn = jaxmod.jit(run, static_argnames=("k",))
+                scores = (qv.astype(matrix.dtype)
+                          @ matrix.T).astype(jnp.float32)
+                return jaxmod.lax.top_k(scores, k)       # [B, k] each
+            self._batch_query_fn = jaxmod.jit(run, static_argnames=("k",))
 
         capacity = self._device.shape[0]
         oversample = max(top_k, 16)
+        pending = list(range(len(qs)))
+        results: dict[int, list[QueryResult]] = {}
         while True:
             k = min(capacity, oversample)
-            vals, idx = self._query_fn(self._device,
-                                       jnp.asarray(q), k)
+            vals, idx = self._batch_query_fn(
+                self._device, jnp.asarray(qs[pending]), k)
             vals = np.asarray(vals)
             idx = np.asarray(idx)
-            out = []
-            for score, row in zip(vals, idx):
-                row = int(row)
-                if row >= len(self._ids) or row in self._deleted_rows:
-                    continue  # padding rows score ~0; skip
-                meta = self._metadata[row]
-                if flt and not matches_filter(meta, flt):
-                    continue
-                out.append(QueryResult(self._ids[row], float(score),
-                                       dict(meta)))
-                if len(out) == top_k:
-                    return out
-            if k >= capacity or k >= len(self._ids) + len(
-                    self._deleted_rows):
-                return out
+            still = []
+            for bi, qi in enumerate(pending):
+                out = []
+                for score, row in zip(vals[bi], idx[bi]):
+                    row = int(row)
+                    if row >= len(self._ids) or row in self._deleted_rows:
+                        continue  # padding rows score ~0; skip
+                    meta = self._metadata[row]
+                    if flt and not matches_filter(meta, flt):
+                        continue
+                    out.append(QueryResult(self._ids[row], float(score),
+                                           dict(meta)))
+                    if len(out) == top_k:
+                        break
+                results[qi] = out
+                if (len(out) < top_k and k < capacity
+                        and k < len(self._ids)):
+                    still.append(qi)
+            if not still:
+                return [results[i] for i in range(len(qs))]
+            pending = still
             oversample *= 4
 
     # -- deletes / persistence ----------------------------------------

@@ -105,3 +105,43 @@ def test_pipeline_runs_on_tpu_store(fixtures_dir):
         "location": str(fixtures_dir / "ietf-sample.mbox")})
     stats = p.ingest_and_run("s")
     assert stats["reports"] == stats["threads"] > 0
+
+
+def test_query_batch_matches_single_queries():
+    """One fused dispatch for B queries returns exactly what B single
+    queries return — including deleted-row skipping and metadata
+    filters."""
+    import numpy as np
+
+    from copilot_for_consensus_tpu.vectorstore import create_vector_store
+
+    rng = np.random.default_rng(3)
+    vs = create_vector_store({"driver": "tpu", "dimension": 16})
+    vs.connect()
+    vs.add_embeddings([
+        (f"v{i}", rng.standard_normal(16).astype(np.float32),
+         {"group": "a" if i % 2 else "b"})
+        for i in range(50)
+    ])
+    vs.delete(["v7", "v8"])
+    queries = [rng.standard_normal(16).astype(np.float32)
+               for _ in range(5)]
+
+    batch = vs.query_batch(queries, top_k=4)
+    singles = [vs.query(q, top_k=4) for q in queries]
+    assert len(batch) == 5
+    for b, s in zip(batch, singles):
+        assert [r.id for r in b] == [r.id for r in s]
+        assert all(abs(x.score - y.score) < 1e-5 for x, y in zip(b, s))
+
+    # filtered batch matches filtered singles
+    fb = vs.query_batch(queries, top_k=3, flt={"group": "a"})
+    fs = [vs.query(q, top_k=3, flt={"group": "a"}) for q in queries]
+    for b, s in zip(fb, fs):
+        assert [r.id for r in b] == [r.id for r in s]
+        assert all(r.metadata["group"] == "a" for r in b)
+
+    # empty store returns a list per query
+    empty = create_vector_store({"driver": "tpu", "dimension": 16})
+    empty.connect()
+    assert empty.query_batch(queries, top_k=3) == [[]] * 5

@@ -79,15 +79,20 @@ class TokenWindowChunker(Chunker):
             piece = words[start:start + words_per_chunk]
             chunk_text = " ".join(piece)
             tokens = estimate_tokens(chunk_text)
-            if (chunks and tokens < self.p.min_chunk_tokens
+            is_tail = start + words_per_chunk >= len(words)
+            if (chunks and is_tail and tokens < self.p.min_chunk_tokens
                     and chunks[-1].token_count + tokens <= self.p.max_chunk_tokens):
-                # merge small tail into the previous chunk
+                # Merge a small FINAL piece into the previous chunk.
+                # The tail check matters: when min_chunk_tokens exceeds
+                # chunk_size, every window is "small" — merging a
+                # mid-stream window and stopping would drop the words
+                # past it (found by the chunker fuzz harness).
                 merged = chunks[-1].text + " " + chunk_text
                 chunks[-1] = Chunk(chunks[-1].seq, merged,
                                    estimate_tokens(merged))
                 break
             chunks.append(Chunk(len(chunks), chunk_text, tokens))
-            if start + words_per_chunk >= len(words):
+            if is_tail:
                 break
             start += step
         return chunks

@@ -319,3 +319,67 @@ def test_sqlite_pushdown_matches_python_matcher(tmp_path_factory, docs,
     assert got == want, flt
     assert store.count_documents("chunks", flt) == len(want)
     store.close()
+
+
+# ---------------------------------------------------------------------------
+# int4 weight quantization (ops/quant_matmul.py + models/quant.py)
+# ---------------------------------------------------------------------------
+
+
+@fuzz_settings(50)
+@given(
+    d=st.integers(min_value=1, max_value=16).map(lambda x: x * 2),
+    f=st.integers(min_value=1, max_value=24),
+    data=st.data(),
+)
+def test_int4_pack_unpack_roundtrip_any_shape(d, f, data):
+    """pack_int4/unpack_int4 are exact inverses for every even row count
+    and any nibble values, with and without leading dims."""
+    import numpy as np
+
+    from copilot_for_consensus_tpu.ops.quant_matmul import (
+        pack_int4,
+        unpack_int4,
+    )
+
+    lead = data.draw(st.sampled_from([(), (3,)]))
+    q = np.asarray(
+        data.draw(st.lists(st.integers(-8, 7),
+                           min_size=int(np.prod(lead, dtype=int)) * d * f,
+                           max_size=int(np.prod(lead, dtype=int)) * d * f)),
+        dtype=np.int8).reshape(*lead, d, f)
+    packed = np.asarray(pack_int4(q))
+    assert packed.shape == (*lead, d // 2, f)
+    assert (np.asarray(unpack_int4(packed)) == q).all()
+
+
+@fuzz_settings(30)
+@given(
+    scale_pow=st.integers(min_value=-6, max_value=4),
+    d=st.sampled_from([2, 8, 64]),
+    f=st.integers(min_value=1, max_value=12),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_int4_quantize_dequant_error_bounded(scale_pow, d, f, seed):
+    """Group-wise int4 round-trip error is bounded by half a
+    quantization step per weight for ANY weight magnitude — the
+    invariant that catches scale-axis or packing-order regressions."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from copilot_for_consensus_tpu.models.quant import (
+        dequant_int4,
+        quantize_tensor_int4,
+    )
+
+    rng = np.random.default_rng(seed)
+    w = (rng.standard_normal((d, f)) * (10.0 ** scale_pow)).astype(
+        np.float32)
+    leaf = quantize_tensor_int4(jnp.asarray(w))
+    wd = np.asarray(dequant_int4(leaf, np.float32))
+    assert wd.shape == w.shape
+    # per-group amax/7 is the step; |err| <= step/2 (+ float slack)
+    g = np.asarray(leaf["scale"]).shape[-2]
+    amax = np.abs(w.reshape(g, d // g, f)).max(axis=1, keepdims=True)
+    step = np.broadcast_to(amax / 7.0, (g, d // g, f)).reshape(d, f)
+    assert (np.abs(wd - w) <= step / 2 + 1e-6 * (1 + step)).all()

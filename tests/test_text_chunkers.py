@@ -83,3 +83,21 @@ def test_draft_detection():
     assert detect_draft_mentions(text) == [
         "draft-ietf-quic-recovery", "draft-mueller-quic-var"]
     assert detect_draft_mentions("") == []
+
+
+def test_token_window_small_windows_drop_no_words():
+    """Regression (fuzz-found): with min_chunk_tokens > chunk_size every
+    window is 'small'; the tail-merge must still only fire on the true
+    final piece — a mid-stream merge used to stop chunking and drop the
+    rest of the text."""
+    from copilot_for_consensus_tpu.text.chunkers import (
+        _WORD_RE,
+        TokenWindowChunker,
+    )
+
+    text = "0 0 0 0 0 0 0 0 1"
+    chunks = TokenWindowChunker(chunk_size=8, overlap=6).chunk(text)
+    got = [w for c in chunks for w in _WORD_RE.findall(c.text)]
+    assert got.count("1") >= 1
+    for w in set(_WORD_RE.findall(text)):
+        assert got.count(w) >= _WORD_RE.findall(text).count(w)

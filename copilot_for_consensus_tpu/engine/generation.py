@@ -211,21 +211,6 @@ class GenerationEngine:
         # ---- jitted programs -------------------------------------------
         impl = attn_impl
 
-        def _prefill(params, tokens, lengths):
-            # Scratch straight in the serving cache dtype: prefill
-            # attention uses the fresh bf16 k/v, the scratch only
-            # ferries them to the insert — a bf16 scratch at full
-            # admission width was the largest admission-path transient
-            # (4.3 GB for 256×128 tokens).
-            scratch = decoder.init_cache(cfg, tokens.shape[0],
-                                         tokens.shape[1],
-                                         dtype=self.kv_dtype)
-            logits, scratch = decoder.prefill(params, tokens, lengths, cfg,
-                                              scratch, attn_impl=impl)
-            return logits, scratch
-
-        self._prefill_fn = jax.jit(_prefill)
-
         def _insert_batch(cache, pref, slots):
             """Insert N prefilled kv blocks into their slots in one
             program. ``slots`` may contain out-of-range ids for padded
@@ -237,7 +222,25 @@ class GenerationEngine:
                 pref["v"].astype(cache["v"].dtype), mode="drop")
             return {"k": k, "v": v}
 
-        self._insert_fn = jax.jit(_insert_batch, donate_argnums=(0,))
+        def _admit_fused(params, tokens, lengths, cache, slots, key):
+            """Prefill + cache insert + first-token sample as ONE
+            program — one dispatch and one sync per admission wave.
+            The prefill scratch is born in the serving cache dtype:
+            prefill attention uses the fresh bf16 k/v, the scratch only
+            ferries them to the insert, and a bf16 scratch at full
+            admission width was the largest admission-path transient
+            (4.3 GB for 256×128 tokens)."""
+            scratch = decoder.init_cache(cfg, tokens.shape[0],
+                                         tokens.shape[1],
+                                         dtype=self.kv_dtype)
+            logits, scratch = decoder.prefill(params, tokens, lengths,
+                                              cfg, scratch,
+                                              attn_impl=impl)
+            cache = _insert_batch(cache, scratch, slots)
+            first = sample(logits, key, self.sampling)
+            return first, cache
+
+        self._admit_fn = jax.jit(_admit_fused, donate_argnums=(3,))
 
         def _decode(params, tokens, positions, cache, key, *, kv_len,
                     n_windows=1):
@@ -308,11 +311,6 @@ class GenerationEngine:
 
         self._decode_fn = jax.jit(_decode, donate_argnums=(3,),
                                   static_argnames=("kv_len", "n_windows"))
-
-        def _sample_only(logits, key):
-            return sample(logits, key, self.sampling)
-
-        self._sample_fn = jax.jit(_sample_only)
 
         # ---- host-side slot state --------------------------------------
         self._free = list(range(num_slots))
@@ -450,13 +448,11 @@ class GenerationEngine:
             tokens[i, :plens[i]] = req.prompt
             lengths[i] = plens[i]
             slots[i] = slot
-        logits, pref_cache = self._prefill_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(lengths))
-        self._cache = self._insert_fn(self._cache, pref_cache,
-                                      jnp.asarray(slots))
         self._key, sub = jax.random.split(self._key)
-        first = np.asarray(jax.device_get(
-            self._sample_fn(logits, sub)))           # the ONE host sync
+        first_dev, self._cache = self._admit_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+            self._cache, jnp.asarray(slots), sub)
+        first = np.asarray(jax.device_get(first_dev))  # the ONE host sync
         prefill_s = time.monotonic() - t0
         for i, (slot, req) in enumerate(batch):
             tok = int(first[i])

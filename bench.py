@@ -52,7 +52,12 @@ def main() -> None:
     # Chaining windows in-program amortizes the per-dispatch host sync
     # (expensive over the tunnel) while keeping the efficient 32-step
     # window buffers; 3×32 = the full 96-token run in ONE dispatch.
-    n_windows = int(os.environ.get("BENCH_WINDOWS_PER_DISPATCH", "3"))
+    # Larger kv extents crash this toolchain's remote compile helper for
+    # the chained program (HTTP 500 at max_len 384/512), so the default
+    # falls back to single windows there.
+    default_windows = "3" if max_len <= 256 else "1"
+    n_windows = int(os.environ.get("BENCH_WINDOWS_PER_DISPATCH",
+                                   default_windows))
 
     import jax.numpy as jnp
     import numpy as np
@@ -96,8 +101,9 @@ def main() -> None:
         for _ in range(slots)
     ]
 
-    # Warmup: compile the steady-state programs — full-batch prefill,
-    # batched insert, and every decode kv bucket the timed run will hit.
+    # Warmup: compile the steady-state programs — the fused admit
+    # program (prefill + insert + first-token sample) and every decode
+    # kv bucket the timed run will hit.
     t0 = time.monotonic()
     eng.generate(prompts, max_new_tokens=new_tokens)
     log(f"warmup (compile + first full run) {time.monotonic() - t0:.1f}s")

@@ -95,9 +95,14 @@ class LongContextEngine:
         if params is None:
             params = decoder.init_params(jax.random.PRNGKey(seed), cfg,
                                          dtype=dtype)
-        if quant.is_quantized(
-                (params.get("layers", {}) or {}).get("wq")):
-            axes = quant.quantize_logical_axes(axes)
+        kind = quant.quant_kind(
+            (params.get("layers", {}) or {}).get("wq"))
+        if kind:
+            # Propagate the detected mode: int4 leaves are {'q4','scale'}
+            # with a [G, F] group-wise scale whose axes differ from the
+            # int8 [1, F] per-channel scale — the default-int8 axes tree
+            # would mismatch the params in shard_pytree.
+            axes = quant.quantize_logical_axes(axes, mode=kind)
             quant.set_pallas_qmatmul(False)   # GSPMD path under the mesh
         self.params = shard_pytree(params, axes, mesh, self._param_rules())
 

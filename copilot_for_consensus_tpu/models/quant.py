@@ -227,17 +227,18 @@ def quantize_logical_axes(axes: dict,
 
     int8: the scale keeps every axis except the (size-1) contraction
     axis, which becomes None/replicated. int4: the packed q4 keeps the
-    original axes (packed rows shard like the rows they encode) and the
-    group axis of the scale inherits the contraction axis name."""
+    original axes (packed rows shard like the rows they encode); the
+    scale's group axis is replicated — it can be size 1 (small models
+    where one group spans the contraction axis) which a tp>1 mesh can't
+    divide, and at ≤G×F×4 bytes the tensor is too small to matter."""
     out = {k: (dict(v) if isinstance(v, dict) else v)
            for k, v in axes.items()}
     for path in leaves:
         t = _get_path(axes, path)
         if t is not None:
-            if mode == "int4":
-                _set_path(out, path, {"q4": t, "scale": t})
-            else:
-                scale_axes = tuple(
-                    None if i == len(t) - 2 else a for i, a in enumerate(t))
-                _set_path(out, path, {"q": t, "scale": scale_axes})
+            scale_axes = tuple(
+                None if i == len(t) - 2 else a for i, a in enumerate(t))
+            _set_path(out, path,
+                      {"q4" if mode == "int4" else "q": t,
+                       "scale": scale_axes})
     return out

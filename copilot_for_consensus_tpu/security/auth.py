@@ -323,8 +323,13 @@ def create_jwt_middleware(jwt_manager: JWTManager,
     return middleware
 
 
-def auth_router(service: AuthService):
-    """Auth HTTP surface (reference ``auth/main.py:115-1074``)."""
+def auth_router(service: AuthService, external_base_url: str | None = None):
+    """Auth HTTP surface (reference ``auth/main.py:115-1074``).
+
+    ``external_base_url`` is the deployment's public https base; when set
+    the discovery document advertises it instead of trusting the
+    client-controlled Host / X-Forwarded-Proto headers (which, behind a
+    cache or misconfigured proxy, allow discovery-document poisoning)."""
     from copilot_for_consensus_tpu.services.http import Router
 
     router = Router()
@@ -369,13 +374,18 @@ def auth_router(service: AuthService):
         discovery rather than a raw JWKS URL; strict consumers also
         require the authorization/token endpoints and standard response
         types, so the full REQUIRED metadata set is advertised."""
-        host = (req.headers.get("host") or req.headers.get("Host")
-                or "localhost")
-        # Behind the TLS edge the advertised URLs must be https — the
-        # generated nginx config forwards the original scheme.
-        proto = (req.headers.get("x-forwarded-proto")
-                 or req.headers.get("X-Forwarded-Proto") or "http")
-        base = f"{proto}://{host}"
+        if external_base_url:
+            base = external_base_url.rstrip("/")
+        else:
+            # Unconfigured (dev) deployments fall back to the request
+            # headers; production should set auth.external_base_url.
+            host = (req.headers.get("host") or req.headers.get("Host")
+                    or "localhost")
+            # Behind the TLS edge the advertised URLs must be https — the
+            # generated nginx config forwards the original scheme.
+            proto = (req.headers.get("x-forwarded-proto")
+                     or req.headers.get("X-Forwarded-Proto") or "http")
+            base = f"{proto}://{host}"
         return {
             "issuer": service.jwt.issuer,
             "authorization_endpoint": f"{base}/auth/login",

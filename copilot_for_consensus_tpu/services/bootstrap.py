@@ -221,8 +221,16 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
     if auth_cfg is not None:
         signer = create_jwt_signer(auth_cfg.get("signer",
                                                 {"driver": "local_rs256"}))
+        # Strict OIDC discovery consumers require issuer == the https
+        # base URL the document is served under, and the gateway
+        # validate-jwt flow checks tokens against the same issuer — so
+        # when external_base_url is set it is the issuer default, keeping
+        # minted tokens and the discovery document consistent.
         jwt = JWTManager(signer,
-                         issuer=auth_cfg.get("issuer", "copilot"),
+                         issuer=auth_cfg.get("issuer")
+                         or (auth_cfg.get("external_base_url")
+                             or "").rstrip("/")
+                         or "copilot",
                          audience=auth_cfg.get("audience", "copilot-api"))
         roles = RoleStore(pipeline.store,
                           default_role=auth_cfg.get("default_role",
@@ -256,7 +264,9 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
             for name, pcfg in providers_cfg.items()
         }
         auth_service = AuthService(jwt, roles, providers)
-        router.merge(auth_router(auth_service))
+        router.merge(auth_router(
+            auth_service,
+            external_base_url=auth_cfg.get("external_base_url")))
         if require_auth:
             router.middleware.append(create_jwt_middleware(
                 jwt,

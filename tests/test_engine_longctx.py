@@ -145,3 +145,36 @@ def test_longctx_ulysses_matches_ring():
         params = eng.params  # share exact weights across impls
         outs[impl] = eng.generate(list(range(1, 40)), max_new_tokens=6)
     assert outs["ring"].tokens == outs["ulysses"].tokens
+
+
+def test_longctx_int4_params_on_tp_mesh():
+    """ADVICE r2 (medium): an int4 param tree must construct and serve —
+    the engine has to detect the quant mode (not assume int8) and the
+    int4 scale's group axis must shard on a tp mesh even when one group
+    spans the whole contraction axis (G=1 on tiny's d_model=128)."""
+    from copilot_for_consensus_tpu.models import quant
+
+    cfg = decoder_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(3), cfg,
+                                 dtype=jnp.float32)
+    qparams = quant.quantize_params(params, mode="int4")
+    assert qparams["layers"]["wq"]["scale"].shape[-2] == 1  # G == 1
+    mesh = build_mesh(MeshConfig(sp=4, tp=2))
+    eng = LongContextEngine(cfg, qparams, mesh=mesh, dtype=jnp.float32,
+                            sampling=SamplingConfig(temperature=0.0),
+                            eos_id=-1, decode_window=4, ctx_block=16)
+    comp = eng.generate(list(range(3, 80)), max_new_tokens=6)
+    # Oracle: greedy over the dequantized weights, unsharded.
+    deq = jax.tree.map(
+        lambda a: a,
+        {**params, "layers": dict(params["layers"])})
+    for path in quant.DECODER_QUANT_LEAVES:
+        node = deq
+        for p in path[:-1]:
+            node = node[p]
+        leaf = qparams
+        for p in path:
+            leaf = leaf[p]
+        node[path[-1]] = quant.dequant_int4(leaf, jnp.float32)
+    want = _greedy_oracle(deq, cfg, list(range(3, 80)), 6)
+    assert comp.tokens == want

@@ -227,3 +227,30 @@ def test_ops_snapshot(server, tokens):
     assert set(ops) == {"collections", "queues", "dead_letters", "pending"}
     assert "reports" in ops["collections"]
     assert set(ops["pending"]) == {"archives", "messages", "chunks"}
+
+
+def test_discovery_doc_prefers_configured_base_url():
+    """ADVICE r2: with auth.external_base_url set, the discovery document
+    must advertise it — not client-controlled Host/X-Forwarded-Proto
+    headers (discovery-document poisoning via cache/proxy)."""
+    srv = serve_pipeline({
+        "auth": {
+            "signer": {"driver": "hs256", "secret": "s"},
+            "providers": {"mock": {}},
+            "allow_insecure_mock": True,
+            "external_base_url": "https://copilot.example.org/",
+        },
+    }).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/.well-known/openid-configuration",
+            headers={"Host": "evil.example.net",
+                     "X-Forwarded-Proto": "gopher"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        base = "https://copilot.example.org"
+        assert doc["jwks_uri"] == f"{base}/.well-known/jwks.json"
+        assert doc["authorization_endpoint"].startswith(base)
+        assert "evil.example.net" not in json.dumps(doc)
+    finally:
+        srv.stop()

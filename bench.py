@@ -78,6 +78,9 @@ def main() -> None:
     if os.environ.get("BENCH_PALLAS", "1") != "1":
         from copilot_for_consensus_tpu.models import quant
         quant.set_pallas_qmatmul(False)
+    if os.environ.get("BENCH_ACT_QUANT", "0") == "1":
+        from copilot_for_consensus_tpu.models import quant
+        quant.set_act_quant("a8")
     cfg = decoder_config(model)
     t0 = time.monotonic()
     eng = GenerationEngine(
@@ -109,12 +112,15 @@ def main() -> None:
     log(f"warmup (compile + first full run) {time.monotonic() - t0:.1f}s")
 
     # Timed run: keep all slots busy for `new_tokens` decode steps each.
+    admit_s0 = eng.admitted_s
     t0 = time.monotonic()
     comps = eng.generate(prompts, max_new_tokens=new_tokens)
     elapsed = time.monotonic() - t0
     total_new = sum(len(c.tokens) for c in comps)
     tok_s = total_new / elapsed
-    log(f"{total_new} tokens in {elapsed:.2f}s across {slots} streams")
+    admit_s = eng.admitted_s - admit_s0   # sums multi-wave admissions
+    log(f"{total_new} tokens in {elapsed:.2f}s across {slots} streams "
+        f"(admission {admit_s:.2f}s, decode+sync {elapsed - admit_s:.2f}s)")
 
     print(json.dumps({
         "metric": f"{model} continuous-batching decode throughput "

@@ -322,6 +322,10 @@ class GenerationEngine:
         self._queue: list[Request] = []
         self._done: dict[int, Completion] = {}
         self._next_id = 0
+        #: cumulative wall time spent in admission waves (prefill +
+        #: insert + first-token sync) since engine build — benches
+        #: snapshot it around a run to split admission from decode.
+        self.admitted_s = 0.0
 
     # ------------------------------------------------------------------
     # public API
@@ -454,6 +458,7 @@ class GenerationEngine:
             self._cache, jnp.asarray(slots), sub)
         first = np.asarray(jax.device_get(first_dev))  # the ONE host sync
         prefill_s = time.monotonic() - t0
+        self.admitted_s += prefill_s
         for i, (slot, req) in enumerate(batch):
             tok = int(first[i])
             self._active[slot] = req

@@ -27,26 +27,45 @@ def qmatmul(x: jax.Array, w) -> jax.Array:
     (``ops/quant_matmul.py``) so the bf16 dequantized weight never
     touches HBM — decode streams the int8/int4 bytes, once."""
     from copilot_for_consensus_tpu.models.quant import (
+        act_quant_mode,
         pallas_qmatmul_enabled,
         quant_kind,
     )
 
     kind = quant_kind(w)
     on_tpu = jax.default_backend() == "tpu"
+    # Activation quantization pays only where the matmul is MXU-bound:
+    # the int8×int8 MXU path doubles the FLOPs rate, so a batched
+    # prefill wave (m ≥ 1024 rows) halves its dominant cost. At decode
+    # widths (m = slots) the step is weight-bandwidth-bound and the
+    # dequant-fused XLA expression wins — measured 3225 vs 2662 tok/s
+    # with a8 forced on decode.
+    m = 1
+    for s in x.shape[:-1]:
+        m *= s
+    a8 = act_quant_mode() == "a8" and on_tpu and m >= 1024
     if kind == "int4":
         from copilot_for_consensus_tpu.ops.quant_matmul import (
             int4_matmul,
             int4_matmul_xla,
+            w4a8_matmul,
         )
         if w["q4"].ndim == 2 and pallas_qmatmul_enabled() and on_tpu:
+            if a8:
+                return w4a8_matmul(x, w["q4"], w["scale"])
             return int4_matmul(x, w["q4"], w["scale"])
         return int4_matmul_xla(x, w["q4"], w["scale"])
     if kind == "int8":
+        if (a8 and w["q"].ndim == 2 and pallas_qmatmul_enabled()):
+            from copilot_for_consensus_tpu.ops.quant_matmul import (
+                w8a8_matmul,
+            )
+            return w8a8_matmul(x, w["q"], w["scale"])
         # Measured on v5e: XLA's own dequant-fused matmul streams int8
         # weights faster than the Pallas kernel at serving shapes
         # (engine decode 2778 vs 2146 tok/s), and it partitions under
-        # GSPMD — so the XLA expression is the int8 path, always. The
-        # Pallas int8 kernel stays for reference/experiments
+        # GSPMD — so the XLA expression is the weight-only int8 path.
+        # The Pallas int8 kernel stays for reference/experiments
         # (ops/quant_matmul.int8_matmul).
         return (x @ w["q"].astype(x.dtype)) * w["scale"].astype(x.dtype)
     return x @ w

@@ -53,6 +53,13 @@ def quant_kind(leaf: Any) -> str | None:
 # forwards are traced lazily from engine internals.
 _PALLAS_QMATMUL = True
 
+# Activation quantization mode for the decode matmuls. "weight_only"
+# keeps activations bf16 (dequant-style matmuls); "a8" dynamically
+# quantizes activations to int8 per row and uses the MXU's native
+# int8×int8 path (W8A8/W4A8 kernels in ops/quant_matmul.py) — the
+# weight bytes then go HBM → VMEM → MXU without a VPU widening pass.
+_ACT_QUANT = "weight_only"
+
 
 def set_pallas_qmatmul(enabled: bool) -> None:
     global _PALLAS_QMATMUL
@@ -61,6 +68,19 @@ def set_pallas_qmatmul(enabled: bool) -> None:
 
 def pallas_qmatmul_enabled() -> bool:
     return _PALLAS_QMATMUL
+
+
+def set_act_quant(mode: str) -> None:
+    """"weight_only" (default) or "a8" (dynamic per-row int8
+    activations into native int8 MXU dots — W8A8-class accuracy)."""
+    if mode not in ("weight_only", "a8"):
+        raise ValueError(f"unknown act-quant mode {mode!r}")
+    global _ACT_QUANT
+    _ACT_QUANT = mode
+
+
+def act_quant_mode() -> str:
+    return _ACT_QUANT
 
 
 def quantize_tensor(w: jax.Array) -> dict[str, jax.Array]:

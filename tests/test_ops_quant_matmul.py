@@ -134,3 +134,79 @@ def test_int4_dequant_with_stacked_leading_dims():
     np.testing.assert_allclose(
         np.asarray(int4_matmul_xla(x, qw["q4"][0], qw["scale"][0])),
         np.asarray(x @ wd[0]), rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# W8A8 / W4A8: quantized-activation kernels (native int8 MXU path)
+# ---------------------------------------------------------------------------
+
+from copilot_for_consensus_tpu.ops.quant_matmul import (  # noqa: E402
+    quantize_rows,
+    w4a8_matmul,
+    w8a8_matmul,
+)
+
+
+def test_quantize_rows_roundtrip():
+    x = jax.random.normal(jax.random.PRNGKey(9), (6, 128)) * 3.0
+    xq, sx = quantize_rows(x)
+    assert xq.dtype == jnp.int8 and sx.shape == (6, 1)
+    rel = jnp.abs(xq * sx - x) / (jnp.abs(x).max(axis=-1, keepdims=True))
+    assert float(rel.max()) < 1 / 127  # half-ULP of the per-row scale
+    # zero rows must not divide by zero
+    xq0, sx0 = quantize_rows(jnp.zeros((2, 16)))
+    assert int(jnp.abs(xq0).sum()) == 0 and bool(jnp.all(sx0 == 1.0))
+
+
+@pytest.mark.parametrize("m,d,f", [(4, 64, 96), (1, 128, 512), (9, 32, 33)])
+def test_w8a8_matches_quantized_oracle(m, d, f):
+    """Exactness contract: given the per-row-quantized activations the
+    kernel's arithmetic is EXACT (int32 accumulation, scales factored
+    out) — only quantize_rows loses information."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (d, f)) * 0.05
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, d))
+    qw = quantize_tensor(w)
+    xq, sx = quantize_rows(x)
+    ref = (xq.astype(jnp.float32) @ qw["q"].astype(jnp.float32)) \
+        * sx * qw["scale"]
+    out = w8a8_matmul(x, qw["q"], qw["scale"], block_f=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # and end-to-end error vs the full-precision product stays at the
+    # few-percent W8A8 level
+    rel = np.abs(np.asarray(out - x @ w)).mean() / \
+        np.abs(np.asarray(x @ w)).mean()
+    assert rel < 0.05, rel
+
+
+@pytest.mark.parametrize("d,f,group", [(512, 64, 256), (256, 48, 256),
+                                       (1024, 96, 512)])
+def test_w4a8_matches_quantized_oracle(d, f, group):
+    w = jax.random.normal(jax.random.PRNGKey(5), (d, f)) * 0.04
+    x = jax.random.normal(jax.random.PRNGKey(6), (8, d))
+    qw = quantize_tensor_int4(w, group=group)
+    xq, sx = quantize_rows(x)
+    wd = dequant_int4_f32(qw)
+    ref = (xq.astype(jnp.float32) @ wd) * sx
+    out = w4a8_matmul(x, qw["q4"], qw["scale"], block_f=16,
+                      interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def dequant_int4_f32(qw):
+    from copilot_for_consensus_tpu.models.quant import dequant_int4
+    return dequant_int4(qw, jnp.float32)
+
+
+def test_w4a8_leading_batch_dims():
+    w = jax.random.normal(jax.random.PRNGKey(2), (256, 48)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 3, 256))
+    qw = quantize_tensor_int4(w, group=256)
+    out = w4a8_matmul(x, qw["q4"], qw["scale"], block_f=16,
+                      interpret=True)
+    assert out.shape == (2, 3, 48)
+    flat = w4a8_matmul(x.reshape(6, 256), qw["q4"], qw["scale"],
+                       block_f=16, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out).reshape(6, 48),
+                                  np.asarray(flat))

@@ -28,8 +28,31 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+PRESETS = {
+    # The pipeline's real serving shape: the orchestrator budgets ~3000
+    # context tokens per summary (reference orchestrator/app/service.py
+    # :57) and asks for ~160 new tokens — a prefill-heavy workload. At
+    # 2048-token prompts HBM caps concurrent streams well below the
+    # short-prompt bench (the KV cache is 9x larger per slot), so slots
+    # drop to 32 and the honest headline is TOTAL processed tokens/s
+    # (prompt + generated), reported alongside decode-only tok/s.
+    # windows_per_dispatch stays 1 here: XLA compiles the long-extent
+    # multi-window chain pathologically (28.5 s vs 6.2 s decode for the
+    # same 160 steps), and at 38 ms/step the per-dispatch sync is noise.
+    "rag2k": {"BENCH_PROMPT_LEN": "2048", "BENCH_MAX_LEN": "2304",
+              "BENCH_NEW_TOKENS": "160", "BENCH_SLOTS": "32",
+              "BENCH_DECODE_WINDOW": "32",
+              "BENCH_WINDOWS_PER_DISPATCH": "1"},
+}
+
+
 def main() -> None:
     import jax
+
+    preset = os.environ.get("BENCH_PRESET")
+    if preset:
+        for k, v in PRESETS[preset].items():
+            os.environ.setdefault(k, v)
 
     model = os.environ.get("BENCH_MODEL", "mistral-7b")
     # fp8 KV cache (the default) halves cache HBM; 16-bit caches halve
@@ -94,6 +117,8 @@ def main() -> None:
         quantize=quantize,
         decode_window=window,
         windows_per_dispatch=n_windows,
+        admission_token_budget=int(os.environ.get("BENCH_ADMIT_TOKENS",
+                                                  "16384")),
     )
     log(f"engine built (random {model} weights, "
         f"{quantize or 'bf16'}) in {time.monotonic() - t0:.1f}s")
@@ -117,14 +142,17 @@ def main() -> None:
     comps = eng.generate(prompts, max_new_tokens=new_tokens)
     elapsed = time.monotonic() - t0
     total_new = sum(len(c.tokens) for c in comps)
+    total_all = total_new + sum(c.prompt_len for c in comps)
     tok_s = total_new / elapsed
     admit_s = eng.admitted_s - admit_s0   # sums multi-wave admissions
-    log(f"{total_new} tokens in {elapsed:.2f}s across {slots} streams "
-        f"(admission {admit_s:.2f}s, decode+sync {elapsed - admit_s:.2f}s)")
+    log(f"{total_new} new tokens ({total_all} incl. prompts) in "
+        f"{elapsed:.2f}s across {slots} streams "
+        f"(admission {admit_s:.2f}s, decode+sync {elapsed - admit_s:.2f}s; "
+        f"total throughput {total_all / elapsed:.0f} tok/s)")
 
     print(json.dumps({
         "metric": f"{model} continuous-batching decode throughput "
-                  f"(1 chip, {slots} streams, "
+                  f"(1 chip, {slots} streams, {prompt_len}-tok prompts, "
                   f"{quantize or 'bf16'} weights)",
         "value": round(tok_s, 2),
         "unit": "tok/s",

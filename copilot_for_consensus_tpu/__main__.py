@@ -158,6 +158,9 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("logmine", help="mine templates from JSON logs",
                    add_help=False)
 
+    sub.add_parser("logstore", help="log aggregation sink + query API "
+                   "(Loki/Promtail role)", add_help=False)
+
     sub.add_parser("exporters", help="store/vector stats exporter",
                    add_help=False)
 
@@ -185,6 +188,12 @@ def main(argv: list[str] | None = None) -> int:
         from copilot_for_consensus_tpu.tools.logmine import main as lm_main
 
         return lm_main(argv[1:])
+    if argv and argv[0] == "logstore":
+        from copilot_for_consensus_tpu.tools.logstore import (
+            main as ls_main,
+        )
+
+        return ls_main(argv[1:])
     if argv and argv[0] == "exporters":
         from copilot_for_consensus_tpu.tools.exporters import main as ex_main
 

@@ -1,0 +1,159 @@
+"""Asynchronous serving front-end for the generation engine.
+
+The engine itself is synchronous and single-owner (one thread drives
+``submit()`` + ``step()``); in-process services interleave their own
+work (bus I/O, prompt building, report writes) with stepping, so the
+device idles whenever the service is busy. This runner gives the engine
+a dedicated dispatcher thread that owns ALL device interaction and
+keeps the chip busy whenever there is work:
+
+* callers ``submit()`` from any thread and get a handle they can wait
+  on; tokenization/prompt prep stays on the caller's thread and
+  overlaps the device's current decode dispatch;
+* the dispatcher admits every pending request a free slot can take as
+  ONE batched prefill wave between decode dispatches (the engine's
+  wave batching amortizes the weight pass over all arrivals that
+  accumulated during the last window);
+* completions resolve caller handles as soon as their dispatch
+  harvests.
+
+True device-side overlap of prefill and decode is not possible on a
+single chip (programs serialize; this backend additionally blocks
+inside the dispatch call — the r2 window-pipelining experiment), so
+the steady-state duty cycle is decode_time / (decode_time +
+admission_time) — what ``scripts/bench_poisson.py`` measures against
+the batch bench.
+
+Reference comparison: the reference's summarization service holds ONE
+blocking HTTP connection per summary (``local_llm_summarizer.py:106``);
+this is the first-party continuous-batching replacement's front door.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from copilot_for_consensus_tpu.engine.generation import (
+    Completion,
+    GenerationEngine,
+)
+
+
+@dataclass
+class Handle:
+    """Caller-side future for one request."""
+
+    request_id: int = -1
+    _event: threading.Event = field(default_factory=threading.Event)
+    _completion: Completion | None = None
+    _error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> Completion:
+        if not self._event.wait(timeout):
+            raise TimeoutError("generation not finished")
+        if self._error is not None:
+            raise self._error
+        assert self._completion is not None
+        return self._completion
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+
+class AsyncEngineRunner:
+    """Dispatcher thread owning a ``GenerationEngine``'s device calls."""
+
+    def __init__(self, engine: GenerationEngine):
+        self.engine = engine
+        self._pending: list[tuple[list[int], int, Handle]] = []
+        self._handles: dict[int, Handle] = {}
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        #: dispatcher-loop stats for benches/metrics
+        self.completed = 0
+        self.decode_busy_s = 0.0
+
+    # -- caller side ----------------------------------------------------
+
+    def start(self) -> "AsyncEngineRunner":
+        if self._thread is not None:
+            raise RuntimeError("runner already started")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="engine-dispatch")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def submit(self, prompt: list[int],
+               max_new_tokens: int = 256) -> Handle:
+        """Thread-safe enqueue; returns a waitable handle."""
+        if self._thread is None:
+            raise RuntimeError("runner not started")
+        h = Handle()
+        with self._work:
+            self._pending.append((prompt, max_new_tokens, h))
+            self._work.notify()
+        return h
+
+    # -- dispatcher side ------------------------------------------------
+
+    def _loop(self) -> None:
+        eng = self.engine
+        while True:
+            with self._work:
+                while (not self._stop and not self._pending
+                       and not eng._active and not eng._queue):
+                    self._work.wait(timeout=0.1)
+                if self._stop:
+                    # resolve nothing further; abandoned handles stay
+                    # unset and their result() times out
+                    return
+                fresh = self._pending
+                self._pending = []
+            # Enqueue arrivals into the engine on the dispatcher thread
+            # (the engine is single-owner; only this thread touches it).
+            # A bad request (e.g. empty prompt) fails ITS handle, not
+            # the loop — an unhandled exception here would kill the
+            # dispatcher and hang every outstanding and future handle.
+            for prompt, mnt, h in fresh:
+                try:
+                    rid = eng.submit(prompt, mnt)
+                except Exception as exc:
+                    h._fail(exc)
+                    continue
+                h.request_id = rid
+                self._handles[rid] = h
+            t0 = time.monotonic()
+            try:
+                comps = eng.step()  # admit wave + one decode dispatch
+            except Exception as exc:
+                # Device/engine failure: every in-flight request is
+                # lost — surface the error on each handle and keep the
+                # dispatcher alive for new work.
+                for h in self._handles.values():
+                    h._fail(exc)
+                self._handles.clear()
+                continue
+            finally:
+                self.decode_busy_s += time.monotonic() - t0
+            for c in comps:
+                self.completed += 1
+                h = self._handles.pop(c.request_id, None)
+                if h is not None:
+                    h._completion = c
+                    h._event.set()

@@ -121,6 +121,9 @@ class GenerationEngine:
         quantize: bool | str = False,
         decode_window: int = 8,
         windows_per_dispatch: int = 1,
+        admission_token_budget: int = 16384,
+        admit_min_rows: int = 1,
+        admit_max_wait_s: float = 0.5,
         profile_dir: str | None = None,
     ):
         self.profile_dir = profile_dir
@@ -144,6 +147,19 @@ class GenerationEngine:
         # of coarser retirement/admission granularity — right for batch
         # workloads, 1 for latency-sensitive serving.
         self.windows_per_dispatch = max(1, windows_per_dispatch)
+        # Prompt tokens one admission wave may prefill: the wave's f32
+        # swiglu transient is budget×d_ff×8 bytes (~0.9 GB at 16k), so
+        # long-context engines (big caches) trade admission batching
+        # for HBM headroom by lowering this.
+        self.admission_token_budget = admission_token_budget
+        # Wave hysteresis for continuous arrivals: a prefill wave costs
+        # a full weight pass + pow-2 row padding regardless of size, so
+        # trickling arrivals amortize badly as 1-2-row waves. With
+        # admit_min_rows > 1 the engine keeps decoding until that many
+        # requests accumulate (or the oldest has waited admit_max_wait_s,
+        # or the batch is fully drained) and admits them as one wave.
+        self.admit_min_rows = max(1, admit_min_rows)
+        self.admit_max_wait_s = admit_max_wait_s
         self._dispatch_steps = self.decode_window * self.windows_per_dispatch
         if self.max_len - self._dispatch_steps < 1:
             raise ValueError(
@@ -268,16 +284,18 @@ class GenerationEngine:
             b = tokens.shape[0]
             shape = (n_l, b, cfg.n_kv_heads, w_sz, cfg.head_dim)
 
-            def run_window(tok, cache, key, pos_w):
+            def run_window(tok, key, done):
                 k_win = jnp.zeros(shape, self.kv_dtype)
                 v_win = jnp.zeros(shape, self.kv_dtype)
+                k_done, v_done = done
 
                 def body(carry, w):
                     tok, k_win, v_win, key = carry
                     key, sub = jax.random.split(key)
                     logits, k_cols, v_cols = decoder.decode_step_windowed(
-                        params, tok, pos_w, w, cfg, cache, k_win, v_win,
-                        kv_len=kv_len)
+                        params, tok, positions, w, cfg, cache, k_win,
+                        v_win, kv_len=kv_len, k_done=k_done,
+                        v_done=v_done)
                     # k_cols: [L, B, H, D] → window col [L, B, H, 1, D]
                     k_win = jax.lax.dynamic_update_slice_in_dim(
                         k_win, k_cols[:, :, :, None].astype(k_win.dtype),
@@ -290,24 +308,34 @@ class GenerationEngine:
 
                 (tok, k_win, v_win, key), toks = jax.lax.scan(
                     body, (tok, k_win, v_win, key), jnp.arange(w_sz))
-                cache = decoder.merge_window(cache, k_win, v_win, pos_w,
-                                             steps=w_sz)
-                return tok, cache, key, toks
+                return tok, key, toks, k_win, v_win
 
+            # Chain windows WITHOUT touching the big cache in between:
+            # completed windows ride along as a fourth attention piece
+            # (k_done) and everything merges once at the end. Merging
+            # per window makes the cache a loop variable, which XLA
+            # ping-pong double-buffers — a second full cache allocation
+            # (+2x at 128x512 fp8: the r2 "compile crash" at kv extents
+            # > 256 was this OOM). Here the cache stays a read-only
+            # invariant until the single final scatter.
+            tok, done, outs, wins = tokens, (None, None), [], []
+            for widx in range(n_windows):
+                tok, key, toks, k_win, v_win = run_window(tok, key, done)
+                outs.append(toks)
+                wins.append((k_win, v_win))
+                if widx + 1 < n_windows:
+                    done = (jnp.concatenate([kw for kw, _ in wins], 3),
+                            jnp.concatenate([vw for _, vw in wins], 3))
             if n_windows == 1:
-                _, cache, _, toks = run_window(tokens, cache, key,
-                                               positions)
-                return toks, cache      # toks: [window, slots]
-
-            def outer(carry, widx):
-                tok, cache, key = carry
-                tok, cache, key, toks = run_window(
-                    tok, cache, key, positions + widx * w_sz)
-                return (tok, cache, key), toks
-
-            (_, cache, _), toks = jax.lax.scan(
-                outer, (tokens, cache, key), jnp.arange(n_windows))
-            return toks.reshape(n_windows * w_sz, b), cache
+                k_all, v_all = wins[0]
+                toks_all = outs[0]
+            else:
+                k_all = jnp.concatenate([kw for kw, _ in wins], 3)
+                v_all = jnp.concatenate([vw for _, vw in wins], 3)
+                toks_all = jnp.concatenate(outs, axis=0)
+            cache = decoder.merge_window(cache, k_all, v_all, positions,
+                                         steps=n_windows * w_sz)
+            return toks_all, cache      # toks: [windows*w_sz, slots]
 
         self._decode_fn = jax.jit(_decode, donate_argnums=(3,),
                                   static_argnames=("kv_len", "n_windows"))
@@ -428,14 +456,30 @@ class GenerationEngine:
         tokens."""
         if not (self._queue and self._free):
             return
+        if (len(self._queue) < self.admit_min_rows
+                and len(self._free) * 4 <= self.num_slots
+                and (time.monotonic() - self._queue[0].submitted_at
+                     < self.admit_max_wait_s)):
+            # Let the wave fill while decode keeps running — but only
+            # while the batch is ≥75% occupied; holding arrivals back
+            # while slots idle wastes more decode capacity than the
+            # wave-padding it saves.
+            return
         t0 = time.monotonic()
         batch: list[tuple[int, Request]] = []
-        # Cap one admission wave at 128 rows: prefill scratch +
-        # activations scale with the wave width (the pow-2 padding can
-        # double it again), and each extra wave costs a full weight
-        # pass — 128 is where the fp8 scratch stays ~1 GB while the
-        # bench's all-at-once arrival still admits in one wave.
+        # Cap one admission wave at 128 rows AND ~16k prompt tokens:
+        # prefill scratch + activations scale with rows × bucket (the
+        # f32 swiglu transient is rows·bucket·d_ff·4 bytes — 0.9 GB at
+        # 16k tokens, 7.5 GB if 128 rows of 2048-token prompts were
+        # padded into one wave), and each extra wave costs a full
+        # weight pass. 128×128 keeps the bench's all-at-once arrival in
+        # one wave; long-prompt (RAG) waves chunk by token budget.
+        longest = 0
         while self._queue and self._free and len(batch) < 128:
+            longest = max(longest, len(self._queue[0].prompt))
+            if batch and (len(batch) + 1) * _next_bucket(
+                    longest, self.buckets) > self.admission_token_budget:
+                break
             batch.append((self._free.pop(0), self._queue.pop(0)))
         plens = [len(req.prompt) for _, req in batch]
         bucket = _next_bucket(max(plens), self.buckets)
@@ -472,14 +516,23 @@ class GenerationEngine:
                              "eos" if tok in self._eos_set else "length")
 
     def _kv_bucket(self) -> int:
-        """Static attention extent for the next decode window: the
+        """Static attention extent for the next decode dispatch: the
         occupied cache prefix rounded up to 128, so only a handful of
-        decode programs ever compile."""
+        decode programs ever compile. The dispatch's own fresh KV lives
+        in the window/done buffers until the final merge, so the extent
+        covers only what was in the cache BEFORE the dispatch."""
         if not self._active:
-            return min(128 + self._dispatch_steps, self.max_len)
+            return min(128, self.max_len)
         hi = max(int(self._positions[s]) for s in self._active)
-        need = hi + self._dispatch_steps + 1
-        return min(-(-need // 128) * 128, self.max_len)
+        bucket = min(-(-(hi + 1) // 128) * 128, self.max_len)
+        # A bucket below the full extent makes the decode program slice
+        # the cache's sequence axis — a STRIDED slice XLA materializes
+        # as a full prefix copy (4.3 GB at 32x2304 — the rag2k OOM).
+        # Near the extent the read saving cannot pay for that copy, so
+        # snap to the full cache (slice = identity, zero-copy).
+        if bucket * 8 >= self.max_len * 7:
+            return self.max_len
+        return bucket
 
     def _decode_once(self) -> None:
         window = self._dispatch_steps

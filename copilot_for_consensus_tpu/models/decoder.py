@@ -235,7 +235,9 @@ def decode_step_windowed(params: Params, tokens: jax.Array,
                          positions0: jax.Array, w: jax.Array,
                          cfg: DecoderConfig, cache: Params,
                          k_win: jax.Array, v_win: jax.Array,
-                         kv_len: int | None = None
+                         kv_len: int | None = None,
+                         k_done: jax.Array | None = None,
+                         v_done: jax.Array | None = None
                          ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step that never writes the big cache.
 
@@ -245,9 +247,13 @@ def decode_step_windowed(params: Params, tokens: jax.Array,
     cache is a read-only loop invariant; fresh KV goes into the small
     per-window buffers ``k_win``/``v_win`` [L, B, Hkv, W, Dh] carried by
     the engine's window scan, and is merged into the cache ONCE per
-    window (``merge_window``).
+    DISPATCH. A multi-window dispatch passes the completed windows as
+    ``k_done``/``v_done`` [L, B, Hkv, Wd, Dh] (a fourth attention
+    piece) instead of merging them — merging per window made the big
+    cache a loop variable again and ping-ponged a second full cache
+    allocation (the r2 OOM at kv extents > 256).
 
-    tokens: [B]; positions0: [B] window-start positions; ``w``: traced
+    tokens: [B]; positions0: [B] dispatch-start positions; ``w``: traced
     in-window step index. Returns ([B, V] fp32 logits, k_cols, v_cols)
     where k_cols/v_cols [L, B, Hkv, Dh] are this step's new KV columns
     for the caller to slot into the window buffers at index ``w``.
@@ -263,9 +269,15 @@ def decode_step_windowed(params: Params, tokens: jax.Array,
     if kv_len is not None and kv_len < k_pref.shape[3]:
         k_pref = k_pref[:, :, :, :kv_len]
         v_pref = v_pref[:, :, :, :kv_len]
+    have_done = k_done is not None
+    xs = (params["layers"], jnp.arange(cfg.n_layers), k_pref, v_pref)
+    if have_done:
+        xs = xs + (k_done, v_done)
 
     def body(x, scanned):
-        layer, li, k_pref_l, v_pref_l = scanned
+        layer, li, k_pref_l, v_pref_l = scanned[:4]
+        k_done_l = scanned[4] if have_done else None
+        v_done_l = scanned[5] if have_done else None
         # Window buffers are [L, B, H, W, D] (attention-native layout;
         # merge_window transposes once per window, not per layer/step).
         k_win_l = jax.lax.dynamic_index_in_dim(k_win, li, 0,
@@ -275,15 +287,14 @@ def decode_step_windowed(params: Params, tokens: jax.Array,
         h, k_cur, v_cur = L.attn_decode_windowed(
             L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
             layer, cfg, positions0, w, k_pref_l, v_pref_l,
-            k_win_l, v_win_l, kv_len=None)
+            k_win_l, v_win_l, kv_len=None,
+            k_done_l=k_done_l, v_done_l=v_done_l)
         x = x + h
         x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
                      layer, cfg)
         return x, (k_cur, v_cur)
 
-    x, (k_cols, v_cols) = jax.lax.scan(
-        body, x, (params["layers"], jnp.arange(cfg.n_layers),
-                  k_pref, v_pref))
+    x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
     return _unembed(x, params, cfg)[:, 0], k_cols, v_cols
 
 

@@ -183,30 +183,36 @@ def attn_decode_windowed(x: jax.Array, layer: dict, cfg: DecoderConfig,
                          positions0: jax.Array, w: jax.Array,
                          k_pref_l: jax.Array, v_pref_l: jax.Array,
                          k_win_l: jax.Array, v_win_l: jax.Array,
-                         kv_len: int | None = None):
+                         kv_len: int | None = None,
+                         k_done_l: jax.Array | None = None,
+                         v_done_l: jax.Array | None = None):
     """Decode attention for one layer against (read-only prefix cache,
-    window buffer, self). Returns (out, k_cur, v_cur) — the caller
-    stacks the per-layer k/v columns into the window buffer; nothing
-    here writes the big cache, which is what keeps it out of the decode
-    scan carry (see ``decoder.decode_step_windowed``).
+    completed-window buffers, current window buffer, self). Returns
+    (out, k_cur, v_cur) — the caller stacks the per-layer k/v columns
+    into the window buffer; nothing here writes the big cache, which is
+    what keeps it out of the decode scan carry (see
+    ``decoder.decode_step_windowed``).
 
-    positions0: [B] window-START positions; ``w``: traced step index
-    within the window (absolute position = positions0 + w, used for
-    RoPE and sliding-window masking).
+    positions0: [B] DISPATCH-start positions; ``w``: traced step index
+    within the current window; ``k_done_l`` [B, Hkv, Wd, Dh] holds the
+    dispatch's already-completed windows (absolute position =
+    positions0 + Wd + w, used for RoPE and sliding-window masking).
     """
     from copilot_for_consensus_tpu.ops.attention import (
         decode_attention_prefix_window,
     )
 
     b = x.shape[0]
-    pos = (positions0 + w)[:, None]
+    n_done = 0 if k_done_l is None else k_done_l.shape[2]
+    pos = (positions0 + n_done + w)[:, None]
     q, k, v = _project_qkv(x, layer, cfg, pos)
     k_cur = k[:, :, 0, :]
     v_cur = v[:, :, 0, :]
     o = decode_attention_prefix_window(
         q[:, :, 0, :], k_pref_l, v_pref_l, k_win_l, v_win_l,
         k_cur, v_cur, prefix_lengths=positions0, w=w,
-        window=cfg.sliding_window, kv_len=kv_len)           # [B, Hq, Dh]
+        window=cfg.sliding_window, kv_len=kv_len,
+        k_done=k_done_l, v_done=v_done_l)                   # [B, Hq, Dh]
     o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim)
     return qmatmul(o, layer["wo"]), k_cur, v_cur
 

@@ -5,6 +5,7 @@ from copilot_for_consensus_tpu.core.factory import register_driver
 register_driver("logger", "stdout", "copilot_for_consensus_tpu.obs.logging:create_logger")
 register_driver("logger", "silent", "copilot_for_consensus_tpu.obs.logging:create_logger")
 register_driver("logger", "memory", "copilot_for_consensus_tpu.obs.logging:create_logger")
+register_driver("logger", "shipping", "copilot_for_consensus_tpu.obs.logging:create_logger")
 
 for _name in ("noop", "inmemory", "prometheus", "pushgateway"):
     register_driver("metrics", _name,

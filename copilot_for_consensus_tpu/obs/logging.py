@@ -80,6 +80,62 @@ class SilentLogger(Logger):
         pass
 
 
+class ShippingLogger(Logger):
+    """Tees records to a local logger AND ships them to the logstore
+    (``tools/logstore.py`` — the Loki/Promtail role) as JSON lines over
+    TCP. Shipping is best-effort: the sink being down must never block
+    or crash the pipeline, so sends are background, bounded-queue,
+    drop-oldest, with lazy reconnects."""
+
+    def __init__(self, tee: Logger, host: str, port: int,
+                 queue_size: int = 4096):
+        import collections
+
+        self.tee = tee
+        self.host, self.port = host, port
+        self._queue: "collections.deque[str]" = collections.deque(
+            maxlen=queue_size)
+        self._wake = threading.Event()
+        self._sock = None
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="log-shipper")
+        self._thread.start()
+
+    def log(self, level: str, message: str, **fields: Any) -> None:
+        self.tee.log(level, message, **fields)
+        record = {"ts": time.time(), "level": level, "message": message,
+                  **fields}
+        if isinstance(self.tee, StdoutLogger) and self.tee.service:
+            record.setdefault("service", self.tee.service)
+        self._queue.append(json.dumps(record, default=str))
+        self._wake.set()
+
+    def _pump(self) -> None:
+        import socket
+
+        while True:
+            self._wake.wait(1.0)
+            self._wake.clear()
+            while self._queue:
+                line = self._queue.popleft()
+                try:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            (self.host, self.port), timeout=3)
+                    self._sock.sendall(line.encode() + b"\n")
+                except OSError:
+                    try:
+                        if self._sock is not None:
+                            self._sock.close()
+                    finally:
+                        self._sock = None
+                    # put it back (front) and back off; the deque's
+                    # maxlen sheds oldest records under pressure
+                    self._queue.appendleft(line)
+                    time.sleep(1.0)
+                    break
+
+
 class MemoryLogger(Logger):
     """Captures records for assertions in tests."""
 
@@ -103,7 +159,8 @@ def get_logger() -> Logger:
 
 
 def create_logger(config: Any = None) -> Logger:
-    """Config-driven logger construction (drivers: stdout, silent, memory)."""
+    """Config-driven logger construction (drivers: stdout, silent,
+    memory, shipping)."""
     cfg = dict(config or {})
     driver = cfg.get("driver", "stdout")
     if driver == "stdout":
@@ -113,4 +170,10 @@ def create_logger(config: Any = None) -> Logger:
         return SilentLogger()
     if driver == "memory":
         return MemoryLogger()
+    if driver == "shipping":
+        return ShippingLogger(
+            StdoutLogger(service=cfg.get("service", ""),
+                         level=cfg.get("level", "info")),
+            host=cfg.get("host", "127.0.0.1"),
+            port=int(cfg.get("port", 5140)))
     raise ValueError(f"unknown logger driver {driver!r}")

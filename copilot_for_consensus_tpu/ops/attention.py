@@ -14,6 +14,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _gqa_expand(k: jax.Array, hq: int) -> jax.Array:
@@ -180,22 +181,30 @@ def decode_attention_prefix_window(
     w: jax.Array,
     window: int = 0,
     kv_len: int | None = None,
+    k_done: jax.Array | None = None,
+    v_done: jax.Array | None = None,
 ) -> jax.Array:
-    """Decode attention over three KV pieces with one joint softmax.
+    """Decode attention over up to four KV pieces with one joint softmax.
 
     The pieces: the big prefix cache (read-only — keeping it OUT of the
     decode scan carry is the whole point: a carried cache is
     re-materialized every step, ~2× the cache bytes per token), the
-    current dispatch window's fresh KV (``k_win`` [B, Hkv, W, D], valid
-    columns [0, w)), and the current token's own KV. Scores are
-    concatenated (tiny), softmaxed jointly — numerically identical to
-    attention over one contiguous cache.
+    completed windows of the CURRENT dispatch (``k_done`` [B, Hkv, Wd,
+    D], all columns valid — kept out of the cache so a multi-window
+    dispatch touches the big cache only once, which is what keeps HBM
+    at ONE cache allocation; merging per-window ping-ponged a second
+    full cache copy and OOM'd at kv extents > 256), the current
+    window's fresh KV (``k_win`` [B, Hkv, W, D], valid columns [0, w)),
+    and the current token's own KV. Scores are concatenated (tiny),
+    softmaxed jointly — numerically identical to attention over one
+    contiguous cache.
 
     q: [B, Hq, D]; k_pref/v_pref: [B, Hkv, S_max, D]; k_cur/v_cur:
     [B, Hkv, D]. prefix_lengths: [B] — valid prefix per slot (the
-    window-START position). ``w``: traced scan counter — window columns
-    at index ≥ w are garbage and masked. ``window``: sliding-window
-    size (0 = full).
+    position where THIS DISPATCH started). ``w``: traced scan counter —
+    window columns at index ≥ w are garbage and masked; done columns
+    precede the current window. ``window``: sliding-window size
+    (0 = full).
     """
     if kv_len is not None and kv_len < k_pref.shape[2]:
         k_pref = k_pref[:, :, :kv_len]
@@ -208,6 +217,7 @@ def decode_attention_prefix_window(
     hkv = k_pref.shape[1]
     s_max = k_pref.shape[2]
     n_win = k_win.shape[2]
+    n_done = 0 if k_done is None else k_done.shape[2]
     group = hq // hkv
     qg = q.reshape(b, hkv, group, d)
     scl = d ** -0.5
@@ -219,7 +229,10 @@ def decode_attention_prefix_window(
     lc = jnp.einsum("bhgd,bhd->bhg", qg, k_cur,
                     preferred_element_type=jnp.float32)[..., None] * scl
 
-    cur_pos = prefix_lengths + w                  # absolute position [B]
+    # The dispatch's own columns start at prefix_lengths: done columns
+    # at +[0, n_done), current-window column i at +n_done+i; the token
+    # itself sits at +n_done+w.
+    cur_pos = prefix_lengths + n_done + w         # absolute position [B]
     pos_p = jnp.arange(s_max)[None, None, None, :]
     mask_p = pos_p < prefix_lengths[:, None, None, None]
     if window > 0:
@@ -227,19 +240,35 @@ def decode_attention_prefix_window(
     iw = jnp.arange(n_win)[None, None, None, :]
     mask_w = iw < w                               # strictly earlier steps
     if window > 0:
-        # Window-buffer column i sits at absolute position
-        # prefix_lengths + i — it too falls out of a sliding window
-        # smaller than the decode window (same rule as the prefix).
-        pos_w = prefix_lengths[:, None, None, None] + iw
+        pos_w = prefix_lengths[:, None, None, None] + n_done + iw
         mask_w &= pos_w > (cur_pos - window)[:, None, None, None]
     lp = jnp.where(mask_p, lp, -jnp.inf)
     lw = jnp.where(mask_w, lw, -jnp.inf)
+    pieces_l = [lp]
+    pieces_v = [v_pref]
+    if n_done:
+        k_done = k_done.astype(dt)
+        ld = jnp.einsum("bhgd,bhwd->bhgw", qg, k_done,
+                        preferred_element_type=jnp.float32) * scl
+        if window > 0:
+            idn = jnp.arange(n_done)[None, None, None, :]
+            pos_dn = prefix_lengths[:, None, None, None] + idn
+            ld = jnp.where(
+                pos_dn > (cur_pos - window)[:, None, None, None],
+                ld, -jnp.inf)
+        pieces_l.append(ld)
+        pieces_v.append(v_done.astype(dt))
+    pieces_l += [lw, lc]
 
-    logits = jnp.concatenate([lp, lw, lc], axis=-1)
+    logits = jnp.concatenate(pieces_l, axis=-1)
     probs = jax.nn.softmax(logits, axis=-1)
     probs = jnp.where(jnp.isnan(probs), 0.0, probs)
-    pp, pw, pc = jnp.split(probs, [s_max, s_max + n_win], axis=-1)
-    out = jnp.einsum("bhgs,bhsd->bhgd", pp.astype(dt), v_pref)
-    out += jnp.einsum("bhgw,bhwd->bhgd", pw.astype(dt), v_win)
-    out += pc.astype(dt) * v_cur[:, :, None, :]
+    splits = np.cumsum([p.shape[-1] for p in pieces_l])[:-1]
+    parts = jnp.split(probs, splits, axis=-1)
+    out = jnp.einsum("bhgs,bhsd->bhgd", parts[0].astype(dt), v_pref)
+    if n_done:
+        out += jnp.einsum("bhgw,bhwd->bhgd", parts[1].astype(dt),
+                          pieces_v[1])
+    out += jnp.einsum("bhgw,bhwd->bhgd", parts[-2].astype(dt), v_win)
+    out += parts[-1].astype(dt) * v_cur[:, :, None, :]
     return out.reshape(b, hq, d)

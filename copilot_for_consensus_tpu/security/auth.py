@@ -198,10 +198,16 @@ class AuthService:
     #: oldest-expiring entries are evicted (unauthenticated /auth/login
     #: floods must not grow memory without bound).
     MAX_PENDING = 10_000
+    #: Document collections for token revocation (logout) and the
+    #: role-assignment request workflow (reference auth/main.py:787).
+    REVOKED = "revoked_tokens"
+    ASSIGNMENTS = "pending_assignments"
 
     def __init__(self, jwt_manager: JWTManager, role_store: RoleStore,
                  providers: dict[str, OIDCProvider] | None = None,
-                 login_ttl_seconds: int = 600):
+                 login_ttl_seconds: int = 600,
+                 max_session_seconds: int = 8 * 3600,
+                 service_accounts: dict[str, dict] | None = None):
         self.jwt = jwt_manager
         self.roles = role_store
         # No silent mock default: the mock provider exchanges any
@@ -210,6 +216,13 @@ class AuthService:
         # auth.allow_insecure_mock when enforcement is on).
         self.providers = dict(providers or {})
         self.login_ttl_seconds = login_ttl_seconds
+        #: silent refresh works until the ORIGINAL login is this old —
+        #: sessions slide within it, then re-authenticate (reference
+        #: auth/main.py:325 refresh semantics).
+        self.max_session_seconds = max_session_seconds
+        #: machine clients for /auth/token client-credentials mint
+        #: (reference auth/main.py:494): {client_id: {secret, roles}}.
+        self.service_accounts = dict(service_accounts or {})
         self._pending: dict[str, dict[str, Any]] = {}  # state → login ctx
         # HTTPServer is threaded; prune iterates while callbacks pop.
         self._pending_lock = threading.Lock()
@@ -258,18 +271,124 @@ class AuthService:
         roles = self.roles.roles_for(email)
         token = self.jwt.mint(email, roles=roles,
                               extra_claims={"provider": prov.name,
-                                            "name": info.get("name", "")})
+                                            "name": info.get("name", ""),
+                                            "auth_time": int(time.time())})
         return {"access_token": token, "token_type": "Bearer",
                 "email": email, "roles": roles}
 
     def validate_token(self, token: str) -> dict[str, Any]:
         try:
-            return self.jwt.verify(token)
+            claims = self.jwt.verify(token)
         except JWTError as exc:
             raise AuthError(str(exc)) from exc
+        if self.is_revoked(claims.get("jti", "")):
+            raise AuthError("token revoked")
+        return claims
 
     def get_jwks(self) -> dict[str, Any]:
         return self.jwt.jwks()
+
+    # -- token lifecycle (reference auth/main.py:325,460,494) ----------
+
+    def refresh_token(self, token: str) -> dict[str, Any]:
+        """Silent refresh: a still-valid token mints a successor with a
+        fresh ``exp`` (and freshly-read roles, so role changes
+        propagate), until the original login exceeds
+        ``max_session_seconds``."""
+        claims = self.validate_token(token)
+        auth_time = int(claims.get("auth_time") or claims.get("iat", 0))
+        if time.time() - auth_time > self.max_session_seconds:
+            raise AuthError("session too old; re-authenticate")
+        email = claims["sub"]
+        roles = (claims.get("roles", []) if claims.get("svc")
+                 else self.roles.roles_for(email))
+        extra = {"auth_time": auth_time}
+        for k in ("provider", "name", "svc"):
+            if k in claims:
+                extra[k] = claims[k]
+        token = self.jwt.mint(email, roles=roles, extra_claims=extra)
+        return {"access_token": token, "token_type": "Bearer",
+                "email": email, "roles": roles}
+
+    def logout(self, token: str) -> None:
+        """Revoke the token's ``jti`` until its natural expiry. Uses the
+        document store so every pipeline process sees the revocation."""
+        claims = self.validate_token(token)
+        self.roles.store.upsert_document(self.REVOKED, {
+            "_id": claims.get("jti", ""),
+            "exp": int(claims.get("exp", time.time() + 3600)),
+        })
+        # Opportunistic prune: entries past their exp can never match
+        # again (verify() rejects expired tokens first), so each logout
+        # also clears the dead ones — the collection stays bounded by
+        # live-token count instead of growing one row per logout ever.
+        now = time.time()
+        for doc in self.roles.store.query_documents(
+                self.REVOKED, {"exp": {"$lt": now}}):
+            self.roles.store.delete_document(self.REVOKED, doc["_id"])
+
+    def is_revoked(self, jti: str) -> bool:
+        if not jti:
+            return False
+        doc = self.roles.store.get_document(self.REVOKED, jti)
+        return doc is not None and time.time() <= doc.get("exp", 0)
+
+    def mint_service_token(self, client_id: str,
+                           client_secret: str) -> dict[str, Any]:
+        """Client-credentials mint for machine callers (retry jobs,
+        exporters, cross-service calls) — reference auth/main.py:494."""
+        acct = self.service_accounts.get(client_id)
+        if acct is None or not _consteq(acct.get("secret", ""),
+                                        client_secret):
+            raise AuthError("invalid client credentials")
+        roles = list(acct.get("roles", []))
+        token = self.jwt.mint(
+            f"svc:{client_id}", roles=roles,
+            extra_claims={"svc": True, "auth_time": int(time.time())})
+        return {"access_token": token, "token_type": "Bearer",
+                "roles": roles}
+
+    # -- role-assignment workflow (reference auth/main.py:787,1074) ----
+
+    def request_roles(self, email: str, roles: list[str],
+                      note: str = "") -> dict[str, Any]:
+        bad = set(roles) - set(ROLES)
+        if bad:
+            raise AuthError(f"unknown roles: {sorted(bad)}")
+        if not roles:
+            raise AuthError("no roles requested")
+        doc = {
+            "_id": f"{email}:{','.join(sorted(roles))}",
+            "email": email, "roles": sorted(set(roles)), "note": note,
+            "status": "pending", "requested_at": int(time.time()),
+        }
+        self.roles.store.upsert_document(self.ASSIGNMENTS, doc)
+        return doc
+
+    def list_pending_assignments(self) -> list[dict]:
+        return self.roles.store.query_documents(
+            self.ASSIGNMENTS, {"status": "pending"})
+
+    def resolve_assignment(self, assignment_id: str, approve: bool,
+                           decided_by: str) -> dict[str, Any]:
+        doc = self.roles.store.get_document(self.ASSIGNMENTS,
+                                            assignment_id)
+        if doc is None or doc.get("status") != "pending":
+            raise AuthError("no such pending assignment")
+        doc["status"] = "approved" if approve else "denied"
+        doc["decided_by"] = decided_by
+        doc["decided_at"] = int(time.time())
+        if approve:
+            merged = sorted(set(self.roles.roles_for(doc["email"]))
+                            | set(doc["roles"]))
+            self.roles.assign(doc["email"], merged)
+        self.roles.store.upsert_document(self.ASSIGNMENTS, doc)
+        return doc
+
+
+def _consteq(a: str, b: str) -> bool:
+    import hmac
+    return hmac.compare_digest(a.encode(), b.encode())
 
 
 # ---------------------------------------------------------------------------
@@ -277,7 +396,8 @@ class AuthService:
 # ---------------------------------------------------------------------------
 
 PUBLIC_PATHS = ("/health", "/readyz", "/metrics", "/auth/login",
-                "/auth/callback", "/.well-known/jwks.json",
+                "/auth/callback", "/auth/token",
+                "/.well-known/jwks.json",
                 "/.well-known/openid-configuration",
                 # The SPA shell and its assets are public; every API call
                 # the SPA makes still carries the bearer token.
@@ -295,9 +415,13 @@ def is_public_path(path: str, public_paths=PUBLIC_PATHS) -> bool:
 def create_jwt_middleware(jwt_manager: JWTManager,
                           required_roles: dict[str, list[str]]
                           | None = None,
-                          public_paths=PUBLIC_PATHS):
+                          public_paths=PUBLIC_PATHS,
+                          is_revoked=None):
     """Router middleware: verifies Bearer tokens, stamps claims into
-    ``req.context``, enforces per-path-prefix role requirements."""
+    ``req.context``, enforces per-path-prefix role requirements.
+    ``is_revoked(jti) -> bool`` plugs the logout denylist in — a
+    logged-out token must fail even though its signature still
+    verifies."""
     required_roles = required_roles or {}
 
     def middleware(req: Request) -> None:
@@ -311,6 +435,8 @@ def create_jwt_middleware(jwt_manager: JWTManager,
             claims = jwt_manager.verify(header[7:])
         except JWTError as exc:
             raise HTTPError(401, f"invalid token: {exc}")
+        if is_revoked is not None and is_revoked(claims.get("jti", "")):
+            raise HTTPError(401, "token revoked")
         req.context.update(claims)
         roles = set(claims.get("roles", []))
         for prefix, needed in required_roles.items():
@@ -396,6 +522,73 @@ def auth_router(service: AuthService, external_base_url: str | None = None):
             "subject_types_supported": ["public"],
         }
 
+    @router.post("/auth/refresh")
+    def refresh(req):
+        """Silent refresh (reference auth/main.py:325): a valid bearer
+        mints a successor with fresh exp + freshly-read roles."""
+        try:
+            return service.refresh_token(_bearer(req))
+        except AuthError as exc:
+            raise HTTPError(401, str(exc))
+
+    @router.post("/auth/logout")
+    def logout(req):
+        """Revoke the presented token until its natural expiry
+        (reference auth/main.py:460)."""
+        try:
+            service.logout(_bearer(req))
+        except AuthError as exc:
+            raise HTTPError(401, str(exc))
+        return {"status": "logged_out"}
+
+    @router.post("/auth/token")
+    def service_token(req):
+        """Client-credentials mint for machine callers (reference
+        auth/main.py:494). Body: {client_id, client_secret}."""
+        body = req.json()
+        if not isinstance(body, dict):
+            raise HTTPError(400, "body must be an object")
+        try:
+            return service.mint_service_token(
+                str(body.get("client_id", "")),
+                str(body.get("client_secret", "")))
+        except AuthError as exc:
+            raise HTTPError(401, str(exc))
+
+    @router.post("/auth/roles/request")
+    def request_roles(req):
+        """Any authenticated user may request roles; admins approve or
+        deny (reference auth/main.py:787)."""
+        claims = _authed(req, service)
+        body = req.json()
+        if not isinstance(body, dict) or "roles" not in body:
+            raise HTTPError(400, "body must have roles")
+        try:
+            return service.request_roles(claims["sub"], body["roles"],
+                                         note=str(body.get("note", "")))
+        except AuthError as exc:
+            raise HTTPError(400, str(exc))
+
+    @router.get("/auth/admin/pending")
+    def list_pending(req):
+        _require_admin(req, service)
+        return {"pending": service.list_pending_assignments()}
+
+    @router.post("/auth/admin/pending/{assignment_id}")
+    def resolve_pending(req):
+        """Approve/deny a pending assignment (reference
+        auth/main.py:1074). Body: {action: "approve"|"deny"}."""
+        claims = _require_admin(req, service)
+        action = (req.json() or {}).get("action", "")
+        if action not in ("approve", "deny"):
+            raise HTTPError(400, "action must be approve|deny")
+        try:
+            return service.resolve_assignment(
+                req.params["assignment_id"], action == "approve",
+                decided_by=claims.get("sub", ""))
+        except AuthError as exc:
+            raise HTTPError(404, str(exc))
+
     @router.get("/auth/admin/users")
     def list_users(req):
         _require_admin(req, service)
@@ -424,13 +617,23 @@ def auth_router(service: AuthService, external_base_url: str | None = None):
     return router
 
 
-def _require_admin(req: Request, service: AuthService) -> None:
-    header = req.headers.get("Authorization", "")
+def _bearer(req: Request) -> str:
+    header = req.headers.get("Authorization") or req.headers.get(
+        "authorization") or ""
     if not header.startswith("Bearer "):
         raise HTTPError(401, "missing bearer token")
+    return header[7:]
+
+
+def _authed(req: Request, service: AuthService) -> dict[str, Any]:
     try:
-        claims = service.validate_token(header[7:])
+        return service.validate_token(_bearer(req))
     except AuthError as exc:
         raise HTTPError(401, str(exc))
+
+
+def _require_admin(req: Request, service: AuthService) -> dict[str, Any]:
+    claims = _authed(req, service)
     if "admin" not in claims.get("roles", []):
         raise HTTPError(403, "admin role required")
+    return claims

@@ -263,7 +263,11 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
             name: create_oidc_provider({"driver": name, **pcfg})
             for name, pcfg in providers_cfg.items()
         }
-        auth_service = AuthService(jwt, roles, providers)
+        auth_service = AuthService(
+            jwt, roles, providers,
+            max_session_seconds=auth_cfg.get("max_session_seconds",
+                                             8 * 3600),
+            service_accounts=auth_cfg.get("service_accounts") or {})
         router.merge(auth_router(
             auth_service,
             external_base_url=auth_cfg.get("external_base_url")))
@@ -273,7 +277,8 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
                 required_roles=auth_cfg.get("required_roles", {
                     "/api/sources": ["admin", "processor"],
                     "/api/upload": ["admin", "processor"],
-                })))
+                }),
+                is_revoked=auth_service.is_revoked))
 
     server = PipelineServer(
         pipeline=pipeline,

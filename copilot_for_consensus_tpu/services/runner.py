@@ -222,7 +222,15 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
     consensus = create_consensus_detector(
         cfg.get("consensus", {"driver": "heuristic"}))
     metrics = InMemoryMetrics()
-    logger = SilentLogger() if not cfg.get("verbose") else None
+    if cfg.get("logger"):
+        # e.g. {"driver": "shipping", "host": "logstore", "port": 5140}
+        # — tees JSON records to the logstore so "query by correlation
+        # id" has a backend in multi-process deployments.
+        from copilot_for_consensus_tpu.obs.logging import create_logger
+
+        logger = create_logger(cfg["logger"])
+    else:
+        logger = SilentLogger() if not cfg.get("verbose") else None
     archive_store = InMemoryArchiveStore()
     retry = RetryPolicy(RetryConfig(max_attempts=3, base_delay=0.01,
                                     max_delay=0.05))

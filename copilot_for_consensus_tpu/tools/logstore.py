@@ -1,0 +1,240 @@
+"""First-party log aggregation — the Loki/Promtail role.
+
+The reference ships logs with Promtail into Loki and queries them by
+correlation id in Grafana (``docker-compose.infra.yml:131-148``). This
+stack's services already emit one JSON object per line with bound
+``correlation_id``/``service`` fields (``obs/logging.py``); what was
+missing is a collector. This module is that collector:
+
+* **Ingest**: newline-delimited JSON over TCP (``--port``); each record
+  lands in an indexed sqlite table. The ``shipping`` logger driver
+  (``obs/logging.ShippingLogger``) tees every service's records here.
+* **Query**: a small HTTP API (``--http-port``):
+  ``GET /logs?correlation_id=&service=&level=&since=&q=&limit=`` —
+  the "trace one document across services" operator story, answerable
+  with one curl. ``GET /health`` and ``GET /metrics`` (Prometheus text)
+  round out the deployment contract.
+* **Retention**: records older than ``--retention-hours`` are pruned on
+  a timer, bounding disk like Loki's retention config.
+
+Run: ``python -m copilot_for_consensus_tpu logstore --db logs.sqlite3``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import socketserver
+import sqlite3
+import threading
+import time
+from typing import Any
+
+
+class LogStore:
+    """Indexed sqlite sink for structured log records (thread-safe)."""
+
+    SCHEMA = """
+    CREATE TABLE IF NOT EXISTS logs (
+        id INTEGER PRIMARY KEY AUTOINCREMENT,
+        ts REAL NOT NULL,
+        level TEXT NOT NULL DEFAULT '',
+        service TEXT NOT NULL DEFAULT '',
+        correlation_id TEXT NOT NULL DEFAULT '',
+        message TEXT NOT NULL DEFAULT '',
+        record TEXT NOT NULL
+    );
+    CREATE INDEX IF NOT EXISTS ix_logs_corr ON logs (correlation_id);
+    CREATE INDEX IF NOT EXISTS ix_logs_ts ON logs (ts);
+    CREATE INDEX IF NOT EXISTS ix_logs_service ON logs (service, ts);
+    """
+
+    def __init__(self, db_path: str = ":memory:"):
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._conn.executescript(self.SCHEMA)
+        self._lock = threading.Lock()
+        self.ingested = 0
+
+    def add(self, record: dict[str, Any]) -> None:
+        ts = record.get("ts")
+        if isinstance(ts, str):
+            try:
+                ts = time.mktime(time.strptime(ts[:19],
+                                               "%Y-%m-%dT%H:%M:%S"))
+            except ValueError:
+                ts = time.time()
+        elif not isinstance(ts, (int, float)):
+            ts = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO logs (ts, level, service, correlation_id,"
+                " message, record) VALUES (?,?,?,?,?,?)",
+                (float(ts), str(record.get("level", "")),
+                 str(record.get("service", "")),
+                 str(record.get("correlation_id", "")),
+                 str(record.get("message", "")),
+                 json.dumps(record, default=str)))
+            self._conn.commit()
+            self.ingested += 1
+
+    def query(self, correlation_id: str = "", service: str = "",
+              level: str = "", since: float = 0.0, text: str = "",
+              limit: int = 500) -> list[dict[str, Any]]:
+        where, params = ["1=1"], []
+        if correlation_id:
+            where.append("correlation_id = ?")
+            params.append(correlation_id)
+        if service:
+            where.append("service = ?")
+            params.append(service)
+        if level:
+            where.append("level = ?")
+            params.append(level)
+        if since:
+            where.append("ts >= ?")
+            params.append(float(since))
+        if text:
+            where.append("message LIKE ?")
+            params.append(f"%{text}%")
+        params.append(max(1, min(int(limit), 5000)))
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT record FROM logs WHERE {' AND '.join(where)} "
+                "ORDER BY ts DESC, id DESC LIMIT ?", params).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def count(self) -> int:
+        with self._lock:
+            return int(self._conn.execute(
+                "SELECT COUNT(*) FROM logs").fetchone()[0])
+
+    def prune(self, older_than_s: float) -> int:
+        cutoff = time.time() - older_than_s
+        with self._lock:
+            cur = self._conn.execute("DELETE FROM logs WHERE ts < ?",
+                                     (cutoff,))
+            self._conn.commit()
+            return cur.rowcount
+
+
+class LogStoreServer:
+    """TCP JSON-lines ingest + HTTP query front, one LogStore behind."""
+
+    def __init__(self, store: LogStore, host: str = "127.0.0.1",
+                 port: int = 0, http_port: int = 0,
+                 retention_hours: float = 72.0):
+        self.store = store
+        self.retention_hours = retention_hours
+        st = store
+
+        class Ingest(socketserver.StreamRequestHandler):
+            def handle(self):
+                for raw in self.rfile:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        st.add(json.loads(raw))
+                    except (json.JSONDecodeError, UnicodeDecodeError):
+                        # a hostile/corrupt line must not kill the sink
+                        st.add({"level": "warning",
+                                "service": "logstore",
+                                "message": "unparseable log line",
+                                "raw": raw[:500].decode("utf-8",
+                                                        "replace")})
+
+        class TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = TCP((host, port), Ingest)
+        self.port = self._tcp.server_address[1]
+        self._http = self._build_http(host, http_port)
+        self.http_port = self._http.port
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def _build_http(self, host: str, port: int):
+        from copilot_for_consensus_tpu.services.http import (
+            HTTPServer,
+            Router,
+        )
+
+        router = Router()
+        store = self.store
+
+        @router.get("/health")
+        def health(req):
+            return {"status": "ok", "records": store.count()}
+
+        @router.get("/logs")
+        def logs(req):
+            q = req.query
+            return {"logs": store.query(
+                correlation_id=q.get("correlation_id", ""),
+                service=q.get("service", ""),
+                level=q.get("level", ""),
+                since=float(q.get("since", 0) or 0),
+                text=q.get("q", ""),
+                limit=int(q.get("limit", 500) or 500))}
+
+        @router.get("/metrics")
+        def metrics(req):
+            return ("# TYPE copilot_logstore_records gauge\n"
+                    f"copilot_logstore_records {store.count()}\n"
+                    "# TYPE copilot_logstore_ingested_total counter\n"
+                    f"copilot_logstore_ingested_total {store.ingested}\n")
+
+        return HTTPServer(router, host, port)
+
+    def start(self) -> "LogStoreServer":
+        self._http.start()
+        t = threading.Thread(target=self._tcp.serve_forever, daemon=True,
+                             name="logstore-ingest")
+        t.start()
+        self._threads.append(t)
+        p = threading.Thread(target=self._prune_loop, daemon=True,
+                             name="logstore-prune")
+        p.start()
+        self._threads.append(p)
+        return self
+
+    def _prune_loop(self) -> None:
+        while not self._stop.wait(300):
+            self.store.prune(self.retention_hours * 3600)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        self._http.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="logstore", description=__doc__.split("\n\n")[0])
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=5140,
+                    help="TCP JSON-lines ingest port")
+    ap.add_argument("--http-port", type=int, default=5141,
+                    help="query/health/metrics HTTP port")
+    ap.add_argument("--db", default="logs.sqlite3")
+    ap.add_argument("--retention-hours", type=float, default=72.0)
+    args = ap.parse_args(argv)
+    srv = LogStoreServer(LogStore(args.db), host=args.host,
+                         port=args.port, http_port=args.http_port,
+                         retention_hours=args.retention_hours)
+    srv.start()
+    print(json.dumps({"event": "logstore", "ingest_port": srv.port,
+                      "http_port": srv.http_port, "db": args.db}),
+          flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,12 +44,27 @@ async function refreshUserBox() {
   if (!token.get()) { box.innerHTML = `<a href="#/login" class="btn">Sign in</a>`; return; }
   try {
     const me = await api("/auth/userinfo");
-    box.innerHTML = `<div class="who"><b>${esc(me.email || me.sub)}</b>` +
-      `<small>${(me.roles || []).map(esc).join(", ")}</small></div>` +
+    box.innerHTML = `<a class="who" href="#/account"><b>${esc(me.email || me.sub)}</b>` +
+      `<small>${(me.roles || []).map(esc).join(", ")}</small></a>` +
       `<button class="btn ghost" id="logout">Sign out</button>`;
-    $("#logout").onclick = () => { token.clear(); location.hash = "#/login"; refreshUserBox(); };
+    $("#logout").onclick = async () => {
+      // Server-side revoke (the jti denylist) BEFORE dropping the local
+      // copy — clearing localStorage alone leaves a live token behind.
+      try { await api("/auth/logout", { method: "POST" }); } catch {}
+      token.clear(); location.hash = "#/login"; refreshUserBox();
+    };
   } catch { box.innerHTML = `<a href="#/login" class="btn">Sign in</a>`; }
 }
+
+/* Silent refresh (reference auth/main.py:325): slide the session while
+   the tab is open; the server re-reads roles so approvals show up. */
+setInterval(async () => {
+  if (!token.get()) return;
+  try {
+    const out = await api("/auth/refresh", { method: "POST" });
+    token.set(out.access_token);
+  } catch { /* expired/revoked: next 401 routes to #/login */ }
+}, 10 * 60 * 1000);
 
 /* ---------- pages ---------- */
 
@@ -307,67 +322,197 @@ async function pageSources() {
     } catch (e) { err(e); }
   };
   $("#new-src").onclick = () => {
-    $("#form-slot").innerHTML = `<form id="src-form" class="card stack">
+    // Validation UX (reference SourceForm.tsx): per-fetcher location
+    // requirements checked inline before the request, field-level error
+    // text instead of a whole-page error, busy state on submit.
+    $("#form-slot").innerHTML = `<form id="src-form" class="card stack" novalidate>
       <h3>New source</h3>
-      <input name="name" placeholder="name" required>
-      <select name="fetcher"><option>local</option><option>http</option>
-        <option>imap</option><option>rsync</option><option>mock</option></select>
-      <input name="location" placeholder="path / url">
-      <div class="inline"><button class="btn">Create</button>
+      <label>Name <input name="name" placeholder="ietf-quic-archive"></label>
+      <div class="field-err" data-for="name"></div>
+      <label>Fetcher <select name="fetcher"><option>local</option><option>http</option>
+        <option>imap</option><option>rsync</option><option>mock</option></select></label>
+      <label>Location <input name="location" placeholder="path / url"></label>
+      <div class="field-err" data-for="location"></div>
+      <div class="inline"><button class="btn" id="src-submit">Create</button>
       <button type="button" class="btn ghost" id="cancel">Cancel</button></div></form>`;
     $("#cancel").onclick = () => ($("#form-slot").innerHTML = "");
+    const fieldErr = (name, msg) => {
+      const el = $(`#src-form .field-err[data-for="${name}"]`);
+      if (el) el.textContent = msg || "";
+    };
     $("#src-form").onsubmit = async (ev) => {
       ev.preventDefault();
       const fd = new FormData(ev.target);
+      const name = (fd.get("name") || "").trim();
+      const fetcher = fd.get("fetcher");
+      const location_ = (fd.get("location") || "").trim();
+      let bad = false;
+      fieldErr("name", name ? "" : "A source name is required.");
+      bad = bad || !name;
+      if (fetcher !== "mock" && !location_) {
+        fieldErr("location", `The ${fetcher} fetcher needs a location.`);
+        bad = true;
+      } else if (fetcher === "http" && !/^https?:\/\//.test(location_)) {
+        fieldErr("location", "HTTP sources need an http(s):// URL.");
+        bad = true;
+      } else if (fetcher === "imap" && !location_.includes("@") && !location_.includes("imap")) {
+        fieldErr("location", "IMAP sources look like imap://user@host/folder.");
+        bad = true;
+      } else fieldErr("location", "");
+      if (bad) return;
+      const btn = $("#src-submit");
+      btn.disabled = true; btn.textContent = "Creating…";
       try {
         await api("/api/sources", { method: "POST", body: {
-          name: fd.get("name"), fetcher: fd.get("fetcher"), location: fd.get("location") } });
+          name, fetcher, location: location_ } });
         $("#form-slot").innerHTML = ""; reload();
-      } catch (e) { err(e); }
+      } catch (e) {
+        btn.disabled = false; btn.textContent = "Create";
+        fieldErr("location", e.message || String(e));
+      }
     };
   };
   reload();
 }
 
+const ALL_ROLES = ["admin", "reader", "processor", "orchestrator"];
+
+function roleModal(email, current, onSave) {
+  // Role-management modal (reference RoleManagementModal.tsx):
+  // checkbox per role instead of a comma-separated text field.
+  const overlay = document.createElement("div");
+  overlay.className = "overlay";
+  overlay.innerHTML = `<div class="card modal">
+    <h3>Roles for ${esc(email)}</h3>
+    <div class="stack" id="role-checks">${ALL_ROLES.map((r) => `
+      <label class="check"><input type="checkbox" value="${r}"
+        ${current.includes(r) ? "checked" : ""}> ${r}</label>`).join("")}</div>
+    <div class="inline">
+      <button class="btn" id="modal-save">Save</button>
+      <button class="btn ghost" id="modal-cancel">Cancel</button></div></div>`;
+  document.body.appendChild(overlay);
+  const close = () => overlay.remove();
+  overlay.onclick = (ev) => { if (ev.target === overlay) close(); };
+  $("#modal-cancel", overlay).onclick = close;
+  $("#modal-save", overlay).onclick = async () => {
+    const roles = [...overlay.querySelectorAll("input:checked")].map((i) => i.value);
+    try { await onSave(roles); close(); } catch (e) { close(); err(e); }
+  };
+}
+
 async function pageAdmin() {
   render(`<div class="toolbar"><h2>Admin</h2></div>
     <div class="grid"><div class="card"><h3>Pipeline</h3><dl id="stats" class="stats"></dl></div>
-    <div class="card"><h3>Users &amp; roles</h3><div id="users" class="stack"></div>
-      <form id="role-form" class="inline">
-        <input name="email" placeholder="email" required>
-        <input name="roles" placeholder="roles (comma-sep)" required>
-        <button class="btn sm">Set roles</button></form></div></div>`);
+    <div class="card"><h3>Pending role requests</h3><div id="pending-box" class="stack"></div></div>
+    <div class="card wide"><h3>Users &amp; roles</h3>
+      <div class="inline"><input id="user-search" placeholder="Search users…">
+        <button class="btn sm" id="add-user">Add user</button></div>
+      <div id="users" class="stack"></div></div></div>`);
   try {
     const s = await api("/stats");
     $("#stats").innerHTML = Object.entries(s).map(([k, v]) =>
       `<dt>${esc(k)}</dt><dd>${esc(v)}</dd>`).join("");
   } catch (e) { $("#stats").innerHTML = `<dd class="muted">${esc(e.message)}</dd>`; }
+  let allUsers = [];
+  const drawUsers = () => {
+    const q = ($("#user-search").value || "").toLowerCase();
+    const shown = allUsers.filter((x) =>
+      !q || (x.email || "").toLowerCase().includes(q) ||
+      (x.roles || []).some((r) => r.includes(q)));
+    $("#users").innerHTML = shown.map((x) => `
+      <div class="row"><b>${esc(x.email)}</b>
+        <span>${(x.roles || []).map((r) => `<span class="tag">${esc(r)}</span>`).join(" ")}</span>
+        <span class="actions">
+          <button class="btn sm" data-edit="${esc(x.email)}">Edit roles</button>
+          <button class="btn sm ghost" data-email="${esc(x.email)}">Remove</button>
+        </span></div>`).join("")
+      || `<p class="muted">${q ? "No users match." : "No explicit role assignments."}</p>`;
+    $("#users").querySelectorAll("button[data-edit]").forEach((b) => {
+      b.onclick = () => {
+        const u = allUsers.find((x) => x.email === b.dataset.edit);
+        roleModal(u.email, u.roles || [], async (roles) => {
+          await api(`/auth/admin/users/${encodeURIComponent(u.email)}`,
+            { method: "PUT", body: { roles } });
+          loadUsers();
+        });
+      };
+    });
+    $("#users").querySelectorAll("button[data-email]").forEach((b) => {
+      b.onclick = async () => {
+        await api(`/auth/admin/users/${encodeURIComponent(b.dataset.email)}`, { method: "DELETE" });
+        loadUsers();
+      };
+    });
+  };
   const loadUsers = async () => {
     try {
-      const u = await api("/auth/admin/users");
-      $("#users").innerHTML = (u.users || []).map((x) => `
-        <div class="row"><b>${esc(x.email)}</b>
-          <span>${(x.roles || []).map((r) => `<span class="tag">${esc(r)}</span>`).join(" ")}</span>
-          <button class="btn sm ghost" data-email="${esc(x.email)}">Remove</button></div>`).join("")
-        || `<p class="muted">No explicit role assignments.</p>`;
-      $("#users").querySelectorAll("button[data-email]").forEach((b) => {
-        b.onclick = async () => {
-          await api(`/auth/admin/users/${encodeURIComponent(b.dataset.email)}`, { method: "DELETE" });
-          loadUsers();
-        };
-      });
+      allUsers = (await api("/auth/admin/users")).users || [];
+      drawUsers();
     } catch (e) { $("#users").innerHTML = `<p class="muted">${esc(e.message)} (admin role required)</p>`; }
   };
-  $("#role-form").onsubmit = async (ev) => {
-    ev.preventDefault();
-    const fd = new FormData(ev.target);
-    try {
-      await api(`/auth/admin/users/${encodeURIComponent(fd.get("email"))}`, {
-        method: "PUT", body: { roles: fd.get("roles").split(",").map((r) => r.trim()).filter(Boolean) } });
-      ev.target.reset(); loadUsers();
-    } catch (e) { err(e); }
+  $("#user-search").oninput = drawUsers;
+  $("#add-user").onclick = () => {
+    const email = prompt("Email of the user to assign roles to:");
+    if (email) roleModal(email.trim(), ["reader"], async (roles) => {
+      await api(`/auth/admin/users/${encodeURIComponent(email.trim())}`,
+        { method: "PUT", body: { roles } });
+      loadUsers();
+    });
   };
-  loadUsers();
+  const loadPending = async () => {
+    try {
+      const p = (await api("/auth/admin/pending")).pending || [];
+      $("#pending-box").innerHTML = p.length ? p.map((a) => `
+        <div class="row"><div><b>${esc(a.email)}</b>
+          <span>${(a.roles || []).map((r) => `<span class="tag">${esc(r)}</span>`).join(" ")}</span>
+          ${a.note ? `<p class="muted">${esc(a.note)}</p>` : ""}</div>
+          <span class="actions">
+            <button class="btn sm" data-res="approve" data-id="${esc(a._id)}">Approve</button>
+            <button class="btn sm ghost" data-res="deny" data-id="${esc(a._id)}">Deny</button>
+          </span></div>`).join("")
+        : `<p class="muted">No pending requests.</p>`;
+      $("#pending-box").querySelectorAll("button[data-res]").forEach((b) => {
+        b.onclick = async () => {
+          try {
+            await api(`/auth/admin/pending/${encodeURIComponent(b.dataset.id)}`,
+              { method: "POST", body: { action: b.dataset.res } });
+            loadPending(); loadUsers();
+          } catch (e) { err(e); }
+        };
+      });
+    } catch (e) { $("#pending-box").innerHTML = `<p class="muted">${esc(e.message)}</p>`; }
+  };
+  loadUsers(); loadPending();
+}
+
+async function pageAccount() {
+  // Self-service: who am I + request more roles (the requester side of
+  // the reference's PendingAssignments flow).
+  try {
+    const me = await api("/auth/userinfo");
+    render(`<div class="card narrow">
+      <h2>Account</h2>
+      <dl class="stats"><dt>Identity</dt><dd>${esc(me.sub)}</dd>
+        <dt>Provider</dt><dd>${esc(me.provider || "—")}</dd>
+        <dt>Roles</dt><dd>${(me.roles || []).map((r) => `<span class="tag">${esc(r)}</span>`).join(" ") || "—"}</dd></dl>
+      <h3>Request access</h3>
+      <form id="req-form" class="stack">
+        <div class="stack">${ALL_ROLES.filter((r) => !(me.roles || []).includes(r)).map((r) => `
+          <label class="check"><input type="checkbox" value="${r}"> ${r}</label>`).join("") || "<p class='muted'>You already hold every role.</p>"}</div>
+        <input name="note" placeholder="why do you need this? (optional)">
+        <button class="btn">Request roles</button>
+        <div id="req-out" class="muted"></div></form></div>`);
+    $("#req-form").onsubmit = async (ev) => {
+      ev.preventDefault();
+      const roles = [...ev.target.querySelectorAll("input:checked")].map((i) => i.value);
+      if (!roles.length) { $("#req-out").textContent = "Pick at least one role."; return; }
+      try {
+        await api("/auth/roles/request", { method: "POST",
+          body: { roles, note: new FormData(ev.target).get("note") } });
+        $("#req-out").textContent = "Requested — an admin will approve or deny.";
+      } catch (e) { $("#req-out").textContent = e.message; }
+    };
+  } catch (e) { err(e); }
 }
 
 /* ---------- router ---------- */
@@ -382,6 +527,7 @@ const routes = [
   [/^#\/sources$/, pageSources],
   [/^#\/ops$/, pageOps],
   [/^#\/admin$/, pageAdmin],
+  [/^#\/account$/, pageAccount],
 ];
 
 function route() {

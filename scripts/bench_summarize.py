@@ -30,11 +30,11 @@ sys.path.insert(0, str(REPO))
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default="mistral-7b")
-    ap.add_argument("--threads", type=int, default=24,
+    ap.add_argument("--threads", type=int, default=96,
                     help="how many threads to summarize (fixture threads "
                          "are replicated to reach this)")
     ap.add_argument("--max-new-tokens", type=int, default=160)
-    ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--num-slots", type=int, default=64)
     args = ap.parse_args()
 
     from copilot_for_consensus_tpu.services.runner import build_pipeline

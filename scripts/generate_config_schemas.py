@@ -243,6 +243,8 @@ DRIVERS: dict[str, dict[str, dict]] = {
         "stdout": dict(service="", level="info"),
         "memory": dict(service="", level="info"),
         "silent": {},
+        "shipping": dict(service="", level="info",
+                         host="127.0.0.1", port=5140),
     },
     "error_reporter": {"console": {}, "silent": {}, "collecting": {}},
     "archive_fetcher": {

@@ -168,3 +168,30 @@ def test_fp8_kv_cache_close_to_full_precision():
     assert not np.array_equal(x, y), "fp8 cache read should perturb logits"
     cos = float(x @ y / (np.linalg.norm(x) * np.linalg.norm(y)))
     assert cos > 0.99, cos
+
+
+@pytest.mark.parametrize("n_windows", [1, 3])
+def test_sliding_window_greedy_multi_window(n_windows):
+    """tiny-swa through the chained-window dispatch: the done-piece
+    masking (completed windows held OUT of the cache until the single
+    end-of-dispatch merge) must respect the sliding window exactly —
+    greedy tokens match the naive forward oracle."""
+    cfg = decoder_config("tiny-swa")
+    assert cfg.sliding_window > 0
+    params = decoder.init_params(jax.random.PRNGKey(9), cfg,
+                                 dtype=jnp.float32)
+    eng = GenerationEngine(cfg, params, num_slots=2, max_len=64,
+                           prefill_buckets=(16,), dtype=jnp.float32,
+                           attn_impl="xla", decode_window=4,
+                           windows_per_dispatch=n_windows)
+    prompt = list(range(5, 17))
+    comp = eng.generate([prompt], max_new_tokens=16)[0]
+    toks, want = list(prompt), []
+    for _ in range(16):
+        logits = decoder.forward(params, jnp.asarray([toks]), cfg,
+                                 attn_impl="xla")
+        nxt = int(jnp.argmax(logits[0, -1]))
+        want.append(nxt)
+        toks.append(nxt)
+    assert comp.tokens == want[:len(comp.tokens)]
+    assert len(comp.tokens) >= 8

@@ -15,7 +15,7 @@ import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 
 class HTTPError(Exception):
@@ -115,8 +115,14 @@ class Router:
             matched_path = True
             if m != method.upper():
                 continue
+            # Path params arrive percent-encoded (clients MUST encode
+            # ids containing '/', '@', ':'); handlers deal in decoded
+            # values — without this, a UI-encoded id like
+            # 'a%40b.org%3Aprocessor' silently misses every store key.
+            params = {k: unquote(v)
+                      for k, v in match.groupdict().items()}
             req = Request(method.upper(), parsed.path, query, headers,
-                          body, match.groupdict())
+                          body, params)
             try:
                 for mw in self.middleware:
                     mw(req)

@@ -221,7 +221,17 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
     summarizer = create_summarizer(cfg.get("llm", {"driver": "mock"}))
     consensus = create_consensus_detector(
         cfg.get("consensus", {"driver": "heuristic"}))
-    metrics = InMemoryMetrics()
+    if cfg.get("metrics"):
+        # e.g. {"driver": "pushgateway", "gateway_url": ...} — without
+        # this the config key would be dead and push semantics silently
+        # unavailable to the pipeline process.
+        from copilot_for_consensus_tpu.obs.metrics import (
+            create_metrics_collector,
+        )
+
+        metrics = create_metrics_collector(cfg["metrics"])
+    else:
+        metrics = InMemoryMetrics()
     if cfg.get("logger"):
         # e.g. {"driver": "shipping", "host": "logstore", "port": 5140}
         # — tees JSON records to the logstore so "query by correlation

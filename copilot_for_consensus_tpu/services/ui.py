@@ -31,8 +31,14 @@ _CONTENT_TYPES = {
 
 
 def _serve_asset(name: str) -> Response:
-    # resolve() + containment check: path traversal cannot escape UI_ROOT.
-    path = (UI_ROOT / name).resolve()
+    # resolve() + containment check: path traversal cannot escape
+    # UI_ROOT. Hostile names (NUL bytes etc., now reachable since the
+    # router percent-decodes path params — found by the API fuzzer) must
+    # 404, not 500 from a pathlib ValueError.
+    try:
+        path = (UI_ROOT / name).resolve()
+    except (ValueError, OSError):
+        raise HTTPError(404, "asset not found")
     if not path.is_relative_to(UI_ROOT) or not path.is_file():
         raise HTTPError(404, "asset not found")
     ctype = _CONTENT_TYPES.get(path.suffix, "application/octet-stream")

@@ -170,21 +170,32 @@ class LogStoreServer:
 
         @router.get("/logs")
         def logs(req):
+            from copilot_for_consensus_tpu.services.http import HTTPError
+
             q = req.query
+            try:
+                since = float(q.get("since", 0) or 0)
+                limit = int(q.get("limit", 500) or 500)
+            except ValueError:
+                raise HTTPError(400, "since/limit must be numeric")
             return {"logs": store.query(
                 correlation_id=q.get("correlation_id", ""),
                 service=q.get("service", ""),
                 level=q.get("level", ""),
-                since=float(q.get("since", 0) or 0),
+                since=since,
                 text=q.get("q", ""),
-                limit=int(q.get("limit", 500) or 500))}
+                limit=limit)}
 
         @router.get("/metrics")
         def metrics(req):
-            return ("# TYPE copilot_logstore_records gauge\n"
-                    f"copilot_logstore_records {store.count()}\n"
-                    "# TYPE copilot_logstore_ingested_total counter\n"
-                    f"copilot_logstore_ingested_total {store.ingested}\n")
+            from copilot_for_consensus_tpu.services.http import Response
+
+            return Response(
+                "# TYPE copilot_logstore_records gauge\n"
+                f"copilot_logstore_records {store.count()}\n"
+                "# TYPE copilot_logstore_ingested_total counter\n"
+                f"copilot_logstore_ingested_total {store.ingested}\n",
+                content_type="text/plain; version=0.0.4")
 
         return HTTPServer(router, host, port)
 

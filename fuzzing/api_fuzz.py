@@ -1,0 +1,166 @@
+"""OpenAPI-driven HTTP API fuzzer — the Schemathesis role.
+
+The reference replays schema-generated requests against each service's
+FastAPI app (``fuzzing/README.md`` Schemathesis section). Here the
+router publishes its own OpenAPI 3.1 document, so the fuzzer reads the
+LIVE spec (no drift possible), generates hostile-but-well-addressed
+requests for every (path, method) and asserts the server-side contract:
+
+* never a 5xx (unhandled exception escaping a handler);
+* every non-204 response body parses as JSON;
+* unauthenticated requests to guarded paths yield 401/403, never 2xx.
+
+Parameter values mix type-respecting randoms with the classic hostile
+set (huge numbers, SQL/JSON metacharacters, path traversal, unicode
+junk, empty/overlong strings).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+HOSTILE_STRINGS = [
+    "", " ", "'", '"', "\\", "../../etc/passwd", "%00", "\x00", "\n",
+    "A" * 2048, "☃" * 64, "{\"$gt\": \"\"}", "1; DROP TABLE docs--",
+    "-1", "0", "999999999999999999999", "NaN", "null", "true", "{{7*7}}",
+    "<script>alert(1)</script>", "%s%s%s", "id:*", "..%2f..%2f",
+]
+
+
+@dataclass
+class Violation:
+    method: str
+    url: str
+    status: int | str
+    detail: str
+
+
+@dataclass
+class ApiFuzzReport:
+    requests: int = 0
+    violations: list[Violation] = field(default_factory=list)
+
+
+def _value_for(schema: dict, rng: random.Random) -> object:
+    t = (schema or {}).get("type")
+    roll = rng.random()
+    if roll < 0.5:
+        return rng.choice(HOSTILE_STRINGS)
+    if t == "integer":
+        return rng.choice([0, 1, -1, 2**31, 25, -(2**63)])
+    if t == "boolean":
+        return rng.choice(["true", "false", "maybe"])
+    if t == "number":
+        return rng.choice([0.0, -1.5, 1e308, "inf"])
+    return rng.choice(HOSTILE_STRINGS + ["plain", "x-y_z.1"])
+
+
+def _body_for(rng: random.Random) -> object:
+    roll = rng.random()
+    if roll < 0.25:
+        return {rng.choice(["roles", "action", "name", "topic", "note",
+                            "client_id", "x"]): rng.choice(
+            HOSTILE_STRINGS + [[], {}, None, 0, ["admin"], {"a": 1}])}
+    if roll < 0.45:
+        return rng.choice(HOSTILE_STRINGS)
+    if roll < 0.6:
+        return [rng.choice(HOSTILE_STRINGS)]
+    if roll < 0.8:
+        return {}
+    return None
+
+
+def _is_public(spec: dict, concrete_path: str, template: str) -> bool:
+    """Route-level auth expectation, from the same source of truth the
+    middleware uses."""
+    from copilot_for_consensus_tpu.security.auth import is_public_path
+
+    return is_public_path(template) or is_public_path(concrete_path)
+
+
+def fuzz_api(base_url: str, token: str = "", per_route: int = 10,
+             seed: int = 0, mutate_auth: bool = True) -> ApiFuzzReport:
+    """Fetch the live spec from ``/api/openapi.json`` and fuzz every
+    route. Returns the contract-violation report."""
+    rng = random.Random(seed)
+    with urllib.request.urlopen(base_url + "/api/openapi.json",
+                                timeout=10) as resp:
+        spec = json.loads(resp.read())
+    report = ApiFuzzReport()
+
+    for path, methods in sorted(spec.get("paths", {}).items()):
+        for method, op in sorted(methods.items()):
+            if method.upper() not in ("GET", "POST", "PUT", "DELETE",
+                                      "PATCH"):
+                continue
+            params = op.get("parameters", [])
+            for i in range(per_route):
+                url_path = path
+                for p in params:
+                    if p.get("in") == "path":
+                        v = str(_value_for(p.get("schema"), rng))
+                        url_path = url_path.replace(
+                            "{%s}" % p["name"],
+                            urllib.parse.quote(v or "x", safe=""))
+                q = {p["name"]: str(_value_for(p.get("schema"), rng))
+                     for p in params
+                     if p.get("in") == "query" and rng.random() < 0.7}
+                url = base_url + url_path
+                if q:
+                    url += "?" + urllib.parse.urlencode(q)
+                body = None
+                if method.upper() in ("POST", "PUT", "PATCH"):
+                    body = _body_for(rng)
+                headers = {"Content-Type": "application/json"}
+                authed = bool(token) and (not mutate_auth
+                                          or rng.random() < 0.7)
+                if authed:
+                    headers["Authorization"] = f"Bearer {token}"
+                elif token and rng.random() < 0.5:
+                    headers["Authorization"] = rng.choice(
+                        ["Bearer " + token[:-2], "Bearer zzz", "Basic x",
+                         "Bearer", ""])
+                guarded = not _is_public(spec, url_path, path)
+                req = urllib.request.Request(
+                    url, method=method.upper(),
+                    data=(json.dumps(body).encode()
+                          if body is not None else None),
+                    headers=headers)
+                report.requests += 1
+                try:
+                    with urllib.request.urlopen(req, timeout=15) as r:
+                        status, raw = r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    status, raw = e.code, e.read()
+                except urllib.error.URLError as e:
+                    report.violations.append(Violation(
+                        method, url, "conn", f"connection died: {e}"))
+                    continue
+                if status >= 500:
+                    report.violations.append(Violation(
+                        method, url, status,
+                        f"5xx (unhandled exception): {raw[:300]!r}"))
+                elif (not authed and guarded
+                        and 200 <= status < 300):
+                    # the advertised oracle: a mutated/absent token
+                    # reaching a guarded route with a 2xx is an auth
+                    # bypass, the worst possible finding
+                    report.violations.append(Violation(
+                        method, url, status,
+                        "AUTH BYPASS: unauthenticated 2xx on guarded "
+                        "route"))
+                elif raw and status != 204:
+                    try:
+                        json.loads(raw)
+                    except json.JSONDecodeError:
+                        if not url_path.startswith(("/ui", "/metrics")) \
+                                and url_path != "/":
+                            report.violations.append(Violation(
+                                method, url, status,
+                                f"non-JSON body: {raw[:120]!r}"))
+    return report

@@ -1,0 +1,158 @@
+"""First-party coverage-guided mutation fuzzer — the Atheris role.
+
+The reference's fuzz stack pairs Hypothesis property tests with
+Atheris coverage-guided fuzzing (``fuzzing/README.md:40-78``). Atheris
+is not available in this environment, so this module implements the
+same loop from scratch:
+
+* **Coverage feedback**: ``sys.monitoring`` (PEP 669) line events,
+  filtered to the package under test. An input that lights up a new
+  (code, line) pair joins the corpus.
+* **Mutations**: byte flips, truncation, duplication, interesting-value
+  splices, corpus crossover — the classic AFL menu, byte-oriented so it
+  composes with any ``bytes -> None`` target.
+* **Crash oracle**: any exception outside the target's declared
+  contract set is a finding; the offending input is returned for
+  reproduction (and checked into ``fuzzing/regressions/`` when real
+  bugs are found).
+
+Targets wrap the parsers that take untrusted input end-to-end: mbox,
+JWT, chunkers, normalizer, storage filters, event envelopes.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+INTERESTING = [
+    b"", b"\x00", b"\xff", b"\xff\xfe", b"\n", b"\r\n", b"\n\nFrom ",
+    b"{", b"}", b"[", b"]", b'"', b"\\", b"\\u0000", b"%s", b"{{", b"=?",
+    b"\xc3\x28", b"\xe2\x82", b"0" * 32, b"-1", b"9" * 20, b".",
+    b"Content-Type: text/html", b"base64", b"eyJ", b"..", b"$gt",
+]
+
+
+@dataclass
+class FuzzResult:
+    executions: int
+    corpus_size: int
+    coverage: int
+    crashes: list[tuple[bytes, BaseException]] = field(
+        default_factory=list)
+
+
+class CoverageTracer:
+    """Line coverage for one package prefix via sys.monitoring."""
+
+    TOOL_ID = 4  # free slot (0=debugger, 1=coverage, 2=profiler, 3=opt)
+
+    def __init__(self, path_prefix: str):
+        self.prefix = path_prefix
+        self.seen: set[tuple[str, int]] = set()
+        self._current: set[tuple[str, int]] = set()
+        self._mon = sys.monitoring
+
+    def __enter__(self):
+        mon = self._mon
+        mon.use_tool_id(self.TOOL_ID, "covfuzz")
+
+        def on_line(code, line):
+            if self.prefix in code.co_filename:
+                self._current.add((code.co_filename, line))
+
+        mon.register_callback(self.TOOL_ID, mon.events.LINE, on_line)
+        mon.set_events(self.TOOL_ID, mon.events.LINE)
+        return self
+
+    def __exit__(self, *exc):
+        self._mon.set_events(self.TOOL_ID, 0)
+        self._mon.register_callback(self.TOOL_ID, self._mon.events.LINE,
+                                    None)
+        self._mon.free_tool_id(self.TOOL_ID)
+
+    def run(self, fn: Callable[[], Any]) -> tuple[int, BaseException | None]:
+        """Execute fn, return (newly-covered line count, exception)."""
+        self._current = set()
+        err = None
+        try:
+            fn()
+        except BaseException as exc:   # noqa: BLE001 — the oracle decides
+            err = exc
+        new = self._current - self.seen
+        self.seen |= self._current
+        return len(new), err
+
+
+def mutate(data: bytes, corpus: list[bytes],
+           rng: random.Random) -> bytes:
+    buf = bytearray(data)
+    for _ in range(rng.randint(1, 4)):
+        op = rng.randrange(7)
+        if op == 0 and buf:                      # bit flip
+            i = rng.randrange(len(buf))
+            buf[i] ^= 1 << rng.randrange(8)
+        elif op == 1 and buf:                    # byte set
+            buf[rng.randrange(len(buf))] = rng.randrange(256)
+        elif op == 2 and len(buf) > 1:           # truncate / delete span
+            i = rng.randrange(len(buf))
+            del buf[i:i + rng.randint(1, 8)]
+        elif op == 3:                            # insert interesting
+            i = rng.randint(0, len(buf))
+            buf[i:i] = rng.choice(INTERESTING)
+        elif op == 4 and buf:                    # duplicate span
+            i = rng.randrange(len(buf))
+            span = bytes(buf[i:i + rng.randint(1, 16)])
+            buf[i:i] = span
+        elif op == 5 and corpus:                 # crossover with corpus
+            other = rng.choice(corpus)
+            if other:
+                i = rng.randint(0, len(buf))
+                j = rng.randrange(len(other))
+                buf[i:i] = other[j:j + rng.randint(1, 32)]
+        else:                                    # append random bytes
+            buf += bytes(rng.randrange(256)
+                         for _ in range(rng.randint(1, 8)))
+        if len(buf) > 8192:                      # keep inputs bounded
+            del buf[8192:]
+    return bytes(buf)
+
+
+def fuzz(target: Callable[[bytes], None], seeds: list[bytes],
+         allowed: tuple[type[BaseException], ...],
+         max_execs: int = 3000, max_seconds: float = 20.0,
+         seed: int = 0, package: str = "copilot_for_consensus_tpu",
+         stop_on_crash: bool = True) -> FuzzResult:
+    """Coverage-guided loop: mutate corpus entries, keep coverage
+    winners, record contract violations (exceptions not in ``allowed``).
+    Deterministic for a given seed + budget."""
+    rng = random.Random(seed)
+    corpus = [bytes(s) for s in seeds] or [b""]
+    crashes: list[tuple[bytes, BaseException]] = []
+    execs = 0
+    t0 = time.monotonic()
+    with CoverageTracer(package) as cov:
+        for s in corpus:                        # seed coverage
+            _, err = cov.run(lambda: target(s))
+            execs += 1
+            if err is not None and not isinstance(err, allowed):
+                crashes.append((s, err))
+                if stop_on_crash:
+                    return FuzzResult(execs, len(corpus), len(cov.seen),
+                                      crashes)
+        while (execs < max_execs
+               and time.monotonic() - t0 < max_seconds):
+            parent = rng.choice(corpus)
+            child = mutate(parent, corpus, rng)
+            gained, err = cov.run(lambda: target(child))
+            execs += 1
+            if err is not None and not isinstance(err, allowed):
+                crashes.append((child, err))
+                if stop_on_crash:
+                    break
+            elif gained:
+                corpus.append(child)
+    return FuzzResult(execs, len(corpus), len(cov.seen), crashes)

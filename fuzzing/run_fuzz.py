@@ -25,7 +25,9 @@ def main() -> int:
     # fuzz_settings() helper reads (must be set before import).
     os.environ["FUZZ_EXAMPLES_MULT"] = str(mult)
     return pytest.main(["-q", str(here / "test_fuzz_harnesses.py"),
-                        "-p", "no:cacheprovider"])
+                    str(here / "test_coverage_fuzz.py"),
+                    str(here / "test_api_fuzz.py"),
+                    "-p", "no:cacheprovider"])
 
 
 if __name__ == "__main__":

@@ -346,3 +346,42 @@ def test_pending_assignment_workflow(server, tokens):
     status, _ = _call(server.port, "/auth/roles/request", method="POST",
                       token=reader, body={"roles": ["superuser"]})
     assert status == 400
+
+
+def test_percent_encoded_path_params_decode(server, tokens):
+    """UI clients encodeURIComponent path ids ('@', ':'); the router
+    must decode them before handlers use them as store keys — found by
+    review: admin approve/deny always 404'd on encoded assignment ids."""
+    admin = tokens["admin@example.org"]
+    status, _ = _call(server.port,
+                      "/auth/admin/users/enc%40example.org",
+                      method="PUT", token=admin,
+                      body={"roles": ["reader"]})
+    assert status == 200
+    status, users = _call(server.port, "/auth/admin/users", token=admin)
+    assert any(u["email"] == "enc@example.org" for u in users["users"])
+    # pending-assignment ids contain '@' and ':' — resolve via the
+    # encoded form exactly as ui/app.js sends it
+    _, login = _call(server.port, "/auth/login?provider=mock")
+    _, who = _call(server.port,
+                   f"/auth/callback?state={login['state']}"
+                   f"&code=mock:enc2@example.org")
+    status, reqd = _call(server.port, "/auth/roles/request",
+                         method="POST", token=who["access_token"],
+                         body={"roles": ["processor"]})
+    assert status == 200
+    import urllib.parse as up
+    status, out = _call(
+        server.port,
+        "/auth/admin/pending/" + up.quote(reqd["_id"], safe=""),
+        method="POST", token=admin, body={"action": "approve"})
+    assert status == 200 and out["status"] == "approved"
+
+
+def test_hostile_asset_names_404_not_500(server):
+    """Regression for a REAL api-fuzzer finding: once the router began
+    percent-decoding path params, /ui/%00 put a NUL byte into a pathlib
+    path and 500'd. Hostile asset names must 404."""
+    for bad in ("%00", "..%2f..%2fetc%2fpasswd", "%0a", "a%00b.js"):
+        status, _ = _call(server.port, f"/ui/{bad}")
+        assert status == 404, bad

@@ -11,6 +11,6 @@ for _name in ("noop", "inmemory", "prometheus", "pushgateway"):
     register_driver("metrics", _name,
                     "copilot_for_consensus_tpu.obs.metrics:create_metrics_collector")
 
-for _name in ("console", "silent", "collecting"):
+for _name in ("console", "silent", "collecting", "http"):
     register_driver("error_reporter", _name,
                     "copilot_for_consensus_tpu.obs.errors:create_error_reporter")

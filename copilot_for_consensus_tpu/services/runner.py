@@ -195,6 +195,19 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
         # its own empty store and DLQ every event. Tests that rewire
         # store objects across in-process "roles" opt out explicitly.
         if not cfg.get("unsafe_private_stores"):
+            # ingestion writes archive BYTES that parsing reads; when
+            # the two live in different processes a private in-memory
+            # archive store leaves parsing reading nothing and every
+            # archive event dead-letters (found driving the broker-path
+            # scale bench).
+            has_ing = IngestionService.name in roles
+            has_par = ParsingService.name in roles
+            if has_ing != has_par and not cfg.get("archive_store"):
+                raise ValueError(
+                    "roles split ingestion and parsing across processes "
+                    "but archive_store is the private in-memory default; "
+                    "configure a shared one (e.g. {'driver': 'document'} "
+                    "to ride the shared document store)")
             for section, default_driver in (("document_store", "memory"),
                                             ("vector_store", "memory")):
                 sec = dict(cfg.get(section) or {})
@@ -241,7 +254,19 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
         logger = create_logger(cfg["logger"])
     else:
         logger = SilentLogger() if not cfg.get("verbose") else None
-    archive_store = InMemoryArchiveStore()
+    if cfg.get("archive_store"):
+        # Role-split processes need a SHARED archive store (the parsing
+        # worker reads bytes the ingestion process stored): e.g.
+        # {"driver": "document"} rides the shared document store, or
+        # {"driver": "local", "root": ...} a shared volume.
+        from copilot_for_consensus_tpu.archive.base import (
+            create_archive_store,
+        )
+
+        archive_store = create_archive_store(dict(cfg["archive_store"]),
+                                             document_store=store)
+    else:
+        archive_store = InMemoryArchiveStore()
     retry = RetryPolicy(RetryConfig(max_attempts=3, base_delay=0.01,
                                     max_delay=0.05))
 

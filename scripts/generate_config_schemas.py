@@ -246,7 +246,10 @@ DRIVERS: dict[str, dict[str, dict]] = {
         "shipping": dict(service="", level="info",
                          host="127.0.0.1", port=5140),
     },
-    "error_reporter": {"console": {}, "silent": {}, "collecting": {}},
+    "error_reporter": {"console": {}, "silent": {}, "collecting": {},
+                   "http": dict(endpoint="", release="",
+                                environment="production",
+                                min_interval_s=60.0)},
     "archive_fetcher": {
         "local": {}, "http": {}, "imap": {}, "rsync": {}, "mock": {},
     },

@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""Write per-service OpenAPI specs, sliced from the unified router.
+
+The reference generates one spec per FastAPI service
+(``scripts/generate_service_openapi.py``); here the gateway serves one
+unified route table, so the per-service view is a SLICE of the same
+source of truth — each service owns the path prefixes it serves, and
+the slices must tile the whole spec (nothing unclaimed, nothing claimed
+twice) or this script fails.
+
+Run: python scripts/generate_service_openapi.py
+Output: copilot_for_consensus_tpu/schemas/openapi/<service>.json
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+OUT_DIR = REPO / "copilot_for_consensus_tpu" / "schemas" / "openapi"
+
+# Service → the path prefixes it owns (reference
+# docker-compose.services.yml maps the same surfaces to containers).
+SERVICE_PREFIXES: dict[str, tuple[str, ...]] = {
+    "ingestion": ("/api/sources", "/api/upload"),
+    "reporting": ("/api/reports", "/api/threads", "/api/messages",
+                  "/api/search"),
+    "auth": ("/auth", "/.well-known"),
+    "ops": ("/api/ops", "/stats", "/api/openapi.json"),
+    "gateway": ("/", "/ui", "/health", "/readyz", "/metrics"),
+}
+
+
+def slice_spec(spec: dict) -> dict[str, dict]:
+    claimed: dict[str, str] = {}
+    out: dict[str, dict] = {}
+    for svc, prefixes in SERVICE_PREFIXES.items():
+        paths = {}
+        for path, ops in spec["paths"].items():
+            if any(path == p or path.startswith(p.rstrip("/") + "/")
+                   for p in prefixes if p != "/") or (
+                       "/" in prefixes and path == "/"):
+                if path in claimed:
+                    raise SystemExit(
+                        f"path {path} claimed by both {claimed[path]} "
+                        f"and {svc}")
+                claimed[path] = svc
+                paths[path] = ops
+        out[svc] = {
+            **{k: v for k, v in spec.items() if k != "paths"},
+            "info": {**spec["info"],
+                     "title": f"{spec['info']['title']} — {svc}"},
+            "paths": dict(sorted(paths.items())),
+        }
+    unclaimed = sorted(set(spec["paths"]) - set(claimed))
+    if unclaimed:
+        raise SystemExit(
+            f"paths not owned by any service: {unclaimed}; add them to "
+            "SERVICE_PREFIXES in scripts/generate_service_openapi.py")
+    return out
+
+
+def main() -> int:
+    sys.path.insert(0, str(REPO / "scripts"))
+    from generate_openapi import build_spec
+
+    spec = build_spec()
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    for svc, sub in slice_spec(spec).items():
+        out = OUT_DIR / f"{svc}.json"
+        out.write_text(json.dumps(sub, indent=2, sort_keys=True) + "\n")
+        print(f"wrote {out} ({len(sub['paths'])} paths)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

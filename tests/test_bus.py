@@ -1,4 +1,7 @@
 import pytest
+pytestmark = pytest.mark.slow   # JAX compiles / multi-process:
+# excluded from the CI fast lane (pytest -m "not slow")
+
 
 from copilot_for_consensus_tpu.bus.base import PublishError
 from copilot_for_consensus_tpu.bus.factory import create_publisher, create_subscriber

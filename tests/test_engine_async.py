@@ -5,6 +5,9 @@ import threading
 import jax
 import jax.numpy as jnp
 import pytest
+pytestmark = pytest.mark.slow   # JAX compiles / multi-process:
+# excluded from the CI fast lane (pytest -m "not slow")
+
 
 from copilot_for_consensus_tpu.engine.async_runner import AsyncEngineRunner
 from copilot_for_consensus_tpu.engine.generation import GenerationEngine

@@ -7,6 +7,10 @@ from copilot_for_consensus_tpu.engine.embedding import EmbeddingEngine
 from copilot_for_consensus_tpu.engine.tokenizer import HashWordTokenizer
 from copilot_for_consensus_tpu.models.configs import encoder_config
 
+import pytest
+pytestmark = pytest.mark.slow   # JAX compiles / multi-process:
+# excluded from the CI fast lane (pytest -m "not slow")
+
 CFG = encoder_config("tiny")
 
 

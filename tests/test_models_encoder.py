@@ -6,6 +6,10 @@ import numpy as np
 from copilot_for_consensus_tpu.models import encoder
 from copilot_for_consensus_tpu.models.configs import encoder_config
 
+import pytest
+pytestmark = pytest.mark.slow   # JAX compiles / multi-process:
+# excluded from the CI fast lane (pytest -m "not slow")
+
 CFG = encoder_config("tiny")
 PARAMS = encoder.init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
 

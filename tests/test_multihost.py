@@ -13,6 +13,9 @@ import sys
 import textwrap
 
 import pytest
+pytestmark = pytest.mark.slow   # JAX compiles / multi-process:
+# excluded from the CI fast lane (pytest -m "not slow")
+
 
 from copilot_for_consensus_tpu.parallel.multihost import MultiHostConfig
 

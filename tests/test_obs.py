@@ -61,3 +61,74 @@ def test_memory_logger_captures():
     log = MemoryLogger()
     log.warning("hmm", a=1)
     assert log.records == [{"level": "warning", "message": "hmm", "a": 1}]
+
+
+def test_http_error_reporter_sentry_role():
+    """Sentry-role driver: events POST as JSON with fingerprint +
+    tags; repeats of the same error site rate-limit; a dead endpoint
+    degrades to the fallback without raising."""
+    import json as _json
+    import time
+
+    from copilot_for_consensus_tpu.obs.errors import (
+        CollectingErrorReporter,
+        HTTPErrorReporter,
+        create_error_reporter,
+    )
+    from copilot_for_consensus_tpu.services.http import HTTPServer, Router
+
+    received = []
+    router = Router()
+
+    @router.post("/events")
+    def events(req):
+        received.append(_json.loads(req.body))
+        return {"ok": True}
+
+    srv = HTTPServer(router)
+    srv.start()
+    try:
+        rep = HTTPErrorReporter(
+            f"http://127.0.0.1:{srv.port}/events",
+            release="r3", environment="test", min_interval_s=60.0)
+
+        def boom():
+            raise RuntimeError("kaboom")
+
+        for _ in range(3):       # same site: only the first ships
+            try:
+                boom()
+            except RuntimeError as exc:
+                rep.report(exc, {"service": "parsing", "doc": "d1"})
+        deadline = time.monotonic() + 10
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(received) == 1
+        ev = received[0]
+        assert ev["error_type"] == "RuntimeError"
+        assert ev["release"] == "r3" and ev["environment"] == "test"
+        assert ev["tags"]["service"] == "parsing"
+        assert "boom" in ev["stacktrace"]
+        assert rep.suppressed == 2
+    finally:
+        srv.stop()
+
+    # endpoint down: report() must not raise; fallback collects
+    fb = CollectingErrorReporter()
+    dead = HTTPErrorReporter("http://127.0.0.1:1/events", fallback=fb,
+                             min_interval_s=0.0)
+    try:
+        raise ValueError("lost")
+    except ValueError as exc:
+        dead.report(exc)
+    deadline = time.monotonic() + 10
+    while not fb.reports and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert fb.reports and "lost" in str(fb.reports[0][0])
+
+    # factory dispatch + config validation
+    assert isinstance(create_error_reporter(
+        {"driver": "http", "endpoint": "http://x/e"}), HTTPErrorReporter)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="endpoint"):
+        create_error_reporter({"driver": "http"})

@@ -99,3 +99,29 @@ def test_ui_public_but_api_guarded_when_auth_on():
 
 
 import urllib.error  # noqa: E402  (used in except clauses above)
+
+
+def test_committed_service_specs_match_router():
+    """Per-service OpenAPI slices (scripts/generate_service_openapi.py)
+    must tile the unified spec exactly and stay fresh."""
+    import importlib.util
+    import json
+    import pathlib
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo / "scripts"))
+    spec_mod = importlib.util.spec_from_file_location(
+        "gen_svc_openapi", repo / "scripts" / "generate_service_openapi.py")
+    gen = importlib.util.module_from_spec(spec_mod)
+    spec_mod.loader.exec_module(gen)
+    from generate_openapi import build_spec
+
+    slices = gen.slice_spec(build_spec())
+    out_dir = repo / "copilot_for_consensus_tpu" / "schemas" / "openapi"
+    committed = {p.stem: json.loads(p.read_text())
+                 for p in out_dir.glob("*.json")}
+    assert set(committed) == set(slices)
+    for svc, want in slices.items():
+        assert committed[svc]["paths"].keys() == want["paths"].keys(), (
+            f"{svc} spec stale; rerun scripts/generate_service_openapi.py")

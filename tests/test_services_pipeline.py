@@ -2,6 +2,9 @@
 # cascade delete, and failure-event behavior. Mirrors the reference's
 # zero-infra full-pipeline strategy (SURVEY.md §4).
 import pytest
+pytestmark = pytest.mark.slow   # JAX compiles / multi-process:
+# excluded from the CI fast lane (pytest -m "not slow")
+
 
 from copilot_for_consensus_tpu.core import events as ev
 from copilot_for_consensus_tpu.services.runner import build_pipeline

@@ -9,6 +9,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+pytestmark = pytest.mark.slow   # JAX compiles / multi-process:
+# excluded from the CI fast lane (pytest -m "not slow")
+
 
 from copilot_for_consensus_tpu import train
 from copilot_for_consensus_tpu.checkpoint import TrainCheckpointer

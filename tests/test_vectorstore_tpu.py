@@ -1,6 +1,9 @@
 # On-device vector store vs the in-memory oracle.
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow   # JAX compiles / multi-process:
+# excluded from the CI fast lane (pytest -m "not slow")
+
 
 from copilot_for_consensus_tpu.vectorstore.factory import create_vector_store
 

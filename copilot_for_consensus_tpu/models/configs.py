@@ -29,10 +29,14 @@ class DecoderConfig:
     experts_per_token: int = 2
     expert_capacity_factor: float = 1.25
     tie_embeddings: bool = False
+    #: explicit per-head width; 0 derives d_model // n_heads. Needed by
+    #: tensor-parallel stage-local views, where n_heads is divided by tp
+    #: but each head keeps its full width.
+    head_dim_override: int = 0
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def is_moe(self) -> bool:

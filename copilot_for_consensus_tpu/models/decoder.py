@@ -131,16 +131,20 @@ def _unembed(x: jax.Array, params: Params, cfg: DecoderConfig) -> jax.Array:
 
 def block(x: jax.Array, layer: Params, cfg: DecoderConfig,
           lengths: jax.Array | None = None,
-          attn_impl: str = "auto") -> jax.Array:
+          attn_impl: str = "auto", reduce=None) -> jax.Array:
     """One transformer block: [B, S, D] → [B, S, D]. The single source of
     the block body — forward and the pp pipeline both run this, so model
-    changes cannot drift between them."""
+    changes cannot drift between them. ``reduce`` (default identity)
+    completes partial products when the layer's head/ffn width is
+    tensor-parallel sharded — the pp×tp path passes a psum."""
+    if reduce is None:
+        reduce = lambda t: t  # noqa: E731
     h, _, _ = L.attn_prefill(
         L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
         layer, cfg, lengths=lengths, impl=attn_impl)
-    x = x + h
-    return x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
-                    layer, cfg)
+    x = x + reduce(h)
+    return x + reduce(_ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                           layer, cfg))
 
 
 def forward(params: Params, tokens: jax.Array, cfg: DecoderConfig,

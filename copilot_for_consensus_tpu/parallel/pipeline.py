@@ -17,11 +17,12 @@ masked-psum'd back to all devices (cheap at [B, S, D] test scale; a
 multi-slice deployment would leave them stage-local for the loss).
 
 Layer weights shard their leading (layer-stack) axis over ``pp`` — the
-``layers`` logical axis below. Parallelism here is pp-only: the explicit
-shard_map specs replicate weights/activations over every other mesh axis,
-so meshes with tp/dp > 1 are correct but redundant inside the pipeline
-(intra-stage tp would need manual collectives in the stage body — a
-follow-up, not a property of this module yet).
+``layers`` logical axis below. With ``tp_axis`` set, each stage ALSO
+tensor-parallelizes its layers Megatron-style inside the shard_map
+body: qkv and gate/up are column-parallel (no communication), wo and
+w_down are row-parallel, and the two partial products psum over ``tp``
+per layer — heads and ffn width divide across the tp ranks, so a
+pp×tp mesh holds 1/(pp·tp) of the stack per device.
 """
 
 from __future__ import annotations
@@ -34,7 +35,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from copilot_for_consensus_tpu.models import decoder
+from copilot_for_consensus_tpu.models import decoder, layers as L
 from copilot_for_consensus_tpu.models.configs import DecoderConfig
 from copilot_for_consensus_tpu.parallel.sharding import (
     DEFAULT_RULES,
@@ -60,9 +61,22 @@ def shard_params_for_pipeline(params: Any, cfg: DecoderConfig,
                         PIPELINE_RULES)
 
 
-def _pp_shard(layers_local, x_mb, lengths, *, axis, cfg, impl):
+def _block_tp(x, layer, cfg_local, lengths, impl, tp_axis):
+    """One transformer block with its heads/ffn width SPLIT over
+    ``tp_axis`` (the leaves in ``layer`` are already the local shards).
+    The wo and w_down products are partial sums — ``decoder.block``'s
+    ``reduce`` hook psums each (the standard column→row Megatron
+    schedule: two collectives per layer), so the block body itself
+    stays single-sourced in decoder.py."""
+    return decoder.block(x, layer, cfg_local, lengths, impl,
+                         reduce=lambda t: jax.lax.psum(t, tp_axis))
+
+
+def _pp_shard(layers_local, x_mb, lengths, *, axis, cfg, impl,
+              tp_axis=None):
     """Per-device body. layers_local: this stage's layer span (leading dim
-    L/P); x_mb: [M, mb, S, D] microbatched embeddings (replicated);
+    L/P; head/ffn axes further split over ``tp_axis`` when set);
+    x_mb: [M, mb, S, D] microbatched embeddings (replicated);
     lengths: [M, mb] (replicated)."""
     pp = jax.lax.psum(1, axis)
     stage = jax.lax.axis_index(axis)
@@ -72,11 +86,27 @@ def _pp_shard(layers_local, x_mb, lengths, *, axis, cfg, impl):
 
     vary = lambda t: jax.lax.pcast(t, (axis,), to="varying")  # noqa: E731
 
-    def run_stage(x, mb_lengths):
-        def body(x, layer):
-            return decoder.block(x, layer, cfg, mb_lengths, impl), None
-        x, _ = jax.lax.scan(body, x, layers_local)
-        return x
+    if tp_axis is not None:
+        import dataclasses
+
+        tp = jax.lax.psum(1, tp_axis)
+        cfg_local = dataclasses.replace(
+            cfg, n_heads=cfg.n_heads // tp,
+            n_kv_heads=cfg.n_kv_heads // tp, d_ff=cfg.d_ff // tp,
+            head_dim_override=cfg.head_dim)
+
+        def run_stage(x, mb_lengths):
+            def body(x, layer):
+                return _block_tp(x, layer, cfg_local, mb_lengths, impl,
+                                 tp_axis), None
+            x, _ = jax.lax.scan(body, x, layers_local)
+            return x
+    else:
+        def run_stage(x, mb_lengths):
+            def body(x, layer):
+                return decoder.block(x, layer, cfg, mb_lengths, impl), None
+            x, _ = jax.lax.scan(body, x, layers_local)
+            return x
 
     def body(t, carry):
         recv, out_buf = carry
@@ -104,14 +134,23 @@ def _pp_shard(layers_local, x_mb, lengths, *, axis, cfg, impl):
         axis)
 
 
+#: which axis of each layer leaf splits over tp (column-parallel out
+#: axes for qkv/gate/up, row-parallel in axes for wo/down); norms stay
+#: replicated.
+_TP_LEAF_AXIS = {"wq": 2, "wk": 2, "wv": 2, "w_gate": 2, "w_up": 2,
+                 "wo": 1, "w_down": 1}
+
+
 def pipeline_forward(params: Any, tokens: jax.Array, cfg: DecoderConfig,
                      mesh: Mesh, *, n_microbatches: int,
                      lengths: jax.Array | None = None,
-                     axis: str = "pp", attn_impl: str = "auto"
-                     ) -> jax.Array:
+                     axis: str = "pp", tp_axis: str | None = None,
+                     attn_impl: str = "auto") -> jax.Array:
     """[B, S] tokens → [B, S, V] fp32 logits with the layer stack
-    pipelined over ``axis``. Embed/unembed run replicated outside the
-    pipeline (they are one matmul each; the stack dominates)."""
+    pipelined over ``axis`` and (optionally) each stage's heads/ffn
+    width tensor-parallel over ``tp_axis``. Embed/unembed run
+    replicated outside the pipeline (they are one matmul each; the
+    stack dominates)."""
     b, s = tokens.shape
     m = n_microbatches
     if b % m:
@@ -120,6 +159,16 @@ def pipeline_forward(params: Any, tokens: jax.Array, cfg: DecoderConfig,
         raise ValueError(
             f"{cfg.n_layers} layers not divisible by {axis}="
             f"{mesh.shape[axis]} stages")
+    if tp_axis is not None:
+        tp = mesh.shape[tp_axis]
+        if cfg.is_moe:
+            raise ValueError("intra-stage tp does not cover MoE layers")
+        for dim, nm in ((cfg.n_heads, "n_heads"),
+                        (cfg.n_kv_heads, "n_kv_heads"),
+                        (cfg.d_ff, "d_ff")):
+            if dim % tp:
+                raise ValueError(f"{nm}={dim} not divisible by "
+                                 f"{tp_axis}={tp}")
     if lengths is None:
         lengths = jnp.full((b,), s, jnp.int32)
 
@@ -127,11 +176,18 @@ def pipeline_forward(params: Any, tokens: jax.Array, cfg: DecoderConfig,
     x_mb = x.reshape(m, b // m, s, x.shape[-1])
     len_mb = lengths.reshape(m, b // m)
 
-    layer_specs = jax.tree.map(
-        lambda leaf: P(axis, *([None] * (leaf.ndim - 1))),
-        params["layers"])
+    def leaf_spec(path, leaf):
+        name = path[-1].key
+        dims = [axis] + [None] * (leaf.ndim - 1)
+        if tp_axis is not None and name in _TP_LEAF_AXIS:
+            dims[_TP_LEAF_AXIS[name]] = tp_axis
+        return P(*dims)
+
+    layer_specs = jax.tree_util.tree_map_with_path(
+        leaf_spec, params["layers"])
     fn = shard_map(
-        functools.partial(_pp_shard, axis=axis, cfg=cfg, impl=attn_impl),
+        functools.partial(_pp_shard, axis=axis, cfg=cfg, impl=attn_impl,
+                          tp_axis=tp_axis),
         mesh=mesh,
         in_specs=(layer_specs, P(), P()),
         out_specs=P(),
@@ -139,6 +195,46 @@ def pipeline_forward(params: Any, tokens: jax.Array, cfg: DecoderConfig,
     y = fn(params["layers"], x_mb, len_mb)
     y = y.reshape(b, s, -1)
     return decoder._unembed(y, params, cfg)
+
+
+def pipeline_greedy_decode(params: Any, prompt: jax.Array,
+                           cfg: DecoderConfig, mesh: Mesh, *,
+                           n_new_tokens: int, n_microbatches: int = 1,
+                           axis: str = "pp", tp_axis: str | None = None,
+                           attn_impl: str = "auto") -> jax.Array:
+    """Greedy decode THROUGH the pp(×tp) pipeline: each step re-runs the
+    pipelined forward over the grown sequence and appends the argmax
+    token. prompt: [B, S] → returns [B, n_new_tokens].
+
+    This is the prefill-style serving path for the pipelined stack
+    (batch scoring / short generations where the layer stack doesn't
+    fit one slice); a KV-cached windowed pp decode is the long-form
+    follow-up. The sequence buffer is padded once so every step runs
+    the SAME program shape (one compile), with ``lengths`` masking the
+    not-yet-generated tail."""
+    b, s0 = prompt.shape
+    buf = jnp.concatenate(
+        [prompt, jnp.zeros((b, n_new_tokens), prompt.dtype)], axis=1)
+
+    def step(carry, _):
+        buf, n = carry
+        lengths = jnp.full((b,), n, jnp.int32)
+        logits = pipeline_forward(
+            params, buf, cfg, mesh, n_microbatches=n_microbatches,
+            lengths=lengths, axis=axis, tp_axis=tp_axis,
+            attn_impl=attn_impl)
+        # argmax at each row's last valid position
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        nxt = jnp.argmax(last, axis=-1).astype(buf.dtype)
+        buf = jax.vmap(
+            lambda row, pos, tok: jax.lax.dynamic_update_slice(
+                row, tok[None], (pos,)))(buf, lengths, nxt)
+        return (buf, n + 1), nxt
+
+    (_, _), toks = jax.lax.scan(step, (buf, jnp.int32(s0)),
+                                None, length=n_new_tokens)
+    return toks.T                                     # [B, n_new]
 
 
 def make_pipeline_train_step(cfg: DecoderConfig, optimizer, mesh: Mesh,

@@ -202,10 +202,12 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
             # scale bench).
             has_ing = IngestionService.name in roles
             has_par = ParsingService.name in roles
-            if has_ing != has_par and not cfg.get("archive_store"):
+            arch_driver = dict(cfg.get("archive_store")
+                               or {}).get("driver", "memory")
+            if has_ing != has_par and arch_driver == "memory":
                 raise ValueError(
                     "roles split ingestion and parsing across processes "
-                    "but archive_store is the private in-memory default; "
+                    "but the archive_store driver is private in-memory; "
                     "configure a shared one (e.g. {'driver': 'document'} "
                     "to ride the shared document store)")
             for section, default_driver in (("document_store", "memory"),

@@ -283,12 +283,22 @@ DRIVERS: dict[str, dict[str, dict]] = {
 }
 
 
+# Keys the factory hard-requires (construction raises without them) —
+# the schema must not promise a config shape the factory rejects.
+REQUIRED_KEYS: dict[tuple[str, str], list[str]] = {
+    ("error_reporter", "http"): ["endpoint"],
+}
+
+
 def driver_schema(kind: str, name: str, keys: dict) -> dict:
     props: dict = {"driver": {"const": name}}
+    required = ["driver"] + REQUIRED_KEYS.get((kind, name), [])
     for key, value in keys.items():
         tname = {str: "string", int: "integer", float: "number",
                  bool: "boolean", list: "array", dict: "object"}[type(value)]
-        props[key] = {"type": tname, "default": value}
+        props[key] = {"type": tname}
+        if key not in required:
+            props[key]["default"] = value
     return {
         "$schema": "https://json-schema.org/draft/2020-12/schema",
         "$id": ("copilot-for-consensus-tpu/schemas/configs/adapters/"
@@ -296,7 +306,7 @@ def driver_schema(kind: str, name: str, keys: dict) -> dict:
         "title": f"{kind} driver: {name}",
         "type": "object",
         "properties": props,
-        "required": ["driver"],
+        "required": required,
         "additionalProperties": True,
     }
 

@@ -196,6 +196,14 @@ class GenerationEngine:
             params = quant.quantize_params(params, mode=qmode)
         if qmode:
             axes = quant.quantize_logical_axes(axes, mode=qmode)
+        if (qmode == "int4" and mesh is None and not cfg.is_moe
+                and quant.pallas_qmatmul_enabled()
+                and jax.default_backend() == "tpu"):
+            # Fused qkv / gate+up leaves: 4 Pallas calls per layer
+            # instead of 7 — per-call overhead (~65 µs) is what erased
+            # int4's halved-byte advantage. Single-chip serving only
+            # (no sharding rules for the fused leaves).
+            params = quant.fuse_int4_projections(params)
         if mesh is not None:
             # shard_pytree device_puts numpy leaves shard-by-shard, so a
             # host-resident (mmap'd) checkpoint never fully materializes

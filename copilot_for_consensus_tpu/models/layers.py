@@ -43,7 +43,7 @@ def qmatmul(x: jax.Array, w) -> jax.Array:
     m = 1
     for s in x.shape[:-1]:
         m *= s
-    a8 = act_quant_mode() == "a8" and on_tpu and m >= 1024
+    a8 = act_quant_mode() == "a8" and on_tpu
     if kind == "int4":
         from copilot_for_consensus_tpu.ops.quant_matmul import (
             int4_matmul,
@@ -51,12 +51,19 @@ def qmatmul(x: jax.Array, w) -> jax.Array:
             w4a8_matmul,
         )
         if w["q4"].ndim == 2 and pallas_qmatmul_enabled() and on_tpu:
+            # int4 in a8 mode takes the int8-MXU kernel at EVERY width:
+            # the bf16 group dots of the weight-only kernel lose to it
+            # at decode shapes too (harness: 31.2 vs 33.7 ms/pass).
             if a8:
                 return w4a8_matmul(x, w["q4"], w["scale"])
             return int4_matmul(x, w["q4"], w["scale"])
         return int4_matmul_xla(x, w["q4"], w["scale"])
     if kind == "int8":
-        if (a8 and w["q"].ndim == 2 and pallas_qmatmul_enabled()):
+        # int8 a8 pays only where the matmul is MXU-bound (m ≥ 1024,
+        # prefill waves); at decode widths the dequant-fused XLA
+        # expression wins (3225 vs 2662 tok/s forced).
+        if (a8 and m >= 1024 and w["q"].ndim == 2
+                and pallas_qmatmul_enabled()):
             from copilot_for_consensus_tpu.ops.quant_matmul import (
                 w8a8_matmul,
             )
@@ -125,12 +132,20 @@ def _project_qkv(x: jax.Array, layer: dict, cfg: DecoderConfig,
                  positions: jax.Array):
     b, s, _ = x.shape
     dh = cfg.head_dim
-    q = qmatmul(x, layer["wq"]).reshape(
-        b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
-    k = qmatmul(x, layer["wk"]).reshape(
-        b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
-    v = qmatmul(x, layer["wv"]).reshape(
-        b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
+    if "wqkv" in layer:
+        # Fused int4 projection (quant.fuse_int4_projections): one
+        # kernel call; split the product by column.
+        nq, nkv = cfg.n_heads * dh, cfg.n_kv_heads * dh
+        qkv = qmatmul(x, layer["wqkv"])
+        q, k, v = (qkv[..., :nq], qkv[..., nq:nq + nkv],
+                   qkv[..., nq + nkv:])
+    else:
+        q = qmatmul(x, layer["wq"])
+        k = qmatmul(x, layer["wk"])
+        v = qmatmul(x, layer["wv"])
+    q = q.reshape(b, s, cfg.n_heads, dh).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, cfg.n_kv_heads, dh).transpose(0, 2, 1, 3)
     inv_freq = rope_frequencies(dh, cfg.rope_theta)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
@@ -224,8 +239,15 @@ def attn_decode_windowed(x: jax.Array, layer: dict, cfg: DecoderConfig,
 
 def swiglu(x: jax.Array, layer: dict) -> jax.Array:
     """SwiGLU MLP: silu(x·Wg) ⊙ (x·Wu) · Wd — Llama/Mistral family FFN."""
-    gate = jax.nn.silu(qmatmul(x, layer["w_gate"]).astype(jnp.float32))
-    up = qmatmul(x, layer["w_up"]).astype(jnp.float32)
+    if "w_gu" in layer:
+        # Fused int4 gate+up (quant.fuse_int4_projections): one kernel
+        # call, split by column.
+        gu = qmatmul(x, layer["w_gu"]).astype(jnp.float32)
+        f = gu.shape[-1] // 2
+        gate, up = jax.nn.silu(gu[..., :f]), gu[..., f:]
+    else:
+        gate = jax.nn.silu(qmatmul(x, layer["w_gate"]).astype(jnp.float32))
+        up = qmatmul(x, layer["w_up"]).astype(jnp.float32)
     return qmatmul((gate * up).astype(x.dtype), layer["w_down"])
 
 

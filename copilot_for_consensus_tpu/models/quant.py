@@ -135,6 +135,45 @@ def quantize_tensor_int4(w: jax.Array,
             "scale": scale.reshape(*lead, d // group, f)}
 
 
+def fuse_int4_projections(params: dict) -> dict:
+    """Fuse the int4 qkv and gate/up leaves into single wide leaves.
+
+    Decode through the Pallas int4 kernels pays ~65 µs per kernel call
+    (measured r3); 7 calls/layer lose the format's halved-bytes
+    advantage. q/k/v share the input x, as do gate/up, so their packed
+    nibbles and group scales concatenate along the OUTPUT axis into one
+    ``wqkv`` [L, D/2, (Hq+2Hkv)·dh] and one ``w_gu`` [L, D/2, 2F] —
+    4 calls/layer. ``layers._project_qkv`` / ``layers.swiglu`` split the
+    fused product by column; packing along D is untouched, so per-group
+    scales stay exact. Single-device serving only (the fused leaves have
+    no sharding rules); callers gate on ``mesh is None``."""
+    layers_t = params.get("layers", {})
+    if "wqkv" in layers_t or "wq" not in layers_t:
+        return params
+    if quant_kind(layers_t["wq"]) != "int4" or \
+            quant_kind(layers_t.get("w_gate")) != "int4":
+        raise ValueError("fuse_int4_projections needs int4 leaves")
+    if layers_t["w_gate"]["q4"].ndim != 3:
+        # MoE expert leaves are [L, E, D/2, F]: moe_ffn dispatches per
+        # expert by name and must keep w_gate/w_up — fusing (and
+        # deleting) them breaks every MoE forward.
+        raise ValueError(
+            "fuse_int4_projections supports dense FFN leaves only; "
+            "gate fusion on cfg.is_moe at the call site")
+
+    def cat(*leaves):
+        return {"q4": jnp.concatenate([l["q4"] for l in leaves], axis=-1),
+                "scale": jnp.concatenate([l["scale"] for l in leaves],
+                                         axis=-1)}
+
+    fused = dict(layers_t)
+    fused["wqkv"] = cat(layers_t["wq"], layers_t["wk"], layers_t["wv"])
+    fused["w_gu"] = cat(layers_t["w_gate"], layers_t["w_up"])
+    for k in ("wq", "wk", "wv", "w_gate", "w_up"):
+        del fused[k]
+    return {**params, "layers": fused}
+
+
 def _get_path(tree: dict, path: tuple[str, ...]):
     node = tree
     for p in path:

@@ -112,3 +112,49 @@ def test_moe_int4_forward_runs():
     cos = (f * q).sum(-1) / (np.linalg.norm(f, axis=-1)
                              * np.linalg.norm(q, axis=-1) + 1e-9)
     assert cos.min() > 0.9
+
+
+def test_fuse_int4_projections_preserves_forward():
+    """The fused wqkv / w_gu leaves must produce the same logits as the
+    unfused int4 tree (identical nibbles + scales, split by column)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from copilot_for_consensus_tpu.models import decoder, quant
+    from copilot_for_consensus_tpu.models.configs import decoder_config
+
+    cfg = decoder_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(11), cfg,
+                                 dtype=jnp.float32)
+    qp = quant.quantize_params(params, mode="int4")
+    fused = quant.fuse_int4_projections(qp)
+    assert "wqkv" in fused["layers"] and "wq" not in fused["layers"]
+    assert "w_gu" in fused["layers"]
+    toks = jax.random.randint(jax.random.PRNGKey(12), (2, 9), 3,
+                              cfg.vocab_size)
+    ref = decoder.forward(qp, toks, cfg, attn_impl="xla")
+    out = decoder.forward(fused, toks, cfg, attn_impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # idempotent + validation
+    assert quant.fuse_int4_projections(fused) is fused or \
+        "wqkv" in quant.fuse_int4_projections(fused)["layers"]
+
+
+def test_fuse_int4_rejects_moe_leaves():
+    """Review repro: MoE expert leaves must not be fused/deleted — the
+    per-expert dispatch reads w_gate/w_up by name."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from copilot_for_consensus_tpu.models import decoder, quant
+    from copilot_for_consensus_tpu.models.configs import decoder_config
+
+    cfg = decoder_config("tiny-moe")
+    params = decoder.init_params(jax.random.PRNGKey(1), cfg,
+                                 dtype=jnp.float32)
+    qp = quant.quantize_params(params, mode="int4")
+    with pytest.raises(ValueError, match="dense FFN"):
+        quant.fuse_int4_projections(qp)

@@ -7,6 +7,9 @@ from __future__ import annotations
 from typing import Any
 
 from copilot_for_consensus_tpu.core.factory import register_driver
+from copilot_for_consensus_tpu.core.openai_compat import (
+    azure_default_api_version,
+)
 from copilot_for_consensus_tpu.summarization.base import (
     MockSummarizer,
     Summarizer,
@@ -42,8 +45,26 @@ def create_summarizer(config: Any = None, **kwargs: Any) -> Summarizer:
             profile_dir=_cfg_get(config, "profile_dir"),
             **kwargs,
         )
+    if driver in ("openai", "azure_openai"):
+        # One client covers the reference's llm_openai AND
+        # llm_azure_openai_gpt drivers (openai_summarizer.py:23), plus
+        # any OpenAI-compatible server (vLLM/Ollama/llama.cpp).
+        from copilot_for_consensus_tpu.summarization.openai_summarizer \
+            import OpenAISummarizer
+
+        return OpenAISummarizer(
+            base_url=_cfg_get(config, "base_url", ""),
+            api_key=_cfg_get(config, "api_key", "") or "",
+            model=_cfg_get(config, "model", "gpt-4o-mini"),
+            temperature=float(_cfg_get(config, "temperature", 0.2)),
+            max_tokens=int(_cfg_get(config, "max_tokens", 512)),
+            api_version=azure_default_api_version(
+                driver, _cfg_get(config, "api_version", "")),
+        )
     raise ValueError(f"unknown llm_backend driver {driver!r}")
 
 
 register_driver("llm_backend", "mock", create_summarizer)
 register_driver("llm_backend", "tpu", create_summarizer)
+register_driver("llm_backend", "openai", create_summarizer)
+register_driver("llm_backend", "azure_openai", create_summarizer)

@@ -219,12 +219,24 @@ DRIVERS: dict[str, dict[str, dict]] = {
     "embedding_backend": {
         "mock": dict(dimension=32),
         "tpu": dict(model="minilm-l6", checkpoint="", batch_size=64),
+        "openai": dict(base_url="", api_key="",
+                       model="text-embedding-3-small", dimension=1536,
+                       batch_size=256, api_version=""),
+        "azure_openai": dict(base_url="", api_key="",
+                             model="text-embedding-3-small",
+                             dimension=1536, batch_size=256,
+                             api_version="2024-02-01"),
     },
     "llm_backend": {
         "mock": dict(max_sentences=3),
         "tpu": dict(model="mistral-7b", max_new_tokens=256, num_slots=4,
                     max_len=4096, checkpoint="", long_context=False,
                     kv_dtype="", quantize="int8", profile_dir=""),
+        "openai": dict(base_url="", api_key="", model="gpt-4o-mini",
+                       temperature=0.2, max_tokens=512, api_version=""),
+        "azure_openai": dict(base_url="", api_key="",
+                             model="gpt-4o-mini", temperature=0.2,
+                             max_tokens=512, api_version="2024-02-01"),
     },
     "chunker": {
         "token_window": dict(chunk_size=384, overlap=50,
@@ -287,6 +299,10 @@ DRIVERS: dict[str, dict[str, dict]] = {
 # the schema must not promise a config shape the factory rejects.
 REQUIRED_KEYS: dict[tuple[str, str], list[str]] = {
     ("error_reporter", "http"): ["endpoint"],
+    ("embedding_backend", "openai"): ["base_url"],
+    ("embedding_backend", "azure_openai"): ["base_url"],
+    ("llm_backend", "openai"): ["base_url"],
+    ("llm_backend", "azure_openai"): ["base_url"],
 }
 
 

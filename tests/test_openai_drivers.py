@@ -1,0 +1,164 @@
+# OpenAI-compatible drivers against an in-process mock server: the
+# interoperability path the reference serves via llm_openai /
+# llm_azure_openai_gpt / OpenAIEmbeddingProvider.
+import json
+
+import pytest
+
+from copilot_for_consensus_tpu.embedding.base import EmbeddingError
+from copilot_for_consensus_tpu.embedding.factory import (
+    create_embedding_provider,
+)
+from copilot_for_consensus_tpu.services.http import HTTPServer, Router
+from copilot_for_consensus_tpu.summarization.base import (
+    RateLimitError,
+    SummarizationError,
+    ThreadContext,
+)
+from copilot_for_consensus_tpu.summarization.factory import create_summarizer
+
+
+@pytest.fixture()
+def mock_openai():
+    """Minimal OpenAI-compatible endpoint: records requests, scriptable
+    failures via state dict."""
+    router = Router()
+    state = {"requests": [], "fail_next": None}
+
+    @router.post("/v1/chat/completions")
+    def chat(req):
+        body = req.json()
+        state["requests"].append(("chat", dict(req.headers), body))
+        if state["fail_next"] == 429:
+            state["fail_next"] = None
+            from copilot_for_consensus_tpu.services.http import (
+                HTTPError,
+                Response,
+            )
+            return Response({"error": "slow down"}, status=429,
+                            headers={"Retry-After": "7"})
+        user = body["messages"][-1]["content"]
+        return {
+            "model": body["model"],
+            "choices": [{"message": {
+                "role": "assistant",
+                "content": f"SUMMARY[{body['model']}] of: {user[:40]}"}}],
+            "usage": {"prompt_tokens": 10, "completion_tokens": 5},
+        }
+
+    @router.post("/v1/embeddings")
+    def embeddings(req):
+        body = req.json()
+        state["requests"].append(("emb", dict(req.headers), body))
+        texts = body["input"]
+        return {"data": [
+            {"index": i, "embedding": [float(len(t)), float(i), 1.0]}
+            for i, t in enumerate(texts)
+        ][::-1]}     # reversed: clients must re-sort by index
+
+    srv = HTTPServer(router)
+    srv.start()
+    yield srv, state
+    srv.stop()
+
+
+def _thread():
+    return ThreadContext(
+        thread_id="t1", subject="QUIC drafts", participants=["a@x"],
+        message_count=3,
+        chunks=[{"chunk_id": "c1", "message_doc_id": "m1",
+                 "text": "we should adopt the draft", "score": 0.9}])
+
+
+def test_openai_summarizer_end_to_end(mock_openai):
+    srv, state = mock_openai
+    summ = create_summarizer({
+        "driver": "openai",
+        "base_url": f"http://127.0.0.1:{srv.port}/v1",
+        "api_key": "sk-test", "model": "gpt-4o-mini"})
+    s = summ.summarize(_thread())
+    assert s.summary_text.startswith("SUMMARY[gpt-4o-mini]")
+    assert s.prompt_tokens == 10 and s.completion_tokens == 5
+    # citations come from chunks, never the model output
+    assert s.citations[0].chunk_id == "c1"
+    kind, headers, body = state["requests"][0]
+    assert headers.get("Authorization") == "Bearer sk-test"
+    assert body["messages"][0]["role"] == "system"
+    assert "QUIC drafts" in body["messages"][1]["content"]
+
+
+def test_openai_summarizer_rate_limit_surfaces_retry_after(mock_openai):
+    srv, state = mock_openai
+    state["fail_next"] = 429
+    summ = create_summarizer({
+        "driver": "openai",
+        "base_url": f"http://127.0.0.1:{srv.port}/v1"})
+    with pytest.raises(RateLimitError) as ei:
+        summ.summarize(_thread())
+    assert ei.value.retry_after_s == 7.0
+    # next call succeeds — the service retry loop handles the wait
+    assert summ.summarize(_thread()).summary_text
+
+
+def test_azure_conventions(mock_openai):
+    srv, state = mock_openai
+    summ = create_summarizer({
+        "driver": "azure_openai",
+        "base_url": f"http://127.0.0.1:{srv.port}/v1",
+        "api_key": "azkey"})
+    summ.summarize(_thread())
+    _, headers, _ = state["requests"][0]
+    assert headers.get("Api-Key") == "azkey" or \
+        headers.get("api-key") == "azkey"
+
+
+def test_openai_embeddings_batch_and_ordering(mock_openai):
+    srv, state = mock_openai
+    prov = create_embedding_provider({
+        "driver": "openai",
+        "base_url": f"http://127.0.0.1:{srv.port}/v1",
+        "dimension": 3, "batch_size": 2})
+    vecs = prov.embed_batch(["aa", "bbbb", "cc"])
+    # one request per batch_size=2 window
+    assert len([r for r in state["requests"] if r[0] == "emb"]) == 2
+    # index re-sort: vector i belongs to text i despite reversed reply
+    assert vecs[0][0] == 2.0 and vecs[1][0] == 4.0 and vecs[2][0] == 2.0
+    assert prov.embed("xyz")[0] == 3.0
+
+
+def test_unreachable_backend_raises_cleanly():
+    summ = create_summarizer({"driver": "openai",
+                              "base_url": "http://127.0.0.1:1/v1"})
+    with pytest.raises(SummarizationError, match="unreachable"):
+        summ.summarize(_thread())
+    prov = create_embedding_provider({"driver": "openai",
+                                      "base_url": "http://127.0.0.1:1/v1"})
+    with pytest.raises(EmbeddingError):
+        prov.embed("x")
+
+
+def test_base_url_required():
+    with pytest.raises(ValueError, match="base_url"):
+        create_summarizer({"driver": "openai"})
+    with pytest.raises(ValueError, match="base_url"):
+        create_embedding_provider({"driver": "azure_openai"})
+
+
+def test_retry_after_parses_http_date_and_garbage():
+    """RFC 7231 allows an HTTP-date Retry-After (some gateways send it);
+    it must map to seconds, and garbage must fall back — never raise
+    (review finding: a date crashed the 429 path entirely)."""
+    import email.utils
+    import time as _time
+
+    from copilot_for_consensus_tpu.core.openai_compat import (
+        parse_retry_after,
+    )
+
+    assert parse_retry_after("7") == 7.0
+    assert parse_retry_after(None, default=2.0) == 2.0
+    assert parse_retry_after("soon™", default=3.0) == 3.0
+    future = email.utils.formatdate(_time.time() + 30, usegmt=True)
+    assert 20.0 < parse_retry_after(future) <= 31.0
+    past = email.utils.formatdate(_time.time() - 300, usegmt=True)
+    assert parse_retry_after(past) == 0.0

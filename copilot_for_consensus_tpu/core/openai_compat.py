@@ -74,6 +74,11 @@ def openai_post(base_url: str, path: str, payload: dict[str, Any], *,
         raise error_cls(f"backend unreachable: {exc}") from exc
     except json.JSONDecodeError as exc:
         raise error_cls(f"backend returned non-JSON: {exc}") from exc
+    except (TimeoutError, OSError) as exc:
+        # urlopen wraps connect-phase timeouts in URLError, but a stall
+        # DURING resp.read() raises raw TimeoutError/OSError — callers
+        # must never see raw transport exceptions.
+        raise error_cls(f"backend timed out mid-response: {exc}") from exc
 
 
 def azure_default_api_version(driver: str, configured: str) -> str:

@@ -19,6 +19,21 @@ class EmbeddingError(Exception):
     pass
 
 
+from copilot_for_consensus_tpu.core.retry import (  # noqa: E402
+    RetryableError as _RetryableError,
+)
+
+
+class EmbeddingRateLimitError(EmbeddingError, _RetryableError):
+    """Backend 429: transient by definition. Also a RetryableError, so
+    the service retry loop backs off and re-attempts instead of
+    terminally failing the document's embedding."""
+
+    def __init__(self, message: str = "", retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class EmbeddingProvider(abc.ABC):
     @property
     @abc.abstractmethod

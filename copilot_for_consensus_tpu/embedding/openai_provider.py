@@ -16,6 +16,7 @@ from copilot_for_consensus_tpu.core.openai_compat import openai_post
 from copilot_for_consensus_tpu.embedding.base import (
     EmbeddingError,
     EmbeddingProvider,
+    EmbeddingRateLimitError,
 )
 
 
@@ -47,7 +48,8 @@ class OpenAIEmbeddingProvider(EmbeddingProvider):
             self.base_url, "/embeddings",
             {"model": self.model, "input": list(texts)},
             api_key=self.api_key, api_version=self.api_version,
-            timeout_s=self.timeout_s, error_cls=EmbeddingError)
+            timeout_s=self.timeout_s, error_cls=EmbeddingError,
+            rate_limit_cls=EmbeddingRateLimitError)
         try:
             rows: list[Any] = sorted(out["data"], key=lambda d: d["index"])
             vecs = [list(map(float, d["embedding"])) for d in rows]

@@ -90,9 +90,28 @@ class Pipeline:
     def drain(self, max_messages: int | None = None) -> int:
         """Dispatch up to ``max_messages`` queued events (unbounded when
         None) until quiescent. With an external bus, round-robin the
-        per-service durable subscribers against one shared budget."""
+        per-service durable subscribers against one shared budget.
+
+        A pipelined summarization service keeps generations in flight
+        after the bus looks empty; quiescence then means "bus drained
+        AND nothing in flight" — their completions publish follow-up
+        events this loop must also dispatch."""
+        summ = self.summarization
+        # The in-flight wait applies only to UNBOUNDED drains: a caller
+        # asking for max_messages wants bounded stepping, not
+        # run-to-quiescence.
+        await_flight = (max_messages is None
+                        and getattr(summ, "pipelined", False))
         if not self.ext_subscribers:
-            return self.broker.drain(max_messages)
+            handled = self.broker.drain(max_messages)
+            while await_flight:
+                if summ.in_flight:
+                    summ.flush()
+                n = self.broker.drain(None)
+                handled += n
+                if not summ.in_flight and n == 0:
+                    break       # bus empty AND nothing generating
+            return handled
         n = 0
         while max_messages is None or n < max_messages:
             budget = None if max_messages is None else max_messages - n
@@ -104,6 +123,12 @@ class Pipeline:
                     break
             n += handled
             if not handled:
+                # Quiescence must include in-flight generations: their
+                # completions publish events this loop still has to
+                # dispatch (same contract as the in-proc branch).
+                if await_flight and summ.in_flight:
+                    summ.flush()
+                    continue
                 break
         return n
 
@@ -312,6 +337,7 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
         **common)
     summarization = SummarizationService(
         publisher(), store, summarizer, consensus_detector=consensus,
+        pipelined=bool(dict(cfg.get("llm") or {}).get("pipelined")),
         **common)
     reporting = ReportingService(
         publisher(), store,

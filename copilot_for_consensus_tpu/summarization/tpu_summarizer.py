@@ -146,6 +146,74 @@ class TPUSummarizer(Summarizer):
     def summarize(self, thread: ThreadContext) -> Summary:
         return self.summarize_batch([thread])[0]
 
+    def _engine_generate(self, prompts: list[list[int]]) -> list:
+        """All short-path generation funnels through here so the
+        single-owner invariant holds: once summarize_async has started
+        the dispatcher thread, IT owns the engine, and synchronous
+        callers must route through it rather than racing device calls
+        from their own thread."""
+        runner = getattr(self, "_runner", None)
+        if runner is None:
+            return self.engine.generate(prompts, self.max_new_tokens)
+        handles = [runner.submit(p, self.max_new_tokens)
+                   for p in prompts]
+        return [h.result(timeout=600.0) for h in handles]
+
+    def summarize_async(self, thread: ThreadContext):
+        """Submit one thread into the continuous batch WITHOUT waiting:
+        returns a zero-arg callable that blocks for and returns the
+        Summary. Many in-flight submissions share the decode batch —
+        this is what actually fills the engine's slots when callers
+        (the summarization service) receive work one event at a time.
+        Long-context prompts fall back to the synchronous path (the
+        sp-sharded engine is single-request by design)."""
+        from copilot_for_consensus_tpu.engine.async_runner import (
+            AsyncEngineRunner,
+        )
+
+        prompt = self.tokenizer.encode(
+            build_prompt(thread, self.template, self.system),
+            add_bos=True)
+        if self.long_engine is not None and \
+                len(prompt) > self._short_limit:
+            # The long engine is a separate device program owner, so the
+            # synchronous call cannot race the batch engine's dispatcher
+            # thread (self.engine must NOT be driven here: once a runner
+            # exists it is the engine's single owner).
+            comp = self.long_engine.generate(
+                prompt, max_new_tokens=self.max_new_tokens)
+            summary = Summary(
+                thread_id=thread.thread_id,
+                summary_text=self.tokenizer.decode(comp.tokens).strip(),
+                citations=citations_from_chunks(thread.chunks),
+                model=f"tpu:{self._model}",
+                prompt_tokens=comp.prompt_len,
+                completion_tokens=len(comp.tokens),
+            )
+            return lambda timeout=None: summary
+        if getattr(self, "_runner", None) is None:
+            self._runner = AsyncEngineRunner(self.engine).start()
+        handle = self._runner.submit(prompt, self.max_new_tokens)
+
+        def wait(timeout: float | None = 600.0) -> Summary:
+            comp = handle.result(timeout)
+            return Summary(
+                thread_id=thread.thread_id,
+                summary_text=self.tokenizer.decode(comp.tokens).strip(),
+                citations=citations_from_chunks(thread.chunks),
+                model=f"tpu:{self._model}",
+                prompt_tokens=comp.prompt_len,
+                completion_tokens=len(comp.tokens),
+            )
+
+        return wait
+
+    def close(self) -> None:
+        runner = getattr(self, "_runner", None)
+        if runner is not None:
+            runner.stop()
+            self._runner = None
+
     def summarize_batch(self, threads: list[ThreadContext]) -> list[Summary]:
         """Continuous batching: all threads share the decode batch; any
         prompt exceeding the batch window runs on the long-context path."""
@@ -165,9 +233,8 @@ class TPUSummarizer(Summarizer):
                 comps[i] = self.long_engine.generate(
                     prompts[i], max_new_tokens=self.max_new_tokens)
         if short_idx:
-            for i, c in zip(short_idx, self.engine.generate(
-                    [prompts[i] for i in short_idx],
-                    max_new_tokens=self.max_new_tokens)):
+            for i, c in zip(short_idx, self._engine_generate(
+                    [prompts[i] for i in short_idx])):
                 comps[i] = c
         out = []
         for thread, comp in zip(threads, comps):

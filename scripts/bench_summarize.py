@@ -46,7 +46,11 @@ def main() -> int:
                 "num_slots": args.num_slots,
                 "max_len": 1024,
                 "kv_dtype": "float8_e4m3fn",
-                "max_new_tokens": args.max_new_tokens},
+                "max_new_tokens": args.max_new_tokens,
+                # async submission keeps the decode slots full even
+                # though bus events arrive one at a time (without this
+                # the wall time is ~7 s x threads, slot count moot)
+                "pipelined": True},
     })
     build_s = time.monotonic() - t0
     print(f"pipeline with TPU engines built in {build_s:.1f}s",

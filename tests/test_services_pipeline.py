@@ -140,3 +140,32 @@ def test_semantic_search_finds_reports(pipeline):
                       msg["subject"].split()[0])
     hits = pipeline.reporting.search_reports(topic_word)
     assert isinstance(hits, list)
+
+
+def test_pipelined_summarization_matches_sync():
+    """Pipelined mode (async engine submission + harvester thread) must
+    produce the same set of reports as the synchronous path — drain()
+    treats in-flight generations as pending work."""
+    import pathlib
+
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    fixture = str(pathlib.Path(__file__).parent / "fixtures"
+                  / "ietf-sample.mbox")
+    results = {}
+    for mode in ("sync", "pipelined"):
+        p = build_pipeline({
+            "embedding": {"driver": "mock", "dimension": 16},
+            "llm": {"driver": "tpu", "model": "tiny", "num_slots": 4,
+                    "max_len": 160, "max_new_tokens": 8,
+                    "pipelined": mode == "pipelined"},
+        })
+        p.ingestion.create_source({"source_id": "s", "name": "s",
+                                   "fetcher": "local",
+                                   "location": fixture})
+        stats = p.ingest_and_run("s")
+        assert p.summarization.in_flight == 0
+        results[mode] = stats
+        p.summarization.summarizer.close()
+    assert results["pipelined"]["reports"] == results["sync"]["reports"]
+    assert results["pipelined"]["reports"] >= 3

@@ -13,7 +13,21 @@ from copilot_for_consensus_tpu.core.factory import register_driver
 
 
 class ArchiveStoreError(Exception):
-    pass
+    def __init__(self, message: str, status: int | None = None):
+        super().__init__(message)
+        #: HTTP status for remote backends — callers branch on THIS,
+        #: not on message substrings.
+        self.status = status
+
+
+def validate_archive_id(archive_id: str) -> str:
+    """Reject rather than sanitize: a silently-renamed id would break
+    content addressing (and ../ traversal must never reach storage).
+    The ONE definition every driver shares."""
+    if not archive_id or not all(
+            c.isalnum() or c in "-_" for c in archive_id):
+        raise ArchiveStoreError(f"invalid archive id {archive_id!r}")
+    return archive_id
 
 
 class ArchiveStore(abc.ABC):
@@ -58,12 +72,7 @@ class LocalVolumeArchiveStore(ArchiveStore):
         self.root.mkdir(parents=True, exist_ok=True)
 
     def _path(self, archive_id: str) -> pathlib.Path:
-        # Reject rather than sanitize: a silently-renamed id would break
-        # content addressing (and ../ traversal must never reach disk).
-        if not archive_id or not all(
-                c.isalnum() or c in "-_" for c in archive_id):
-            raise ArchiveStoreError(f"invalid archive id {archive_id!r}")
-        return self.root / f"{archive_id}.mbox"
+        return self.root / f"{validate_archive_id(archive_id)}.mbox"
 
     def save(self, archive_id, content, metadata=None):
         p = self._path(archive_id)
@@ -134,8 +143,21 @@ def create_archive_store(config: Any = None, **kwargs: Any) -> ArchiveStore:
         if store is None:
             raise ValueError("document driver needs document_store=")
         return DocumentArchiveStore(store)
+    if driver == "azure_blob":
+        from copilot_for_consensus_tpu.archive.azure_blob import (
+            AzureBlobArchiveStore,
+        )
+
+        get = (config.get if isinstance(config, dict)
+               else lambda k, d=None: getattr(config, k, d))
+        return AzureBlobArchiveStore(
+            account=get("account", ""),
+            container=get("container", "archives"),
+            account_key=get("account_key", "") or "",
+            sas_token=get("sas_token", "") or "",
+            endpoint=get("endpoint", "") or "")
     raise ValueError(f"unknown archive_store driver {driver!r}")
 
 
-for _name in ("memory", "local", "document"):
+for _name in ("memory", "local", "document", "azure_blob"):
     register_driver("archive_store", _name, create_archive_store)

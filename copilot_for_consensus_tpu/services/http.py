@@ -174,9 +174,14 @@ class HTTPServer:
                 for k, v in resp.headers.items():
                     self.send_header(k, v)
                 self.end_headers()
-                self.wfile.write(resp.raw)
+                if self.command != "HEAD":
+                    # HEAD advertises Content-Length but MUST NOT send
+                    # the body (writing it corrupts keep-alive streams
+                    # and trips strict clients).
+                    self.wfile.write(resp.raw)
 
             do_GET = do_POST = do_PUT = do_DELETE = _serve
+            do_HEAD = _serve
 
             def log_message(self, *args):  # quiet by default
                 pass

@@ -267,6 +267,8 @@ DRIVERS: dict[str, dict[str, dict]] = {
     },
     "archive_store": {
         "memory": {},
+        "azure_blob": dict(account="", container="archives",
+                           account_key="", sas_token="", endpoint=""),
         "local": dict(root="var/archives"),
         "document": {},
     },
@@ -303,6 +305,7 @@ REQUIRED_KEYS: dict[tuple[str, str], list[str]] = {
     ("embedding_backend", "azure_openai"): ["base_url"],
     ("llm_backend", "openai"): ["base_url"],
     ("llm_backend", "azure_openai"): ["base_url"],
+    ("archive_store", "azure_blob"): ["account"],
 }
 
 

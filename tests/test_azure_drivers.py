@@ -1,0 +1,180 @@
+# Azure Blob archive store against an in-process mock implementing the
+# Blob REST wire contract (PUT/GET/HEAD/DELETE + SharedKey signature
+# verification) — the driver speaks raw REST, no SDK, so the same code
+# path serves real Azure / Azurite wherever egress exists.
+import base64
+
+import pytest
+
+from copilot_for_consensus_tpu.archive.azure_blob import (
+    AzureBlobArchiveStore,
+    _shared_key_signature,
+)
+from copilot_for_consensus_tpu.archive.base import (
+    ArchiveStoreError,
+    create_archive_store,
+)
+from copilot_for_consensus_tpu.services.http import (
+    HTTPServer,
+    Response,
+    Router,
+)
+
+KEY = base64.b64encode(b"contract-test-account-key").decode()
+
+
+@pytest.fixture()
+def mock_blob():
+    """Blob-service mock: verifies the SharedKey signature of every
+    request by recomputing it from the same canonicalization."""
+    router = Router()
+    blobs: dict[str, tuple[bytes, dict]] = {}
+    state = {"auth_failures": 0}
+
+    def _check_sig(req, method, length):
+        url = f"http://host{req.path}"
+        sign_headers = {k.lower(): v for k, v in req.headers.items()
+                        if k.lower().startswith("x-ms-")}
+        if "Content-Type" in req.headers:
+            sign_headers["Content-Type"] = req.headers["Content-Type"]
+        expect = _shared_key_signature(
+            "testacct", KEY, method, url, sign_headers, length)
+        got = req.headers.get("Authorization", "")
+        if got != expect:
+            state["auth_failures"] += 1
+            return Response({"error": "auth"}, status=403)
+        return None
+
+    @router.route("PUT", "/archives/{name}")
+    def put(req):
+        bad = _check_sig(req, "PUT", len(req.body))
+        if bad:
+            return bad
+        meta = {k.lower()[len("x-ms-meta-"):]: v
+                for k, v in req.headers.items()
+                if k.lower().startswith("x-ms-meta-")}
+        blobs[req.params["name"]] = (req.body, meta)
+        return Response("", status=201, content_type="text/plain")
+
+    @router.get("/archives/{name}")
+    def get(req):
+        bad = _check_sig(req, "GET", 0)
+        if bad:
+            return bad
+        if req.params["name"] not in blobs:
+            return Response({"error": "BlobNotFound"}, status=404)
+        return Response(blobs[req.params["name"]][0],
+                        content_type="application/octet-stream")
+
+    @router.route("HEAD", "/archives/{name}")
+    def head(req):
+        bad = _check_sig(req, "HEAD", 0)
+        if bad:
+            return bad
+        if req.params["name"] not in blobs:
+            return Response({"error": "BlobNotFound"}, status=404)
+        return Response("", content_type="text/plain")
+
+    @router.delete("/archives/{name}")
+    def delete(req):
+        bad = _check_sig(req, "DELETE", 0)
+        if bad:
+            return bad
+        if req.params["name"] not in blobs:
+            return Response({"error": "BlobNotFound"}, status=404)
+        del blobs[req.params["name"]]
+        return Response("", status=202, content_type="text/plain")
+
+    srv = HTTPServer(router)
+    srv.start()
+    yield srv, blobs, state
+    srv.stop()
+
+
+def _store(srv):
+    return create_archive_store({
+        "driver": "azure_blob", "account": "testacct",
+        "container": "archives", "account_key": KEY,
+        "endpoint": f"http://127.0.0.1:{srv.port}"})
+
+
+def test_blob_roundtrip_with_shared_key(mock_blob):
+    srv, blobs, state = mock_blob
+    store = _store(srv)
+    uri = store.save("arch-1", b"From a@b\n\nhello\n",
+                     metadata={"source id": "ietf"})
+    assert uri.endswith("/archives/arch-1.mbox")
+    assert store.exists("arch-1") and not store.exists("nope")
+    assert store.load("arch-1") == b"From a@b\n\nhello\n"
+    # metadata keys sanitized to identifier-safe form
+    assert blobs["arch-1.mbox"][1].get("source_id") == "ietf"
+    assert store.delete("arch-1") is True
+    assert store.delete("arch-1") is False
+    assert state["auth_failures"] == 0
+
+
+def test_blob_bad_key_rejected(mock_blob):
+    srv, _, state = mock_blob
+    bad = AzureBlobArchiveStore(
+        "testacct", "archives",
+        account_key=base64.b64encode(b"wrong").decode(),
+        endpoint=f"http://127.0.0.1:{srv.port}")
+    with pytest.raises(ArchiveStoreError, match="403"):
+        bad.save("arch-2", b"x")
+    assert state["auth_failures"] == 1
+
+
+def test_blob_missing_archive_and_hostile_ids(mock_blob):
+    srv, _, _ = mock_blob
+    store = _store(srv)
+    with pytest.raises(ArchiveStoreError, match="not found"):
+        store.load("absent")
+    with pytest.raises(ArchiveStoreError, match="invalid archive id"):
+        store.save("../escape", b"x")
+
+
+def test_blob_unreachable_endpoint():
+    store = AzureBlobArchiveStore("a", "c", account_key=KEY,
+                                  endpoint="http://127.0.0.1:1")
+    with pytest.raises(ArchiveStoreError, match="unreachable"):
+        store.load("arch-1")
+
+
+def test_blob_config_validation():
+    with pytest.raises(ValueError, match="account"):
+        AzureBlobArchiveStore("", "c", account_key=KEY)
+    with pytest.raises(ValueError, match="account_key or sas"):
+        AzureBlobArchiveStore("a", "c")
+
+
+def test_blob_metadata_validation(mock_blob):
+    srv, _, _ = mock_blob
+    store = _store(srv)
+    for bad_meta, pat in [({"subject": "ellipsis…💥"}, "header-safe"),
+                          ({"x": "a\r\nInjected: yes"}, "line breaks"),
+                          ({"9rank": "v"}, "identifier"),
+                          ({"": "v"}, "identifier"),
+                          ({"a b": "1", "a.b": "2"}, "collide")]:
+        with pytest.raises(ArchiveStoreError, match=pat):
+            store.save("meta-case", b"x", metadata=bad_meta)
+
+
+def test_blob_container_not_found_is_an_error_not_absent(mock_blob):
+    """A misconfigured container must surface, not read as
+    'archive absent' (review finding: substring matching on 404s)."""
+    srv, _, _ = mock_blob
+    import urllib.error
+
+    from copilot_for_consensus_tpu.services.http import Response
+
+    router = srv.router
+    @router.route("HEAD", "/wrong/{name}")
+    def head_missing_container(req):
+        return Response("", status=404,
+                        headers={"x-ms-error-code": "ContainerNotFound"},
+                        content_type="text/plain")
+    bad = AzureBlobArchiveStore(
+        "testacct", "wrong", account_key=KEY,
+        endpoint=f"http://127.0.0.1:{srv.port}")
+    with pytest.raises(ArchiveStoreError, match="ContainerNotFound"):
+        bad.exists("arch-1")

@@ -113,18 +113,20 @@ class AzureBlobArchiveStore(ArchiveStore):
             if exc.code in ok:
                 return exc.code, exc.read()
             body = exc.read()[:200].decode("utf-8", "replace")
-            # HEAD 404s carry no body; Azure signals the error class in
-            # the x-ms-error-code header instead.
+            # Azure signals the error class in x-ms-error-code (HEAD
+            # 404s carry no body); fall back to sniffing the body XML.
             err_code = exc.headers.get("x-ms-error-code", "")
-            if "ContainerNotFound" in err_code:
-                body = body or err_code
-            if exc.code == 404 and "ContainerNotFound" not in body:
-                raise ArchiveStoreError(
-                    f"archive not found: {archive_id}",
-                    status=404) from exc
-            raise ArchiveStoreError(
-                f"blob {method} failed: HTTP {exc.code} {body}",
-                status=exc.code) from exc
+            if not err_code and "ContainerNotFound" in body:
+                err_code = "ContainerNotFound"
+            if exc.code == 404 and err_code != "ContainerNotFound":
+                err = ArchiveStoreError(
+                    f"archive not found: {archive_id}", status=404)
+            else:
+                err = ArchiveStoreError(
+                    f"blob {method} failed: HTTP {exc.code} "
+                    f"{body or err_code}", status=exc.code)
+            err.error_code = err_code
+            raise err from exc
         except (urllib.error.URLError, TimeoutError, OSError) as exc:
             raise ArchiveStoreError(f"blob endpoint unreachable: "
                                     f"{exc}") from exc
@@ -137,7 +139,8 @@ class AzureBlobArchiveStore(ArchiveStore):
             # be header-safe — reject what Azure (or urllib's header
             # injection guard) would, as ArchiveStoreError rather than
             # a raw UnicodeEncodeError/ValueError escaping mid-save.
-            safe = "".join(c if c.isalnum() else "_" for c in str(k))
+            safe = "".join(c if (c.isascii() and c.isalnum())
+                           else "_" for c in str(k))
             if not safe or not (safe[0].isalpha() or safe[0] == "_"):
                 raise ArchiveStoreError(
                     f"metadata key {k!r} is not a valid identifier")
@@ -148,11 +151,11 @@ class AzureBlobArchiveStore(ArchiveStore):
             seen[safe] = str(k)
             value = str(v)
             try:
-                value.encode("latin-1")
+                value.encode("ascii")
             except UnicodeEncodeError as exc:
                 raise ArchiveStoreError(
                     f"metadata value for {k!r} is not header-safe "
-                    f"(latin-1 only)") from exc
+                    f"(ascii only)") from exc
             if "\r" in value or "\n" in value:
                 raise ArchiveStoreError(
                     f"metadata value for {k!r} contains line breaks")
@@ -170,10 +173,13 @@ class AzureBlobArchiveStore(ArchiveStore):
             self._request("HEAD", archive_id)
             return True
         except ArchiveStoreError as exc:
-            # Branch on the STATUS, not the message: a 404 with
-            # ContainerNotFound (misconfigured container) must raise,
-            # not masquerade as blob-absent.
-            if exc.status == 404 and "ContainerNotFound" not in str(exc):
+            # Branch on structured fields only: a 404 whose error code
+            # is ContainerNotFound (misconfigured container) must
+            # raise, not masquerade as blob-absent — and an archive id
+            # that happens to CONTAIN that substring must not confuse
+            # the classification.
+            if exc.status == 404 and getattr(
+                    exc, "error_code", "") != "ContainerNotFound":
                 return False
             raise
 
@@ -182,6 +188,7 @@ class AzureBlobArchiveStore(ArchiveStore):
             self._request("DELETE", archive_id, ok=(202,))
             return True
         except ArchiveStoreError as exc:
-            if exc.status == 404 and "ContainerNotFound" not in str(exc):
+            if exc.status == 404 and getattr(
+                    exc, "error_code", "") != "ContainerNotFound":
                 return False
             raise

@@ -25,7 +25,8 @@ def validate_archive_id(archive_id: str) -> str:
     content addressing (and ../ traversal must never reach storage).
     The ONE definition every driver shares."""
     if not archive_id or not all(
-            c.isalnum() or c in "-_" for c in archive_id):
+            (c.isascii() and c.isalnum()) or c in "-_"
+            for c in archive_id):
         raise ArchiveStoreError(f"invalid archive id {archive_id!r}")
     return archive_id
 

@@ -3,29 +3,13 @@ signers, OIDC providers."""
 
 from __future__ import annotations
 
-from typing import Any
 
 from copilot_for_consensus_tpu.core.factory import register_driver
 from copilot_for_consensus_tpu.security.secrets import (
-    EnvSecretProvider,
-    LocalSecretProvider,
-    StaticSecretProvider,
+    create_secret_provider,
 )
 
-
-def create_secret_provider(config: Any) -> Any:
-    cfg = dict(config or {})
-    driver = cfg.get("driver", "env")
-    if driver == "env":
-        return EnvSecretProvider()
-    if driver == "local":
-        return LocalSecretProvider(cfg.get("root", "secrets"))
-    if driver == "static":
-        return StaticSecretProvider(cfg.get("values", {}))
-    raise ValueError(f"unknown secret_provider driver {driver!r}")
-
-
-for _name in ("env", "local", "static"):
+for _name in ("env", "local", "static", "default", "azure_keyvault"):
     register_driver("secret_provider", _name, create_secret_provider)
 
 for _name in ("local_rs256", "hs256"):

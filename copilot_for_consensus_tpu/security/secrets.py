@@ -87,3 +87,127 @@ def default_secret_resolver(env: Mapping[str, str] | None = None) -> Callable[[s
     return ChainSecretProvider(
         EnvSecretProvider(env), LocalSecretProvider(secrets_dir)
     )
+
+
+class AzureKeyVaultSecretProvider(SecretProvider):
+    """Azure Key Vault secrets via raw REST — no SDK (reference
+    ``copilot_secrets/azurekeyvault_provider.py`` rides the SDK).
+
+    AAD client-credentials flow mints the bearer token
+    (``POST {authority}/{tenant}/oauth2/v2.0/token``), cached until
+    shortly before expiry; secrets read via
+    ``GET {vault}/secrets/{name}?api-version=7.4``. ``authority`` and
+    ``vault_url`` overrides point the provider at mocks/emulators —
+    how ``tests/test_azure_drivers.py`` exercises the wire contract in
+    this zero-egress image.
+    """
+
+    API_VERSION = "7.4"
+
+    def __init__(self, vault_url: str, tenant_id: str, client_id: str,
+                 client_secret: str,
+                 authority: str = "https://login.microsoftonline.com",
+                 timeout_s: float = 15.0):
+        if not all((vault_url, tenant_id, client_id, client_secret)):
+            raise ValueError(
+                "azure_keyvault needs vault_url, tenant_id, client_id, "
+                "client_secret")
+        self.vault_url = vault_url.rstrip("/")
+        self.tenant_id = tenant_id
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.authority = authority.rstrip("/")
+        self.timeout_s = timeout_s
+        self._token: str | None = None
+        self._token_exp = 0.0
+
+    def _bearer(self) -> str:
+        import json
+        import time
+        import urllib.parse
+        import urllib.request
+
+        if self._token and time.time() < self._token_exp - 60:
+            return self._token
+        scope = f"{self.vault_url}/.default"
+        body = urllib.parse.urlencode({
+            "grant_type": "client_credentials",
+            "client_id": self.client_id,
+            "client_secret": self.client_secret,
+            "scope": scope,
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.authority}/{self.tenant_id}/oauth2/v2.0/token",
+            data=body, method="POST",
+            headers={"Content-Type":
+                     "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            tok = json.loads(resp.read())
+        self._token = tok["access_token"]
+        self._token_exp = time.time() + float(tok.get("expires_in", 300))
+        return self._token
+
+    def get_secret(self, name: str) -> str:
+        import json
+        import urllib.error
+        import urllib.request
+
+        if not name or not all(
+                (c.isascii() and c.isalnum()) or c == "-"
+                for c in name):
+            raise SecretNotFoundError(name)   # KV's own name charset
+        try:
+            bearer = self._bearer()
+        except urllib.error.HTTPError as exc:
+            raise RuntimeError(
+                f"key vault token request failed: "
+                f"HTTP {exc.code}") from exc
+        except (urllib.error.URLError, TimeoutError, OSError) as exc:
+            raise RuntimeError(
+                f"key vault token endpoint unreachable: {exc}") from exc
+        req = urllib.request.Request(
+            f"{self.vault_url}/secrets/{name}"
+            f"?api-version={self.API_VERSION}",
+            headers={"Authorization": f"Bearer {bearer}"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                return str(json.loads(resp.read())["value"])
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                raise SecretNotFoundError(name) from exc
+            raise RuntimeError(
+                f"key vault GET {name} failed: HTTP {exc.code}") from exc
+        except (urllib.error.URLError, TimeoutError, OSError) as exc:
+            raise RuntimeError(
+                f"key vault unreachable: {exc}") from exc
+
+
+def create_secret_provider(config=None) -> SecretProvider:
+    """Config-driven construction: env / local / static / chain-default
+    / azure_keyvault."""
+    cfg = dict(config or {})
+    driver = cfg.get("driver", "default")
+    if driver == "default":
+        if cfg.get("root"):
+            return ChainSecretProvider(
+                EnvSecretProvider(),
+                LocalSecretProvider(cfg["root"]))
+        # the SAME chain config-load-time secret:// resolution uses —
+        # including its COPILOT_SECRETS_DIR handling
+        return default_secret_resolver()
+    if driver == "env":
+        return EnvSecretProvider()
+    if driver == "local":
+        return LocalSecretProvider(cfg.get("root", "secrets"))
+    if driver == "static":
+        return StaticSecretProvider(cfg.get("values", {}))
+    if driver == "azure_keyvault":
+        return AzureKeyVaultSecretProvider(
+            vault_url=cfg.get("vault_url", ""),
+            tenant_id=cfg.get("tenant_id", ""),
+            client_id=cfg.get("client_id", ""),
+            client_secret=cfg.get("client_secret", ""),
+            authority=cfg.get("authority",
+                              "https://login.microsoftonline.com"))
+    raise ValueError(f"unknown secrets driver {driver!r}")

@@ -280,6 +280,10 @@ DRIVERS: dict[str, dict[str, dict]] = {
         "env": {},
         "local": dict(root="secrets"),
         "static": dict(values={}),
+        "default": dict(root="secrets"),
+        "azure_keyvault": dict(vault_url="", tenant_id="", client_id="",
+                               client_secret="",
+                               authority="https://login.microsoftonline.com"),
     },
     "jwt_signer": {
         "local_rs256": dict(private_pem=""),
@@ -306,6 +310,7 @@ REQUIRED_KEYS: dict[tuple[str, str], list[str]] = {
     ("llm_backend", "openai"): ["base_url"],
     ("llm_backend", "azure_openai"): ["base_url"],
     ("archive_store", "azure_blob"): ["account"],
+    ("secret_provider", "azure_keyvault"): ["vault_url", "tenant_id", "client_id", "client_secret"],
 }
 
 

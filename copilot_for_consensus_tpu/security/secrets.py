@@ -187,7 +187,10 @@ def create_secret_provider(config=None) -> SecretProvider:
     """Config-driven construction: env / local / static / chain-default
     / azure_keyvault."""
     cfg = dict(config or {})
-    driver = cfg.get("driver", "default")
+    # 'env' stays the implicit default (the pre-r3 factory behavior):
+    # silently adding the local-file fallback could resolve a stale
+    # on-disk secret that the environment deliberately omits.
+    driver = cfg.get("driver", "env")
     if driver == "default":
         if cfg.get("root"):
             return ChainSecretProvider(

@@ -17,10 +17,20 @@ def create_document_store(config: Any = None, validate: bool = True):
         store = InMemoryDocumentStore(cfg)
     elif driver == "sqlite":
         store = SQLiteDocumentStore(cfg)
+    elif driver == "azure_cosmos":
+        from copilot_for_consensus_tpu.storage.azure_cosmos import (
+            AzureCosmosDocumentStore,
+        )
+
+        store = AzureCosmosDocumentStore(
+            account=cfg.get("account", ""),
+            master_key=cfg.get("master_key", ""),
+            database=cfg.get("database", "copilot"),
+            endpoint=cfg.get("endpoint", "") or "")
     else:
         raise ValueError(f"unknown document_store driver {driver!r}")
     return ValidatingDocumentStore(store) if validate else store
 
 
-for _name in ("memory", "sqlite"):
+for _name in ("memory", "sqlite", "azure_cosmos"):
     register_driver("document_store", _name, create_document_store)

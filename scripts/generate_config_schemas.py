@@ -210,6 +210,8 @@ DRIVERS: dict[str, dict[str, dict]] = {
     "document_store": {
         "memory": {},
         "sqlite": dict(path="var/documents.sqlite3"),
+        "azure_cosmos": dict(account="", master_key="",
+                             database="copilot", endpoint=""),
     },
     "vector_store": {
         "memory": dict(dimension=0, persist_path=""),
@@ -310,6 +312,7 @@ REQUIRED_KEYS: dict[tuple[str, str], list[str]] = {
     ("llm_backend", "openai"): ["base_url"],
     ("llm_backend", "azure_openai"): ["base_url"],
     ("archive_store", "azure_blob"): ["account"],
+    ("document_store", "azure_cosmos"): ["account", "master_key"],
     ("secret_provider", "azure_keyvault"): ["vault_url", "tenant_id", "client_id", "client_secret"],
 }
 

@@ -266,3 +266,322 @@ def test_keyvault_config_validation():
 
     with pytest.raises(ValueError, match="vault_url"):
         create_secret_provider({"driver": "azure_keyvault"})
+
+
+# ---------------------------------------------------------------------------
+# Azure Cosmos DB document store (SQL API over REST)
+# ---------------------------------------------------------------------------
+
+
+def _eval_cosmos_sql(sql, params, docs):
+    """Evaluate the constrained SQL grammar translate_filter emits —
+    enough of the Cosmos SQL surface to round-trip the driver's
+    queries; anything else fails loudly."""
+    import re
+
+    pvals = {p["name"]: p["value"] for p in params}
+    m = re.match(
+        r"SELECT (VALUE COUNT\(1\)|\*) FROM c"
+        r"(?: WHERE (?P<where>.*?))?"
+        r"(?: ORDER BY (?P<order>[^)]+?))?"
+        r"(?: OFFSET (?P<off>\d+) LIMIT (?P<lim>\d+))?$", sql)
+    assert m, f"mock cannot parse: {sql}"
+
+    def get(doc, dotted):
+        cur = doc
+        for part in dotted.split(".")[1:]:     # drop leading 'c'
+            if not isinstance(cur, dict) or part not in cur:
+                return None, False
+            cur = cur[part]
+        return cur, True
+
+    def _wrapped(t):
+        # outer parens strippable only if they MATCH (depth never hits
+        # zero before the final char)
+        if not (t.startswith("(") and t.endswith(")")):
+            return False
+        depth = 0
+        for i, c in enumerate(t):
+            depth += c == "("
+            depth -= c == ")"
+            if depth == 0 and i < len(t) - 1:
+                return False
+        return True
+
+    def term(doc, t):
+        t = t.strip()
+        while _wrapped(t):
+            t = t[1:-1].strip()
+        if " OR " in t:
+            return any(term(doc, s) for s in _split(t, " OR "))
+        if " AND " in t:
+            return all(term(doc, s) for s in _split(t, " AND "))
+        if t == "true":
+            return True
+        if t.startswith("NOT IS_DEFINED("):
+            return not get(doc, t[15:-1])[1]
+        if t.startswith("IS_DEFINED("):
+            return get(doc, t[11:-1])[1]
+        if t.startswith("NOT ARRAY_CONTAINS("):
+            arr, f = t[len("NOT ARRAY_CONTAINS("):-1].split(", ")
+            v, ex = get(doc, f)
+            return not (ex and v in pvals[arr])
+        if t.startswith("ARRAY_CONTAINS("):
+            arr, f = t[len("ARRAY_CONTAINS("):-1].split(", ")
+            v, ex = get(doc, f)
+            return ex and v in pvals[arr]
+        if t.startswith("RegexMatch("):
+            f, pat = t[len("RegexMatch("):-1].split(", ")
+            v, ex = get(doc, f)
+            return ex and isinstance(v, str) and \
+                re.search(pvals[pat], v) is not None
+        mm = re.match(r"(c[.\w]+) (=|!=|<=|>=|<|>) (@p\d+)$", t)
+        assert mm, f"mock cannot parse term: {t}"
+        v, ex = get(doc, mm.group(1))
+        arg = pvals[mm.group(3)]
+        op = mm.group(2)
+        if not ex or v is None:
+            # real Cosmos: comparisons on undefined are undefined —
+            # the row never matches, INCLUDING for != (the driver's
+            # translator wraps $ne with NOT IS_DEFINED to compensate)
+            return False
+        return {"=": v == arg, "!=": v != arg, "<": v < arg,
+                "<=": v <= arg, ">": v > arg, ">=": v >= arg}[op]
+
+    def _split(t, sep):
+        # split at depth 0 only
+        out, depth, cur = [], 0, ""
+        i = 0
+        while i < len(t):
+            if t[i] == "(":
+                depth += 1
+            elif t[i] == ")":
+                depth -= 1
+            if depth == 0 and t[i:i + len(sep)] == sep:
+                out.append(cur)
+                cur = ""
+                i += len(sep)
+                continue
+            cur += t[i]
+            i += 1
+        out.append(cur)
+        return out
+
+    hits = [d for d in docs.values()
+            if term(d, m.group("where") or "true")]
+    if m.group("order"):
+        for part in reversed(m.group("order").split(", ")):
+            f, d = part.rsplit(" ", 1)
+            hits.sort(key=lambda x: (get(x, f)[0] is None, get(x, f)[0]),
+                      reverse=(d == "DESC"))
+    if m.group("off") is not None:
+        off, lim = int(m.group("off")), int(m.group("lim"))
+        hits = hits[off:off + lim]
+    if sql.startswith("SELECT VALUE COUNT"):
+        return [len(hits)]
+    return hits
+
+
+@pytest.fixture()
+def mock_cosmos():
+    import json as _json
+
+    router = Router()
+    state = {"colls": {}, "bad_auth": 0}
+
+    def _h(req, name):
+        for k, v in req.headers.items():
+            if k.lower() == name:
+                return v
+        return None
+
+    def _authed(req):
+        auth = req.headers.get("Authorization", "")
+        if "type%3Dmaster" not in auth or "sig%3D" not in auth:
+            state["bad_auth"] += 1
+            return False
+        return True
+
+    @router.post("/dbs")
+    def create_db(req):
+        return Response({"id": req.json()["id"]}, status=201)
+
+    @router.post("/dbs/{db}/colls")
+    def create_coll(req):
+        name = req.json()["id"]
+        if name in state["colls"]:
+            return Response({"error": "Conflict"}, status=409)
+        state["colls"][name] = {}
+        return Response({"id": name}, status=201)
+
+    @router.post("/dbs/{db}/colls/{coll}/docs")
+    def docs_endpoint(req):
+        if not _authed(req):
+            return Response({"error": "auth"}, status=401)
+        coll = state["colls"].setdefault(req.params["coll"], {})
+        if _h(req, "x-ms-documentdb-isquery") == "true":
+            q = req.json()
+            hits = _eval_cosmos_sql(q["query"], q["parameters"], coll)
+            page = 3                       # force continuation handling
+            start = int(_h(req, "x-ms-continuation") or 0)
+            body = {"Documents": hits[start:start + page]}
+            headers = {}
+            if start + page < len(hits):
+                headers["x-ms-continuation"] = str(start + page)
+            return Response(body, headers=headers)
+        doc = req.json()
+        is_upsert = _h(req, "x-ms-documentdb-is-upsert") == "true"
+        if doc["id"] in coll and not is_upsert:
+            return Response({"error": "Conflict"}, status=409)
+        state["etag"] = state.get("etag", 0) + 1
+        coll[doc["id"]] = {**doc, "_rid": "rid", "_ts": 1,
+                           "_self": "s", "_etag": f"e{state['etag']}",
+                           "_attachments": "a"}
+        return Response(doc, status=201)
+
+    @router.put("/dbs/{db}/colls/{coll}/docs/{id}")
+    def replace_doc(req):
+        coll = state["colls"].setdefault(req.params["coll"], {})
+        cur = coll.get(req.params["id"])
+        if cur is None:
+            return Response({"error": "NotFound"}, status=404)
+        if_match = _h(req, "if-match")
+        if if_match and if_match != cur["_etag"]:
+            return Response({"error": "PreconditionFailed"}, status=412)
+        state["etag"] = state.get("etag", 0) + 1
+        coll[req.params["id"]] = {**req.json(), "_rid": "rid", "_ts": 2,
+                                  "_self": "s",
+                                  "_etag": f"e{state['etag']}",
+                                  "_attachments": "a"}
+        return coll[req.params["id"]]
+
+    @router.get("/dbs/{db}/colls/{coll}/docs/{id}")
+    def get_doc(req):
+        doc = state["colls"].get(req.params["coll"], {}).get(
+            req.params["id"])
+        if doc is None:
+            return Response({"error": "NotFound"}, status=404)
+        return doc
+
+    @router.delete("/dbs/{db}/colls/{coll}/docs/{id}")
+    def del_doc(req):
+        coll = state["colls"].get(req.params["coll"], {})
+        if req.params["id"] not in coll:
+            return Response({"error": "NotFound"}, status=404)
+        del coll[req.params["id"]]
+        return Response("", status=204, content_type="text/plain")
+
+    srv = HTTPServer(router)
+    srv.start()
+    yield srv, state
+    srv.stop()
+
+
+def _cosmos(srv):
+    from copilot_for_consensus_tpu.storage.azure_cosmos import (
+        AzureCosmosDocumentStore,
+    )
+
+    return AzureCosmosDocumentStore(
+        "acct", base64.b64encode(b"cosmos-master-key").decode(),
+        endpoint=f"http://127.0.0.1:{srv.port}")
+
+
+def test_cosmos_crud_roundtrip(mock_cosmos):
+    srv, state = mock_cosmos
+    store = _cosmos(srv)
+    store.connect()
+    rid = store.upsert_document("reports", {
+        "report_id": "r1", "thread_id": "t1", "status": "published",
+        "score": 7, "nested": {"k": "v"}})
+    assert rid == "r1"
+    doc = store.get_document("reports", "r1")
+    assert doc == {"report_id": "r1", "thread_id": "t1",
+                   "status": "published", "score": 7,
+                   "nested": {"k": "v"}}          # system props stripped
+    assert store.get_document("reports", "absent") is None
+    store.upsert_document("reports", {"report_id": "r1",
+                                      "thread_id": "t1",
+                                      "status": "draft", "score": 9})
+    assert store.update_document("reports", "r1", {"score": 10})
+    assert store.get_document("reports", "r1")["score"] == 10
+    assert not store.update_document("reports", "nope", {"x": 1})
+    assert store.delete_document("reports", "r1") is True
+    assert store.delete_document("reports", "r1") is False
+    assert state["bad_auth"] == 0
+
+
+def test_cosmos_insert_conflict(mock_cosmos):
+    from copilot_for_consensus_tpu.storage.base import DuplicateKeyError
+
+    srv, _ = mock_cosmos
+    store = _cosmos(srv)
+    store.insert_document("threads", {"thread_id": "t1", "n": 1})
+    with pytest.raises(DuplicateKeyError):
+        store.insert_document("threads", {"thread_id": "t1", "n": 2})
+    assert store.insert_or_ignore("threads",
+                                  {"thread_id": "t1", "n": 3}) is False
+
+
+def test_cosmos_query_filters_match_memory_store(mock_cosmos):
+    """Oracle: every supported filter shape returns the same documents
+    through (translate_filter → Cosmos SQL → mock evaluator) as the
+    in-memory matcher on identical data."""
+    from copilot_for_consensus_tpu.storage.memory import (
+        InMemoryDocumentStore,
+    )
+
+    srv, _ = mock_cosmos
+    store = _cosmos(srv)
+    mem = InMemoryDocumentStore()
+    mem.connect()
+    docs = [
+        {"chunk_id": f"c{i}", "thread_id": f"t{i % 3}",
+         "status": ["pending", "embedded"][i % 2], "n": i,
+         "meta": {"lang": ["en", "de"][i % 2]},
+         **({"extra": True} if i == 4 else {})}
+        for i in range(9)
+    ]
+    for d in docs:
+        store.upsert_document("chunks", d)
+        mem.upsert_document("chunks", d)
+    filters = [
+        None,
+        {"thread_id": "t1"},
+        {"status": "embedded", "thread_id": "t0"},
+        {"n": {"$gte": 3, "$lt": 7}},
+        {"chunk_id": {"$in": ["c1", "c5", "zz"]}},
+        {"status": {"$ne": "pending"}},
+        {"thread_id": {"$nin": ["t0", "t2"]}},
+        {"extra": {"$exists": True}},
+        {"extra": {"$exists": False}},
+        {"meta.lang": "de"},
+        {"chunk_id": {"$regex": "^c[12]$"}},
+        {"$or": [{"thread_id": "t0"}, {"n": {"$gt": 7}}]},
+        {"$and": [{"status": "pending"}, {"n": {"$lte": 4}}]},
+    ]
+    for flt in filters:
+        got = sorted(d["chunk_id"]
+                     for d in store.query_documents("chunks", flt))
+        want = sorted(d["chunk_id"]
+                      for d in mem.query_documents("chunks", flt))
+        assert got == want, (flt, got, want)
+        assert store.count_documents("chunks", flt) == len(want), flt
+    # sort + limit/skip
+    page = store.query_documents("chunks", None, sort=[("n", -1)],
+                                 limit=3, skip=2)
+    assert [d["n"] for d in page] == [6, 5, 4]
+    # delete by filter
+    assert store.delete_documents("chunks", {"status": "pending"}) == 5
+    assert store.count_documents("chunks") == 4
+
+
+def test_cosmos_rejects_hostile_field_paths(mock_cosmos):
+    from copilot_for_consensus_tpu.storage.base import StorageError
+
+    srv, _ = mock_cosmos
+    store = _cosmos(srv)
+    with pytest.raises(StorageError, match="field path"):
+        store.query_documents("chunks", {"a;DROP": 1})
+    with pytest.raises(StorageError, match="operator"):
+        store.query_documents("chunks", {"a": {"$where": "1"}})

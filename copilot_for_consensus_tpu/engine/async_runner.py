@@ -106,6 +106,10 @@ class AsyncEngineRunner:
             raise RuntimeError("runner not started")
         h = Handle()
         with self._work:
+            if self._stop:
+                # a submit racing stop() must not enqueue a handle the
+                # (exiting) dispatcher will never resolve
+                raise RuntimeError("runner stopped")
             self._pending.append((prompt, max_new_tokens, h))
             self._work.notify()
         return h
@@ -120,8 +124,16 @@ class AsyncEngineRunner:
                        and not eng._active and not eng._queue):
                     self._work.wait(timeout=0.1)
                 if self._stop:
-                    # resolve nothing further; abandoned handles stay
-                    # unset and their result() times out
+                    # Fail every outstanding handle promptly — a caller
+                    # blocked in result() must not sit out its full
+                    # timeout just because the runner was stopped.
+                    exc = RuntimeError("runner stopped")
+                    for _, _, h in self._pending:
+                        h._fail(exc)
+                    for h in self._handles.values():
+                        h._fail(exc)
+                    self._pending.clear()
+                    self._handles.clear()
                     return
                 fresh = self._pending
                 self._pending = []

@@ -226,6 +226,10 @@ class AuthService:
         self._pending: dict[str, dict[str, Any]] = {}  # state → login ctx
         # HTTPServer is threaded; prune iterates while callbacks pop.
         self._pending_lock = threading.Lock()
+        #: callbacks run with the jti on every local revocation — the
+        #: JWT middleware registers its cache invalidator here so an
+        #: in-process logout takes effect on the very next request.
+        self.on_revoke: list[Any] = []
 
     def _prune_pending_locked(self) -> None:
         now = time.time()
@@ -318,6 +322,8 @@ class AuthService:
             "_id": claims.get("jti", ""),
             "exp": int(claims.get("exp", time.time() + 3600)),
         })
+        for cb in self.on_revoke:
+            cb(claims.get("jti", ""))
         # Opportunistic prune: entries past their exp can never match
         # again (verify() rejects expired tokens first), so each logout
         # also clears the dead ones — the collection stays bounded by
@@ -416,13 +422,52 @@ def create_jwt_middleware(jwt_manager: JWTManager,
                           required_roles: dict[str, list[str]]
                           | None = None,
                           public_paths=PUBLIC_PATHS,
-                          is_revoked=None):
+                          is_revoked=None,
+                          revocation_cache_ttl: float = 5.0):
     """Router middleware: verifies Bearer tokens, stamps claims into
     ``req.context``, enforces per-path-prefix role requirements.
     ``is_revoked(jti) -> bool`` plugs the logout denylist in — a
     logged-out token must fail even though its signature still
-    verifies."""
+    verifies.
+
+    Revocation results are cached per-jti for ``revocation_cache_ttl``
+    seconds: with a remote document store behind ``is_revoked`` (e.g.
+    the Cosmos driver) an uncached check adds an HTTP round-trip to
+    every API call. A revoked verdict is cached forever (tokens don't
+    un-revoke); a clean verdict only for the TTL, which bounds the
+    post-logout acceptance window. Set ttl=0 to disable."""
     required_roles = required_roles or {}
+    # jti -> (expires_at_monotonic, revoked)
+    _revocation_cache: dict[str, tuple[float, bool]] = {}
+    _cache_lock = threading.Lock()
+    # bumped by invalidate(); a clean verdict computed against the store
+    # BEFORE an invalidation must not be written back AFTER it (TOCTOU:
+    # the revoked token would be accepted for a full TTL in the very
+    # process that performed the logout)
+    _generation = [0]
+
+    def _check_revoked(jti: str) -> bool:
+        if revocation_cache_ttl <= 0:
+            return bool(is_revoked(jti))
+        now = time.monotonic()
+        with _cache_lock:
+            hit = _revocation_cache.get(jti)
+            if hit is not None and (hit[1] or hit[0] > now):
+                return hit[1]
+            gen = _generation[0]
+        revoked = bool(is_revoked(jti))
+        with _cache_lock:
+            if len(_revocation_cache) > 10000:   # bound memory
+                cutoff = time.monotonic()
+                for k in [k for k, (exp, rv) in _revocation_cache.items()
+                          if not rv and exp <= cutoff]:
+                    del _revocation_cache[k]
+                if len(_revocation_cache) > 10000:
+                    _revocation_cache.clear()
+            if revoked or _generation[0] == gen:
+                _revocation_cache[jti] = (now + revocation_cache_ttl,
+                                          revoked)
+        return revoked
 
     def middleware(req: Request) -> None:
         if is_public_path(req.path, public_paths):
@@ -435,7 +480,7 @@ def create_jwt_middleware(jwt_manager: JWTManager,
             claims = jwt_manager.verify(header[7:])
         except JWTError as exc:
             raise HTTPError(401, f"invalid token: {exc}")
-        if is_revoked is not None and is_revoked(claims.get("jti", "")):
+        if is_revoked is not None and _check_revoked(claims.get("jti", "")):
             raise HTTPError(401, "token revoked")
         req.context.update(claims)
         roles = set(claims.get("roles", []))
@@ -446,6 +491,15 @@ def create_jwt_middleware(jwt_manager: JWTManager,
                         403, f"requires one of roles {needed}")
                 break
 
+    def invalidate(jti: str) -> None:
+        """Drop a jti's cached verdict — wired to the local logout path
+        so in-process revocation is immediate; the TTL only bounds
+        revocations performed by OTHER replicas."""
+        with _cache_lock:
+            _revocation_cache.pop(jti, None)
+            _generation[0] += 1
+
+    middleware.invalidate = invalidate
     return middleware
 
 

@@ -272,13 +272,18 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
             auth_service,
             external_base_url=auth_cfg.get("external_base_url")))
         if require_auth:
-            router.middleware.append(create_jwt_middleware(
+            mw = create_jwt_middleware(
                 jwt,
                 required_roles=auth_cfg.get("required_roles", {
                     "/api/sources": ["admin", "processor"],
                     "/api/upload": ["admin", "processor"],
                 }),
-                is_revoked=auth_service.is_revoked))
+                is_revoked=auth_service.is_revoked,
+                revocation_cache_ttl=auth_cfg.get(
+                    "revocation_cache_ttl", 5.0))
+            # local logouts bypass the TTL entirely
+            auth_service.on_revoke.append(mw.invalidate)
+            router.middleware.append(mw)
 
     server = PipelineServer(
         pipeline=pipeline,

@@ -108,12 +108,21 @@ class Router:
         parsed = urlparse(raw_path)
         query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
         matched_path = False
+        method = method.upper()
+        # HEAD falls back to the GET handler (RFC 9110 §9.3.2) — the
+        # server layer suppresses the body while keeping Content-Length
+        # honest — but an explicitly registered HEAD route wins.
+        acceptable = {method}
+        if method == "HEAD" and not any(
+                m == "HEAD" and regex.match(parsed.path)
+                for m, regex, _ in self._routes):
+            acceptable = {"GET"}
         for m, regex, fn in self._routes:
             match = regex.match(parsed.path)
             if match is None:
                 continue
             matched_path = True
-            if m != method.upper():
+            if m not in acceptable:
                 continue
             # Path params arrive percent-encoded (clients MUST encode
             # ids containing '/', '@', ':'); handlers deal in decoded

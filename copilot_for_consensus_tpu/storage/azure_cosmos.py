@@ -107,11 +107,16 @@ def translate_filter(flt: Mapping[str, Any] | None
         terms = []
         for key, cond in sub.items():
             if key == "$or":
+                # empty $or matches nothing (any([]) in the base
+                # contract); '()' would be an opaque Cosmos 400
                 terms.append("(" + " OR ".join(
-                    f"({clause(s)})" for s in cond) + ")")
+                    f"({clause(s)})" for s in cond) + ")"
+                    if cond else "false")
             elif key == "$and":
+                # empty $and is vacuously true (all([]))
                 terms.append("(" + " AND ".join(
-                    f"({clause(s)})" for s in cond) + ")")
+                    f"({clause(s)})" for s in cond) + ")"
+                    if cond else "true")
             else:
                 terms.append(condition(key, cond))
         return " AND ".join(terms) if terms else "true"

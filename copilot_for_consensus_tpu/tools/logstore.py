@@ -135,7 +135,13 @@ class LogStoreServer:
                     if not raw:
                         continue
                     try:
-                        st.add(json.loads(raw))
+                        obj = json.loads(raw)
+                        if not isinstance(obj, dict):
+                            # valid JSON but not an object ('42', '[]')
+                            # would AttributeError inside LogStore.add
+                            raise json.JSONDecodeError(
+                                "not an object", "", 0)
+                        st.add(obj)
                     except (json.JSONDecodeError, UnicodeDecodeError):
                         # a hostile/corrupt line must not kill the sink
                         st.add({"level": "warning",

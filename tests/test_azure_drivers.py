@@ -318,6 +318,8 @@ def _eval_cosmos_sql(sql, params, docs):
             return all(term(doc, s) for s in _split(t, " AND "))
         if t == "true":
             return True
+        if t == "false":
+            return False
         if t.startswith("NOT IS_DEFINED("):
             return not get(doc, t[15:-1])[1]
         if t.startswith("IS_DEFINED("):
@@ -559,6 +561,12 @@ def test_cosmos_query_filters_match_memory_store(mock_cosmos):
         {"chunk_id": {"$regex": "^c[12]$"}},
         {"$or": [{"thread_id": "t0"}, {"n": {"$gt": 7}}]},
         {"$and": [{"status": "pending"}, {"n": {"$lte": 4}}]},
+        # degenerate lists: empty $or matches nothing (any([])), empty
+        # $and everything (all([])) — must not emit invalid SQL '()'
+        {"$or": []},
+        {"$and": []},
+        {"status": "pending", "$or": []},
+        {"status": "pending", "$and": []},
     ]
     for flt in filters:
         got = sorted(d["chunk_id"]

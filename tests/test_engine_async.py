@@ -76,6 +76,53 @@ def test_async_submit_before_start_raises():
         runner.submit([1, 2, 3], 4)
 
 
+def test_async_stop_fails_outstanding_handles_promptly():
+    """stop() must resolve blocked callers with RuntimeError instead of
+    leaving them to sit out their full result() timeout."""
+    import time
+
+    class _StubEngine:
+        """Never completes anything; step() blocks until released."""
+
+        def __init__(self):
+            self._active = {}
+            self._queue = []
+            self.release = threading.Event()
+            self._rid = 0
+
+        def submit(self, prompt, max_new_tokens):
+            self._rid += 1
+            self._queue.append(self._rid)
+            return self._rid
+
+        def step(self):
+            self.release.wait(10)
+            return []
+
+    eng = _StubEngine()
+    runner = AsyncEngineRunner(eng).start()
+    h = runner.submit([1, 2, 3], 4)
+    errs = []
+
+    def waiter():
+        try:
+            h.result(timeout=60)
+        except BaseException as exc:   # noqa: BLE001 — record whatever
+            errs.append(exc)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)                    # let the dispatcher enter step()
+    t0 = time.monotonic()
+    eng.release.set()
+    runner.stop()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert time.monotonic() - t0 < 10        # promptly, not the full 60s
+    assert len(errs) == 1 and isinstance(errs[0], RuntimeError)
+    assert "runner stopped" in str(errs[0])
+
+
 def test_async_bad_request_fails_its_handle_not_the_loop():
     """An invalid submit (empty prompt) must error THAT handle while the
     dispatcher keeps serving everyone else."""

@@ -63,6 +63,29 @@ def test_health_quartet_public(server):
         assert status == 200
 
 
+def test_head_routes_to_get_handler(server):
+    """HEAD on a GET route must return the GET status + headers and
+    NO body bytes on the wire (RFC 9110 §9.3.2) — stray body bytes
+    corrupt keep-alive streams for strict probes. Raw socket because
+    urllib's HTTPResponse never reads a HEAD body, which would make a
+    read()==b'' assertion vacuous."""
+    import socket
+
+    with socket.create_connection(("127.0.0.1", server.port),
+                                  timeout=10) as s:
+        s.sendall(b"HEAD /health HTTP/1.1\r\n"
+                  b"Host: x\r\nConnection: close\r\n\r\n")
+        raw = b""
+        while chunk := s.recv(4096):
+            raw += chunk
+    head, _, after_headers = raw.partition(b"\r\n\r\n")
+    assert head.split(b"\r\n")[0].split(b" ")[1] == b"200"
+    m = [ln for ln in head.split(b"\r\n")
+         if ln.lower().startswith(b"content-length:")]
+    assert m and int(m[0].split(b":")[1]) > 0            # honest length
+    assert after_headers == b""                          # no body bytes
+
+
 def test_api_requires_token(server):
     status, body = _call(server.port, "/api/reports")
     assert status == 401

@@ -83,10 +83,17 @@ def test_hostile_ingest_line_does_not_kill_sink():
     try:
         with socket.create_connection(("127.0.0.1", srv.port)) as s:
             s.sendall(b"not json at all\n")
+            # valid JSON but not an object: must hit the fallback
+            # record, not AttributeError the connection handler
+            s.sendall(b"42\n")
+            s.sendall(b'["also", "valid", "json"]\n')
             s.sendall(b'{"message": "fine", "service": "x"}\n')
-        assert _wait(lambda: srv.store.count() >= 2)
+        assert _wait(lambda: srv.store.count() >= 4)
         ok = srv.store.query(service="x")
         assert ok and ok[0]["message"] == "fine"
+        junk = srv.store.query(service="logstore")
+        assert len(junk) == 3
+        assert all(r["message"] == "unparseable log line" for r in junk)
     finally:
         srv.stop()
 

@@ -85,3 +85,50 @@ def test_factory():
     assert create_jwt_signer().alg == "RS256"
     with pytest.raises(ValueError):
         create_jwt_signer({"driver": "nope"})
+
+
+def test_jwt_middleware_revocation_cache():
+    """The middleware must NOT hit the revocation store on every request
+    (with a remote document store that is an HTTP round-trip per call):
+    clean verdicts are cached for the TTL, local invalidation is
+    immediate, revoked verdicts stick."""
+    from copilot_for_consensus_tpu.security.auth import (
+        create_jwt_middleware,
+    )
+    from copilot_for_consensus_tpu.services.http import (
+        HTTPError,
+        Request,
+    )
+
+    manager = JWTManager(HS256Signer("s"), issuer="i", audience="a")
+    token = manager.mint("u@example.org", roles=["reader"])
+    calls = []
+    revoked: set[str] = set()
+
+    def is_revoked(jti):
+        calls.append(jti)
+        return jti in revoked
+
+    mw = create_jwt_middleware(manager, is_revoked=is_revoked,
+                               revocation_cache_ttl=60.0)
+
+    def req():
+        return Request("GET", "/api/reports", {}, {
+            "Authorization": f"Bearer {token}"}, b"", {})
+
+    for _ in range(5):
+        mw(req())
+    assert len(calls) == 1            # 4 of 5 served from cache
+    jti = calls[0]
+
+    # local logout: invalidate → next request re-checks and rejects
+    revoked.add(jti)
+    mw.invalidate(jti)
+    with pytest.raises(HTTPError) as exc:
+        mw(req())
+    assert exc.value.status == 401
+    assert len(calls) == 2
+    # revoked verdict is cached too — no further store traffic
+    with pytest.raises(HTTPError):
+        mw(req())
+    assert len(calls) == 2

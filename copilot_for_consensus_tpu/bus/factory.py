@@ -27,6 +27,12 @@ def create_publisher(config: Any = None, validate: bool = True):
         from copilot_for_consensus_tpu.bus.broker import BrokerPublisher
 
         pub = BrokerPublisher(cfg)
+    elif driver == "azure_servicebus":
+        from copilot_for_consensus_tpu.bus.azure_servicebus import (
+            AzureServiceBusPublisher,
+        )
+
+        pub = AzureServiceBusPublisher(cfg)
     elif driver == "noop":
         pub = NoopPublisher()
     else:
@@ -44,6 +50,12 @@ def create_subscriber(config: Any = None, validate: bool = True,
         from copilot_for_consensus_tpu.bus.broker import BrokerSubscriber
 
         sub = BrokerSubscriber(cfg)
+    elif driver == "azure_servicebus":
+        from copilot_for_consensus_tpu.bus.azure_servicebus import (
+            AzureServiceBusSubscriber,
+        )
+
+        sub = AzureServiceBusSubscriber(cfg)
     elif driver == "noop":
         sub = NoopSubscriber()
     else:
@@ -51,5 +63,5 @@ def create_subscriber(config: Any = None, validate: bool = True,
     return ValidatingSubscriber(sub, on_invalid=on_invalid) if validate else sub
 
 
-for _name in ("inproc", "broker", "zmq", "noop"):
+for _name in ("inproc", "broker", "zmq", "noop", "azure_servicebus"):
     register_driver("message_bus", _name, create_publisher)

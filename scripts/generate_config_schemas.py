@@ -206,6 +206,13 @@ DRIVERS: dict[str, dict[str, dict]] = {
         "broker": dict(_BROKER_KEYS),
         "zmq": dict(_BROKER_KEYS),          # config alias of broker
         "noop": {},
+        "azure_servicebus": dict(namespace="", key_name="", key="",
+                                 endpoint="", topic="copilot.events",
+                                 group="", lock_duration_s=60,
+                                 max_redeliveries=3, peek_timeout_s=1,
+                                 poll_interval_s=0.05, timeout_s=30.0,
+                                 auto_renew=True, retry_attempts=3,
+                                 retry_backoff_s=0.3),
     },
     "document_store": {
         "memory": {},
@@ -313,6 +320,7 @@ REQUIRED_KEYS: dict[tuple[str, str], list[str]] = {
     ("llm_backend", "azure_openai"): ["base_url"],
     ("archive_store", "azure_blob"): ["account"],
     ("document_store", "azure_cosmos"): ["account", "master_key"],
+    ("message_bus", "azure_servicebus"): ["key"],
     ("secret_provider", "azure_keyvault"): ["vault_url", "tenant_id", "client_id", "client_secret"],
 }
 

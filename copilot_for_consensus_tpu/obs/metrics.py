@@ -179,4 +179,15 @@ def create_metrics_collector(config: Any = None) -> MetricsCollector:
             job=cfg.get("job", "copilot"),
             namespace=cfg.get("namespace", "copilot"),
         )
+    if driver == "azure_monitor":
+        from copilot_for_consensus_tpu.obs.azure_monitor import (
+            AzureMonitorMetrics,
+        )
+
+        return AzureMonitorMetrics(
+            cfg.get("connection_string", ""),
+            namespace=cfg.get("namespace", "copilot"),
+            export_interval_s=float(cfg.get("export_interval_s", 60.0)),
+            raise_on_error=bool(cfg.get("raise_on_error", False)),
+        )
     raise ValueError(f"unknown metrics driver {driver!r}")

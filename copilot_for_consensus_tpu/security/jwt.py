@@ -141,6 +141,18 @@ def create_jwt_signer(config: Any = None, **kwargs: Any) -> JWTSigner:
         if not secret:
             raise ValueError("hs256 signer needs a secret")
         return HS256Signer(secret)
+    if driver == "azure_keyvault":
+        from copilot_for_consensus_tpu.security.keyvault_signer import (
+            AzureKeyVaultSigner,
+        )
+
+        return AzureKeyVaultSigner(
+            cfg.get("vault_url", ""), cfg.get("key_name", ""),
+            cfg.get("tenant_id", ""), cfg.get("client_id", ""),
+            cfg.get("client_secret", ""),
+            key_version=cfg.get("key_version", ""),
+            authority=cfg.get("authority",
+                              "https://login.microsoftonline.com"))
     raise ValueError(f"unknown jwt_signer driver {driver!r}")
 
 

@@ -1,0 +1,248 @@
+"""Azure Key Vault JWT signer — raw REST, no SDK.
+
+Fills the role of the reference's
+``copilot_jwt_signer/keyvault_signer.py:102`` (KeyVaultJWTSigner: sign
+via Key Vault's ``sign`` operation so the private key NEVER leaves the
+vault, JWK/PEM publication from the vault's public half, transient-error
+retry behind a circuit breaker). Same driver conventions as the repo's
+other Azure adapters: AAD client-credentials bearer (as
+``security/secrets.py`` Key Vault provider), endpoint/authority
+overrides for the wire-contract mock, stdlib HTTP only.
+
+Wire surface (Key Vault REST 7.4):
+
+* ``GET  {vault}/keys/{name}/{version}`` → public JWK (n, e, kid)
+* ``POST {vault}/keys/{name}/{version}/sign`` with
+  ``{"alg": "RS256", "value": b64url(sha256(signing_input))}`` →
+  ``{"value": b64url(signature)}``
+
+Verification is local against the fetched public key, so token
+validation never round-trips to the vault.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any
+
+from copilot_for_consensus_tpu.security.jwt import JWTError, JWTSigner
+
+API_VERSION = "7.4"
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(data: str) -> bytes:
+    return base64.urlsafe_b64decode(data + "=" * (-len(data) % 4))
+
+
+class CircuitBreaker:
+    """Stop hammering the vault after repeated failures (reference
+    ``keyvault_signer.py:18``): after ``threshold`` consecutive
+    failures the circuit opens for ``cooldown_s`` and calls fail fast;
+    one success closes it."""
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 30.0):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._failures = 0
+        self._open_until = 0.0
+        self._lock = threading.Lock()
+
+    def call(self, fn, *args, **kwargs):
+        with self._lock:
+            if time.monotonic() < self._open_until:
+                raise JWTError(
+                    "key vault circuit open (recent failures); "
+                    f"retrying after {self.cooldown_s}s cooldown")
+        try:
+            out = fn(*args, **kwargs)
+        except Exception:
+            with self._lock:
+                self._failures += 1
+                if self._failures >= self.threshold:
+                    self._open_until = (time.monotonic()
+                                        + self.cooldown_s)
+                    self._failures = 0
+            raise
+        with self._lock:
+            self._failures = 0
+        return out
+
+
+class AzureKeyVaultSigner(JWTSigner):
+    alg = "RS256"
+
+    def __init__(self, vault_url: str, key_name: str,
+                 tenant_id: str, client_id: str, client_secret: str, *,
+                 key_version: str = "",
+                 authority: str = "https://login.microsoftonline.com",
+                 timeout_s: float = 15.0, retry_attempts: int = 2,
+                 retry_backoff_s: float = 0.2,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 30.0):
+        if not all((vault_url, key_name, tenant_id, client_id,
+                    client_secret)):
+            raise ValueError(
+                "azure_keyvault signer needs vault_url, key_name, "
+                "tenant_id, client_id, client_secret")
+        self.vault_url = vault_url.rstrip("/")
+        self.key_name = key_name
+        self.key_version = key_version
+        self.authority = authority.rstrip("/")
+        self.tenant_id = tenant_id
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.timeout_s = timeout_s
+        self.retry_attempts = retry_attempts
+        self.retry_backoff_s = retry_backoff_s
+        self.breaker = CircuitBreaker(breaker_threshold,
+                                      breaker_cooldown_s)
+        self._token: str | None = None
+        self._token_exp = 0.0
+        self._jwk: dict[str, Any] | None = None
+        self._pub = None                      # cryptography public key
+        self._kid = ""
+        self._lock = threading.Lock()         # guards the AAD token
+        self._load_lock = threading.Lock()    # guards key-fetch init
+
+    @property
+    def kid(self) -> str:
+        """Lazy: JWTManager reads this for the JWT header before the
+        first sign, so the vault key must be fetched here too."""
+        self._load_public()
+        return self._kid
+
+    # -- AAD bearer (same flow as security/secrets.py Key Vault) -------
+
+    def _bearer(self) -> str:
+        with self._lock:
+            if self._token and time.time() < self._token_exp - 60:
+                return self._token
+        body = urllib.parse.urlencode({
+            "grant_type": "client_credentials",
+            "client_id": self.client_id,
+            "client_secret": self.client_secret,
+            "scope": f"{self.vault_url}/.default",
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.authority}/{self.tenant_id}/oauth2/v2.0/token",
+            data=body, method="POST",
+            headers={"Content-Type":
+                     "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            tok = json.loads(r.read())
+        with self._lock:
+            self._token = tok["access_token"]
+            self._token_exp = time.time() + float(
+                tok.get("expires_in", 300))
+            return self._token
+
+    def _request(self, method: str, path: str,
+                 body: dict | None = None) -> dict:
+        url = (f"{self.vault_url}{path}?api-version={API_VERSION}")
+        attempt = 0
+        while True:
+            # the AAD token fetch shares the retry/JWTError envelope:
+            # a transient token-endpoint blip must retry, and callers
+            # who catch JWTError (JWTManager, auth middleware) must see
+            # auth failures in that class, not raw urllib errors
+            try:
+                req = urllib.request.Request(
+                    url, method=method,
+                    data=json.dumps(body).encode() if body else None,
+                    headers={"Authorization":
+                             f"Bearer {self._bearer()}",
+                             "Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                transient = exc.code in (408, 429, 500, 502, 503, 504)
+                if not (transient and attempt < self.retry_attempts):
+                    raise JWTError(
+                        f"key vault {method} {path}: HTTP {exc.code} "
+                        f"{exc.read()[:120].decode('utf-8', 'replace')}"
+                    ) from exc
+            except (urllib.error.URLError, TimeoutError, OSError) as exc:
+                if attempt >= self.retry_attempts:
+                    raise JWTError(
+                        f"key vault unreachable: {exc}") from exc
+            time.sleep(self.retry_backoff_s * (2 ** attempt))
+            attempt += 1
+
+    # -- key material ---------------------------------------------------
+
+    def _key_path(self) -> str:
+        version = f"/{self.key_version}" if self.key_version else ""
+        return f"/keys/{self.key_name}{version}"
+
+    def _load_public(self) -> None:
+        # double-checked under _load_lock; _pub is assigned LAST so a
+        # racing reader that sees it non-None also sees _kid/_jwk set
+        # (a separate lock from the AAD one — _request → _bearer takes
+        # _lock while we hold _load_lock)
+        if self._pub is not None:
+            return
+        with self._load_lock:
+            if self._pub is not None:
+                return
+            bundle = self.breaker.call(self._request, "GET",
+                                       self._key_path())
+            jwk = bundle.get("key", bundle)
+            if jwk.get("kty") not in ("RSA", "RSA-HSM"):
+                raise JWTError(
+                    f"key vault key {self.key_name} is "
+                    f"{jwk.get('kty')}, need RSA for RS256")
+            n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
+            e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
+            from cryptography.hazmat.primitives.asymmetric.rsa import (
+                RSAPublicNumbers,
+            )
+
+            # stable kid: the vault's key identifier version, else an
+            # n/e digest like the local signer
+            kid_src = jwk.get("kid", "")
+            self._kid = (kid_src.rsplit("/", 1)[-1] if kid_src
+                         else hashlib.sha256(
+                             f"{n:x}:{e:x}".encode()).hexdigest()[:16])
+            self._jwk = {"kty": "RSA", "use": "sig", "alg": "RS256",
+                         "kid": self._kid, "n": jwk["n"],
+                         "e": jwk["e"]}
+            self._pub = RSAPublicNumbers(e, n).public_key()
+
+    # -- JWTSigner surface ---------------------------------------------
+
+    def sign(self, signing_input: bytes) -> bytes:
+        self._load_public()
+        digest = hashlib.sha256(signing_input).digest()
+        out = self.breaker.call(
+            self._request, "POST", f"{self._key_path()}/sign",
+            {"alg": "RS256", "value": _b64url(digest)})
+        return _b64url_decode(out["value"])
+
+    def verify(self, signing_input: bytes, signature: bytes) -> bool:
+        self._load_public()
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        try:
+            self._pub.verify(signature, signing_input,
+                             padding.PKCS1v15(), hashes.SHA256())
+            return True
+        except InvalidSignature:
+            return False
+
+    def public_jwk(self) -> dict[str, Any]:
+        self._load_public()
+        return dict(self._jwk)

@@ -21,8 +21,14 @@ def create_vector_store(config: Any = None):
         from copilot_for_consensus_tpu.vectorstore.native import NativeFlatVectorStore
 
         return NativeFlatVectorStore(cfg)
+    if driver == "azure_ai_search":
+        from copilot_for_consensus_tpu.vectorstore.azure_ai_search import (
+            AzureAISearchVectorStore,
+        )
+
+        return AzureAISearchVectorStore(cfg)
     raise ValueError(f"unknown vector_store driver {driver!r}")
 
 
-for _name in ("memory", "tpu", "native"):
+for _name in ("memory", "tpu", "native", "azure_ai_search"):
     register_driver("vector_store", _name, create_vector_store)

@@ -224,6 +224,12 @@ DRIVERS: dict[str, dict[str, dict]] = {
         "memory": dict(dimension=0, persist_path=""),
         "tpu": dict(dimension=0, dtype="bfloat16", persist_path=""),
         "native": dict(dimension=0, persist_path=""),
+        "azure_ai_search": dict(endpoint="", api_key="",
+                                index_name="embeddings", dimension=0,
+                                filterable_keys=list(
+                                    ("thread_id", "archive_id",
+                                     "chunk_id", "message_doc_id")),
+                                timeout_s=30.0),
     },
     "embedding_backend": {
         "mock": dict(dimension=32),
@@ -259,6 +265,10 @@ DRIVERS: dict[str, dict[str, dict]] = {
         "prometheus": dict(namespace="copilot"),
         "pushgateway": dict(gateway_url="http://localhost:9091",
                             job="copilot", namespace="copilot"),
+        "azure_monitor": dict(connection_string="",
+                              namespace="copilot",
+                              export_interval_s=60.0,
+                              raise_on_error=False),
     },
     "logger": {
         "stdout": dict(service="", level="info"),
@@ -297,6 +307,10 @@ DRIVERS: dict[str, dict[str, dict]] = {
     "jwt_signer": {
         "local_rs256": dict(private_pem=""),
         "hs256": dict(secret=""),
+        "azure_keyvault": dict(
+            vault_url="", key_name="", key_version="", tenant_id="",
+            client_id="", client_secret="",
+            authority="https://login.microsoftonline.com"),
     },
     "oidc_provider": {
         name: dict(client_id="", client_secret="", redirect_uri="")
@@ -321,6 +335,12 @@ REQUIRED_KEYS: dict[tuple[str, str], list[str]] = {
     ("archive_store", "azure_blob"): ["account"],
     ("document_store", "azure_cosmos"): ["account", "master_key"],
     ("message_bus", "azure_servicebus"): ["key"],
+    ("vector_store", "azure_ai_search"): ["endpoint", "api_key",
+                                          "dimension"],
+    ("metrics", "azure_monitor"): ["connection_string"],
+    ("jwt_signer", "azure_keyvault"): ["vault_url", "key_name",
+                                       "tenant_id", "client_id",
+                                       "client_secret"],
     ("secret_provider", "azure_keyvault"): ["vault_url", "tenant_id", "client_id", "client_secret"],
 }
 

@@ -1,0 +1,371 @@
+# Azure AI Search vector store against a wire-contract mock: index
+# provisioning with the HNSW profile, mergeOrUpload batching, vector
+# search with OData filter pushdown, score conversion, lookup/delete/
+# count/clear — with the in-memory store as the similarity oracle.
+import json
+import math
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from copilot_for_consensus_tpu.vectorstore.azure_ai_search import (
+    AzureAISearchVectorStore,
+)
+from copilot_for_consensus_tpu.vectorstore.base import VectorStoreError
+from copilot_for_consensus_tpu.vectorstore.memory import InMemoryVectorStore
+
+API_KEY = "search-admin-key"
+
+
+class _MockSearchService:
+    def __init__(self):
+        self.indexes = {}          # name -> {"definition", "docs"}
+        self.lock = threading.Lock()
+        self.stats = {"bad_auth": 0, "searches": 0}
+
+    @staticmethod
+    def _cosine(a, b):
+        dot = sum(x * y for x, y in zip(a, b))
+        na = math.sqrt(sum(x * x for x in a)) or 1e-30
+        nb = math.sqrt(sum(x * x for x in b)) or 1e-30
+        return dot / (na * nb)
+
+    def _filter_pred(self, expr):
+        """Evaluate the OData subset the driver emits; anything else
+        fails loudly."""
+        if expr is None:
+            return lambda doc: True
+        def eq_pred(term):
+            m = re.fullmatch(r"(\w+) eq '((?:[^']|'')*)'", term.strip())
+            if not m:
+                return None
+            key, val = m.group(1), m.group(2).replace("''", "'")
+            return lambda d, k=key, v=val: d.get(k) == v
+
+        terms = expr.split(" and ")
+        preds = []
+        for term in terms:
+            term = term.strip()
+            p = eq_pred(term)
+            if p:
+                preds.append(p)
+                continue
+            # eq-or membership chains: (k eq 'a' or k eq 'b')
+            if term.startswith("(") and term.endswith(")"):
+                alts = [eq_pred(t) for t in term[1:-1].split(" or ")]
+                assert all(alts), f"mock cannot evaluate: {term!r}"
+                preds.append(
+                    lambda d, a=alts: any(p(d) for p in a))
+                continue
+            raise AssertionError(f"mock cannot evaluate OData: {term!r}")
+        return lambda doc: all(p(doc) for p in preds)
+
+    def search(self, index, body):
+        self.stats["searches"] += 1
+        docs = list(self.indexes[index]["docs"].values())
+        pred = self._filter_pred(body.get("filter"))
+        docs = [d for d in docs if pred(d)]
+        vqs = body.get("vectorQueries") or []
+        if vqs:
+            (vq,) = vqs
+            assert vq["kind"] == "vector" and vq["fields"] == "embedding"
+            scored = []
+            for d in docs:
+                cos = self._cosine(vq["vector"], d["embedding"])
+                scored.append((1.0 / (1.0 + (1.0 - cos)), d))
+            scored.sort(key=lambda t: -t[0])
+            scored = scored[:min(int(vq["k"]),
+                                 int(body.get("top", vq["k"])))]
+        else:
+            scored = [(1.0, d) for d in docs][:int(body.get("top",
+                                                            50))]
+        select = (body.get("select") or "").split(",")
+        out = []
+        for score, d in scored:
+            row = {k: d.get(k) for k in select if k}
+            row["@search.score"] = score
+            out.append(row)
+        resp = {"value": out}
+        if body.get("count"):
+            resp["@odata.count"] = len(docs)
+        return resp
+
+
+def _make_handler(state):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, status, obj=None):
+            body = (json.dumps(obj).encode()
+                    if obj is not None else b"")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _handle(self, method):
+            if self.headers.get("api-key") != API_KEY:
+                state.stats["bad_auth"] += 1
+                return self._reply(403, {"error": "forbidden"})
+            parsed = urllib.parse.urlparse(self.path)
+            assert "api-version=" in (parsed.query or "")
+            path = urllib.parse.unquote(parsed.path)
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n)) if n else None
+            with state.lock:
+                return self._route(method, path, body)
+
+        def _route(self, method, path, body):
+            m = re.fullmatch(r"/indexes/([^/]+)", path)
+            if m:
+                name = m.group(1)
+                if method == "PUT":
+                    # index update with a changed schema is rejected
+                    # like the real service
+                    old = state.indexes.get(name)
+                    if old and old["definition"]["fields"] != \
+                            body["fields"]:
+                        return self._reply(400,
+                                           {"error": "schema change"})
+                    state.indexes.setdefault(
+                        name, {"definition": body, "docs": {}})
+                    state.indexes[name]["definition"] = body
+                    return self._reply(201 if old is None else 200)
+                if method == "DELETE":
+                    return self._reply(
+                        204 if state.indexes.pop(name, None) else 404)
+            m = re.fullmatch(r"/indexes/([^/]+)/docs/index", path)
+            if m and method == "POST":
+                index = state.indexes.get(m.group(1))
+                if index is None:
+                    return self._reply(404)
+                results = []
+                dims = next(
+                    f["dimensions"] for f in
+                    index["definition"]["fields"]
+                    if f["name"] == "embedding")
+                for action in body["value"]:
+                    act = action.pop("@search.action")
+                    key = action["id"]
+                    if act in ("mergeOrUpload", "upload"):
+                        if len(action.get("embedding") or []) != dims:
+                            results.append(
+                                {"key": key, "status": False,
+                                 "errorMessage": "dimension mismatch",
+                                 "statusCode": 400})
+                            continue
+                        index["docs"][key] = action
+                        results.append({"key": key, "status": True,
+                                        "statusCode": 200})
+                    elif act == "delete":
+                        index["docs"].pop(key, None)
+                        results.append({"key": key, "status": True,
+                                        "statusCode": 200})
+                return self._reply(200, {"value": results})
+            m = re.fullmatch(r"/indexes/([^/]+)/docs/search", path)
+            if m and method == "POST":
+                index = state.indexes.get(m.group(1))
+                if index is None:
+                    return self._reply(404)
+                return self._reply(200, state.search(m.group(1), body))
+            m = re.fullmatch(r"/indexes/([^/]+)/docs/\$count", path)
+            if m and method == "GET":
+                index = state.indexes.get(m.group(1))
+                if index is None:
+                    return self._reply(404)
+                return self._reply(200, len(index["docs"]))
+            m = re.fullmatch(
+                r"/indexes/([^/]+)/docs\('((?:[^']|'')*)'\)", path)
+            if m and method == "GET":
+                index = state.indexes.get(m.group(1))
+                # OData key literal: '' unescapes to ' (path itself
+                # already percent-decoded above)
+                doc = (index or {"docs": {}})["docs"].get(
+                    m.group(2).replace("''", "'"))
+                if doc is None:
+                    return self._reply(404)
+                return self._reply(200, doc)
+            return self._reply(400, {"error": f"unroutable {path}"})
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_PUT(self):
+            self._handle("PUT")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+    return Handler
+
+
+@pytest.fixture()
+def mock_search():
+    state = _MockSearchService()
+    server = ThreadingHTTPServer(("127.0.0.1", 0),
+                                 _make_handler(state))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{server.server_address[1]}", state
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def _store(endpoint, **kw):
+    cfg = {"endpoint": endpoint, "api_key": API_KEY, "dimension": 8,
+           **kw}
+    s = AzureAISearchVectorStore(cfg)
+    s.connect()
+    return s
+
+
+def _vec(seed, dim=8):
+    return [math.sin(seed * (i + 1)) for i in range(dim)]
+
+
+def test_index_provisioned_with_reference_hnsw_profile(mock_search):
+    """The created index carries the reference's HNSW configuration
+    (azure_ai_search_store.py:255 — m=4, efConstruction=400,
+    efSearch=500, cosine)."""
+    endpoint, state = mock_search
+    _store(endpoint, index_name="emb")
+    definition = state.indexes["emb"]["definition"]
+    (algo,) = definition["vectorSearch"]["algorithms"]
+    assert algo["kind"] == "hnsw"
+    assert algo["hnswParameters"] == {
+        "m": 4, "efConstruction": 400, "efSearch": 500,
+        "metric": "cosine"}
+    fields = {f["name"]: f for f in definition["fields"]}
+    assert fields["id"]["key"] and fields["id"]["filterable"]
+    assert fields["embedding"]["dimensions"] == 8
+    assert fields["thread_id"]["filterable"]
+
+
+def test_query_matches_memory_store_oracle(mock_search):
+    """Same vectors, same queries, same filters: ids, order, and
+    (converted) cosine scores match the in-memory reference store."""
+    endpoint, _ = mock_search
+    azure = _store(endpoint)
+    mem = InMemoryVectorStore({})
+    for i in range(30):
+        md = {"thread_id": f"t{i % 3}", "chunk_id": f"c{i}",
+              "note": "unfiltered-extra"}
+        azure.add_embedding(f"v{i}", _vec(i + 1), md)
+        mem.add_embedding(f"v{i}", _vec(i + 1), md)
+    for flt in (None, {"thread_id": "t1"},
+                {"thread_id": {"$in": ["t0", "t2"]}},
+                {"thread_id": {"$in": []}},
+                {"thread_id": "t1", "chunk_id": "c4"},
+                {"thread_id": "nope"}):
+        got = azure.query(_vec(5), top_k=5, flt=flt)
+        want = mem.query(_vec(5), top_k=5, flt=flt)
+        assert [r.id for r in got] == [r.id for r in want], flt
+        for g, w in zip(got, want):
+            assert g.score == pytest.approx(w.score, abs=1e-6)
+            assert g.metadata == w.metadata
+
+
+def test_batched_upsert_and_count_and_get(mock_search):
+    endpoint, _ = mock_search
+    azure = _store(endpoint)
+    n = azure.add_embeddings(
+        (f"v{i}", _vec(i + 1), {"chunk_id": f"c{i}"})
+        for i in range(7))
+    assert n == 7 and azure.count() == 7
+    vec, md = azure.get("v3")
+    assert vec == pytest.approx(_vec(4))
+    assert md == {"chunk_id": "c3"}
+    assert azure.get("absent") is None
+    # upsert semantics: same id replaces, count stable
+    azure.add_embedding("v3", _vec(99), {"chunk_id": "r"})
+    assert azure.count() == 7
+    assert azure.get("v3")[1] == {"chunk_id": "r"}
+
+
+def test_delete_reports_honest_counts(mock_search):
+    endpoint, _ = mock_search
+    azure = _store(endpoint)
+    for i in range(5):
+        azure.add_embedding(f"v{i}", _vec(i + 1),
+                            {"thread_id": f"t{i % 2}"})
+    assert azure.delete(["v0", "v1", "ghost"]) == 2
+    assert azure.count() == 3
+    assert azure.delete_by_filter({"thread_id": "t0"}) == 2
+    assert azure.count() == 1
+
+
+def test_hostile_ids_roundtrip(mock_search):
+    """Ids are arbitrary strings per the base contract: commas must not
+    split membership filters, quotes must not break OData literals."""
+    endpoint, _ = mock_search
+    azure = _store(endpoint)
+    hostile = ["a,b", "it's", "plain", "a", "b"]
+    for i, vid in enumerate(hostile):
+        azure.add_embedding(vid, _vec(i + 1), {"chunk_id": vid})
+    vec, md = azure.get("it's")
+    assert md == {"chunk_id": "it's"}
+    # deleting "a,b" must NOT count/touch docs "a" and "b"
+    assert azure.delete(["a,b"]) == 1
+    assert azure.count() == 4
+    assert azure.get("a") is not None and azure.get("b") is not None
+
+
+def test_dimension_mismatch_and_unsupported_filters(mock_search):
+    endpoint, _ = mock_search
+    azure = _store(endpoint)
+    with pytest.raises(VectorStoreError, match="dimension"):
+        azure.add_embedding("bad", [1.0, 2.0], {})
+    with pytest.raises(VectorStoreError, match="dimension"):
+        azure.query([1.0] * 3)
+    azure.add_embedding("ok", _vec(1), {"note": "x"})
+    with pytest.raises(VectorStoreError, match="filterable_keys"):
+        azure.query(_vec(1), flt={"note": "x"})
+    with pytest.raises(VectorStoreError, match="operator"):
+        azure.query(_vec(1), flt={"thread_id": {"$gt": "a"}})
+
+
+def test_clear_drops_and_recreates_index(mock_search):
+    endpoint, state = mock_search
+    azure = _store(endpoint)
+    azure.add_embedding("v1", _vec(1), {})
+    azure.clear()
+    assert azure.count() == 0
+    assert "embeddings" in state.indexes       # recreated
+    azure.add_embedding("v2", _vec(2), {})
+    assert azure.count() == 1
+
+
+def test_bad_api_key_and_validation(mock_search):
+    endpoint, state = mock_search
+    bad = AzureAISearchVectorStore(
+        {"endpoint": endpoint, "api_key": "wrong", "dimension": 8})
+    with pytest.raises(VectorStoreError, match="403"):
+        bad.connect()
+    assert state.stats["bad_auth"] >= 1
+    with pytest.raises(ValueError, match="endpoint"):
+        AzureAISearchVectorStore({"api_key": "k", "dimension": 8})
+    with pytest.raises(ValueError, match="dimension"):
+        AzureAISearchVectorStore({"endpoint": "http://x",
+                                  "api_key": "k"})
+
+
+def test_factory_registration(mock_search):
+    from copilot_for_consensus_tpu.vectorstore.factory import (
+        create_vector_store,
+    )
+
+    endpoint, _ = mock_search
+    store = create_vector_store({
+        "driver": "azure_ai_search", "endpoint": endpoint,
+        "api_key": API_KEY, "dimension": 8})
+    assert isinstance(store, AzureAISearchVectorStore)
+    assert store.dimension == 8

@@ -1,0 +1,224 @@
+# Azure Key Vault JWT signer against a wire-contract mock: the mock
+# holds the RSA private key (like the real vault — the client only ever
+# sees the public JWK and sign results), AAD client-credentials, JWKS
+# publication, end-to-end JWT mint/verify, and the circuit breaker.
+import base64
+import hashlib
+import json as _json
+
+import pytest
+
+from copilot_for_consensus_tpu.security.jwt import (
+    JWTError,
+    JWTManager,
+    create_jwt_signer,
+)
+from copilot_for_consensus_tpu.security.keyvault_signer import (
+    AzureKeyVaultSigner,
+    CircuitBreaker,
+)
+from copilot_for_consensus_tpu.services.http import (
+    HTTPServer,
+    Response,
+    Router,
+)
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+@pytest.fixture(scope="module")
+def vault_key():
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+@pytest.fixture()
+def mock_vault(vault_key):
+    """AAD token endpoint + Key Vault keys endpoint; private key stays
+    server-side."""
+    router = Router()
+    state = {"token_calls": 0, "sign_calls": 0, "get_calls": 0,
+             "fail_signs": 0}
+    pub = vault_key.public_key().public_numbers()
+
+    def _n_bytes(n):
+        return n.to_bytes((n.bit_length() + 7) // 8, "big")
+
+    @router.post("/tenant-1/oauth2/v2.0/token")
+    def token(req):
+        import urllib.parse as up
+
+        state["token_calls"] += 1
+        form = dict(up.parse_qsl(req.body.decode()))
+        if form.get("client_secret") != "app-secret":
+            return Response({"error": "invalid_client"}, status=401)
+        return {"access_token": "tok-kv", "expires_in": 3600}
+
+    def _jwk():
+        return {"kid": "https://vault/keys/signing/v77", "kty": "RSA",
+                "n": _b64url(_n_bytes(pub.n)),
+                "e": _b64url(_n_bytes(pub.e)),
+                "key_ops": ["sign", "verify"]}
+
+    @router.get("/keys/{name}")
+    def get_key(req):
+        state["get_calls"] += 1
+        if req.headers.get("Authorization") != "Bearer tok-kv":
+            return Response({"error": "unauthorized"}, status=401)
+        return {"key": _jwk()}
+
+    @router.post("/keys/{name}/sign")
+    def sign(req):
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            Prehashed,
+        )
+
+        state["sign_calls"] += 1
+        if state["fail_signs"] > 0:
+            state["fail_signs"] -= 1
+            return Response({"error": "throttled"}, status=429)
+        if req.headers.get("Authorization") != "Bearer tok-kv":
+            return Response({"error": "unauthorized"}, status=401)
+        body = _json.loads(req.body)
+        assert body["alg"] == "RS256"
+        digest = base64.urlsafe_b64decode(
+            body["value"] + "=" * (-len(body["value"]) % 4))
+        sig = vault_key.sign(digest, padding.PKCS1v15(),
+                             Prehashed(hashes.SHA256()))
+        return {"kid": _jwk()["kid"], "value": _b64url(sig)}
+
+    srv = HTTPServer(router)
+    srv.start()
+    yield srv, state
+    srv.stop()
+
+
+def _signer(srv, **kw):
+    base = f"http://127.0.0.1:{srv.port}"
+    kw.setdefault("retry_attempts", 0)
+    return AzureKeyVaultSigner(base, "signing", "tenant-1", "app-1",
+                               "app-secret", authority=base, **kw)
+
+
+def test_jwt_mint_and_verify_via_vault(mock_vault):
+    """Full path: the JWT's signature is produced by the vault's sign
+    operation and verifies against the published JWK — the private key
+    never crossed the wire."""
+    srv, state = mock_vault
+    signer = _signer(srv)
+    manager = JWTManager(signer, issuer="iss", audience="aud")
+    token = manager.mint("user@example.org", roles=["admin"])
+    claims = manager.verify(token)
+    assert claims["sub"] == "user@example.org"
+    assert claims["roles"] == ["admin"]
+    assert state["sign_calls"] == 1
+    # header kid is the vault key version
+    header = _json.loads(base64.urlsafe_b64decode(
+        token.split(".")[0] + "=="))
+    assert header["kid"] == "v77"
+    # verify is local: no extra vault round-trips
+    manager.verify(token)
+    assert state["sign_calls"] == 1 and state["get_calls"] == 1
+
+
+def test_jwks_publication_matches_vault_key(mock_vault, vault_key):
+    srv, _ = mock_vault
+    jwk = _signer(srv).public_jwk()
+    assert jwk["kty"] == "RSA" and jwk["alg"] == "RS256"
+    pub = vault_key.public_key().public_numbers()
+    n = int.from_bytes(base64.urlsafe_b64decode(
+        jwk["n"] + "=" * (-len(jwk["n"]) % 4)), "big")
+    assert n == pub.n
+
+
+def test_tampered_signature_rejected(mock_vault):
+    srv, _ = mock_vault
+    manager = JWTManager(_signer(srv), issuer="i", audience="a")
+    token = manager.mint("u")
+    head, payload, sig = token.split(".")
+    forged = payload[:-2] + ("AA" if payload[-2:] != "AA" else "BB")
+    with pytest.raises(JWTError):
+        manager.verify(f"{head}.{forged}.{sig}")
+
+
+def test_bad_credentials_surface_as_jwt_error(mock_vault):
+    srv, _ = mock_vault
+    base = f"http://127.0.0.1:{srv.port}"
+    bad = AzureKeyVaultSigner(base, "signing", "tenant-1", "app-1",
+                              "wrong-secret", authority=base,
+                              retry_attempts=0)
+    with pytest.raises(Exception, match="401|invalid_client"):
+        bad.sign(b"payload")
+
+
+def test_transient_sign_errors_retry_then_succeed(mock_vault):
+    srv, state = mock_vault
+    signer = _signer(srv, retry_attempts=2, retry_backoff_s=0.01)
+    state["fail_signs"] = 2          # two 429s, then success
+    assert signer.sign(b"data")
+    assert state["sign_calls"] >= 3
+
+
+def test_circuit_breaker_opens_and_cools_down(mock_vault):
+    srv, state = mock_vault
+    signer = _signer(srv, breaker_threshold=2, breaker_cooldown_s=30.0)
+    signer._load_public()            # prime key fetch
+    state["fail_signs"] = 10**6      # hard-down vault
+    for _ in range(2):
+        with pytest.raises(JWTError, match="429"):
+            signer.sign(b"x")
+    hits = state["sign_calls"]
+    with pytest.raises(JWTError, match="circuit open"):
+        signer.sign(b"x")
+    assert state["sign_calls"] == hits     # failed fast, no wire call
+
+
+def test_circuit_breaker_unit():
+    br = CircuitBreaker(threshold=2, cooldown_s=60)
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("down")
+
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            br.call(boom)
+    with pytest.raises(JWTError, match="circuit open"):
+        br.call(boom)
+    assert len(calls) == 2
+
+
+def test_factory_and_validation(mock_vault):
+    srv, _ = mock_vault
+    base = f"http://127.0.0.1:{srv.port}"
+    with pytest.raises(ValueError, match="vault_url"):
+        create_jwt_signer({"driver": "azure_keyvault"})
+    signer = create_jwt_signer({
+        "driver": "azure_keyvault", "vault_url": base,
+        "key_name": "signing", "tenant_id": "tenant-1",
+        "client_id": "app-1", "client_secret": "app-secret",
+        "authority": base})
+    assert isinstance(signer, AzureKeyVaultSigner)
+    assert signer.alg == "RS256"
+
+
+def test_non_rsa_key_rejected(mock_vault):
+    srv, _ = mock_vault
+    router = srv.router
+
+    @router.get("/ec/keys/{name}")
+    def ec_key(req):
+        return {"key": {"kty": "EC", "kid": "k", "n": "", "e": ""}}
+
+    base = f"http://127.0.0.1:{srv.port}"
+    signer = AzureKeyVaultSigner(
+        f"{base}/ec", "p256", "tenant-1", "app-1", "app-secret",
+        authority=base, retry_attempts=0)
+    with pytest.raises(JWTError, match="EC"):
+        signer.sign(b"x")

@@ -119,6 +119,14 @@ def main() -> None:
         windows_per_dispatch=n_windows,
         admission_token_budget=int(os.environ.get("BENCH_ADMIT_TOKENS",
                                                   "16384")),
+        # Chunked-prefill piggybacking (prompts ≥ min_prompt ride the
+        # decode dispatches instead of stalling them in admission
+        # waves). BENCH_PIGGYBACK=0 restores the pure-wave path.
+        prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "64")),
+        prefill_rows=int(os.environ.get("BENCH_PREFILL_ROWS", "4")),
+        piggyback_min_prompt=(
+            10**9 if os.environ.get("BENCH_PIGGYBACK", "0") != "1"
+            else int(os.environ.get("BENCH_PIGGYBACK_MIN", "512"))),
     )
     log(f"engine built (random {model} weights, "
         f"{quantize or 'bf16'}) in {time.monotonic() - t0:.1f}s")

@@ -22,7 +22,6 @@ import dataclasses
 import json
 import pathlib
 import shutil
-from typing import Any
 
 import numpy as np
 

@@ -121,7 +121,8 @@ class AsyncEngineRunner:
         while True:
             with self._work:
                 while (not self._stop and not self._pending
-                       and not eng._active and not eng._queue):
+                       and not eng._active and not eng._queue
+                       and not getattr(eng, "_prefilling", None)):
                     self._work.wait(timeout=0.1)
                 if self._stop:
                     # Fail every outstanding handle promptly — a caller

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -43,8 +43,8 @@ from copilot_for_consensus_tpu.parallel.sharding import (
     shard_pytree,
 )
 
-try:  # NamedSharding only used when a mesh is provided
-    from jax.sharding import Mesh, NamedSharding
+try:  # jax.sharding only needed when a mesh is provided
+    from jax.sharding import Mesh
 except Exception:  # pragma: no cover
     Mesh = Any  # type: ignore
 
@@ -124,6 +124,10 @@ class GenerationEngine:
         admission_token_budget: int = 16384,
         admit_min_rows: int = 1,
         admit_max_wait_s: float = 0.5,
+        prefill_chunk: int = 64,
+        prefill_rows: int = 4,
+        piggyback_min_prompt: int = 10**9,
+        admit_hold_strict: bool = False,
         profile_dir: str | None = None,
     ):
         self.profile_dir = profile_dir
@@ -160,6 +164,36 @@ class GenerationEngine:
         # or the batch is fully drained) and admits them as one wave.
         self.admit_min_rows = max(1, admit_min_rows)
         self.admit_max_wait_s = admit_max_wait_s
+        #: strict hold: apply the admit_min_rows hysteresis even when
+        #: many slots are free. Bigger waves amortize the weight pass
+        #: better (measured 9.9k vs 7k prompt tok/s at 64- vs 33-row
+        #: waves); under heavy continuous load the idle-slot bypass
+        #: defeats the batching, so load-oriented deployments set this.
+        self.admit_hold_strict = admit_hold_strict
+        # Chunked-prefill piggybacking: prompts in
+        # [piggyback_min_prompt, decode_window*prefill_chunk] skip the
+        # monolithic admission wave and ride the decode dispatches,
+        # prefill_chunk tokens per decode step across prefill_rows
+        # packed lanes — prefill FLOPs overlapping the bandwidth-bound
+        # decode stream. OPT-IN (default off): on this toolchain the
+        # piggyback program's structural costs (static P*C row padding
+        # in every matmul, ~65 µs per pallas call, scan-carry buffer
+        # rematerialization, no donation aliasing) measured above the
+        # overlap gain in every serving shape tried — an EMPTY chunk
+        # grid added +1.0 s to a 0.78 s dispatch — so the wave path
+        # stays the default. The machinery is kept correct (oracle
+        # tests vs the wave path) for backends where dispatch is
+        # cheaper; full measurements in docs/PERF.md (r4 study).
+        # Requires single-window dispatches and a dense model with no
+        # sliding window narrower than the cache.
+        self.prefill_chunk = max(1, prefill_chunk)
+        self.prefill_rows = max(1, prefill_rows)
+        self.piggyback_min_prompt = piggyback_min_prompt
+        self._piggyback_ok = (
+            self.windows_per_dispatch == 1 and not cfg.is_moe
+            and (cfg.sliding_window == 0
+                 or cfg.sliding_window >= self.max_len))
+        self._prefilling: list[tuple[Request, float]] = []  # packer feed
         self._dispatch_steps = self.decode_window * self.windows_per_dispatch
         if self.max_len - self._dispatch_steps < 1:
             raise ValueError(
@@ -348,11 +382,90 @@ class GenerationEngine:
         self._decode_fn = jax.jit(_decode, donate_argnums=(3,),
                                   static_argnames=("kv_len", "n_windows"))
 
+        def _decode_piggyback(params, tokens, positions, cache, key,
+                              pre_tokens, pre_rope_base, pre_kv_begin,
+                              pre_kv_len, pre_sel_rel, pre_sel_w,
+                              pre_sel_p, pre_sidx, pre_pidx, *, kv_len):
+            """One decode window where every step also prefills C-token
+            chunks for P packed lanes (chunked-prefill piggybacking;
+            see ``decoder.decode_step_piggyback``). All packing
+            metadata is host-built (``_pack_prefill``): per-step arrays
+            [W, P] scan alongside the step index; the completion list
+            (sel_w, sel_p — up to W*P rows may finish per dispatch) and
+            the buffer→cache scatter maps are dispatch-level. Chunk KV
+            accumulates in dispatch buffers carried like the decode
+            window buffers and merges into the cache once; first tokens
+            for every completed row are sampled at the end from the
+            gathered last-position hidden states."""
+            w_sz = self.decode_window
+            n_l = cfg.n_layers
+            b = tokens.shape[0]
+            p, chunk = pre_tokens.shape[1], pre_tokens.shape[2]
+            win_shape = (n_l, b, cfg.n_kv_heads, w_sz, cfg.head_dim)
+            buf_shape = (n_l, p, cfg.n_kv_heads, w_sz * chunk,
+                         cfg.head_dim)
+
+            def body(carry, scanned):
+                tok, k_win, v_win, kbuf, vbuf, key = carry
+                w, pre_tok_w, rope_b, kv_b, kv_l, sel_r = scanned
+                key, sub = jax.random.split(key)
+                (logits, k_cols, v_cols, pre_k, pre_v,
+                 h_step) = decoder.decode_step_piggyback(
+                    params, tok, positions, w, cfg, cache, k_win,
+                    v_win, pre_tok_w, rope_b, kv_b, kv_l, sel_r,
+                    kbuf, vbuf, kv_len=kv_len)
+                k_win = jax.lax.dynamic_update_slice_in_dim(
+                    k_win, k_cols[:, :, :, None].astype(k_win.dtype),
+                    w, axis=3)
+                v_win = jax.lax.dynamic_update_slice_in_dim(
+                    v_win, v_cols[:, :, :, None].astype(v_win.dtype),
+                    w, axis=3)
+                kbuf = jax.lax.dynamic_update_slice_in_dim(
+                    kbuf, pre_k.astype(kbuf.dtype), w * chunk, axis=3)
+                vbuf = jax.lax.dynamic_update_slice_in_dim(
+                    vbuf, pre_v.astype(vbuf.dtype), w * chunk, axis=3)
+                nxt = sample(logits, sub, self.sampling)
+                return (nxt, k_win, v_win, kbuf, vbuf, key), (nxt,
+                                                              h_step)
+
+            carry0 = (tokens,
+                      jnp.zeros(win_shape, self.kv_dtype),
+                      jnp.zeros(win_shape, self.kv_dtype),
+                      jnp.zeros(buf_shape, self.kv_dtype),
+                      jnp.zeros(buf_shape, self.kv_dtype),
+                      key)
+            (tok, k_win, v_win, kbuf, vbuf, key), (toks, h_all) = \
+                jax.lax.scan(body, carry0,
+                             (jnp.arange(w_sz), pre_tokens,
+                              pre_rope_base, pre_kv_begin, pre_kv_len,
+                              pre_sel_rel))
+            new_cache = decoder.merge_window(cache, k_win, v_win,
+                                            positions, steps=w_sz)
+            new_cache = decoder.merge_prefill(new_cache, kbuf, vbuf,
+                                              pre_sidx, pre_pidx)
+            # first tokens for completed rows: gather [M, D] hidden
+            # states at the host-chosen (step, lane) completion points
+            h_sel = h_all[pre_sel_w, pre_sel_p]            # [M, D]
+            first_logits = decoder._unembed(
+                h_sel[:, None, :], params, cfg)[:, 0]
+            key, sub = jax.random.split(key)
+            first = sample(first_logits, sub, self.sampling)
+            return toks, first, new_cache
+
+        self._piggy_fn = jax.jit(_decode_piggyback, donate_argnums=(3,),
+                                 static_argnames=("kv_len",))
+
         # ---- host-side slot state --------------------------------------
         self._free = list(range(num_slots))
         self._active: dict[int, Request] = {}          # slot → request
         self._generated: dict[int, list[int]] = {}     # slot → new tokens
-        self._positions = np.zeros(num_slots, dtype=np.int32)
+        # Free/prefilling slots park at position max_len (out of range):
+        # every decode dispatch advances ALL rows and merges their
+        # garbage KV at positions0+w — an in-range stale position would
+        # let a freed slot's garbage overwrite a piggyback-prefilling
+        # occupant's freshly written timeline.
+        self._positions = np.full(num_slots, self.max_len,
+                                  dtype=np.int32)
         self._next_tok = np.zeros(num_slots, dtype=np.int32)
         self._t_prefill: dict[int, float] = {}
         self._queue: list[Request] = []
@@ -362,6 +475,15 @@ class GenerationEngine:
         #: insert + first-token sync) since engine build — benches
         #: snapshot it around a run to split admission from decode.
         self.admitted_s = 0.0
+        #: dispatch accounting (benches read these to see where the
+        #: time went): piggybacked vs plain decode dispatches, and how
+        #: many prompt tokens / rows rode the piggyback path
+        self.piggy_s = 0.0
+        self.piggy_dispatches = 0
+        self.plain_s = 0.0
+        self.plain_dispatches = 0
+        self.piggy_rows = 0
+        self.piggy_tokens = 0
 
     # ------------------------------------------------------------------
     # public API
@@ -416,7 +538,7 @@ class GenerationEngine:
         """Admit queued requests into free slots, run one decode step for
         all active slots, retire finished ones. Returns completions."""
         self._admit()
-        if self._active:
+        if self._active or self._prefilling:
             self._decode_once()
         return self._drain_done()
 
@@ -444,7 +566,7 @@ class GenerationEngine:
 
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._prefilling)
 
     @property
     def active_count(self) -> int:
@@ -464,8 +586,44 @@ class GenerationEngine:
         tokens."""
         if not (self._queue and self._free):
             return
+        if self._piggyback_ok:
+            # Eligible prompts ride the decode dispatches chunk by
+            # chunk (_decode_once) INSTEAD of a monolithic wave — up to
+            # ~two dispatches' worth of backlog, the piggyback grid's
+            # absorption rate. Beyond that the wave takes the overflow:
+            # bulk cold-start admission is MXU-bound either way and the
+            # wave's big matmuls do it at the best rate (measured on
+            # the one-shot 32×2048 batch), while a steady trickle rides
+            # the dispatches nearly free (measured: +0.18 s per
+            # dispatch carrying 8192 prompt tokens vs 0.77 s as a
+            # standalone wave). The backlog bound makes the policy
+            # self-balancing with no occupancy heuristics.
+            cap = self.decode_window * self.prefill_chunk
+            budget = 2 * cap * self.prefill_rows - sum(
+                len(r.prompt) for r, _ in self._prefilling)
+            keep = []
+            for req in self._queue:
+                plen = len(req.prompt)
+                if (self.piggyback_min_prompt <= plen <= cap
+                        and plen <= budget):
+                    # whole prompts only: the packer places each row as
+                    # one consecutive chunk run inside a single
+                    # dispatch, so its kv never straddles buffers. NO
+                    # slot yet — slots are taken at PACK time, so a
+                    # slot is only occupied during the dispatch that
+                    # prefills it (binding at admit time measured ~2
+                    # dispatches of per-slot idleness under Poisson
+                    # load, which ate the whole piggyback win).
+                    self._prefilling.append((req, time.monotonic()))
+                    budget -= plen
+                else:
+                    keep.append(req)
+            self._queue = keep
+            if not (self._queue and self._free):
+                return
         if (len(self._queue) < self.admit_min_rows
-                and len(self._free) * 4 <= self.num_slots
+                and (self.admit_hold_strict
+                     or len(self._free) * 4 <= self.num_slots)
                 and (time.monotonic() - self._queue[0].submitted_at
                      < self.admit_max_wait_s)):
             # Let the wave fill while decode keeps running — but only
@@ -529,9 +687,12 @@ class GenerationEngine:
         decode programs ever compile. The dispatch's own fresh KV lives
         in the window/done buffers until the final merge, so the extent
         covers only what was in the cache BEFORE the dispatch."""
-        if not self._active:
+        # piggyback-prefilling rows have no cache prefix (whole rows
+        # pack into one dispatch), so only active decode positions
+        # constrain the extent
+        hi = max([int(self._positions[s]) for s in self._active] + [0])
+        if hi == 0:
             return min(128, self.max_len)
-        hi = max(int(self._positions[s]) for s in self._active)
         bucket = min(-(-(hi + 1) // 128) * 128, self.max_len)
         # A bucket below the full extent makes the decode program slice
         # the cache's sequence axis — a STRIDED slice XLA materializes
@@ -545,17 +706,29 @@ class GenerationEngine:
     def _decode_once(self) -> None:
         window = self._dispatch_steps
         self._key, sub = jax.random.split(self._key)
-        toks, self._cache = self._decode_fn(
-            self.params,
-            jnp.asarray(self._next_tok),
-            jnp.asarray(self._positions),
-            self._cache,
-            sub,
-            kv_len=self._kv_bucket(),
-            n_windows=self.windows_per_dispatch,
-        )
-        toks = np.asarray(jax.device_get(toks))  # [dispatch_steps, slots]
-        for slot, req in list(self._active.items()):
+        # Snapshot BEFORE dispatch: rows the piggyback path activates
+        # mid-call were prefilling during this window — their decode
+        # lanes carried garbage and must not be harvested this round.
+        active_before = list(self._active.items())
+        t0 = time.monotonic()
+        if self._prefilling and self._free:
+            toks = self._dispatch_piggyback(sub)
+            self.piggy_s += time.monotonic() - t0
+            self.piggy_dispatches += 1
+        else:
+            toks, self._cache = self._decode_fn(
+                self.params,
+                jnp.asarray(self._next_tok),
+                jnp.asarray(self._positions),
+                self._cache,
+                sub,
+                kv_len=self._kv_bucket(),
+                n_windows=self.windows_per_dispatch,
+            )
+            toks = np.asarray(jax.device_get(toks))  # [steps, slots]
+            self.plain_s += time.monotonic() - t0
+            self.plain_dispatches += 1
+        for slot, req in active_before:
             gen = self._generated[slot]
             finished = None
             for step in range(window):
@@ -577,7 +750,107 @@ class GenerationEngine:
             if finished:
                 self._retire(slot, finished)
 
+    def _pack_prefill(self):
+        """Pack whole pending prompts into the W×P chunk grid.
+
+        Each selected row occupies one consecutive run of steps in one
+        lane (its buffer span is contiguous, so the flash begin/length
+        bounds describe it exactly). First-fit over lanes; rows that
+        don't fit wait for the next dispatch. Returns the per-step
+        metadata arrays, the completion list, the buffer→cache scatter
+        maps, and the selected (slot, req, started, lane, end_step)
+        rows — everything ``_piggy_fn`` needs, all host-built.
+        """
+        w_sz, chunk = self.decode_window, self.prefill_chunk
+        p = self.prefill_rows
+        buf = w_sz * chunk
+        m_sel = w_sz * p                       # max completions
+        pre_tok = np.zeros((w_sz, p, chunk), dtype=np.int32)
+        rope_base = np.zeros((w_sz, p), dtype=np.int32)
+        kv_begin = np.full((w_sz, p), buf, dtype=np.int32)   # idle: all
+        kv_len = np.zeros((w_sz, p), dtype=np.int32)         # masked
+        sel_rel = np.zeros((w_sz, p), dtype=np.int32)
+        sel_w = np.zeros(m_sel, dtype=np.int32)
+        sel_p = np.zeros(m_sel, dtype=np.int32)
+        sidx = np.full((p, buf), self.num_slots, dtype=np.int32)  # OOB
+        pidx = np.full((p, buf), self.max_len, dtype=np.int32)
+        lane_next = [0] * p
+        placed = []
+        deferred = []
+        for req, started in self._prefilling:
+            plen = len(req.prompt)
+            steps = -(-plen // chunk)
+            lane = min(range(p), key=lambda i: lane_next[i])
+            if lane_next[lane] + steps > w_sz or not self._free:
+                deferred.append((req, started))
+                continue                        # wait for next dispatch
+            slot = self._free.pop(0)
+            s0 = lane_next[lane]
+            lane_next[lane] = s0 + steps
+            flat = np.zeros(steps * chunk, dtype=np.int32)
+            flat[:plen] = req.prompt
+            pre_tok[s0:s0 + steps, lane] = flat.reshape(steps, chunk)
+            rope_base[s0:s0 + steps, lane] = np.arange(steps) * chunk
+            kv_begin[s0:s0 + steps, lane] = s0 * chunk
+            kv_len[s0:s0 + steps, lane] = s0 * chunk + np.minimum(
+                (np.arange(steps) + 1) * chunk, plen)
+            end = s0 + steps - 1
+            sel_rel[end, lane] = (plen - 1) % chunk
+            sel_w[len(placed)] = end
+            sel_p[len(placed)] = lane
+            sidx[lane, s0 * chunk:s0 * chunk + plen] = slot
+            pidx[lane, s0 * chunk:s0 * chunk + plen] = np.arange(plen)
+            placed.append((slot, req, started, len(placed)))
+            self.piggy_rows += 1
+            self.piggy_tokens += plen
+        self._prefilling = deferred
+        return (pre_tok, rope_base, kv_begin, kv_len, sel_rel, sel_w,
+                sel_p, sidx, pidx, placed)
+
+    def _dispatch_piggyback(self, key) -> np.ndarray:
+        """One decode window with packed prefill chunks riding it.
+        Returns the decoded tokens [window, slots]; completed prompts
+        are activated into their slots here."""
+        (pre_tok, rope_base, kv_begin, kv_len, sel_rel, sel_w, sel_p,
+         sidx, pidx, placed) = self._pack_prefill()
+        toks_dev, first_dev, self._cache = self._piggy_fn(
+            self.params,
+            jnp.asarray(self._next_tok),
+            jnp.asarray(self._positions),
+            self._cache,
+            key,
+            jnp.asarray(pre_tok),
+            jnp.asarray(rope_base),
+            jnp.asarray(kv_begin),
+            jnp.asarray(kv_len),
+            jnp.asarray(sel_rel),
+            jnp.asarray(sel_w),
+            jnp.asarray(sel_p),
+            jnp.asarray(sidx),
+            jnp.asarray(pidx),
+            kv_len=self._kv_bucket(),
+        )
+        toks = np.asarray(jax.device_get(toks_dev))
+        first = np.asarray(jax.device_get(first_dev))
+        now = time.monotonic()
+        for slot, req, started, i in placed:
+            # every placed row completed (whole prompts only); its
+            # first generated token was sampled in-program from the
+            # last prompt position
+            tok = int(first[i])
+            self._active[slot] = req
+            self._generated[slot] = [tok]
+            self._positions[slot] = len(req.prompt)
+            self._next_tok[slot] = tok
+            self._t_prefill[slot] = now - started
+            req.decode_started_at = now
+            if tok in self._eos_set or req.max_new_tokens <= 1:
+                self._retire(slot,
+                             "eos" if tok in self._eos_set else "length")
+        return toks
+
     def _retire(self, slot: int, reason: str) -> None:
+        self._positions[slot] = self.max_len   # park OOB (see __init__)
         req = self._active.pop(slot)
         gen = self._generated.pop(slot)
         if gen and gen[-1] in self._eos_set:

@@ -21,7 +21,6 @@ from copilot_for_consensus_tpu.gateway.base import (
     INTERNAL_PATHS,
     GatewayAdapter,
     path_regex,
-    routes_from_spec,
 )
 
 

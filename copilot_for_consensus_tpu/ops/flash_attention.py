@@ -26,6 +26,8 @@ NEG_INF = -1e30
 
 def _flash_kernel(
     len_ref,      # SMEM [B]            valid kv length per batch row
+    off_ref,      # SMEM [B]            query position offset per row
+    begin_ref,    # SMEM [B]            first valid kv position per row
     q_ref,        # VMEM [1, 1, bq, d]
     k_ref,        # VMEM [1, 1, bk, d]
     v_ref,        # VMEM [1, 1, bk, d]
@@ -51,13 +53,22 @@ def _flash_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q_start = qi * bq
+    # q_offsets place the query block inside the kv timeline (chunked
+    # prefill: C fresh queries at the end of a growing kv run);
+    # kv_begins exclude a kv PREFIX (lane packing: earlier rows'
+    # chunks in the same dispatch buffer). Dynamic (SMEM) because both
+    # advance every engine scan step.
+    q_off = off_ref[bi]
+    kv_begin = begin_ref[bi]
+    q_start = qi * bq + q_off
     k_start = ki * bk
-    # Whole kv block beyond the causal frontier (or before the window) is
-    # skipped — with kv innermost this prunes ~half the work for causal.
-    in_range = True
+    # Whole kv block beyond the causal frontier, before the begin
+    # bound, or before the window is skipped — with kv innermost this
+    # prunes the dead work.
+    in_range = k_start + bk - 1 >= kv_begin
     if causal:
-        in_range = k_start <= q_start + bq - 1
+        in_range = jnp.logical_and(in_range,
+                                   k_start <= q_start + bq - 1)
     if window > 0:
         in_range = jnp.logical_and(
             in_range, k_start + bk - 1 > q_start - window
@@ -74,7 +85,7 @@ def _flash_kernel(
 
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = k_pos < len_ref[bi]
+        mask = (k_pos < len_ref[bi]) & (k_pos >= kv_begin)
         if causal:
             mask &= k_pos <= q_pos
         if window > 0:
@@ -113,30 +124,49 @@ def flash_attention(
     causal: bool = True,
     window: int = 0,
     kv_lengths: jax.Array | None = None,
+    q_offsets: jax.Array | None = None,
+    kv_begins: jax.Array | None = None,
     block_q: int = 256,
     block_kv: int = 256,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """q: [B, Hq, S, D], k/v: [B, Hkv, S, D] → [B, Hq, S, D]."""
-    b, hq, s, d = q.shape
-    hkv = k.shape[1]
+    """q: [B, Hq, Sq, D], k/v: [B, Hkv, Skv, D] → [B, Hq, Sq, D].
+
+    ``Sq`` and ``Skv`` may differ; ``q_offsets`` [B] (dynamic) places
+    each row's query block at an offset in the kv timeline — query i is
+    position ``q_offsets[b] + i`` for causal/window masking. This is
+    what lets a chunked prefill run its C fresh queries against the
+    full run of already-written kv with flash tiling instead of a
+    materialized [C, Skv] score tensor. ``kv_begins`` [B] (dynamic)
+    masks a kv PREFIX per row (positions < begin never attend) — lane
+    packing puts several rows' chunks in one dispatch buffer, and a
+    row must not see its predecessors'.
+    """
+    b, hq, s_q_in, d = q.shape
+    hkv, s_kv_in = k.shape[1], k.shape[2]
     group = hq // hkv
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    bq = min(block_q, s)
-    bk = min(block_kv, s)
-    pad_q = (-s) % bq
-    pad_k = (-s) % bk
-    s_q, s_kv = s + pad_q, s + pad_k
+    bq = min(block_q, s_q_in)
+    bk = min(block_kv, s_kv_in)
+    pad_q = (-s_q_in) % bq
+    pad_k = (-s_kv_in) % bk
+    s_q, s_kv = s_q_in + pad_q, s_kv_in + pad_k
     if pad_q:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
     if pad_k:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
     if kv_lengths is None:
-        kv_lengths = jnp.full((b,), s, dtype=jnp.int32)
+        kv_lengths = jnp.full((b,), s_kv_in, dtype=jnp.int32)
     kv_lengths = kv_lengths.astype(jnp.int32)
+    if q_offsets is None:
+        q_offsets = jnp.zeros((b,), dtype=jnp.int32)
+    q_offsets = q_offsets.astype(jnp.int32)
+    if kv_begins is None:
+        kv_begins = jnp.zeros((b,), dtype=jnp.int32)
+    kv_begins = kv_begins.astype(jnp.int32)
 
     grid = (b, hq, s_q // bq, s_kv // bk)
     out = pl.pallas_call(
@@ -146,9 +176,13 @@ def flash_attention(
         ),
         grid=grid,
         in_specs=[
-            # whole lengths vector in SMEM; indexed by program_id(0) in
-            # the kernel (a rank-1 block of 1 over [B] is rejected by the
-            # TPU lowering's tiling rules when B > 1)
+            # whole lengths/offsets vectors in SMEM; indexed by
+            # program_id(0) in the kernel (a rank-1 block of 1 over [B]
+            # is rejected by the TPU lowering's tiling rules when B > 1)
+            pl.BlockSpec((b,), lambda bi, hi, qi, ki: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((b,), lambda bi, hi, qi, ki: (0,),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((b,), lambda bi, hi, qi, ki: (0,),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, bq, d),
@@ -167,5 +201,5 @@ def flash_attention(
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
-    )(kv_lengths, q, k, v)
-    return out[:, :, :s, :]
+    )(kv_lengths, q_offsets, kv_begins, q, k, v)
+    return out[:, :, :s_q_in, :]

@@ -33,9 +33,9 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from copilot_for_consensus_tpu.models import decoder, layers as L
+from copilot_for_consensus_tpu.models import decoder
 from copilot_for_consensus_tpu.models.configs import DecoderConfig
 from copilot_for_consensus_tpu.parallel.sharding import (
     DEFAULT_RULES,

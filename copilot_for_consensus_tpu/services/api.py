@@ -14,7 +14,6 @@ when enabled.
 from __future__ import annotations
 
 import base64
-from typing import Any
 
 from copilot_for_consensus_tpu.services.http import (
     HTTPError,

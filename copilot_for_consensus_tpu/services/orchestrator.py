@@ -18,8 +18,7 @@ encoder) instead of the neutral-score 0.5 doc-store fallback
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any
+from dataclasses import dataclass
 
 from copilot_for_consensus_tpu.core import events as ev
 from copilot_for_consensus_tpu.core.ids import generate_summary_id

@@ -19,7 +19,6 @@ from copilot_for_consensus_tpu.archive.base import ArchiveStore
 from copilot_for_consensus_tpu.core import events as ev
 from copilot_for_consensus_tpu.core.ids import (
     generate_message_doc_id,
-    generate_thread_id,
 )
 from copilot_for_consensus_tpu.core.retry import DocumentNotFoundError
 from copilot_for_consensus_tpu.services.base import BaseService

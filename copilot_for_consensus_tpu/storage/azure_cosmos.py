@@ -34,7 +34,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from email.utils import formatdate
-from typing import Any, Iterable, Mapping, Sequence
+from typing import Any, Mapping
 
 from copilot_for_consensus_tpu.storage import registry
 from copilot_for_consensus_tpu.storage.base import (

@@ -9,7 +9,6 @@ participants, message_count, subject).
 
 from __future__ import annotations
 
-from typing import Any
 
 from copilot_for_consensus_tpu.summarization.base import (
     Summarizer,

@@ -15,7 +15,7 @@ import mailbox
 import pathlib
 import tempfile
 from dataclasses import dataclass, field
-from datetime import datetime, timezone
+from datetime import timezone
 from typing import Iterator
 
 

@@ -24,7 +24,7 @@ machinery will requeue.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics

@@ -65,6 +65,20 @@ def main() -> None:
         admit_min_rows=int(os.environ.get("BENCH_ADMIT_MIN_ROWS", "1")),
         admit_max_wait_s=float(os.environ.get("BENCH_ADMIT_MAX_WAIT",
                                               "1.5")),
+        admit_hold_strict=os.environ.get("BENCH_ADMIT_STRICT",
+                                         "0") == "1",
+        # chunked-prefill piggybacking: short prompts pack into the
+        # decode dispatches' chunk lanes instead of stalling decode in
+        # admission waves (BENCH_PIGGYBACK=0 restores pure waves)
+        # C=32 sizes the chunk grid (W*C*P = 4096 tokens/dispatch) so
+        # its flops just fill the decode bandwidth floor at this load;
+        # an oversized grid pays its padding flops whether or not
+        # arrivals fill it (measured: empty 8192 grid = +1.0 s/dispatch)
+        prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "32")),
+        prefill_rows=int(os.environ.get("BENCH_PREFILL_ROWS", "4")),
+        piggyback_min_prompt=(
+            10**9 if os.environ.get("BENCH_PIGGYBACK", "0") != "1"
+            else int(os.environ.get("BENCH_PIGGYBACK_MIN", "64"))),
         seed=0)
 
     rng = np.random.default_rng(0)
@@ -123,6 +137,11 @@ def main() -> None:
     elapsed = time.monotonic() - t_start
     runner.stop()
 
+    print(f"dispatches: piggy {eng.piggy_dispatches} "
+          f"({eng.piggy_s:.1f}s, {eng.piggy_rows} rows / "
+          f"{eng.piggy_tokens} prompt tokens), plain "
+          f"{eng.plain_dispatches} ({eng.plain_s:.1f}s), waves "
+          f"{eng.admitted_s:.1f}s", file=sys.stderr)
     tok_s = served_tokens / elapsed
     frac = tok_s / args.batch_tok_s
     lat_arr = np.asarray(sorted(lat)) if lat else np.asarray([0.0])

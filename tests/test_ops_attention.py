@@ -119,3 +119,28 @@ def test_prefix_window_decode_matches_contiguous(window):
         w=jnp.int32(w), window=window)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_flash_q_offsets_match_xla_oracle():
+    """Distinct Sq/Skv with per-row dynamic query offsets (the chunked-
+    prefill shape) must match the XLA reference with q_offset."""
+    import numpy as np
+
+    from copilot_for_consensus_tpu.ops.attention import attention_xla
+    from copilot_for_consensus_tpu.ops.flash_attention import flash_attention
+
+    rng = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(rng, 3)
+    b, hq, hkv, c, s_kv, d = 3, 4, 2, 8, 64, 16
+    q = jax.random.normal(kq, (b, hq, c, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hkv, s_kv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hkv, s_kv, d), jnp.float32)
+    offset = 24                      # queries sit at positions 24..31
+    lengths = jnp.asarray([32, 29, 25])
+    got = flash_attention(q, k, v, causal=True, kv_lengths=lengths,
+                          q_offsets=jnp.full((b,), offset),
+                          block_q=8, block_kv=16)
+    want = attention_xla(q, k, v, causal=True, q_offset=offset,
+                         kv_lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)

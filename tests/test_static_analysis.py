@@ -1,0 +1,77 @@
+# The first-party static-analysis lane must stay green AND keep
+# catching what it claims to catch (a policy that can't fail is not a
+# policy — same spirit as the fuzzer's seeded-bug effectiveness proof).
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+SCRIPT = ROOT / "scripts" / "validate_python.py"
+
+sys.path.insert(0, str(ROOT / "scripts"))
+import validate_python as vp  # noqa: E402
+
+
+def test_repo_is_clean_fast():
+    """Syntax + AST policies hold over the whole source tree (the
+    import-smoke stage runs in CI's dedicated lint job; the suite
+    itself already imports everything)."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--fast"], cwd=ROOT,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.parametrize("snippet,expect", [
+    ("def f(x=[]):\n    return x\n", "mutable default"),
+    ("def f(x={'a': 1}):\n    return x\n", "mutable default"),
+    ("try:\n    pass\nexcept:\n    pass\n", "bare 'except:'"),
+    ("import json\nimport os\nprint(os.name)\n", "unused import 'json'"),
+    ("def f(:\n    pass\n", "syntax"),
+])
+def test_lane_catches_seeded_bugs(tmp_path, snippet, expect):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(textwrap.dedent(snippet))
+    errs = (vp.check_syntax([bad]) if expect == "syntax" else
+            vp.check_syntax([bad])
+            + vp.check_mutable_defaults([bad])
+            + vp.check_bare_except([bad])
+            + vp.check_unused_imports([bad]))
+    assert any(expect in e for e in errs), errs
+
+
+def test_lane_exemptions_hold(tmp_path):
+    """noqa lines, __all__ strings, and used imports must NOT flag."""
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import json  # noqa: used by doctest\n"
+        "import os\n"
+        "__all__ = ['os']\n"
+        "print(os.name)\n")
+    assert vp.check_unused_imports([ok]) == []
+
+
+def test_syntax_error_reported_not_crashing(tmp_path):
+    """A file with a syntax error must yield ONE syntax finding from
+    the whole lane, never an unhandled SyntaxError out of main()."""
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n    pass\n")
+    errs = (vp.check_syntax([bad]) + vp.check_mutable_defaults([bad])
+            + vp.check_bare_except([bad])
+            + vp.check_unused_imports([bad]))
+    assert len(errs) == 1 and "syntax" in errs[0]
+
+
+def test_constructor_call_defaults_flagged(tmp_path):
+    bad = tmp_path / "ctor.py"
+    bad.write_text("def f(x=list(), y=dict()):\n    return x, y\n")
+    errs = vp.check_mutable_defaults([bad])
+    assert len(errs) == 2
+    # frozen-config style defaults (arbitrary constructor calls) pass:
+    # only the builtin mutable containers are the documented class
+    ok = tmp_path / "cfg.py"
+    ok.write_text("def f(x=Config()):\n    return x\n")
+    assert vp.check_mutable_defaults([ok]) == []

@@ -71,6 +71,47 @@ class ParsingService(BaseService):
             generate_message_doc_id(archive_id, msg.message_id, idx)
             for idx, msg in enumerate(parsed)
         ]
+        # Thread documents FIRST, message events after: every JSONParsed
+        # event fans out to consumers that will resolve the message's
+        # thread doc (the orchestrator hard-requires it). Publishing the
+        # per-message events before the archive's thread docs existed
+        # opened a race as long as the whole archive's parse (~minutes
+        # for a 2,500-message archive on a small host) — far beyond the
+        # retry budget; diagnosed from the r3 scale run's 313
+        # DocumentNotFoundError("thread ... not in store") exhaustions
+        # (red artifact preserved at docs/artifacts/SCALE_BROKER_r3
+        # .json; the current SCALE_BROKER.json is the green rerun with
+        # this fix). Docs-before-events is the
+        # same crash-consistency ordering the startup requeue assumes.
+        for tid, th in threads.items():
+            members = [parsed[i] for i in th.message_indices]
+            draft_mentions = sorted({
+                d for m in members
+                for d in detect_draft_mentions(m.body_raw)})
+            # upsert REPLACES the document: carry over the recovery
+            # spine's fields so an archive redelivery can't wipe a
+            # thread's summary link or reset its retry budget
+            prev = self.store.get_document("threads", tid) or {}
+            carried = {k: prev[k] for k in
+                       ("summary_id", "attempt_count", "last_attempt_at")
+                       if k in prev}
+            self.store.upsert_document("threads", {
+                **carried,
+                "parsed_at": prev.get("parsed_at") or _now_iso(),
+                "thread_id": tid,
+                "archive_ids": [archive_id],
+                "source_id": source_id,
+                "subject": th.subject,
+                "root_message_id": th.root_message_id,
+                "message_ids": [m.message_id for m in members],
+                "message_doc_ids": [doc_ids[i] for i in th.message_indices],
+                "participants": th.participants,
+                "message_count": len(members),
+                "first_message_date": th.first_date,
+                "last_message_date": th.last_date,
+                "draft_mentions": draft_mentions,
+            })
+
         published = 0
         for idx, msg in enumerate(parsed):
             doc_id = doc_ids[idx]
@@ -99,26 +140,6 @@ class ParsingService(BaseService):
                     message_doc_id=doc_id, archive_id=archive_id,
                     thread_id=thread_id, correlation_id=correlation_id))
                 published += 1
-
-        for tid, th in threads.items():
-            members = [parsed[i] for i in th.message_indices]
-            draft_mentions = sorted({
-                d for m in members
-                for d in detect_draft_mentions(m.body_raw)})
-            self.store.upsert_document("threads", {
-                "thread_id": tid,
-                "archive_ids": [archive_id],
-                "source_id": source_id,
-                "subject": th.subject,
-                "root_message_id": th.root_message_id,
-                "message_ids": [m.message_id for m in members],
-                "message_doc_ids": [doc_ids[i] for i in th.message_indices],
-                "participants": th.participants,
-                "message_count": len(members),
-                "first_message_date": th.first_date,
-                "last_message_date": th.last_date,
-                "draft_mentions": draft_mentions,
-            })
 
         self.store.update_document("archives", archive_id, {
             "parsed": True,

@@ -65,7 +65,29 @@ def default_rules() -> list[RetryRule]:
                 archive_id=d.get("archive_id", ""),
                 chunk_ids=[d["chunk_id"]]),
             max_attempts=5),
+        threads_recovery_rule(),
     ]
+
+
+def threads_recovery_rule() -> RetryRule:
+    """Summarization stage: a thread without a stored summary is stuck.
+
+    This is the recovery spine the PIPELINED summarizer leans on (it
+    acks the bus BEFORE the summary is durable, so a crash between
+    engine ack and report store loses the summary with no redelivery).
+    Re-orchestrating is idempotent: the deterministic summary id
+    dedupes an unchanged context (and the dedup branch backfills the
+    thread's ``summary_id`` link if only THAT write was lost), and the
+    summarizer skips summaries that already exist. The ONE definition —
+    the orchestrator's startup requeue uses it too, so the cron rule
+    and the boot path cannot drift. Age anchors on the thread doc's
+    ``parsed_at`` (set at parse time), so healthy mid-pipeline threads
+    are not churned before ``min_stuck_seconds``.
+    """
+    return RetryRule(
+        "threads", {"summary_id": {"$exists": False}},
+        lambda d: ev.EmbeddingsGenerated(thread_ids=[d["thread_id"]]),
+        max_attempts=5)
 
 
 def pending_counts(store: Any,

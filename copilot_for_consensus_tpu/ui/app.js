@@ -83,7 +83,7 @@ async function pageLogin() {
   // /auth/login initiates the PKCE flow and returns {state, authorize_url};
   // the callback only accepts a server-issued state.
   const initiate = (provider) =>
-    api(`/auth/login?provider=${provider}&redirect_uri=` +
+    api(`/auth/login?provider=${encodeURIComponent(provider)}&redirect_uri=` +
         encodeURIComponent(location.origin + "/?from=oidc"));
   $("#mock-form").onsubmit = async (ev) => {
     ev.preventDefault();
@@ -310,11 +310,11 @@ async function pageSources() {
         b.onclick = async () => {
           try {
             if (b.dataset.act === "trigger") {
-              const out = await api(`/api/sources/${b.dataset.id}/trigger`, { method: "POST" });
+              const out = await api(`/api/sources/${encodeURIComponent(b.dataset.id)}/trigger`, { method: "POST" });
               b.textContent = `Ingested ${out.ingested_archives}`;
               setTimeout(() => (b.textContent = "Trigger"), 2500);
             } else if (confirm(`Delete source ${b.dataset.id} and all derived documents?`)) {
-              await api(`/api/sources/${b.dataset.id}`, { method: "DELETE" }); reload();
+              await api(`/api/sources/${encodeURIComponent(b.dataset.id)}`, { method: "DELETE" }); reload();
             }
           } catch (e) { err(e); }
         };

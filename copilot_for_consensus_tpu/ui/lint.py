@@ -1,0 +1,388 @@
+"""XSS-escape policy scanner for the vanilla-JS SPA.
+
+The reference's React routes get escaping from JSX for free and pin
+behavior with per-route ``*.test.tsx``. This SPA renders with template
+literals + ``innerHTML``, so escaping is a POLICY: every ``${...}``
+interpolation that can carry API data must pass through ``esc()`` (or
+another audited-safe form). This scanner enforces that policy and is
+run by ``tests/test_ui.py`` — dropping ``esc()`` from any interpolation
+fails CI (no JS runtime ships in this image, so the policy is enforced
+at the source level; behavioral coverage comes from the server-side
+integration tests next to it).
+
+A tiny tokenizer walks template literals (nesting included) and
+classifies each interpolation:
+
+* ``esc(...)``-wrapped (whole expression) — safe;
+* chained element-wise escapes like ``xs.map(esc).join(", ")`` — safe;
+* ``fmtDate(...)`` — safe (Date formatting of parsed input);
+* expressions whose every free data source is itself a nested template
+  literal (scanned recursively) or an explicitly SAFE_EXPR — audited
+  by hand; anything else is a finding.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+UI_DIR = pathlib.Path(__file__).resolve().parent
+
+#: hand-audited interpolations that do not need esc(): constants,
+#: control attributes built from literals, or values escaped
+#: element-wise inside a nested (recursively scanned) template.
+SAFE_EXPR = (
+    # pagination arithmetic over integers + the PAGE constant
+    re.compile(r"^offset(\s*[+\-]\s*(got|1|PAGE))?$"),
+    re.compile(r"^PAGE$"),
+    re.compile(r'^got \? `\$\{offset \+ 1\}–\$\{offset \+ got\}` : "end of list"$'),
+    # ternaries whose BOTH branches are string literals
+    re.compile(r"""^[^?`]*\?\s*(['"]).*?\1\s*:\s*(['"]).*?\2$"""),
+    # role checkbox values come from the ALL_ROLES constant
+    re.compile(r"^r$"),
+    # firstRunMsg is a call-site string literal
+    re.compile(r"^firstRunMsg$"),
+    # numeric: length of an array
+    re.compile(r"^\([^()]*\|\|\s*\[\]\)\.length$"),
+    # audited one-offs: textContent/selector/dialog contexts (NOT
+    # innerHTML — esc() there would show literal entities to the user)
+    re.compile(r"^out\.ingested_archives$"),   # textContent, numeric
+    re.compile(r"^name$"),                     # selector, literal arg
+    re.compile(r"^fetcher$"),                  # textContent, <select>
+    re.compile(r"^b\.dataset\.id$"),          # confirm() dialog text
+)
+
+#: escaping wrappers (esc for HTML, encodeURIComponent for the
+#: URL-building template literals, fmtDate for parsed dates)
+SAFE_WRAPPERS = ("esc", "fmtDate", "encodeURIComponent")
+
+
+def template_interpolations(src: str) -> list[tuple[int, str]]:
+    """Yield (line, expression) for every ``${...}`` inside every
+    template literal, including nested templates. The walker
+    understands just enough JS to stay in sync: quoted strings,
+    ``//``/``/* */`` comments, and regex literals (recognized by the
+    preceding token — a ``/`` after ``( = , : [ ! & | ? { ; return``
+    starts a regex, not a division)."""
+    out: list[tuple[int, str]] = []
+    n = len(src)
+
+    def skip_plain(i: int, line: int, stop: str) -> tuple[int, int, str]:
+        """Advance through code until one of ``stop`` chars at depth 0
+        of the constructs we understand; returns (i, line, char)."""
+        last_sig = ""                       # last significant char seen
+        while i < n:
+            c = src[i]
+            if c == "\n":
+                line += 1
+                i += 1
+                continue
+            if c in stop:
+                return i, line, c
+            if c in "\"'":
+                quote = c
+                i += 1
+                while i < n and src[i] != quote:
+                    if src[i] == "\\":
+                        i += 1
+                    elif src[i] == "\n":
+                        line += 1
+                    i += 1
+                i += 1
+                last_sig = quote
+                continue
+            if c == "/" and i + 1 < n and src[i + 1] == "/":
+                while i < n and src[i] != "\n":
+                    i += 1
+                continue
+            if c == "/" and i + 1 < n and src[i + 1] == "*":
+                i += 2
+                while i + 1 < n and not (src[i] == "*"
+                                         and src[i + 1] == "/"):
+                    if src[i] == "\n":
+                        line += 1
+                    i += 1
+                i += 2
+                last_sig = ""
+                continue
+            if c == "/" and last_sig in "(=,:[!&|?{;<>+-" + "":
+                # regex literal (expression position)
+                i += 1
+                in_class = False
+                while i < n:
+                    if src[i] == "\\":
+                        i += 1
+                    elif src[i] == "[":
+                        in_class = True
+                    elif src[i] == "]":
+                        in_class = False
+                    elif src[i] == "/" and not in_class:
+                        break
+                    elif src[i] == "\n":
+                        line += 1
+                    i += 1
+                i += 1
+                while i < n and src[i].isalpha():   # flags
+                    i += 1
+                last_sig = "/"
+                continue
+            if not c.isspace():
+                last_sig = c
+            i += 1
+        return i, line, ""
+
+    def scan_template(i: int, line: int) -> tuple[int, int]:
+        # called just past the opening backtick
+        while i < n:
+            c = src[i]
+            if c == "\n":
+                line += 1
+                i += 1
+                continue
+            if c == "\\":
+                i += 2
+                continue
+            if c == "`":
+                return i + 1, line
+            if c == "$" and i + 1 < n and src[i + 1] == "{":
+                j, jline = i + 2, line
+                expr_start = j
+                depth = 1
+                while j < n and depth:
+                    j, jline, ch = skip_plain(j, jline, "{}`")
+                    if ch == "{":
+                        depth += 1
+                        j += 1
+                    elif ch == "}":
+                        depth -= 1
+                        j += 1
+                    elif ch == "`":
+                        j, jline = scan_template(j + 1, jline)
+                    else:
+                        break
+                expr = src[expr_start:j - 1].strip()
+                out.append((line, expr))
+                i, line = j, jline
+                continue
+            i += 1
+        return i, line
+
+    i, line = 0, 1
+    while i < n:
+        i, line, ch = skip_plain(i, line, "`")
+        if ch != "`":
+            break
+        i, line = scan_template(i + 1, line)
+    return out
+
+
+def _skip_template(s: str, i: int) -> int:
+    """``s[i]`` is an opening backtick; returns the index just past the
+    matching closer, honoring escapes and ``${...}`` nesting."""
+    n = len(s)
+    i += 1
+    while i < n:
+        c = s[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == "`":
+            return i + 1
+        if c == "$" and i + 1 < n and s[i + 1] == "{":
+            depth, i = 1, i + 2
+            while i < n and depth:
+                if s[i] == "\\":
+                    i += 2
+                    continue
+                if s[i] == "`":
+                    i = _skip_template(s, i)
+                    continue
+                if s[i] == "{":
+                    depth += 1
+                elif s[i] == "}":
+                    depth -= 1
+                i += 1
+            continue
+        i += 1
+    return i
+
+
+def _strip_templates(s: str) -> str:
+    """Replace every top-level template literal span with ``\\`\\```."""
+    out, i, n = [], 0, len(s)
+    while i < n:
+        c = s[i]
+        if c in "\"'":
+            quote, j = c, i + 1
+            while j < n and s[j] != quote:
+                j += 2 if s[j] == "\\" else 1
+            out.append(s[i:j + 1])
+            i = j + 1
+        elif c == "`":
+            out.append("``")
+            i = _skip_template(s, i)
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+
+def _split_top(expr: str, seps: tuple[str, ...]) -> list[str]:
+    """Split on the given separator tokens at paren/bracket/brace/
+    string/template depth 0."""
+    parts, buf, i, n = [], [], 0, len(expr)
+    depth = 0
+    while i < n:
+        c = expr[i]
+        if c in "\"'":
+            j = i + 1
+            while j < n and expr[j] != c:
+                j += 2 if expr[j] == "\\" else 1
+            buf.append(expr[i:j + 1])
+            i = j + 1
+            continue
+        if c == "`":
+            j = _skip_template(expr, i)
+            buf.append(expr[i:j])
+            i = j
+            continue
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+        if depth == 0:
+            hit = next((s for s in seps
+                        if expr.startswith(s, i)), None)
+            if hit is not None:
+                parts.append("".join(buf))
+                buf = []
+                i += len(hit)
+                continue
+        buf.append(c)
+        i += 1
+    parts.append("".join(buf))
+    return parts
+
+
+def _is_whole_call(expr: str, names=SAFE_WRAPPERS) -> bool:
+    """``name( ... )`` where the opening paren's match is the LAST
+    char — a prefix match alone would bless ``esc(a) + r.bio``."""
+    for name in names:
+        if not expr.startswith(name + "("):
+            continue
+        depth, i, n = 0, len(name), len(expr)
+        while i < n:
+            c = expr[i]
+            if c in "\"'":
+                j = i + 1
+                while j < n and expr[j] != c:
+                    j += 2 if expr[j] == "\\" else 1
+                i = j + 1
+                continue
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    return i == n - 1
+            i += 1
+    return False
+
+
+_RECEIVER = re.compile(r"^\(?[\w$.]+( \|\| \[\])?\)?$")
+# receiver is irrelevant (its ELEMENTS feed the map argument; only the
+# argument's RETURN value is rendered): greedy .* binds to the last
+# .map, whose arg must be esc itself or an arrow with a safe body
+_MAP_JOIN = re.compile(
+    r"^.*\.map\((?P<arg>.+)\)\s*\.join\((\"[^\"]*\"|'[^']*')\)$",
+    re.S)
+_ARROW = re.compile(r"^\(?[\w$, ]*\)?\s*=>\s*(?P<body>.+)$", re.S)
+_INT = re.compile(r"^\d+$")
+_STRING = re.compile(r"^(\"(?:[^\"\\]|\\.)*\"|'(?:[^'\\]|\\.)*')$")
+
+
+def _balanced(s: str) -> bool:
+    depth = 0
+    for c in s:
+        depth += c in "([{"
+        depth -= c in ")]}"
+        if depth < 0:
+            return False
+    return depth == 0
+
+
+def _safe_rendered(expr: str) -> bool:
+    """Is every RENDERED terminal of this expression escape-safe?
+
+    Decomposes by the operators that combine rendered values — ``||``
+    fallbacks, ``+`` concatenation, ``?:`` branches (the condition is
+    a boolean, never rendered) — and requires each terminal to be a
+    string literal, a nested template (scanned separately by the main
+    walker), a whole esc()/fmtDate()/encodeURIComponent() call, an
+    ``xs.map(esc).join("...")`` chain, ``.length``, or an audited
+    SAFE_EXPR. A compound like ``esc(a) + r.bio`` therefore fails on
+    the ``r.bio`` terminal — prefix/suffix matching alone blessed it.
+    """
+    expr = expr.strip()
+    if not expr:
+        return True
+    while (expr.startswith("(") and expr.endswith(")")
+           and _balanced(expr[1:-1])):
+        expr = expr[1:-1].strip()
+    flat0 = " ".join(expr.split())
+    # audited whole-expression forms win before decomposition (e.g.
+    # `offset + 1` is integer arithmetic, not concatenation)
+    if any(p.match(flat0) for p in SAFE_EXPR) or _INT.match(flat0):
+        return True
+    # ternary: condition is not rendered; both branches are
+    parts = _split_top(expr, ("?",))
+    if len(parts) > 1:
+        branches = _split_top("?".join(parts[1:]), (":",))
+        return all(_safe_rendered(b) for b in branches)
+    for seps in (("||",), ("&&",), ("+",)):
+        parts = _split_top(expr, seps)
+        if len(parts) > 1:
+            return all(_safe_rendered(p) for p in parts)
+    flat = " ".join(expr.split())
+    if _STRING.match(flat):
+        return True
+    if flat.startswith("`") and _skip_template(flat, 0) == len(flat):
+        return True                # nested template, scanned on its own
+    if _is_whole_call(flat):
+        return True
+    m = _MAP_JOIN.match(flat)
+    if m:
+        arg = m.group("arg").strip()
+        if arg == "esc":
+            return True
+        am = _ARROW.match(arg)
+        if am and _safe_rendered(am.group("body")):
+            return True
+    if flat.endswith(".length") and _RECEIVER.match(flat[:-7]):
+        return True
+    if any(p.match(flat) for p in SAFE_EXPR):
+        return True
+    return False
+
+
+def unescaped_interpolations(src: str) -> list[tuple[int, str]]:
+    """The scanner's verdicts: interpolations whose rendered terminals
+    are neither escaped nor on the audited safe list."""
+    bad = []
+    for line, expr in template_interpolations(src):
+        if not _safe_rendered(expr):
+            bad.append((line, " ".join(expr.split())))
+    return bad
+
+
+def scan_app_js() -> list[tuple[int, str]]:
+    return unescaped_interpolations((UI_DIR / "app.js").read_text())
+
+
+if __name__ == "__main__":
+    findings = scan_app_js()
+    for line, expr in findings:
+        print(f"app.js:{line}: unescaped interpolation: ${{{expr}}}")
+    print(f"{len(findings)} finding(s)")
+    raise SystemExit(1 if findings else 0)

@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""End-to-end acceptance drive for a deployed stack.
+
+The compose e2e CI lane (``.github/workflows/compose-e2e.yml``) boots
+``deploy/docker-compose.yml`` (+ CI overlay) and runs THIS script
+against the gateway — the role of the reference's
+``docker-compose-ci.yml`` verification steps: ingest the fixture mbox
+through the public API, wait for reports to materialize, and check the
+observability surfaces. It works against any running deployment
+(compose, k8s port-forward, or a bare ``serve`` process), so the same
+acceptance drive is usable by operators.
+
+    python scripts/compose_e2e.py --base http://127.0.0.1:8080 \
+        [--logstore http://127.0.0.1:5141] [--prometheus http://127.0.0.1:9090]
+
+Exit 0 = every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import pathlib
+import time
+import urllib.error
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURE = REPO / "tests" / "fixtures" / "ietf-sample.mbox"
+
+
+def call(url: str, body: dict | None = None, timeout: float = 15.0):
+    req = urllib.request.Request(
+        url, method="POST" if body is not None else "GET",
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+        ctype = resp.headers.get("Content-Type", "")
+        return resp.status, (json.loads(raw)
+                             if "json" in ctype else raw)
+
+
+def wait_until(what: str, fn, deadline_s: float = 180.0,
+               interval_s: float = 2.0):
+    t0 = time.monotonic()
+    last_err = None
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            out = fn()
+            if out is not None:
+                print(f"  ok: {what} ({time.monotonic() - t0:.0f}s)")
+                return out
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            last_err = exc
+        time.sleep(interval_s)
+    raise SystemExit(f"TIMEOUT waiting for {what}: {last_err}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--base", default="http://127.0.0.1:8080")
+    ap.add_argument("--logstore", default="")
+    ap.add_argument("--prometheus", default="")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    args = ap.parse_args()
+    base = args.base.rstrip("/")
+
+    # 1. liveness
+    wait_until("gateway /health", lambda: (
+        call(f"{base}/health")[1] if True else None), args.timeout)
+
+    # 2. ingest the fixture mbox through the public upload API
+    status, out = call(f"{base}/api/upload", {
+        "filename": "ietf-sample.mbox",
+        "source_id": "e2e",
+        "content_b64": base64.b64encode(FIXTURE.read_bytes()).decode(),
+    })
+    assert out.get("status") in ("ingested", "duplicate"), out
+    print(f"  ok: upload → {out}")
+
+    # 3. the pipeline runs to reports (parse→chunk→embed→orchestrate→
+    #    summarize→report through the DURABLE broker)
+    def reports():
+        _, body = call(f"{base}/api/reports?limit=10")
+        return body["reports"] if body.get("reports") else None
+
+    got = wait_until("reports materialize", reports, args.timeout)
+    assert got[0].get("summary_text") or got[0].get("summary"), got[0]
+    print(f"  ok: {len(got)} report(s); first subject: "
+          f"{got[0].get('subject', '')[:60]!r}")
+
+    # 4. report detail + SPA shell
+    rid = got[0]["report_id"]
+    _, detail = call(f"{base}/api/reports/{rid}")
+    assert detail["report_id"] == rid
+    _, shell = call(f"{base}/")
+    assert b"app.js" in shell
+    print("  ok: report detail + SPA shell")
+
+    # 5. metrics exposition carries pipeline counters
+    _, metrics = call(f"{base}/metrics")
+    text = metrics.decode() if isinstance(metrics, bytes) else str(metrics)
+    assert "copilot_" in text, text[:200]
+    print("  ok: /metrics exposition")
+
+    # 6. ops snapshot: nothing left pending
+    _, ops = call(f"{base}/api/ops")
+    assert ops.get("collections", {}).get("reports", 0) >= 1, ops
+    print(f"  ok: ops snapshot {ops.get('collections')}")
+
+    # 7. optional: logstore received shipped records
+    if args.logstore:
+        def shipped():
+            _, body = call(f"{args.logstore.rstrip('/')}/logs?limit=5")
+            return body["logs"] or None
+
+        wait_until("logstore records", shipped, 60.0)
+
+    # 8. optional: prometheus scraped the pipeline target
+    if args.prometheus:
+        def target_up():
+            _, body = call(f"{args.prometheus.rstrip('/')}"
+                           "/api/v1/targets")
+            active = body.get("data", {}).get("activeTargets", [])
+            return [t for t in active if t.get("health") == "up"] or None
+
+        up = wait_until("prometheus targets up", target_up, 120.0)
+        print(f"  ok: {len(up)} prometheus target(s) up")
+
+    print("E2E OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import random
 import sys
@@ -225,13 +226,39 @@ def _broker_mode(args, tmp: pathlib.Path, n_arch: int, gen_s: float) -> int:
                 args.messages - (args.messages // n_arch) * (n_arch - 1))
               // args.thread_size) for a in range(n_arch))
         t1 = time.monotonic()
-        for a in range(n_arch):
-            p.ingestion.trigger_source(f"bench-{a}")
+        # Ingestion backpressure (the r3 run's crit breach diagnosis:
+        # triggering all 40 archives at once floods json.parsed to
+        # 17,946 on a 1-core host and starves parsing — r3
+        # SCALE_BROKER.json). Pace triggers against the parsed-queue
+        # depth instead: the ingestion scheduler holds the next archive
+        # until the pipeline has drained below the threshold — the same
+        # role the reference's scheduler plays for periodic sources.
+        backpressure = int(os.environ.get("SCALE_BACKPRESSURE", "2000"))
+        pending_triggers = list(range(n_arch))
+        triggered = 0
         max_depth: dict[str, int] = {}
         deadline = time.monotonic() + max(600, args.messages / 30)
         while time.monotonic() < deadline:
-            for rk, d in p.routing_key_depths().items():
+            depths = p.routing_key_depths()
+            for rk, d in depths.items():
                 max_depth[rk] = max(max_depth.get(rk, 0), d)
+            # The parsed-queue depth LAGS triggering by the archive's
+            # whole parse latency, so gate primarily on archives
+            # outstanding (triggered − parsed): at most 2 archives
+            # (~5k messages) in flight bounds every downstream queue
+            # regardless of how slowly the 1-core host drains.
+            parsed_archives = p.store.count_documents(
+                "archives", {"parsed": True})
+            if (pending_triggers
+                    and triggered - parsed_archives < 2
+                    and max(depths.get("json.parsed", 0),
+                            depths.get("chunks.prepared", 0),
+                            depths.get("embeddings.generated", 0))
+                    < backpressure):
+                p.ingestion.trigger_source(
+                    f"bench-{pending_triggers.pop(0)}")
+                triggered += 1
+                continue
             # Completion needs BOTH counts: racing orchestrations can
             # mint duplicate reports before parsing finishes, so the
             # report count alone declares victory early.
@@ -241,14 +268,78 @@ def _broker_mode(args, tmp: pathlib.Path, n_arch: int, gen_s: float) -> int:
                 break
             time.sleep(1.0)
         run_s = time.monotonic() - t1
+        # Settle to quiescence before auditing: the completion check
+        # fires on message+report counts while late summarizations are
+        # still in the queues. If anything is STILL missing after the
+        # queues quiet down (retry-exhausted orchestrations), run the
+        # production recovery spine — the stuck-document retry job —
+        # exactly as the deployed cron does, and let it drain.
+        from copilot_for_consensus_tpu.tools.retry_job import (
+            RetryStuckDocumentsJob,
+            default_rules,
+        )
+
+        def _missing() -> int:
+            return p.store.count_documents(
+                "threads", {"summary_id": {"$exists": False}})
+
+        settle_deadline = min(deadline + 600,
+                              time.monotonic()
+                              + max(240, args.messages / 80))
+        swept = False
+        while time.monotonic() < settle_deadline:
+            depths = p.routing_key_depths()
+            busy = sum(d for rk, d in depths.items()
+                       if not rk.endswith(".failed"))
+            if busy == 0:
+                if _missing() == 0:
+                    break
+                if not swept:
+                    # sweep as the cron WOULD after the backoff window:
+                    # min_stuck=0 alone still gates on backoff_minutes
+                    # anchored at parsed_at, which would skip threads
+                    # parsed in the run's final minutes
+                    RetryStuckDocumentsJob(
+                        p.store, p.orchestrator.publisher,
+                        default_rules(),
+                        min_stuck_seconds=0.0).run_once(
+                        now=time.time() + 600)
+                    swept = True
+                    continue
+                break                       # swept and drained: final
+            time.sleep(1.0)
         stats = p.reporting.stats()
+        # Failure audit (r3 verdict: 313 unexplained orchestration.failed
+        # events): drain the failure queue, classify the errors, and
+        # verify NO thread actually lost its summary — retry-exhausted
+        # orchestrations are re-covered by the threads-stage recovery
+        # rule (tools/retry_job.py default_rules), so transient
+        # cross-process visibility races under load degrade to retries,
+        # not lost work.
+        from copilot_for_consensus_tpu.bus.broker import BrokerSubscriber
+
+        failures: list[dict] = []
+        audit = BrokerSubscriber({"port": port}, group="bench-audit")
+        audit.subscribe(["orchestration.failed",
+                         "summarization.failed"],
+                        lambda env: failures.append(env))
+        audit.drain()
+        audit.close()
+        by_error: dict[str, int] = {}
+        for env in failures:
+            key = (env.get("data", {}).get("error_type", "?") + ": "
+                   + env.get("data", {}).get("error", "")[:60])
+            by_error[key] = by_error.get(key, 0) + 1
+        threads_missing_summary = p.store.count_documents(
+            "threads", {"summary_id": {"$exists": False}})
         # every pipeline event crossed the broker: archives + 3 hops per
         # message (parsed->chunked->embedded) + 3 per thread
         events = (n_arch + 3 * args.messages
                   + 3 * stats.get("reports", 0))
         worst = max(max_depth.values() or [0])
         ok = (stats.get("reports", 0) >= expected_reports
-              and worst <= 10000)
+              and worst <= 10000
+              and threads_missing_summary == 0)
         out = {
             "stage": "broker_total", "messages": args.messages,
             "generate_s": round(gen_s, 1), "pipeline_s": round(run_s, 1),
@@ -258,6 +349,15 @@ def _broker_mode(args, tmp: pathlib.Path, n_arch: int, gen_s: float) -> int:
             "max_queue_depth": max_depth,
             "queue_depth_slo": {"warn": 1000, "crit": 10000,
                                 "worst": worst},
+            "failure_audit": {
+                "events": len(failures),
+                "by_error": by_error,
+                "threads_missing_summary": threads_missing_summary,
+                "note": ("failure events are retries exhausted under "
+                         "load; the threads-stage recovery rule "
+                         "re-orchestrates them — ok requires zero "
+                         "threads left without a summary"),
+            },
             "stats": stats, "ok": ok,
         }
         print(json.dumps(out))

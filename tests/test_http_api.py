@@ -249,7 +249,8 @@ def test_ops_snapshot(server, tokens):
     assert status == 200
     assert set(ops) == {"collections", "queues", "dead_letters", "pending"}
     assert "reports" in ops["collections"]
-    assert set(ops["pending"]) == {"archives", "messages", "chunks"}
+    assert set(ops["pending"]) == {"archives", "messages", "chunks",
+                                   "threads"}
 
 
 def test_discovery_doc_prefers_configured_base_url():

@@ -1,0 +1,207 @@
+# UI lane: the vanilla-JS SPA has no JS runtime in this image, so
+# behavior is pinned from three directions — the XSS-escape policy
+# scanner over app.js (with seeded-bug effectiveness proofs: dropping
+# esc() anywhere fails), a UI↔API contract-sync test (every endpoint
+# the SPA calls must exist on the live router), and server-side asset
+# integration tests over real sockets. The reference pins the same
+# surface with per-route *.test.tsx under a node runtime
+# (ui/src/routes/AdminDashboard.test.tsx etc.).
+import json
+import pathlib
+import re
+import urllib.request
+
+import pytest
+
+from copilot_for_consensus_tpu.ui import lint
+
+APP_JS = (pathlib.Path(lint.UI_DIR) / "app.js").read_text()
+
+
+# ---------------------------------------------------------------------------
+# XSS-escape policy
+# ---------------------------------------------------------------------------
+
+
+def test_app_js_escape_policy_clean():
+    assert lint.unescaped_interpolations(APP_JS) == []
+
+
+def test_scanner_sees_every_interpolation():
+    """The policy is only as good as the scanner's reach: it must find
+    every ${...} the source contains (counted lexically)."""
+    found = len(lint.template_interpolations(APP_JS))
+    # raw count of '${' inside the file minus ones inside ordinary
+    # strings/comments is hard to get with grep alone; assert a floor
+    # that catches the scanner silently going blind (it found 0 before
+    # it learned JS regex literals — this pins that bug class)
+    assert found >= 80, found
+
+
+@pytest.mark.parametrize("snippet", [
+    # esc() dropped from an innerHTML interpolation
+    "render(`<h2>${r.subject}</h2>`);",
+    # element-wise escape dropped from a joined list
+    "render(`<p>${(x.participants || []).map(String).join(', ')}</p>`);",
+    # new unescaped data in an attribute
+    'list.innerHTML = `<a href="#/x/${item.id}">go</a>`;',
+    # nested template whose INNER interpolation is unescaped
+    "render(`<ul>${xs.map((x) => `<li>${x.name}</li>`).join('')}</ul>`);",
+    # COMPOUND bypass attempts (r4 review): a safe fragment must not
+    # bless the unsafe terminal riding alongside it
+    "render(`<h2>${esc(r.subject) + r.bio}</h2>`);",
+    "render(`<p>${r.bio + xs.map(esc).join(', ')}</p>`);",
+    "render(`<p>${ok ? `<b>${esc(a)}</b>` : r.subject}</p>`);",
+])
+def test_scanner_catches_seeded_xss(snippet):
+    assert lint.unescaped_interpolations(snippet), snippet
+
+
+@pytest.mark.parametrize("snippet", [
+    "render(`<h2>${esc(r.subject)}</h2>`);",
+    "render(`<p>${(x.participants || []).map(esc).join(', ')}</p>`);",
+    "api(`/api/reports/${encodeURIComponent(id)}`);",
+    "render(`<ul>${xs.map((x) => `<li>${esc(x.name)}</li>`).join('')}</ul>`);",
+])
+def test_scanner_allows_escaped_forms(snippet):
+    assert lint.unescaped_interpolations(snippet) == []
+
+
+def test_tokenizer_survives_regex_comments_and_nesting():
+    """The walker must stay in sync across the constructs that made a
+    naive scanner go blind (JS regex literals, comments, nesting)."""
+    src = (
+        "const re = /[&<>\"'`]/g; // trailing ` in regex and comment `\n"
+        "/* block with ` backtick */\n"
+        "const a = `outer ${inner ? `mid ${esc(deep)}` : ''} tail`;\n"
+    )
+    exprs = [e for _, e in lint.template_interpolations(src)]
+    assert any("esc(deep)" in e for e in exprs)
+    assert any(e.startswith("inner ?") for e in exprs)
+
+
+def test_esc_function_covers_html_metacharacters():
+    """esc() itself must keep escaping all five metacharacters — the
+    scanner trusts it."""
+    m = re.search(r"function esc\(s\) \{\n(.+?)\n\}", APP_JS, re.S)
+    assert m, "esc() definition moved"
+    body = m.group(1)
+    for ch in ["&amp;", "&lt;", "&gt;", "&quot;", "&#39;"]:
+        assert ch in body, f"esc() no longer emits {ch}"
+
+
+# ---------------------------------------------------------------------------
+# UI ↔ API contract sync
+# ---------------------------------------------------------------------------
+
+
+def _ui_api_calls() -> set[tuple[str, str]]:
+    """(method, path-pattern) for every api(...) call in app.js, with
+    interpolations normalized to {param} and query strings dropped."""
+    calls = set()
+    for m in re.finditer(
+            r'api\(\s*(`([^`]*)`|"([^"]*)")'
+            r'(?:\s*\+[^,)]*)?'            # string concatenation tails
+            r'(?:,\s*\{\s*method:\s*"(\w+)")?', APP_JS):
+        path = m.group(2) or m.group(3) or ""
+        method = m.group(4) or "GET"
+        path = re.sub(r"\$\{[^}]*\}", "{p}", path)
+        path = path.split("?")[0]
+        if not path.startswith("/"):
+            continue
+        calls.add((method, path))
+    return calls
+
+
+def test_every_ui_call_exists_on_the_router():
+    """Route drift protection: each endpoint the SPA references must be
+    servable by the live router (the reference gets this from typed API
+    clients; here the contract is tested)."""
+    from copilot_for_consensus_tpu.services.bootstrap import serve_pipeline
+
+    srv = serve_pipeline({"auth": {
+        "signer": {"driver": "hs256", "secret": "ui-test"},
+        "providers": {"mock": {}}, "allow_insecure_mock": True,
+    }})
+    table = [(m, re.sub(r"\{\w+\}", "{p}", pattern))
+             for m, pattern, _ in srv.http.router.route_table]
+    missing = [(m, p) for m, p in _ui_api_calls()
+               if not any(m == tm and p == tp for tm, tp in table)]
+    assert not missing, f"SPA calls endpoints the router lacks: {missing}"
+    assert len(_ui_api_calls()) >= 20   # reach: the SPA's full surface
+
+
+# ---------------------------------------------------------------------------
+# Server-side integration (real sockets)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    from copilot_for_consensus_tpu.services.bootstrap import serve_pipeline
+
+    srv = serve_pipeline({"auth": {
+        "signer": {"driver": "hs256", "secret": "ui-test"},
+        "providers": {"mock": {}}, "allow_insecure_mock": True,
+    }}).start()
+    yield srv
+    srv.stop()
+
+
+def _get(port, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type",
+                                             ""), resp.read()
+
+
+def test_spa_shell_and_assets_served(server):
+    status, ctype, body = _get(server.port, "/")
+    assert status == 200 and ctype.startswith("text/html")
+    assert b'src="/ui/app.js"' in body or b"src=/ui/app.js" in body
+    status, ctype, body = _get(server.port, "/ui/app.js")
+    assert status == 200 and "javascript" in ctype
+    assert b"function esc(" in body
+    status, ctype, _ = _get(server.port, "/ui/style.css")
+    assert status == 200 and ctype.startswith("text/css")
+
+
+def test_hostile_asset_names_404_not_500(server):
+    import urllib.error
+
+    for name in ("%2e%2e%2fsecrets", "..%2f..%2fetc%2fpasswd", "%00",
+                 "app.js%00.html"):
+        try:
+            status, _, _ = _get(server.port, f"/ui/{name}")
+        except urllib.error.HTTPError as exc:
+            status = exc.code
+        assert status == 404, (name, status)
+
+
+def test_hostile_report_content_survives_api_roundtrip(server):
+    """The API must deliver hostile content VERBATIM as JSON (escaping
+    is the SPA's job at render time, enforced by the policy scanner) —
+    double-escaping server-side would corrupt legitimate content."""
+    payload = "<script>alert(1)</script> & 'quotes' \"too\""
+    server.pipeline.store.insert_document("reports", {
+        "report_id": "r-xss", "summary_id": "s-xss",
+        "thread_id": "t-xss",
+        "subject": payload, "summary_text": payload,
+        "status": "published", "published_at": "2026-07-31T00:00:00Z",
+    })
+    # login for the API call
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/auth/login?provider=mock",
+            timeout=10) as r:
+        state = json.loads(r.read())["state"]
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/auth/callback?state={state}"
+            "&code=mock:reader@example.org", timeout=10) as r:
+        tok = json.loads(r.read())["access_token"]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/api/reports/r-xss",
+        headers={"Authorization": f"Bearer {tok}"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        body = json.loads(r.read())
+    assert body["subject"] == payload
+    assert body["summary_text"] == payload

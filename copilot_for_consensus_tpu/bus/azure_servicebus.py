@@ -75,6 +75,22 @@ def sas_token(endpoint: str, key_name: str, key: str,
             f"&se={expiry}&skn={key_name}")
 
 
+#: routing keys ride inside SqlFilter + ATOM XML (see
+#: ``ensure_subscription``) — only this safe alphabet is accepted
+_SAFE_RK = re.compile(r"[A-Za-z0-9._-]+\Z")
+
+
+def validate_routing_key(rk: str) -> None:
+    """Reject routing keys that cannot be safely interpolated into a
+    SqlFilter expression / ATOM XML rule body. Called for EVERY key
+    before any subscription state is mutated, so a bad key in a batch
+    cannot leave partial routes behind."""
+    if not _SAFE_RK.match(rk):
+        raise ValueError(
+            f"routing key {rk!r} contains characters outside "
+            "[A-Za-z0-9._-]; refusing to build a SqlFilter from it")
+
+
 def entity_name(rk: str, group: str) -> str:
     """Subscription name for (group, routing key): a readable sanitized
     prefix + a digest of the UNsanitized pair. The digest is what makes
@@ -177,7 +193,13 @@ class _Transport:
                             max_delivery_count: int) -> None:
         """Create subscription + replace the match-all $Default rule
         with the routing-key SQL filter (the reference's Bicep
-        ``EventTypeFilter`` rule)."""
+        ``EventTypeFilter`` rule).
+
+        ``rk`` is interpolated into both a SqlFilter expression and an
+        ATOM XML body, so it is restricted to ``[A-Za-z0-9._-]``
+        (``validate_routing_key``) — a quote or XML metacharacter would
+        break or ALTER the subscription rule."""
+        validate_routing_key(rk)
         atom = ('<entry xmlns="http://www.w3.org/2005/Atom">'
                 '<content type="application/xml">'
                 '<SubscriptionDescription xmlns="http://schemas.'
@@ -287,6 +309,10 @@ class AzureServiceBusSubscriber(EventSubscriber):
     # -- wiring ---------------------------------------------------------
 
     def subscribe(self, routing_keys, callback) -> None:
+        # validate the whole batch BEFORE mutating routes: a bad key
+        # mid-list must not leave earlier keys half-registered
+        for rk in routing_keys:
+            validate_routing_key(rk)
         self._t.ensure_topic(self.topic)
         for rk in routing_keys:
             self._routes[rk] = callback
@@ -311,6 +337,14 @@ class AzureServiceBusSubscriber(EventSubscriber):
         if status == 204:
             return None
         props = json.loads(headers.get("brokerproperties", "{}"))
+        # the publisher stamps the routing key as a custom property,
+        # which comes back as its own JSON-quoted header on receive
+        stamped_rk = None
+        if headers.get("routing_key"):
+            try:
+                stamped_rk = json.loads(headers["routing_key"])
+            except ValueError:
+                stamped_rk = headers["routing_key"]
         lock_path = urllib.parse.urlparse(
             headers.get("location", "")).path
         if not lock_path:       # per-spec fallback construction
@@ -327,7 +361,8 @@ class AzureServiceBusSubscriber(EventSubscriber):
                          f"/messages/"
                          f"{urllib.parse.quote(str(mid), safe='')}/"
                          f"{urllib.parse.quote(str(token), safe='')}")
-        return {"raw": raw, "props": props, "lock_path": lock_path}
+        return {"raw": raw, "props": props, "lock_path": lock_path,
+                "stamped_rk": stamped_rk}
 
     def _complete(self, msg: dict) -> bool:
         try:
@@ -369,6 +404,18 @@ class AzureServiceBusSubscriber(EventSubscriber):
             self._complete(msg)
             return
         if cb is None:
+            self._complete(msg)
+            return
+        # The subscription's SQL rule is asserted idempotently, but a
+        # message enqueued through the match-all $Default rule during
+        # the create-subscription -> delete-$Default window carries
+        # whatever routing key the publisher STAMPED (the same custom
+        # property the SQL rule filters on). Route by the stamp, not
+        # the subscription: a mismatch is completed (dropped), never
+        # delivered to the wrong callback. Unstamped messages (foreign
+        # publishers) are not checkable and dispatch as before.
+        stamped = msg.get("stamped_rk")
+        if stamped is not None and stamped != rk:
             self._complete(msg)
             return
         stop_renew = threading.Event()

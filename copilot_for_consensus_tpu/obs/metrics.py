@@ -68,6 +68,14 @@ class InMemoryMetrics(MetricsCollector):
         with self._lock:
             self.gauges.setdefault(name, {})[_label_key(labels)] = value
 
+    def set_counter(self, name, value, labels=None):
+        """Set a counter to an absolute value — for scrape-time totals
+        read from an external monotonic source (e.g. /proc cpu
+        seconds), which must render with counter TYPE metadata so
+        ``rate()`` consumers and OpenMetrics linters see a counter."""
+        with self._lock:
+            self.counters.setdefault(name, {})[_label_key(labels)] = value
+
     def observe(self, name, value, labels=None):
         with self._lock:
             series = self.histograms.setdefault(name, {})

@@ -1,0 +1,116 @@
+"""Process/host resource gauges for the alert pack's resource_limits
+group.
+
+The reference watches container resources through cAdvisor +
+node-exporter series (``infra/prometheus/alerts/resource_limits.yml``);
+this framework's services are first-party processes, so the equivalent
+gauges are read straight from ``/proc``, the cgroup-v2 files, and
+``statvfs`` — no sidecar exporters. Every service's ``/metrics``
+exposition stamps them (``services/bootstrap._BusGaugeMetrics``), and
+the standalone stats exporter (``tools/exporters.py``) does too.
+
+Series emitted (all prefixed by the metrics namespace, default
+``copilot``):
+
+- ``process_resident_bytes``       — VmRSS
+- ``process_memory_limit_bytes``   — cgroup memory.max, else host
+  MemTotal (so the ratio alert is meaningful under compose/k8s limits
+  AND bare processes)
+- ``process_cpu_seconds_total``    — utime+stime (counter)
+- ``process_open_fds``
+- ``process_start_time_seconds``   — wall-clock at module import;
+  ``changes()`` over it is the restart-rate alert
+- ``disk_free_bytes`` / ``disk_total_bytes`` with a ``path`` label
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+_CLK_TCK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+_START_TIME = time.time()
+
+#: paths whose free space matters operationally: the working dir (sqlite
+#: stores, archives, logstore files live under it) and the root fs
+_DISK_PATHS: tuple[str, ...] = (".", "/")
+
+
+def _read_first(path: str) -> str | None:
+    try:
+        with open(path) as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
+def _rss_bytes() -> float:
+    text = _read_first("/proc/self/status") or ""
+    for line in text.splitlines():
+        if line.startswith("VmRSS:"):
+            return float(line.split()[1]) * 1024.0
+    return 0.0
+
+
+def _cpu_seconds() -> float:
+    text = _read_first("/proc/self/stat") or ""
+    # fields 14/15 (1-based) are utime/stime in clock ticks; the comm
+    # field can contain spaces, so split after the closing paren
+    try:
+        rest = text.rsplit(")", 1)[1].split()
+        return (int(rest[11]) + int(rest[12])) / float(_CLK_TCK)
+    except (IndexError, ValueError):
+        return 0.0
+
+
+def _memory_limit_bytes() -> float:
+    # cgroup v2 (compose/k8s memory limits land here); "max" = unlimited
+    for path in ("/sys/fs/cgroup/memory.max",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        text = (_read_first(path) or "").strip()
+        if text and text != "max":
+            try:
+                v = float(text)
+            except ValueError:
+                continue
+            # some v1 kernels report "no limit" as a huge sentinel
+            if v < 1 << 60:
+                return v
+    text = _read_first("/proc/meminfo") or ""
+    for line in text.splitlines():
+        if line.startswith("MemTotal:"):
+            return float(line.split()[1]) * 1024.0
+    return 0.0
+
+
+def _open_fds() -> float:
+    try:
+        return float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        return 0.0
+
+
+def resource_gauges(metrics, disk_paths: tuple[str, ...] = _DISK_PATHS,
+                    ) -> None:
+    """Stamp the resource series into ``metrics`` (an object with
+    ``gauge(name, value, labels=...)``). Never raises: a missing /proc
+    entry (non-Linux dev box) just leaves gauges at 0, which the alert
+    ratios treat as absent-not-firing."""
+    metrics.gauge("process_resident_bytes", _rss_bytes())
+    metrics.gauge("process_memory_limit_bytes", _memory_limit_bytes())
+    # a _total series is a COUNTER; render it with counter metadata
+    # where the collector supports absolute counter sets
+    set_counter = getattr(metrics, "set_counter", metrics.gauge)
+    set_counter("process_cpu_seconds_total", _cpu_seconds())
+    metrics.gauge("process_open_fds", _open_fds())
+    metrics.gauge("process_start_time_seconds", _START_TIME)
+    for path in disk_paths:
+        try:
+            st = os.statvfs(path)
+        except OSError:
+            continue
+        label = {"path": os.path.abspath(path)}
+        metrics.gauge("disk_free_bytes",
+                      float(st.f_bavail * st.f_frsize), labels=label)
+        metrics.gauge("disk_total_bytes",
+                      float(st.f_blocks * st.f_frsize), labels=label)

@@ -423,19 +423,25 @@ def create_jwt_middleware(jwt_manager: JWTManager,
                           | None = None,
                           public_paths=PUBLIC_PATHS,
                           is_revoked=None,
-                          revocation_cache_ttl: float = 5.0):
+                          revocation_cache_ttl: float = 0.0):
     """Router middleware: verifies Bearer tokens, stamps claims into
     ``req.context``, enforces per-path-prefix role requirements.
     ``is_revoked(jti) -> bool`` plugs the logout denylist in — a
     logged-out token must fail even though its signature still
     verifies.
 
-    Revocation results are cached per-jti for ``revocation_cache_ttl``
-    seconds: with a remote document store behind ``is_revoked`` (e.g.
-    the Cosmos driver) an uncached check adds an HTTP round-trip to
-    every API call. A revoked verdict is cached forever (tokens don't
-    un-revoke); a clean verdict only for the TTL, which bounds the
-    post-logout acceptance window. Set ttl=0 to disable."""
+    Revocation results can be cached per-jti for
+    ``revocation_cache_ttl`` seconds: with a remote document store
+    behind ``is_revoked`` (e.g. the Cosmos driver) an uncached check
+    adds an HTTP round-trip to every API call. A revoked verdict is
+    cached forever (tokens don't un-revoke); a clean verdict only for
+    the TTL, which bounds the post-logout acceptance window.
+
+    The cache defaults OFF (ttl=0): caching weakens cross-replica
+    logout — a token revoked on another replica stays accepted here
+    for up to the TTL — so deployments must opt in explicitly (the
+    ``auth.revocation_cache_ttl`` config key) after weighing that
+    window against the per-request store round-trip."""
     required_roles = required_roles or {}
     # jti -> (expires_at_monotonic, revoked)
     _revocation_cache: dict[str, tuple[float, bool]] = {}

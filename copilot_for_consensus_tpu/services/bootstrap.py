@@ -100,6 +100,10 @@ class _BusGaugeMetrics:
             name = ("bus_dead_letters" if rk.endswith(".dlq")
                     else "bus_queue_depth")
             self._inner.gauge(name, depth, labels={"queue": rk})
+        # process/host resource series for the resource_limits alerts
+        from copilot_for_consensus_tpu.obs.resources import resource_gauges
+
+        resource_gauges(self._inner)
         return self._inner.render_prometheus()
 
     def __getattr__(self, name):
@@ -279,8 +283,11 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
                     "/api/upload": ["admin", "processor"],
                 }),
                 is_revoked=auth_service.is_revoked,
+                # default OFF: caching a clean verdict weakens
+                # cross-replica logout by up to the TTL; deployments
+                # opt in via auth.revocation_cache_ttl
                 revocation_cache_ttl=auth_cfg.get(
-                    "revocation_cache_ttl", 5.0))
+                    "revocation_cache_ttl", 0.0))
             # local logouts bypass the TTL entirely
             auth_service.on_revoke.append(mw.invalidate)
             router.middleware.append(mw)

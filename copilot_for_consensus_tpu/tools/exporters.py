@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Any
 
 from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+from copilot_for_consensus_tpu.obs.resources import resource_gauges
 from copilot_for_consensus_tpu.storage.registry import KNOWN_COLLECTIONS
 from copilot_for_consensus_tpu.tools.retry_job import pending_counts
 
@@ -70,6 +71,7 @@ class StatsExporter:
                     m.gauge("vectorstore_dimension", float(dim))
             except Exception:
                 m.gauge("vectorstore_vectors", -1.0)
+        resource_gauges(m)
         m.gauge("exporter_scrape_seconds", time.monotonic() - t0)
         return m
 

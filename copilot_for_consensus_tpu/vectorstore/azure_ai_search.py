@@ -295,13 +295,19 @@ class AzureAISearchVectorStore(VectorStore):
         return [float(x) for x in out.get("embedding") or []], md
 
     def delete(self, vec_ids) -> int:
+        """Delete by id; returns the number that existed.
+
+        The count is BEST-EFFORT under the service's eventual
+        consistency: the index API reports statusCode 200 for absent
+        keys too, so existence is probed with a pre-delete search —
+        documents added moments ago may not be searchable yet
+        (under-count), and concurrent deleters can both observe a doc
+        (double-count). Exact-count callers must serialize externally.
+        """
         self._ensure()
         ids = [str(i) for i in vec_ids]
         if not ids:
             return 0
-        # the index API reports success for already-absent keys; count
-        # what actually exists first so the contract's "number deleted"
-        # stays honest
         existing = 0
         for start in range(0, len(ids), 64):
             chunk = ids[start:start + 64]

@@ -1,0 +1,27 @@
+# Fuzz-lane harness: pin the CPU platform BEFORE jax initialises.
+#
+# Same pin as tests/conftest.py (see the comment there): the container's
+# sitecustomize imports jax at interpreter start and snapshots
+# JAX_PLATFORMS from the original env, so only a config update made
+# before backend init reliably wins. Without this pin, a down axon
+# tunnel turns every jax-touching fuzz test into a minutes-long backend
+# reconnect loop (observed while judging round 4).
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))

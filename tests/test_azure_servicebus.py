@@ -260,10 +260,14 @@ def _make_handler(state, endpoint_holder):
                 loc = (f"http://{endpoint_holder[1]}{sub_path}/messages/"
                        f"{urllib.parse.quote(str(bp['MessageId']))}/"
                        f"{token}")
-                return self._reply(201, msg["body"], {
-                    "BrokerProperties": json.dumps(bp),
-                    "Location": loc,
-                })
+                reply_headers = {"Location": loc}
+                # real Service Bus returns custom properties as their
+                # own JSON-quoted headers, NOT inside BrokerProperties
+                for name in ("routing_key", "event_type"):
+                    if name in bp:
+                        reply_headers[name] = json.dumps(bp.pop(name))
+                reply_headers["BrokerProperties"] = json.dumps(bp)
+                return self._reply(201, msg["body"], reply_headers)
             # settle: DELETE/PUT/POST .../messages/{mid}/{token}
             if len(rest) == 3 and rest[0] == "messages":
                 token = rest[2]
@@ -562,3 +566,71 @@ def test_entity_name_injective_sanitized_and_clamped():
     assert entity_name("a-b.c", "svc") != entity_name("b.c", "svc-a")
     assert entity_name("weird/key", "g") != entity_name("weird*key",
                                                         "g")
+
+
+def test_unsafe_routing_key_rejected_at_subscribe(mock_sb):
+    """A routing key outside [A-Za-z0-9._-] would be interpolated into
+    the SqlFilter expression and the ATOM XML rule body; subscribe must
+    refuse it loudly instead of building a broken/altered rule."""
+    endpoint, _ = mock_sb
+    sub = AzureServiceBusSubscriber(_cfg(endpoint, group="g"))
+    for bad in ("a'b", "a<b>", "k&amp", "x y", "q\"r"):
+        with pytest.raises(ValueError, match="routing key"):
+            sub.subscribe([bad], lambda e: None)
+    # a bad key mid-batch must not leave earlier keys half-registered
+    with pytest.raises(ValueError, match="routing key"):
+        sub.subscribe(["good.key", "bad'key"], lambda e: None)
+    assert not sub._routes and not sub._subs
+
+
+def test_default_rule_window_message_not_misrouted(mock_sb):
+    """A message that slipped in through the match-all $Default rule
+    (create-subscription -> delete-$Default window) carries a STAMPED
+    routing key that does not match the subscription's; _dispatch must
+    drop it (complete) rather than hand it to the wrong callback."""
+    endpoint, _ = mock_sb
+    pub = AzureServiceBusPublisher(_cfg(endpoint))
+    got = []
+    sub = AzureServiceBusSubscriber(_cfg(endpoint, group="g"))
+    # simulate the half-provisioned window: subscription exists with
+    # ONLY the match-all $Default rule (crash before rule replacement)
+    name = entity_name("summary.complete", "g")
+    sub._t.ensure_topic(sub.topic)
+    sub._t.request(
+        "PUT", f"/{sub.topic}/subscriptions/{name}",
+        body=(b'<entry xmlns="http://www.w3.org/2005/Atom">'
+              b'<content type="application/xml"><SubscriptionDescription'
+              b' xmlns="http://schemas.microsoft.com/netservices/2010/10/'
+              b'servicebus/connect"><LockDuration>PT60S</LockDuration>'
+              b"<MaxDeliveryCount>4</MaxDeliveryCount>"
+              b"</SubscriptionDescription></content></entry>"),
+        content_type="application/atom+xml", ok=(201, 409))
+    sub._routes["summary.complete"] = got.append
+    sub._subs["summary.complete"] = name
+    # a foreign-key message admitted by $Default during the window...
+    pub.publish_envelope({"event_type": "ArchiveIngested",
+                          "event_id": "stray", "payload": {}},
+                         "archive.ingested")
+    # ...and a legitimate one
+    pub.publish_envelope({"event_type": "SummaryComplete",
+                          "event_id": "ok1", "payload": {}},
+                         "summary.complete")
+    assert sub.drain() == 2          # both settled (one dropped)
+    assert [e["event_id"] for e in got] == ["ok1"]
+
+
+def test_override_routing_key_publish_still_delivered(mock_sb):
+    """publish_envelope(env, routing_key=override) is a supported bus
+    shape: the misroute guard compares the STAMPED key (which equals
+    the override), so override publishes must reach their subscription
+    even though the event type's canonical key differs."""
+    endpoint, _ = mock_sb
+    pub = AzureServiceBusPublisher(_cfg(endpoint))
+    got = []
+    sub = AzureServiceBusSubscriber(_cfg(endpoint, group="audit"))
+    sub.subscribe(["audit.summaries"], got.append)
+    pub.publish_envelope({"event_type": "SummaryComplete",
+                          "event_id": "ov1", "payload": {}},
+                         "audit.summaries")
+    assert sub.drain() == 1
+    assert [e["event_id"] for e in got] == ["ov1"]

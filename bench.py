@@ -151,7 +151,11 @@ def _run_row(name: str, cmd: list[str], env: dict[str, str],
 def extra_rows() -> list[dict]:
     py = sys.executable
     me = os.path.join(REPO, "bench.py")
-    no_extra = {"BENCH_EXTRA": "0", "BENCH_NO_PROBE": "1"}
+    # BENCH_PRESET is pinned EMPTY so a parent-level preset cannot leak
+    # into a differently-labeled child row (children get their preset
+    # geometry as explicit values below).
+    no_extra = {"BENCH_EXTRA": "0", "BENCH_NO_PROBE": "1",
+                "BENCH_PRESET": ""}
     # Preset geometry is passed as EXPLICIT env values (not just
     # BENCH_PRESET): the row label promises a specific configuration,
     # so an inherited user knob (e.g. BENCH_SLOTS) must not re-shape it.

@@ -49,6 +49,8 @@ class Handle:
     _event: threading.Event = field(default_factory=threading.Event)
     _completion: Completion | None = None
     _error: BaseException | None = None
+    _callbacks: list = field(default_factory=list)
+    _cb_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -61,9 +63,39 @@ class Handle:
         assert self._completion is not None
         return self._completion
 
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(handle)`` when the request resolves (completion OR
+        failure). Fires on the dispatcher thread; if already resolved,
+        fires immediately on the calling thread.
+
+        This is the GIL-friendly harvest path: a waiter that POLLS
+        ``done()`` across many handles wakes the interpreter constantly
+        and steals cycles from the dispatch call itself (the measured
+        serving-mode host tax, docs/PERF.md r4); a callback costs one
+        invocation per completion and nothing in between."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _resolve(self, completion: Completion) -> None:
+        self._completion = completion
+        self._finish()
+
     def _fail(self, exc: BaseException) -> None:
         self._error = exc
-        self._event.set()
+        self._finish()
+
+    def _finish(self) -> None:
+        with self._cb_lock:
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:
+                pass    # a broken observer must not kill the dispatcher
 
 
 class AsyncEngineRunner:
@@ -168,5 +200,4 @@ class AsyncEngineRunner:
                 self.completed += 1
                 h = self._handles.pop(c.request_id, None)
                 if h is not None:
-                    h._completion = c
-                    h._event.set()
+                    h._resolve(c)

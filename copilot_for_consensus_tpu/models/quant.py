@@ -16,6 +16,8 @@ through unchanged.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Any
 
 import jax
@@ -66,8 +68,32 @@ def set_pallas_qmatmul(enabled: bool) -> None:
     _PALLAS_QMATMUL = enabled
 
 
+# Thread-local override so ONE engine can re-route ONE of its programs
+# (e.g. long-extent int4 decode → XLA dequant) without flipping the
+# process-wide flag under other engines: the flag is read at TRACE
+# time, so holding the override around a jitted call bakes the route
+# into that program only.
+_PALLAS_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def pallas_qmatmul_override(enabled: bool | None):
+    """Force (or, with None, don't touch) the Pallas-qmatmul route for
+    model code traced on this thread inside the block."""
+    if enabled is None:
+        yield
+        return
+    prev = getattr(_PALLAS_TLS, "value", None)
+    _PALLAS_TLS.value = enabled
+    try:
+        yield
+    finally:
+        _PALLAS_TLS.value = prev
+
+
 def pallas_qmatmul_enabled() -> bool:
-    return _PALLAS_QMATMUL
+    override = getattr(_PALLAS_TLS, "value", None)
+    return _PALLAS_QMATMUL if override is None else override
 
 
 def set_act_quant(mode: str) -> None:

@@ -137,9 +137,20 @@ def reporting_router(service, include_sources: bool = True) -> Router:
 
     @router.get("/api/threads")
     def threads(req):
+        def opt(name):
+            return (_int(req, name, 0, hi=1 << 30)
+                    if req.query.get(name) else None)
+
         return {"threads": service.get_threads(
             offset=_int(req, "offset", 0, hi=1 << 30),
-            limit=_int(req, "limit", 50))}
+            limit=_int(req, "limit", 50),
+            source=req.query.get("source"),
+            min_messages=opt("min_messages"),
+            max_messages=opt("max_messages"),
+            min_participants=opt("min_participants"),
+            max_participants=opt("max_participants"),
+            sort_by=req.query.get("sort_by", "message_count"),
+            descending=req.query.get("sort_order", "desc") != "asc")}
 
     @router.get("/api/threads/{thread_id}")
     def thread(req):

@@ -137,10 +137,63 @@ class ReportingService(BaseService):
 
     # browse endpoints (reference ``reporting/main.py:73-474``)
 
-    def get_threads(self, *, offset: int = 0, limit: int = 50) -> list[dict]:
-        return self.store.query_documents(
-            "threads", {}, sort=[("message_count", -1)],
-            limit=limit, skip=offset)
+    #: sortable thread fields (reference DiscussionsList.tsx query model)
+    THREAD_SORTS = ("message_count", "participant_count", "subject",
+                    "parsed_at")
+
+    def get_threads(self, *, offset: int = 0, limit: int = 50,
+                    source: str | None = None,
+                    min_messages: int | None = None,
+                    max_messages: int | None = None,
+                    min_participants: int | None = None,
+                    max_participants: int | None = None,
+                    sort_by: str = "message_count",
+                    descending: bool = True) -> list[dict]:
+        """Filtered/sorted thread browse (reference
+        ``ui/src/routes/DiscussionsList.tsx:11-22`` query surface:
+        source, participant/message ranges, sort). Filters are pushed
+        into the store query so pagination composes correctly."""
+        flt: dict = {}
+        if source:
+            flt["source_id"] = source
+        rng: dict = {}
+        if min_messages is not None:
+            rng["$gte"] = min_messages
+        if max_messages is not None:
+            rng["$lte"] = max_messages
+        if rng:
+            flt["message_count"] = rng
+        if sort_by not in self.THREAD_SORTS:
+            sort_by = "message_count"
+        participant_work = (min_participants is not None
+                            or max_participants is not None
+                            or sort_by == "participant_count")
+        if not participant_work:
+            # keep limit/skip pushed into the store: the common
+            # no-participant-filter browse must not materialize the
+            # whole collection per page (the same SLO reasoning as
+            # get_reports at the 100k corpus)
+            return self.store.query_documents(
+                "threads", flt,
+                sort=[(sort_by, -1 if descending else 1)],
+                limit=limit or None, skip=offset)
+        # participant ranges/sort derive from a list-typed field — no
+        # store operator for len(); fetch matching rows once, then
+        # filter/sort/paginate here
+        if sort_by == "participant_count":
+            rows = self.store.query_documents("threads", flt)
+            rows.sort(key=lambda r: len(r.get("participants") or []),
+                      reverse=descending)
+        else:
+            rows = self.store.query_documents(
+                "threads", flt, sort=[(sort_by, -1 if descending else 1)])
+        if min_participants is not None:
+            rows = [r for r in rows
+                    if len(r.get("participants") or []) >= min_participants]
+        if max_participants is not None:
+            rows = [r for r in rows
+                    if len(r.get("participants") or []) <= max_participants]
+        return rows[offset:offset + limit] if limit else rows[offset:]
 
     def get_thread(self, thread_id: str) -> dict | None:
         return self.store.get_document("threads", thread_id)

@@ -201,22 +201,132 @@ async function pageReportDetail(id) {
   } catch (e) { err(e); }
 }
 
+/* Discussions list with the reference's filter model
+   (DiscussionsList.tsx:11-22): source / message-range /
+   participant-range filters + sort, persisted in the hash query so
+   filtered views survive reload and back/forward. */
+const THREAD_FILTERS = ["source", "min_messages", "max_messages",
+  "min_participants", "max_participants", "sort_by", "sort_order"];
+
+function threadQuery() {
+  return new URLSearchParams(location.hash.split("?")[1] || "");
+}
+
 async function pageThreads() {
-  render(`<div class="toolbar"><h2>Discussions</h2></div>
+  const q = threadQuery();
+  render(`<div class="toolbar"><h2>Discussions</h2>
+      <button class="btn sm ghost" id="toggle-filters">Filters</button></div>
+    <form id="filters" class="card stack" ${[...q.keys()].some((k) => THREAD_FILTERS.includes(k)) ? "" : "hidden"}>
+      <div class="inline">
+        <label>Source <select name="source"><option value="">any</option></select></label>
+        <label>Sort <select name="sort_by">
+          <option value="message_count">messages</option>
+          <option value="participant_count">participants</option>
+          <option value="subject">subject</option>
+          <option value="parsed_at">parsed</option></select></label>
+        <label>Order <select name="sort_order">
+          <option value="desc">desc</option><option value="asc">asc</option></select></label>
+      </div>
+      <div class="inline">
+        <label>Messages <input name="min_messages" type="number" min="0" placeholder="min" class="num">
+          – <input name="max_messages" type="number" min="0" placeholder="max" class="num"></label>
+        <label>Participants <input name="min_participants" type="number" min="0" placeholder="min" class="num">
+          – <input name="max_participants" type="number" min="0" placeholder="max" class="num"></label>
+      </div>
+      <div class="inline"><button class="btn sm">Apply</button>
+        <button type="button" class="btn sm ghost" id="clear-filters">Clear all</button></div>
+    </form>
+    <div id="badges" class="inline"></div>
     <div id="list" class="stack"></div><div id="pager" class="pager"></div>`);
+  const form = $("#filters");
+  $("#toggle-filters").onclick = () => form.toggleAttribute("hidden");
+  // populate the source dropdown from the live source list
+  try {
+    const srcs = (await api("/api/sources")).sources || [];
+    const sel = form.querySelector("select[name=source]");
+    srcs.forEach((s) => {
+      const o = document.createElement("option");
+      o.value = s.source_id; o.textContent = s.name || s.source_id;
+      sel.appendChild(o);
+    });
+  } catch { /* sources need auth; filter still works by typing the hash */ }
+  THREAD_FILTERS.forEach((k) => {
+    const el = form.elements[k];
+    if (el && q.get(k)) el.value = q.get(k);
+  });
+  const setQuery = (params) => {
+    const qs = params.toString();
+    location.hash = "#/threads" + (qs ? "?" + qs : "");
+  };
+  form.onsubmit = (ev) => {
+    ev.preventDefault();
+    const next = new URLSearchParams();
+    THREAD_FILTERS.forEach((k) => {
+      const v = (form.elements[k] && form.elements[k].value || "").trim();
+      if (v && !(k === "sort_by" && v === "message_count")
+            && !(k === "sort_order" && v === "desc")) next.set(k, v);
+    });
+    setQuery(next);
+  };
+  $("#clear-filters").onclick = () => setQuery(new URLSearchParams());
+  // active-filter badges with one-click removal (reference badge row)
+  const active = THREAD_FILTERS.filter((k) => q.get(k));
+  $("#badges").innerHTML = active.map((k) =>
+    `<button class="tag" data-rm="${esc(k)}" title="remove filter">
+       ${esc(k)}: ${esc(q.get(k))} ✕</button>`).join("");
+  $("#badges").querySelectorAll("button[data-rm]").forEach((b) => {
+    b.onclick = () => { const n = threadQuery(); n.delete(b.dataset.rm); setQuery(n); };
+  });
   const load = async (offset) => {
     try {
-      const t = (await api(`/api/threads?limit=${PAGE}&offset=${offset}`)).threads;
+      const qs = threadQuery(); qs.set("limit", PAGE); qs.set("offset", offset);
+      // URLSearchParams.toString() percent-encodes every value
+      const t = (await api("/api/threads?" + qs.toString())).threads;
       $("#list").innerHTML = t.length ? t.map((x) => `
-        <a class="card row" href="#/threads/${esc(x.thread_id)}">
-          <div><h3>${esc(x.subject || x.thread_id)}</h3>
+        <div class="card row">
+          <div><h3><a href="#/threads/${esc(x.thread_id)}">${esc(x.subject || x.thread_id)}</a></h3>
           <p class="muted">${(x.participants || []).slice(0, 5).map(esc).join(", ")}</p></div>
-          <div class="meta"><span>${esc(x.message_count || 0)} messages</span></div></a>`).join("")
-        : emptyPage(offset, "No discussions parsed yet.");
+          <div class="meta"><span>${esc(x.message_count || 0)} messages</span>
+            <a class="btn sm ghost" href="#/threads/${esc(x.thread_id)}/summary">Summary</a>
+          </div></div>`).join("")
+        : emptyPage(offset, active.length
+            ? "No discussions match these filters."
+            : "No discussions parsed yet.");
       pager(offset, t.length, load);
     } catch (e) { err(e); }
   };
   load(0);
+}
+
+async function pageThreadSummary(id) {
+  // Latest summary for one thread (reference ThreadSummary.tsx): the
+  // newest report published for it, with a copyable thread id and a
+  // link through to the full report.
+  try {
+    const rs = (await api(`/api/reports?thread_id=${encodeURIComponent(id)}&limit=1`)).reports;
+    if (!rs.length) {
+      render(`<div class="card muted"><a href="#/threads">← Discussions</a>
+        <p>No summary found for thread <code>${esc(id)}</code> —
+        the pipeline has not published a report for it yet.</p></div>`);
+      return;
+    }
+    const r = rs[0];
+    render(`<article class="card">
+      <p><a href="#/threads">← Discussions</a></p>
+      <h2>Thread summary</h2>
+      <dl class="stats"><dt>Thread</dt>
+        <dd><code id="tid">${esc(r.thread_id)}</code>
+          <button class="btn sm ghost" id="copy-tid">Copy</button></dd>
+        <dt>Published</dt><dd>${fmtDate(r.published_at)}</dd></dl>
+      <section class="summary">${esc(r.summary_text || r.summary || "")}</section>
+      <p><a class="btn sm" href="#/reports/${esc(r.report_id)}">View full report details →</a></p>
+    </article>`);
+    $("#copy-tid").onclick = async () => {
+      try { await navigator.clipboard.writeText(r.thread_id); } catch {}
+      $("#copy-tid").textContent = "Copied";
+      setTimeout(() => ($("#copy-tid").textContent = "Copy"), 1500);
+    };
+  } catch (e) { err(e); }
 }
 
 async function pageOps() {
@@ -521,7 +631,8 @@ const routes = [
   [/^#\/callback/, pageCallback],
   [/^#\/reports$/, pageReports],
   [/^#\/reports\/(.+)$/, (m) => pageReportDetail(m[1])],
-  [/^#\/threads$/, pageThreads],
+  [/^#\/threads(\?.*)?$/, pageThreads],
+  [/^#\/threads\/([^/?]+)\/summary$/, (m) => pageThreadSummary(m[1])],
   [/^#\/threads\/([^/]+)$/, (m) => pageThreadDetail(m[1])],
   [/^#\/messages\/([^/]+)$/, (m) => pageMessageDetail(m[1])],
   [/^#\/sources$/, pageSources],

@@ -1,0 +1,55 @@
+// Behavior port of reference AdminDashboard.test.tsx +
+// RoleManagementModal: the admin page lists users, "Edit roles" opens
+// the checkbox modal, and saving PUTs the selected role set.
+import { describe, expect, it } from "vitest";
+
+import { bootApp, mockFetch, until } from "./helpers.js";
+
+describe("admin role modal", () => {
+  it("lists users, opens the role modal, saves the new role set",
+     async () => {
+    localStorage.setItem("cfc_token", "admin-tok");
+    let users = [{ email: "u@example.org", roles: ["reader"] }];
+    const puts = [];
+    mockFetch([
+      ["/auth/userinfo", () =>
+        ({ sub: "mock|a", email: "admin@example.org",
+           roles: ["admin"] })],
+      ["/stats", () => ({ threads: 3, reports: 3 })],
+      ["/auth/admin/pending", () => ({ pending: [] })],
+      [/\/auth\/admin\/users\/u%40example.org$/, (url, opts) => {
+        puts.push(JSON.parse(opts.body));
+        users = [{ email: "u@example.org",
+                   roles: JSON.parse(opts.body).roles }];
+        return { ok: true };
+      }],
+      ["/auth/admin/users", () => ({ users })],
+    ]);
+
+    window.location.hash = "#/admin";
+    bootApp();
+
+    const view = document.querySelector("#view");
+    await until(() => /u@example.org/.test(view.textContent));
+    // the current role renders as a tag
+    expect(view.textContent).toContain("reader");
+
+    // open the modal (reference RoleManagementModal: checkbox per role)
+    (await until(() => view.querySelector("button[data-edit]"))).click();
+    const overlay = await until(() =>
+      document.querySelector(".overlay"));
+    const boxes = [...overlay.querySelectorAll("input[type=checkbox]")];
+    expect(boxes.map((b) => b.value)).toEqual(
+      ["admin", "reader", "processor", "orchestrator"]);
+    expect(boxes.find((b) => b.value === "reader").checked).toBe(true);
+
+    // grant processor, save -> PUT carries BOTH roles, modal closes,
+    // list refreshes with the new tag
+    boxes.find((b) => b.value === "processor").checked = true;
+    overlay.querySelector("#modal-save").click();
+    await until(() => puts.length === 1);
+    expect(puts[0].roles.sort()).toEqual(["processor", "reader"]);
+    await until(() => !document.querySelector(".overlay"));
+    await until(() => /processor/.test(view.textContent));
+  });
+});

@@ -1,0 +1,63 @@
+// Behavior port of reference DiscussionsList.test.tsx: thread rows
+// render from the API, the filter form drives the query string (and
+// re-fetch), filtered-empty shows its own message, and filter badges
+// remove individual filters.
+import { describe, expect, it } from "vitest";
+
+import { bootApp, mockFetch, submit, until } from "./helpers.js";
+
+describe("discussions list + filters", () => {
+  it("renders rows, applies min_messages filter, clears via badge",
+     async () => {
+    localStorage.setItem("cfc_token", "tok");
+    const threadQueries = [];
+    mockFetch([
+      ["/auth/userinfo", () =>
+        ({ sub: "mock|r", email: "r@example.org", roles: ["reader"] })],
+      ["/api/sources", () =>
+        ({ sources: [{ source_id: "ietf", name: "IETF archive" }] })],
+      ["/api/threads?", (url) => {
+        const q = new URLSearchParams(url.split("?")[1]);
+        threadQueries.push(q);
+        if (Number(q.get("min_messages") || 0) > 3) {
+          return { threads: [] };
+        }
+        return { threads: [{
+          thread_id: "t1", subject: "Hello QUIC",
+          participants: ["a@x", "b@x"], message_count: 3 }] };
+      }],
+    ]);
+
+    window.location.hash = "#/threads";
+    bootApp();
+
+    const view = document.querySelector("#view");
+    await until(() => /Hello QUIC/.test(view.textContent));
+    // summary deep-link per row (reference summary link column)
+    expect(view.querySelector('a[href="#/threads/t1/summary"]'))
+      .toBeTruthy();
+    // source dropdown populated from the API (reference behavior)
+    await until(() => [...view.querySelectorAll(
+      "select[name=source] option")].some(
+      (o) => o.textContent === "IETF archive"));
+
+    // apply a filter: query string + server query must carry it
+    const form = view.querySelector("#filters");
+    form.elements.min_messages.value = "5";
+    submit(form);
+    await until(() => window.location.hash.includes("min_messages=5"));
+    await until(() => threadQueries.some(
+      (q) => q.get("min_messages") === "5"));
+    // filtered-empty state is NOT the first-run empty state
+    await until(() =>
+      /No discussions match these filters/.test(view.textContent));
+
+    // the active-filter badge removes just that filter
+    const badge = await until(() =>
+      document.querySelector('#badges button[data-rm="min_messages"]'));
+    badge.click();
+    await until(() =>
+      !window.location.hash.includes("min_messages"));
+    await until(() => /Hello QUIC/.test(view.textContent));
+  });
+});

@@ -1,0 +1,65 @@
+// Shared jsdom harness for the vanilla SPA. app.js is a plain script
+// (no modules): we build the index.html shell DOM, install the fetch
+// mock, then indirect-eval the source so its top-level wiring
+// (router, hashchange listener, user box) runs exactly as in a
+// browser. One boot per test FILE — vitest isolates files, so each
+// suite gets a clean window/listener set.
+import { readFileSync } from "node:fs";
+
+export const APP_SRC = readFileSync(
+  new URL("../app.js", import.meta.url), "utf8");
+
+export function bootApp() {
+  document.body.innerHTML = `
+    <header><nav id="nav">
+      <a data-nav="reports" href="#/reports">Reports</a>
+      <a data-nav="threads" href="#/threads">Discussions</a>
+      <a data-nav="admin" href="#/admin">Admin</a>
+    </nav><div id="user-box"></div></header>
+    <main id="view"></main>`;
+  (0, eval)(APP_SRC);
+}
+
+export async function until(fn, ms = 5000) {
+  const t0 = Date.now();
+  let last;
+  while (Date.now() - t0 < ms) {
+    try {
+      const v = fn();
+      if (v) return v;
+      last = v;
+    } catch (e) { last = e; }
+    await new Promise((r) => setTimeout(r, 15));
+  }
+  throw new Error("until() timed out; last=" + String(last));
+}
+
+// Route-table fetch mock. Handlers get (url, opts) and return the
+// JSON body (or [status, body]). Unmatched paths 404 so a typo'd
+// fetch in app.js fails the test instead of hanging it.
+export function mockFetch(routes) {
+  const calls = [];
+  globalThis.fetch = async (url, opts = {}) => {
+    calls.push({ url, opts });
+    for (const [pattern, handler] of routes) {
+      if (typeof pattern === "string" ? url.startsWith(pattern)
+          : pattern.test(url)) {
+        let out = handler(url, opts);
+        let status = 200;
+        if (Array.isArray(out)) [status, out] = out;
+        return {
+          status,
+          ok: status >= 200 && status < 300,
+          text: async () => (out == null ? "" : JSON.stringify(out)),
+        };
+      }
+    }
+    return { status: 404, ok: false, text: async () => "{}" };
+  };
+  return calls;
+}
+
+export function submit(form) {
+  form.dispatchEvent(new window.Event("submit",
+    { bubbles: true, cancelable: true }));
+}

@@ -1,0 +1,53 @@
+// Behavior port of reference ui/src/routes/Login.test.tsx: an
+// unauthenticated visit redirects to the login route, which renders
+// the provider buttons and the developer (mock) sign-in path; a
+// successful mock sign-in lands on reports with the token stored.
+import { describe, expect, it } from "vitest";
+
+import { bootApp, mockFetch, submit, until } from "./helpers.js";
+
+describe("login redirect + mock sign-in", () => {
+  it("401 on a protected page routes to #/login and renders providers",
+     async () => {
+    localStorage.clear();
+    let authed = false;
+    mockFetch([
+      ["/auth/userinfo", () => authed
+        ? { sub: "mock|d", email: "dev@example.org", roles: ["reader"] }
+        : [401, { error: "unauthorized" }]],
+      ["/auth/login", () =>
+        ({ state: "st-1", authorize_url: "https://idp.example/authz" })],
+      ["/auth/callback", () => {
+        authed = true;
+        return { access_token: "tok-123", token_type: "Bearer" };
+      }],
+      ["/api/reports", (url, opts) =>
+        (opts.headers || {}).Authorization === "Bearer tok-123"
+          ? { reports: [] } : [401, { error: "unauthorized" }]],
+    ]);
+
+    window.location.hash = "#/reports";
+    bootApp();
+
+    // unauthenticated: the reports fetch 401s and the app must land
+    // on the login route (reference: unauthenticated -> Login render)
+    await until(() => window.location.hash === "#/login");
+    const view = document.querySelector("#view");
+    await until(() => /Sign in/.test(view.textContent));
+    const providers = [...view.querySelectorAll("#providers button")]
+      .map((b) => b.textContent);
+    expect(providers.some((t) => /Github/i.test(t))).toBe(true);
+    expect(providers.some((t) => /Google/i.test(t))).toBe(true);
+
+    // developer sign-in: PKCE state round-trip + token stored + lands
+    // on reports
+    const form = await until(() => view.querySelector("#mock-form"));
+    form.elements.email.value = "dev@example.org";
+    submit(form);
+    await until(() => localStorage.getItem("cfc_token") === "tok-123");
+    await until(() => window.location.hash === "#/reports");
+    // signed-in user box shows the identity
+    await until(() => /dev@example.org/.test(
+      document.querySelector("#user-box").textContent));
+  });
+});

@@ -1,0 +1,11 @@
+import { defineConfig } from "vitest/config";
+
+export default defineConfig({
+  test: {
+    environment: "jsdom",
+    include: ["tests/**/*.test.js"],
+    // each file boots app.js into a fresh jsdom globals set
+    isolate: true,
+    testTimeout: 10000,
+  },
+});

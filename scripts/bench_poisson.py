@@ -43,7 +43,17 @@ def main() -> None:
     ap.add_argument("--batch-tok-s", type=float, default=3215.0,
                     help="measured batch-bench tok/s for the same config"
                          " (capacity reference)")
+    ap.add_argument("--poll-harvest", action="store_true",
+                    help="legacy 2ms polling harvest loop (the r4 "
+                         "host-tax baseline) instead of completion "
+                         "callbacks — for A/B measurement only")
+    ap.add_argument("--switch-interval", type=float, default=0.0,
+                    help="sys.setswitchinterval override (default: "
+                         "leave CPython's 5ms); raising it cuts GIL "
+                         "handoffs during the dispatch call")
     args = ap.parse_args()
+    if args.switch_interval:
+        sys.setswitchinterval(args.switch_interval)
 
     model = os.environ.get("BENCH_MODEL", "mistral-7b")
     slots = int(os.environ.get("BENCH_SLOTS", "128"))
@@ -99,43 +109,95 @@ def main() -> None:
     print(f"offered load {rate:.1f} req/s "
           f"(capacity ~{cap_req_s:.1f} req/s)", file=sys.stderr)
 
-    handles: list = []
+    # Two harvest modes. Callback mode (default) is the r5 host-tax
+    # fix: the arrival thread sleeps until the NEXT arrival and does
+    # nothing else; completions are accounted on the dispatcher thread
+    # as they resolve. Poll mode is the r4 baseline: wake every 2ms and
+    # scan every in-flight handle — measured to inflate the dispatch
+    # call 0.77s -> 0.90s under load via GIL contention (PERF.md r4).
+    import threading
+
     lat: list[float] = []
-    served_tokens = 0
+    served = [0]
+    acct = threading.Lock()
+
+    def _account(t_sub: float, h) -> None:
+        try:
+            c = h.result(0)
+        except Exception:
+            return                      # failed/stopped request
+        with acct:
+            lat.append(time.monotonic() - t_sub)
+            served[0] += len(c.tokens)
+
+    handles: list = []
     t_start = time.monotonic()
     t_next = t_start
     submitted = 0
-    while True:
-        now = time.monotonic()
-        if now - t_start >= args.duration:
-            break
-        if now >= t_next:
-            handles.append((now, runner.submit(mk_prompt(), new_tokens)))
+    if args.poll_harvest:
+        while True:
+            now = time.monotonic()
+            if now - t_start >= args.duration:
+                break
+            if now >= t_next:
+                handles.append((now, runner.submit(mk_prompt(),
+                                                   new_tokens)))
+                submitted += 1
+                t_next += rng.exponential(1.0 / rate)
+            else:
+                time.sleep(min(0.002, t_next - now))
+            still = []
+            for t_sub, h in handles:
+                if h.done():
+                    _account(t_sub, h)
+                else:
+                    still.append((t_sub, h))
+            handles = still
+    else:
+        # No handle list: retaining every resolved handle (and its
+        # Completion token list) grows memory for the whole run. The
+        # done-callback both accounts AND retires; a plain counter +
+        # condition is all the drain needs.
+        inflight = [0]
+        drained = threading.Condition()
+
+        def _retire(t_sub, h):
+            _account(t_sub, h)
+            with drained:
+                inflight[0] -= 1
+                drained.notify()
+
+        while True:
+            now = time.monotonic()
+            if now - t_start >= args.duration:
+                break
+            if now < t_next:
+                time.sleep(t_next - now)    # ONE sleep per arrival
+                continue
+            t_sub = time.monotonic()
+            h = runner.submit(mk_prompt(), new_tokens)
+            with drained:
+                inflight[0] += 1
+            h.add_done_callback(lambda hh, t=t_sub: _retire(t, hh))
             submitted += 1
             t_next += rng.exponential(1.0 / rate)
-        else:
-            time.sleep(min(0.002, t_next - now))
-        # harvest finished handles without blocking
-        still = []
-        for t_sub, h in handles:
-            if h.done():
-                c = h.result(0)
-                lat.append(time.monotonic() - t_sub)
-                served_tokens += len(c.tokens)
-            else:
-                still.append((t_sub, h))
-        handles = still
     # drain what's in flight (counts toward throughput window only up
     # to the measured elapsed time below)
-    for t_sub, h in handles:
-        try:
-            c = h.result(timeout=120)
-            lat.append(time.monotonic() - t_sub)
-            served_tokens += len(c.tokens)
-        except TimeoutError:
-            pass
+    if args.poll_harvest:
+        for t_sub, h in handles:
+            try:
+                h.result(timeout=120)
+            except Exception:
+                pass
+            _account(t_sub, h)
+    else:
+        deadline = time.monotonic() + 120
+        with drained:
+            while inflight[0] and time.monotonic() < deadline:
+                drained.wait(timeout=1.0)
     elapsed = time.monotonic() - t_start
     runner.stop()
+    served_tokens = served[0]
 
     print(f"dispatches: piggy {eng.piggy_dispatches} "
           f"({eng.piggy_s:.1f}s, {eng.piggy_rows} rows / "
@@ -156,6 +218,12 @@ def main() -> None:
         "p50_latency_s": round(float(lat_arr[len(lat_arr) // 2]), 2),
         "p95_latency_s": round(float(lat_arr[int(len(lat_arr) * 0.95)
                                              - 1]), 2),
+        # the r4 host-tax telemetry: mean plain decode dispatch under
+        # serving load (quiet baseline ~0.77s at 128 slots; 0.90s was
+        # the polling-harvest contention figure)
+        "mean_dispatch_s": round(
+            eng.plain_s / max(1, eng.plain_dispatches), 3),
+        "harvest": "poll" if args.poll_harvest else "callback",
     }))
 
 

@@ -1,0 +1,137 @@
+"""Multi-process serving efficiency: same global mesh, 1 vs 2 processes.
+
+The serving engine runs SPMD over a dp x tp mesh; with 2 processes the
+same programs execute multi-controller and every collective + harvest
+crosses the process boundary through the distributed runtime (the DCN
+tier on localhost). The ratio
+
+    eff = tok_s(2 procs, 2+2 devices) / tok_s(1 proc, 4 devices)
+
+isolates the multi-controller LOCKSTEP overhead (coordination, cross-
+process collectives, allgather harvest) from compute, because compute
+is identical. On CPU this is an upper bound on the overhead fraction —
+real ICI collectives are faster than localhost gRPC, real TPU compute
+is faster than CPU, so the measured overhead seconds here are
+pessimistic in absolute terms.
+
+Usage: python scripts/bench_scaleout.py [--model tiny] [--slots 8]
+       [--new-tokens 32] [--reps 3]
+Prints one JSON line per configuration + a final summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=@LOCAL@"
+    sys.path.insert(0, "@REPO@")
+    rank, nprocs = int(sys.argv[1]), int(sys.argv[2])
+    model, slots, new_tokens, reps = (sys.argv[3], int(sys.argv[4]),
+                                      int(sys.argv[5]), int(sys.argv[6]))
+    if nprocs > 1:
+        from copilot_for_consensus_tpu.parallel.multihost import (
+            MultiHostConfig, initialize_multihost)
+        initialize_multihost(MultiHostConfig(
+            coordinator_address="@COORD@", num_processes=nprocs,
+            process_id=rank))
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from copilot_for_consensus_tpu.engine.generation import (
+        GenerationEngine)
+    from copilot_for_consensus_tpu.models import decoder
+    from copilot_for_consensus_tpu.models.configs import decoder_config
+
+    cfg = decoder_config(model)
+    params = decoder.init_params(jax.random.PRNGKey(7), cfg,
+                                 dtype=jnp.float32)
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(2, len(devs) // 2), ("dp", "tp"))
+    eng = GenerationEngine(cfg, params, mesh=mesh, num_slots=slots,
+                           max_len=96, prefill_buckets=(16,),
+                           dtype=jnp.float32, attn_impl="xla",
+                           decode_window=8)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(3, cfg.vocab_size, size=12).tolist()
+               for _ in range(slots)]
+    eng.generate(prompts, max_new_tokens=new_tokens)      # compile
+    best = None
+    for _ in range(reps):
+        t0 = time.monotonic()
+        comps = eng.generate(prompts, max_new_tokens=new_tokens)
+        dt = time.monotonic() - t0
+        n = sum(len(c.tokens) for c in comps)
+        best = max(best or 0.0, n / dt)
+    print(json.dumps({"rank": rank, "tok_s": round(best, 1)}),
+          flush=True)
+""")
+
+
+def _run(nprocs: int, local_devs: int, args) -> float:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    src = (_WORKER.replace("@REPO@", str(REPO))
+           .replace("@COORD@", coord)
+           .replace("@LOCAL@", str(local_devs)))
+    script = REPO / "scripts" / f"_scaleout_worker_{nprocs}.py"
+    script.write_text(src)
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(rank), str(nprocs),
+             args.model, str(args.slots), str(args.new_tokens),
+             str(args.reps)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={"PATH": "/usr/bin:/bin:/usr/local/bin"})
+            for rank in range(nprocs)]
+        tok_s = 0.0
+        for p in procs:
+            out, err = p.communicate(timeout=900)
+            if p.returncode != 0:
+                raise RuntimeError(err[-2000:])
+            row = json.loads(out.strip().splitlines()[-1])
+            if row["rank"] == 0:
+                tok_s = row["tok_s"]
+        return tok_s
+    finally:
+        script.unlink(missing_ok=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--new-tokens", type=int, default=32)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    one = _run(1, 4, args)      # 1 process, 4 local devices
+    print(json.dumps({"config": "1proc_4dev", "tok_s": one}), flush=True)
+    two = _run(2, 2, args)      # 2 processes x 2 local devices
+    print(json.dumps({"config": "2proc_2+2dev", "tok_s": two}),
+          flush=True)
+    print(json.dumps({
+        "metric": f"{args.model} serving scale-out efficiency "
+                  "(2-process multi-controller vs single-process, "
+                  "same 2x2 mesh, CPU)",
+        "value": round(two / one, 3) if one else 0.0,
+        "unit": "fraction",
+        "tok_s_1proc": one, "tok_s_2proc": two,
+    }))
+
+
+if __name__ == "__main__":
+    main()

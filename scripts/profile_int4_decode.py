@@ -1,0 +1,163 @@
+"""Decompose the int4 long-extent decode pathology on the real chip.
+
+r4 measured 136 ms/step at 32 slots x 3072-token extent (int4 weights)
+vs a ~30 ms bytes floor — 4.5x off roofline exactly where int4 is
+mandatory (the capacity envelope). This script separates the suspects:
+
+1. full decode-window dispatch per extent x qmatmul route
+   (Pallas fused int4 vs XLA dequant — the r5 auto-route candidates);
+2. the weight matmuls alone at decode width (extent-independent by
+   construction — if these degrade with extent, HBM pressure/paging is
+   implicated, not the kernels);
+3. decode attention alone per extent (kv reads scale with extent —
+   if THIS blows past its byte count, the attention kernel or the
+   cache layout is the problem, not the weight path).
+
+Usage (real TPU, quiet machine):
+    python scripts/profile_int4_decode.py [--slots 32] [--extents 512,1024,2048,3072]
+Prints one JSON line per measurement to stdout, human notes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _timeit(fn, *args, reps: int = 10, **kw) -> float:
+    """Median wall seconds of a blocking call after one warmup."""
+    import jax
+
+    jax.block_until_ready(fn(*args, **kw))
+    times = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn(*args, **kw))
+        times.append(time.monotonic() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="mistral-7b")
+    ap.add_argument("--slots", type=int, default=32)
+    ap.add_argument("--extents", default="512,1024,2048,3072")
+    ap.add_argument("--window", type=int, default=32)
+    ap.add_argument("--skip-engine", action="store_true",
+                    help="kernel/attention microbenches only")
+    args = ap.parse_args()
+    extents = [int(x) for x in args.extents.split(",")]
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from copilot_for_consensus_tpu.models import decoder_config, quant
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.device_kind} ({dev.platform})")
+    cfg = decoder_config(args.model)
+
+    # -- 2. weight kernels alone at decode width -----------------------
+    from copilot_for_consensus_tpu.ops.quant_matmul import (
+        int4_matmul,
+        int4_matmul_xla,
+    )
+
+    rng = np.random.default_rng(0)
+    m = args.slots
+    for (n, k) in ((cfg.d_model, cfg.d_model),
+                   (cfg.d_model, cfg.d_ff),
+                   (cfg.d_ff, cfg.d_model)):
+        x = jnp.asarray(rng.normal(size=(m, n)), dtype=jnp.bfloat16)
+        w = quant.quantize_tensor_int4(
+            jnp.asarray(rng.normal(size=(n, k)), dtype=jnp.bfloat16))
+        for route, fn in (("pallas", int4_matmul), ("xla",
+                                                    int4_matmul_xla)):
+            t = _timeit(lambda f=fn: f(x, w["q4"], w["scale"]))
+            print(json.dumps({
+                "probe": "qmatmul", "route": route, "m": m,
+                "shape": [n, k], "ms": round(t * 1e3, 3),
+                "gbps": round((n * k / 2) / t / 1e9, 1)}), flush=True)
+
+    # -- 3. decode attention alone per extent --------------------------
+    from copilot_for_consensus_tpu.ops.attention import decode_attention
+
+    heads, kv_heads, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    for ext in extents:
+        q = jnp.asarray(rng.normal(size=(args.slots, heads, hd)),
+                        dtype=jnp.bfloat16)
+        kc = jnp.asarray(rng.normal(size=(args.slots, kv_heads, ext, hd)),
+                         dtype=jnp.float8_e4m3fn)
+        vc = jnp.asarray(rng.normal(size=(args.slots, kv_heads, ext, hd)),
+                         dtype=jnp.float8_e4m3fn)
+        lens = jnp.full((args.slots,), ext, dtype=jnp.int32)
+        try:
+            t = _timeit(lambda: decode_attention(q, kc, vc, lens))
+            bytes_read = args.slots * kv_heads * ext * hd * 2
+            print(json.dumps({
+                "probe": "decode_attention", "extent": ext,
+                "ms": round(t * 1e3, 3),
+                "gbps": round(bytes_read / t / 1e9, 1)}), flush=True)
+        except Exception as exc:
+            print(json.dumps({"probe": "decode_attention", "extent": ext,
+                              "error": str(exc)[:200]}), flush=True)
+
+    if args.skip_engine:
+        return
+
+    # -- 1. full decode dispatch per extent x route --------------------
+    from copilot_for_consensus_tpu.engine.generation import (
+        GenerationEngine,
+    )
+
+    for ext in extents:
+        for route in ("pallas", "xla"):
+            prompt_len = ext - args.window * 2 - 16
+            try:
+                eng = GenerationEngine(
+                    cfg, num_slots=args.slots, max_len=ext,
+                    prefill_buckets=(prompt_len,), dtype=jnp.bfloat16,
+                    kv_dtype="float8_e4m3fn", quantize="int4",
+                    decode_window=args.window,
+                    admission_token_budget=8192,
+                    # route selection under test: None = Pallas (the
+                    # r4 path), 0 = XLA dequant for every extent
+                    int4_pallas_max_extent=(None if route == "pallas"
+                                            else 0))
+                prompts = [rng.integers(
+                    3, cfg.vocab_size, size=prompt_len).tolist()
+                    for _ in range(args.slots)]
+                t0 = time.monotonic()
+                eng.generate(prompts, max_new_tokens=args.window * 2)
+                warm = time.monotonic() - t0
+                p0, s0 = eng.plain_dispatches, eng.plain_s
+                eng.generate(prompts, max_new_tokens=args.window * 2)
+                n_disp = eng.plain_dispatches - p0
+                disp_s = eng.plain_s - s0
+                ms_step = disp_s / max(1, n_disp) / args.window * 1e3
+                print(json.dumps({
+                    "probe": "engine_step", "extent": ext,
+                    "route": route, "ms_per_step": round(ms_step, 2),
+                    "dispatches": n_disp,
+                    "warmup_s": round(warm, 1)}), flush=True)
+                del eng
+            except Exception as exc:
+                print(json.dumps({
+                    "probe": "engine_step", "extent": ext,
+                    "route": route, "error": str(exc)[:200]}),
+                    flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Azure emulator integration lane (round-5 verdict item 7).
+
+The wire-contract mock suites (`test_azure_servicebus.py`,
+`test_azure_ai_search.py`, ...) encode our BELIEF about each Azure
+REST protocol; this lane checks that belief against Microsoft's own
+emulators, the way the reference's azure-integration CI does
+(reference ``docker-compose.azure-emulators.yml``,
+``.github/workflows/azure-integration-ci.yml``).
+
+Coverage here is the two drivers whose emulators speak the REST data
+plane our drivers implement:
+
+- **Azure Blob archive store** against **Azurite** (full Blob REST).
+- **Cosmos document store** against the **Cosmos vNext emulator**
+  (SQL-over-REST).
+
+Not emulatable: the Service Bus emulator exposes AMQP 1.0 only (no
+REST data plane, which `bus/azure_servicebus.py` implements), and AI
+Search / Key Vault have no official emulators — those drivers remain
+wire-mock-verified only, matching the reference's own gaps (its SB
+emulator block is marked "not yet used in CI").
+
+Run:
+    docker compose -f deploy/docker-compose.azure-emulators.yml up -d
+    AZURITE_BLOB_ENDPOINT=http://127.0.0.1:10000/devstoreaccount1 \
+    COSMOS_EMULATOR_ENDPOINT=http://127.0.0.1:8081 \
+        python -m pytest tests/test_azure_emulators.py -m emulator -v
+
+Each driver's tests skip cleanly when its endpoint env var is unset,
+so the default lanes never depend on docker.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import pytest
+
+pytestmark = [pytest.mark.emulator, pytest.mark.integration]
+
+AZURITE = os.environ.get("AZURITE_BLOB_ENDPOINT", "")
+COSMOS = os.environ.get("COSMOS_EMULATOR_ENDPOINT", "")
+
+# Microsoft's documented well-known Azurite dev credentials — NOT
+# secrets (they only ever authenticate against a local emulator).
+AZURITE_ACCOUNT = "devstoreaccount1"
+AZURITE_KEY = ("Eby8vdM02xNOcqFlqUwJPLlmEtlCDXJ1OUzFT50uSRZ6IFsuFq2UVErC"
+               "z4I6tq/K1SZFPTOtr/KBHBeksoGMGw==")
+# Cosmos emulator's documented fixed master key — same status.
+COSMOS_KEY = ("C2y6yDjf5/R+ob0N8A7Cgv30VRDJIWEHLM+4QDU5DE2nQ9nDuVTqobD4b8"
+              "mGGyPMbIZnqyMsEcaGQy67XIw/Jw==")
+
+
+# -- Azurite: Blob archive store ---------------------------------------
+
+azurite = pytest.mark.skipif(
+    not AZURITE, reason="AZURITE_BLOB_ENDPOINT not set (emulator lane)")
+
+
+def _create_container(container: str) -> None:
+    """Provision the test container with a raw SharedKey PUT (the
+    driver itself deliberately has no provisioning surface — operators
+    own container lifecycle)."""
+    import email.utils
+    import urllib.request
+
+    from copilot_for_consensus_tpu.archive.azure_blob import (
+        _shared_key_signature,
+    )
+
+    url = f"{AZURITE.rstrip('/')}/{container}?restype=container"
+    headers = {"x-ms-date": email.utils.formatdate(usegmt=True),
+               "x-ms-version": "2021-08-06"}
+    headers["Authorization"] = _shared_key_signature(
+        AZURITE_ACCOUNT, AZURITE_KEY, "PUT", url, headers, 0)
+    req = urllib.request.Request(url, method="PUT", headers=headers)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 201
+
+
+@pytest.fixture()
+def blob_store():
+    from copilot_for_consensus_tpu.archive.azure_blob import (
+        AzureBlobArchiveStore,
+    )
+
+    container = f"emul-{uuid.uuid4().hex[:10]}"
+    _create_container(container)
+    return AzureBlobArchiveStore(
+        AZURITE_ACCOUNT, container,
+        account_key=AZURITE_KEY, endpoint=AZURITE)
+
+
+@azurite
+def test_blob_round_trip_against_azurite(blob_store):
+    aid = uuid.uuid4().hex[:16]
+    uri = blob_store.save(aid, b"From x@y Mon\nSubject: hi\n\nbody\n",
+                          {"source_id": "emul"})
+    assert aid in uri
+    assert blob_store.load(aid).startswith(b"From x@y")
+    assert blob_store.exists(aid)
+    assert blob_store.delete(aid)
+    assert not blob_store.exists(aid)
+    assert not blob_store.delete(aid)      # second delete reports absent
+
+
+@azurite
+def test_blob_overwrite_and_missing_against_azurite(blob_store):
+    aid = uuid.uuid4().hex[:16]
+    blob_store.save(aid, b"v1", {})
+    blob_store.save(aid, b"v2 longer content", {})
+    assert blob_store.load(aid) == b"v2 longer content"
+    with pytest.raises(Exception):
+        blob_store.load("0" * 16)          # absent blob must not return junk
+
+
+# -- Cosmos emulator: document store -----------------------------------
+
+cosmos = pytest.mark.skipif(
+    not COSMOS, reason="COSMOS_EMULATOR_ENDPOINT not set (emulator lane)")
+
+
+@pytest.fixture()
+def cosmos_store():
+    from copilot_for_consensus_tpu.storage.azure_cosmos import (
+        AzureCosmosDocumentStore,
+    )
+
+    store = AzureCosmosDocumentStore(
+        "emulator", COSMOS_KEY, database=f"emul{uuid.uuid4().hex[:8]}",
+        endpoint=COSMOS)
+    store.connect()
+    return store
+
+
+@cosmos
+def test_cosmos_crud_and_filters_against_emulator(cosmos_store):
+    st = cosmos_store
+    for i in range(5):
+        st.insert_document("threads", {
+            "thread_id": f"t{i}", "subject": f"subject {i}",
+            "message_count": i})
+    assert st.count_documents("threads") == 5
+    got = st.get_document("threads", "t3")
+    assert got and got["message_count"] == 3
+    # the filter->SQL translation must hold against the REAL query
+    # engine, not just the oracle mock
+    rows = st.query_documents("threads",
+                              {"message_count": {"$gte": 3}})
+    assert sorted(r["thread_id"] for r in rows) == ["t3", "t4"]
+    rows = st.query_documents(
+        "threads", {"thread_id": {"$in": ["t0", "t4", "zz"]}},
+        sort=[("message_count", -1)])
+    assert [r["thread_id"] for r in rows] == ["t4", "t0"]
+    st.update_document("threads", "t0", {"message_count": 99})
+    assert st.get_document("threads", "t0")["message_count"] == 99
+    assert st.delete_document("threads", "t1")
+    assert st.count_documents("threads") == 4

@@ -135,3 +135,38 @@ def test_async_bad_request_fails_its_handle_not_the_loop():
         assert len(good.result(timeout=120).tokens) >= 1
     finally:
         runner.stop()
+
+
+def test_done_callbacks_fire_without_polling():
+    """The r5 harvest path: callbacks fire on resolution (dispatcher
+    thread), fire immediately when registered after resolution, and
+    fire on failure too — no caller ever needs to poll done()."""
+    runner = AsyncEngineRunner(_engine()).start()
+    try:
+        fired = []
+        ev = threading.Event()
+        h = runner.submit([5, 6, 7], 4)
+        h.add_done_callback(lambda hh: (fired.append(hh.request_id),
+                                        ev.set()))
+        assert ev.wait(120)
+        assert fired == [h.request_id]
+        assert h.done() and h.result(0).tokens
+        # late registration: fires immediately on the calling thread
+        late = []
+        h.add_done_callback(lambda hh: late.append("now"))
+        assert late == ["now"]
+        # failure path: bad request resolves its handle via callback
+        fail_ev = threading.Event()
+        bad = runner.submit([], 4)
+        bad.add_done_callback(lambda hh: fail_ev.set())
+        assert fail_ev.wait(30)
+        with pytest.raises(Exception):
+            bad.result(0)
+        # a raising callback must not kill the dispatcher
+        h2 = runner.submit([9, 9], 4)
+        h2.add_done_callback(lambda hh: 1 / 0)
+        assert h2.result(120).tokens
+        h3 = runner.submit([4, 5], 4)
+        assert h3.result(120).tokens       # dispatcher still alive
+    finally:
+        runner.stop()

@@ -409,3 +409,60 @@ def test_hostile_asset_names_404_not_500(server):
     for bad in ("%00", "..%2f..%2fetc%2fpasswd", "%0a", "a%00b.js"):
         status, _ = _call(server.port, f"/ui/{bad}")
         assert status == 404, bad
+
+
+def test_threads_filtering_and_sorting(server, tokens):
+    """DiscussionsList-parity query surface (r5): source/message/
+    participant filters + sort compose server-side so pagination stays
+    correct under filtering."""
+    import time
+
+    tok = tokens["admin@example.org"]
+    # make sure the fixture corpus is ingested (duplicate is fine) and
+    # the async pump has parsed it into thread docs
+    raw = FIXTURE.read_bytes()
+    _call(server.port, "/api/upload", method="POST", token=tok,
+          body={"filename": "threads-filter.mbox",
+                "content_b64": base64.b64encode(raw).decode(),
+                "source_id": "threads-filter"})
+    deadline = time.time() + 30
+    all_t = {"threads": []}
+    while time.time() < deadline and not all_t["threads"]:
+        status, all_t = _call(server.port, "/api/threads?limit=50",
+                              token=tok)
+        assert status == 200
+        time.sleep(0.2)
+    assert all_t["threads"], "pipeline produced no threads"
+
+    status, out = _call(server.port,
+                        "/api/threads?min_messages=2", token=tok)
+    assert status == 200
+    assert all(t["message_count"] >= 2 for t in out["threads"])
+    n_ge2 = sum(1 for t in all_t["threads"] if t["message_count"] >= 2)
+    assert len(out["threads"]) == min(50, n_ge2)
+
+    status, out = _call(server.port,
+                        "/api/threads?max_messages=1", token=tok)
+    assert status == 200
+    assert all(t["message_count"] <= 1 for t in out["threads"])
+
+    status, out = _call(
+        server.port,
+        "/api/threads?sort_by=subject&sort_order=asc", token=tok)
+    subjects = [t.get("subject") or "" for t in out["threads"]]
+    assert subjects == sorted(subjects)
+
+    status, out = _call(server.port,
+                        "/api/threads?max_participants=2", token=tok)
+    assert all(len(t.get("participants") or []) <= 2
+               for t in out["threads"])
+
+    # filters compose with pagination: page size honored after filter
+    status, out = _call(server.port,
+                        "/api/threads?min_messages=1&limit=1", token=tok)
+    assert len(out["threads"]) <= 1
+
+    # a non-integer range value is a 400, not a 500
+    status, _ = _call(server.port,
+                      "/api/threads?min_messages=bogus", token=tok)
+    assert status == 400

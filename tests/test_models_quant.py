@@ -158,3 +158,76 @@ def test_fuse_int4_rejects_moe_leaves():
     qp = quant.quantize_params(params, mode="int4")
     with pytest.raises(ValueError, match="dense FFN"):
         quant.fuse_int4_projections(qp)
+
+
+def test_pallas_override_is_thread_local_and_scoped():
+    """pallas_qmatmul_override must shadow the global flag only on the
+    holding thread and only inside the block — it is how one engine
+    re-routes one program without flipping the route under others."""
+    import threading
+
+    from copilot_for_consensus_tpu.models import quant
+
+    prev = quant.pallas_qmatmul_enabled()
+    quant.set_pallas_qmatmul(True)
+    try:
+        seen = {}
+
+        def other_thread():
+            seen["other"] = quant.pallas_qmatmul_enabled()
+
+        with quant.pallas_qmatmul_override(False):
+            assert not quant.pallas_qmatmul_enabled()
+            t = threading.Thread(target=other_thread)
+            t.start()
+            t.join()
+            # nesting restores the outer override, not the global
+            with quant.pallas_qmatmul_override(True):
+                assert quant.pallas_qmatmul_enabled()
+            assert not quant.pallas_qmatmul_enabled()
+        assert quant.pallas_qmatmul_enabled()
+        assert seen["other"] is True
+        # None = no-op passthrough
+        with quant.pallas_qmatmul_override(None):
+            assert quant.pallas_qmatmul_enabled()
+    finally:
+        quant.set_pallas_qmatmul(prev)
+
+
+def test_engine_auto_routes_long_extent_int4_decode():
+    """int4 engines past the extent threshold trace their decode
+    program with the XLA dequant route (the 136 ms/step @3072 Pallas
+    pathology, r4 verdict Weak 3); short-extent engines keep Pallas."""
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu.engine.generation import (
+        GenerationEngine,
+    )
+    from copilot_for_consensus_tpu.models import quant
+    from copilot_for_consensus_tpu.models.configs import decoder_config
+
+    prev = quant.pallas_qmatmul_enabled()
+    # the auto-route only arms when the global Pallas route is on
+    # (a sharded-engine test earlier in the session may have cleared it)
+    quant.set_pallas_qmatmul(True)
+    cfg = decoder_config("tiny", max_seq_len=4096)
+    long_eng = GenerationEngine(
+        cfg, num_slots=2, max_len=2048, prefill_buckets=(16,),
+        dtype=jnp.float32, quantize="int4", decode_window=4)
+    assert long_eng._decode_pallas_override is False
+    short_eng = GenerationEngine(
+        cfg, num_slots=2, max_len=256, prefill_buckets=(16,),
+        dtype=jnp.float32, quantize="int4", decode_window=4)
+    assert short_eng._decode_pallas_override is None
+    off_eng = GenerationEngine(
+        cfg, num_slots=2, max_len=2048, prefill_buckets=(16,),
+        dtype=jnp.float32, quantize="int4", decode_window=4,
+        int4_pallas_max_extent=None)
+    assert off_eng._decode_pallas_override is None
+    # the routed engine still generates (CPU: both routes are the XLA
+    # expression, so this exercises the wrapped dispatch path only)
+    try:
+        comps = long_eng.generate([[5, 6, 7]], max_new_tokens=4)
+        assert comps[0].tokens
+    finally:
+        quant.set_pallas_qmatmul(prev)

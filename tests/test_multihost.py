@@ -114,3 +114,194 @@ def test_two_process_cpu_cluster_psum(tmp_path):
         outs.append(json.loads(out.strip().splitlines()[-1]))
     assert {o["rank"] for o in outs} == {0, 1}
     assert all(o["psum"] == 10.0 for o in outs)
+
+
+# -- multi-process SERVING (round-5 verdict item 4) ---------------------
+#
+# The dryrun phases and the psum test above prove collectives and
+# compilation; this proves the serving layer itself: a GenerationEngine
+# jitted over a dp=2 x tp=2 mesh spanning TWO processes, requests
+# arriving over the durable broker, completions published back, and a
+# crash-while-holding-leases recovered by the broker's lease expiry
+# (the retry spine's transport tier).
+
+_SERVE_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, "@REPO@")
+    rank = int(sys.argv[1]); mode = sys.argv[2]; total = int(sys.argv[3])
+    from copilot_for_consensus_tpu.bus.broker import (
+        BrokerPublisher, _Client)
+
+    BROKER = "@BROKER@"
+    cli = _Client(BROKER)
+    cli.request({"op": "bind", "rks": ["serve.request"], "group": "svc"})
+
+    def fetch_requests(max_n=4, wait_s=15.0):
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            r = cli.request({"op": "fetch", "rks": ["serve.request"],
+                             "group": "svc", "max": max_n})
+            if r.get("msgs"):
+                return r["msgs"]
+            time.sleep(0.2)
+        return []
+
+    if mode == "crash":
+        # Lease a batch, then die WITHOUT serving or acking: recovery
+        # = the broker re-leases these to the next incarnation.
+        held = fetch_requests() if rank == 0 else []
+        print(json.dumps({"rank": rank, "crashed_holding": len(held)}),
+              flush=True)
+        sys.exit(0)
+
+    from copilot_for_consensus_tpu.parallel.multihost import (
+        MultiHostConfig, initialize_multihost)
+    initialize_multihost(MultiHostConfig(
+        coordinator_address="@COORD@", num_processes=2, process_id=rank))
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from copilot_for_consensus_tpu.engine.generation import (
+        GenerationEngine)
+    from copilot_for_consensus_tpu.models import decoder
+    from copilot_for_consensus_tpu.models.configs import decoder_config
+
+    assert len(jax.devices()) == 4      # 2 procs x 2 local cpu devices
+    cfg = decoder_config("tiny")
+    # identical seed => identical params on both ranks (SPMD lockstep)
+    params = decoder.init_params(jax.random.PRNGKey(7), cfg,
+                                 dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("dp", "tp"))
+    eng = GenerationEngine(cfg, params, mesh=mesh, num_slots=4,
+                           max_len=64, prefill_buckets=(16,),
+                           dtype=jnp.float32, attn_impl="xla",
+                           decode_window=4)
+
+    # Rank 0 leads: it owns the request leases and publishes each batch
+    # to rank 1 (its own queue group) so BOTH ranks drive the identical
+    # jit sequence — the broker is the control plane, XLA collectives
+    # the data plane (SURVEY two-tier comms).
+    served = 0
+    if rank == 0:
+        pub = BrokerPublisher({"address": BROKER})
+        while served < total:
+            msgs = fetch_requests()
+            if not msgs:
+                break
+            reqs = [m["envelope"] for m in msgs]
+            pub.publish_envelope({"event_type": "serve_batch",
+                                  "reqs": reqs}, "serve.batch")
+            comps = eng.generate([r["prompt"] for r in reqs],
+                                 max_new_tokens=6)
+            for r, c in zip(reqs, comps):
+                pub.publish_envelope(
+                    {"event_type": "serve_done",
+                     "request_id": r["request_id"],
+                     "tokens": list(c.tokens)}, "serve.done")
+            # ack ONLY after completions are durably published: a crash
+            # before this line re-leases the whole batch (at-least-once)
+            cli.request({"op": "ack", "ids": [m["id"] for m in msgs]})
+            served += len(msgs)
+        pub.publish_envelope({"event_type": "serve_batch", "reqs": []},
+                             "serve.batch")
+    else:
+        bcli = _Client(BROKER)
+        bcli.request({"op": "bind", "rks": ["serve.batch"],
+                      "group": "rank1"})
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            r = bcli.request({"op": "fetch", "rks": ["serve.batch"],
+                              "group": "rank1", "max": 1})
+            msgs = r.get("msgs") or []
+            if not msgs:
+                time.sleep(0.1)
+                continue
+            env = msgs[0]["envelope"]
+            bcli.request({"op": "ack", "ids": [msgs[0]["id"]]})
+            if not env["reqs"]:
+                break
+            eng.generate([q["prompt"] for q in env["reqs"]],
+                         max_new_tokens=6)
+            served += len(env["reqs"])
+    print(json.dumps({"rank": rank, "served": served}), flush=True)
+""")
+
+
+def _spawn_serve_workers(script: pathlib.Path, mode: str, total: int):
+    return [subprocess.Popen(
+        [sys.executable, str(script), str(rank), mode, str(total)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin"})
+        for rank in (0, 1)]
+
+
+def test_two_process_serving_over_broker_with_crash_recovery(tmp_path):
+    import numpy as np
+
+    from copilot_for_consensus_tpu.bus.broker import (
+        Broker,
+        BrokerPublisher,
+        BrokerSubscriber,
+    )
+
+    broker = Broker(port=0, db_path=str(tmp_path / "queues.db"),
+                    lease_s=3.0).start()
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord = f"127.0.0.1:{s.getsockname()[1]}"
+        script = tmp_path / "serve_worker.py"
+        script.write_text(_SERVE_WORKER.replace("@REPO@", str(REPO))
+                          .replace("@COORD@", coord)
+                          .replace("@BROKER@", broker.address))
+
+        rng = np.random.default_rng(3)
+        pub = BrokerPublisher({"address": broker.address})
+        n_requests = 8
+        for i in range(n_requests):
+            pub.publish_envelope({
+                "event_type": "serve_request",
+                "request_id": f"req-{i}",
+                "prompt": rng.integers(3, 500, size=7).tolist(),
+            }, "serve.request")
+
+        # Phase 1: the engine host crashes while HOLDING leased
+        # requests, before serving or acking any of them.
+        crash = _spawn_serve_workers(script, "crash", n_requests)
+        held = 0
+        for p in crash:
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, err[-2000:]
+            held += json.loads(out.strip().splitlines()[-1]
+                               )["crashed_holding"]
+        assert held > 0, "crash phase must die holding leases"
+
+        # Phase 2: fresh incarnation. The broker re-leases the crashed
+        # batch after lease_s; ALL requests must complete exactly once
+        # (ack-after-publish makes redelivery at-least-once; the
+        # request_id set proves full coverage).
+        import time as _t
+        _t.sleep(3.2)                    # let the crashed leases expire
+        procs = _spawn_serve_workers(script, "serve", n_requests)
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, err[-3000:]
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        assert {o["rank"] for o in outs} == {0, 1}
+        # both ranks drove every request through the SPMD engine
+        assert all(o["served"] == n_requests for o in outs), outs
+
+        got: dict[str, list[int]] = {}
+        sub = BrokerSubscriber({"address": broker.address}, group="test")
+        sub.subscribe(["serve.done"],
+                      lambda e: got.setdefault(e["request_id"],
+                                               e["tokens"]))
+        sub.drain()
+        assert set(got) == {f"req-{i}" for i in range(n_requests)}
+        assert all(len(toks) > 0 for toks in got.values())
+    finally:
+        broker.stop()

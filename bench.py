@@ -170,7 +170,24 @@ def extra_rows() -> list[dict]:
         ("embed", [py, os.path.join(REPO, "scripts", "bench_embed.py")],
          dict(no_extra)),
     ]
-    return [_run_row(name, cmd, env) for name, cmd, env in rows]
+    # Overall wall budget: the driver's snapshot must get its artifact
+    # even when a row runs pathologically slow — rows past the budget
+    # are reported skipped, not silently absent.
+    budget = float(os.environ.get("BENCH_EXTRA_BUDGET", "2400"))
+    out, t0 = [], time.monotonic()
+    for name, cmd, env in rows:
+        spent = time.monotonic() - t0
+        # below 60s a JAX-importing child cannot finish anything —
+        # skip with the honest reason instead of spawning a doomed
+        # subprocess that reports as a row "timeout"
+        if budget - spent < 60.0:
+            out.append({"row": name, "ok": False,
+                        "reason": f"skipped: extra-row budget "
+                                  f"({budget:.0f}s) exhausted"})
+            continue
+        out.append(_run_row(name, cmd, env,
+                            timeout=min(900.0, budget - spent)))
+    return out
 
 
 # -- headline -----------------------------------------------------------

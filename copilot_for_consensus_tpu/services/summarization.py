@@ -59,6 +59,7 @@ class SummarizationService(BaseService):
         self._in_flight: "collections.deque" = collections.deque()
         self._flight_lock = threading.Lock()
         self._flight_event = threading.Event()
+        self._drained = threading.Condition()
         self._harvester: threading.Thread | None = None
 
     def on_SummarizationRequested(self,
@@ -75,12 +76,20 @@ class SummarizationService(BaseService):
             return len(self._in_flight)
 
     def flush(self, timeout: float = 600.0) -> None:
-        """Block until every in-flight generation has been harvested."""
+        """Block until every in-flight generation has been harvested.
+
+        Waits on the drained condition (signalled by the harvester as
+        the queue empties) instead of polling — a 50 Hz poll here is
+        host-side GIL noise exactly while the dispatcher is serving."""
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        while self.in_flight and _time.monotonic() < deadline:
-            _time.sleep(0.02)
+        with self._drained:
+            while self.in_flight:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return
+                self._drained.wait(timeout=min(0.5, remaining))
 
     def _ensure_harvester(self) -> None:
         import threading
@@ -122,6 +131,10 @@ class SummarizationService(BaseService):
             finally:
                 with self._flight_lock:
                     self._in_flight.popleft()
+                    empty = not self._in_flight
+                if empty:
+                    with self._drained:
+                        self._drained.notify_all()
 
     def process_thread(self, thread_id: str, summary_id: str,
                        selected_chunks: list[str],

@@ -184,10 +184,16 @@ _SERVE_WORKER = textwrap.dedent("""
     # to rank 1 (its own queue group) so BOTH ranks drive the identical
     # jit sequence — the broker is the control plane, XLA collectives
     # the data plane (SURVEY two-tier comms).
-    served = 0
+    # Progress is counted in UNIQUE request ids, not fetch sizes: the
+    # broker is at-least-once (a slow first batch — compile time — can
+    # outlive its lease, so its ack no-ops and the batch REDELIVERS).
+    # Counting fetches would then hit `total` before later requests
+    # were ever fetched; unique-id accounting serves every request no
+    # matter how deliveries repeat.
+    seen = set()
     if rank == 0:
         pub = BrokerPublisher({"address": BROKER})
-        while served < total:
+        while len(seen) < total:
             msgs = fetch_requests()
             if not msgs:
                 break
@@ -204,7 +210,7 @@ _SERVE_WORKER = textwrap.dedent("""
             # ack ONLY after completions are durably published: a crash
             # before this line re-leases the whole batch (at-least-once)
             cli.request({"op": "ack", "ids": [m["id"] for m in msgs]})
-            served += len(msgs)
+            seen.update(r["request_id"] for r in reqs)
         pub.publish_envelope({"event_type": "serve_batch", "reqs": []},
                              "serve.batch")
     else:
@@ -225,8 +231,8 @@ _SERVE_WORKER = textwrap.dedent("""
                 break
             eng.generate([q["prompt"] for q in env["reqs"]],
                          max_new_tokens=6)
-            served += len(env["reqs"])
-    print(json.dumps({"rank": rank, "served": served}), flush=True)
+            seen.update(q["request_id"] for q in env["reqs"])
+    print(json.dumps({"rank": rank, "served": len(seen)}), flush=True)
 """)
 
 

@@ -219,3 +219,25 @@ def test_safe_expr_rot_guard():
     # (each scan resets the hit set — the guard reports the LAST scan)
     lint.unescaped_interpolations("const x = `a ${esc(v)} b`;")
     assert len(lint.unused_safe_entries()) == len(lint.SAFE_EXPR)
+
+
+def test_node_lane_files_consistent():
+    """The ui-ci node lane can't run in this image (no node) — pin its
+    wiring statically so a rename/typo can't silently empty the lane:
+    package.json is valid JSON with a test script, the vitest config
+    include-glob matches the committed test files, and the workflow
+    drives the right directory."""
+    ui_dir = pathlib.Path(lint.UI_DIR)
+    pkg = json.loads((ui_dir / "package.json").read_text())
+    assert pkg["scripts"]["test"].startswith("vitest")
+    assert "vitest" in pkg["devDependencies"]
+    assert "jsdom" in pkg["devDependencies"]
+    tests = sorted((ui_dir / "tests").glob("*.test.js"))
+    assert len(tests) >= 3, "behavioral suites missing"
+    helpers = (ui_dir / "tests" / "helpers.js").read_text()
+    assert "../app.js" in helpers          # harness boots the real SPA
+    cfg = (ui_dir / "vitest.config.js").read_text()
+    assert "tests/**/*.test.js" in cfg and "jsdom" in cfg
+    wf = (ui_dir.parents[1] / ".github" / "workflows"
+          / "ui-ci.yml").read_text()
+    assert "copilot_for_consensus_tpu/ui" in wf and "npm test" in wf

@@ -639,7 +639,12 @@ def auth_router(service: AuthService, external_base_url: str | None = None):
         """Approve/deny a pending assignment (reference
         auth/main.py:1074). Body: {action: "approve"|"deny"}."""
         claims = _require_admin(req, service)
-        action = (req.json() or {}).get("action", "")
+        body = req.json()
+        # a valid-JSON but non-object body (e.g. a bare string — found
+        # by the r5 deep fuzz run) is a 400, not an AttributeError 500
+        if not isinstance(body, dict):
+            raise HTTPError(400, "body must be an object")
+        action = body.get("action", "")
         if action not in ("approve", "deny"):
             raise HTTPError(400, "action must be approve|deny")
         try:

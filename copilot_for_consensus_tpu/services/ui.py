@@ -37,9 +37,12 @@ def _serve_asset(name: str) -> Response:
     # 404, not 500 from a pathlib ValueError.
     try:
         path = (UI_ROOT / name).resolve()
+        # is_file() itself stats: a >NAME_MAX component raises OSError
+        # (ENAMETOOLONG) here rather than at resolve() — found by the
+        # r5 deep fuzz run — and must 404 like any other absent asset
+        if not path.is_relative_to(UI_ROOT) or not path.is_file():
+            raise HTTPError(404, "asset not found")
     except (ValueError, OSError):
-        raise HTTPError(404, "asset not found")
-    if not path.is_relative_to(UI_ROOT) or not path.is_file():
         raise HTTPError(404, "asset not found")
     ctype = _CONTENT_TYPES.get(path.suffix, "application/octet-stream")
     return Response(path.read_bytes(), content_type=ctype,

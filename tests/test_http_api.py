@@ -466,3 +466,13 @@ def test_threads_filtering_and_sorting(server, tokens):
     status, _ = _call(server.port,
                       "/api/threads?min_messages=bogus", token=tok)
     assert status == 400
+
+
+def test_pending_resolution_rejects_non_object_body(server, tokens):
+    """Valid JSON that is not an object (a bare string) must 400, not
+    500 via AttributeError — r5 deep-fuzz find on
+    /auth/admin/pending/{id}."""
+    admin = tokens["admin@example.org"]
+    status, body = _call(server.port, "/auth/admin/pending/x",
+                         method="POST", body="approve", token=admin)
+    assert status == 400 and "object" in body["error"]

@@ -1,0 +1,105 @@
+"""Round-5 on-chip measurement sequence (one command when the TPU
+tunnel is back).
+
+The entire r5 build window ran with the axon tunnel down, so every r5
+perf claim awaiting hardware is queued here in priority order, each
+step fault-isolated with a wall budget. Writes R5_ONCHIP.json at the
+repo root with one entry per step (the same subprocess/JSON-line
+parsing as bench.py's extra rows).
+
+    python scripts/r5_onchip.py            # full sequence (~2h)
+    python scripts/r5_onchip.py --only poisson_ab,int4_profile
+
+Steps:
+  bench             full driver bench (headline + rag2k / cap3072 /
+                    poisson / embed extra rows; cap3072 exercises the
+                    int4 auto-route as shipped)
+  poisson_callback  the r5 host-tax fix at serving shape
+                    (target >=80% of batch = >=2550 tok/s)
+  poisson_poll      the r4 baseline loop (--poll-harvest) for the A/B
+  int4_profile      profile_int4_decode.py: decomposes the
+                    136 ms/step @3072 pathology per extent x route
+                    (pallas vs the XLA auto-route)
+  longctx           bench_longctx v2 (20 threads >=16k,
+                    warmup-excluded, per-phase) → LONGCTX_BENCH.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run_step(name: str, cmd: list[str], env: dict[str, str],
+             timeout: float) -> dict:
+    print(f"=== {name}: {' '.join(cmd[-3:])} (budget {timeout:.0f}s)",
+          file=sys.stderr, flush=True)
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO,
+                           env={**os.environ, **env})
+    except subprocess.TimeoutExpired:
+        return {"step": name, "ok": False,
+                "reason": f"timeout after {timeout:.0f}s"}
+    rows = []
+    for line in (r.stdout or "").strip().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    tail = (r.stderr or "").strip().splitlines()[-2:]
+    return {"step": name, "ok": r.returncode == 0 and bool(rows),
+            "rc": r.returncode, "rows": rows,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "stderr_tail": tail}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--only", default="",
+                    help="comma-separated subset of steps")
+    args = ap.parse_args()
+    py = sys.executable
+    steps = [
+        ("bench", [py, str(REPO / "bench.py")], {}, 3600.0),
+        ("poisson_callback",
+         [py, str(REPO / "scripts" / "bench_poisson.py"),
+          "--duration", "60"], {}, 1200.0),
+        ("poisson_poll",
+         [py, str(REPO / "scripts" / "bench_poisson.py"),
+          "--duration", "60", "--poll-harvest"], {}, 1200.0),
+        ("int4_profile",
+         [py, str(REPO / "scripts" / "profile_int4_decode.py")],
+         {}, 2400.0),
+        ("longctx",
+         [py, str(REPO / "scripts" / "bench_longctx.py")], {}, 3600.0),
+        ("scaleout_note",
+         [py, "-c", "import json; print(json.dumps({'note': "
+          "'multi-chip efficiency needs >1 real chip; CPU artifact in "
+          "docs/PERF.md scale-out section'}))"], {}, 60.0),
+    ]
+    only = {s.strip() for s in args.only.split(",") if s.strip()}
+    out = []
+    for name, cmd, env, budget in steps:
+        if only and name not in only and not any(
+                name.startswith(o) for o in only):
+            continue
+        out.append(run_step(name, cmd, env, budget))
+        (REPO / "R5_ONCHIP.json").write_text(
+            json.dumps(out, indent=1) + "\n")
+    print(json.dumps({"steps": [(o["step"], o["ok"]) for o in out]}))
+    return 0 if all(o["ok"] for o in out) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -69,6 +69,18 @@ PRESETS = {
                 "BENCH_WEIGHT_DTYPE": "int4", "BENCH_ADMIT_TOKENS": "8192",
                 "BENCH_DECODE_WINDOW": "32",
                 "BENCH_WINDOWS_PER_DISPATCH": "1"},
+    # Prefix KV-cache reuse (engine/prefix_cache.py): every stream's
+    # prompt opens with the same 384-token span (the RAG workload's
+    # shared system prompt + template head); the radix cache seeds it
+    # from the block pool and prefills only the 128-token tail. The
+    # artifact adds prefix_hit_rate and prefill_tokens_saved (timed-run
+    # deltas) next to the throughput number.
+    "shared_prefix": {"BENCH_PROMPT_LEN": "512", "BENCH_MAX_LEN": "768",
+                      "BENCH_NEW_TOKENS": "96", "BENCH_SLOTS": "32",
+                      "BENCH_SHARED_PREFIX": "384",
+                      "BENCH_PREFIX_BLOCKS": "64",
+                      "BENCH_DECODE_WINDOW": "32",
+                      "BENCH_WINDOWS_PER_DISPATCH": "1"},
 }
 
 
@@ -221,6 +233,11 @@ def headline() -> dict:
     prompt_len = int(knob("BENCH_PROMPT_LEN", "128"))
     new_tokens = int(knob("BENCH_NEW_TOKENS", "96"))
     window = int(knob("BENCH_DECODE_WINDOW", "32"))
+    # Prefix-cache geometry (shared_prefix preset): streams share a
+    # leading span of this many tokens; > 0 also enables the block pool.
+    shared_prefix = int(knob("BENCH_SHARED_PREFIX", "0"))
+    prefix_blocks = int(knob("BENCH_PREFIX_BLOCKS",
+                             "64" if shared_prefix else "0"))
     # Chaining windows in-program amortizes the per-dispatch host sync
     # (expensive over the tunnel) while keeping the efficient 32-step
     # window buffers; 3×32 = the full 96-token run in ONE dispatch.
@@ -253,11 +270,16 @@ def headline() -> dict:
         quant.set_act_quant("a8")
     cfg = decoder_config(model)
     t0 = time.monotonic()
+    # With a shared prefix the steady state prefills only the unique
+    # tail, so give the admission wave a tail-sized bucket next to the
+    # cold-start full-prompt bucket.
+    buckets = tuple(sorted({prompt_len, max(1, prompt_len - shared_prefix)}))
     eng = GenerationEngine(
         cfg,
         num_slots=slots,
         max_len=max_len,
-        prefill_buckets=(prompt_len,),
+        prefill_buckets=buckets,
+        prefix_cache_blocks=prefix_blocks,
         dtype=jnp.bfloat16,
         kv_dtype=kv_name,
         seed=0,
@@ -278,20 +300,37 @@ def headline() -> dict:
         f"{quantize or 'bf16'}) in {time.monotonic() - t0:.1f}s")
 
     rng = np.random.default_rng(0)
-    prompts = [
-        rng.integers(3, cfg.vocab_size, size=prompt_len).tolist()
-        for _ in range(slots)
-    ]
+    if shared_prefix:
+        common = rng.integers(3, cfg.vocab_size,
+                              size=shared_prefix).tolist()
+        prompts = [
+            common + rng.integers(
+                3, cfg.vocab_size,
+                size=prompt_len - shared_prefix).tolist()
+            for _ in range(slots)
+        ]
+    else:
+        prompts = [
+            rng.integers(3, cfg.vocab_size, size=prompt_len).tolist()
+            for _ in range(slots)
+        ]
 
     # Warmup: compile the steady-state programs — the fused admit
     # program (prefill + insert + first-token sample) and every decode
     # kv bucket the timed run will hit.
     t0 = time.monotonic()
     eng.generate(prompts, max_new_tokens=new_tokens)
+    if prefix_blocks:
+        # The first pass was all cache MISSES (blocks publish at
+        # retire), so it compiled only the plain admit program; the
+        # timed run is all HITS and would otherwise pay the seeded-wave
+        # compile inside its measurement. One more pass compiles it.
+        eng.generate(prompts, max_new_tokens=new_tokens)
     log(f"warmup (compile + first full run) {time.monotonic() - t0:.1f}s")
 
     # Timed run: keep all slots busy for `new_tokens` decode steps each.
     admit_s0 = eng.admitted_s
+    ps0 = eng.prefix_stats()
     t0 = time.monotonic()
     comps = eng.generate(prompts, max_new_tokens=new_tokens)
     elapsed = time.monotonic() - t0
@@ -304,7 +343,7 @@ def headline() -> dict:
         f"(admission {admit_s:.2f}s, decode+sync {elapsed - admit_s:.2f}s; "
         f"total throughput {total_all / elapsed:.0f} tok/s)")
 
-    return {
+    out = {
         "metric": f"{model} continuous-batching decode throughput "
                   f"(1 chip, {slots} streams, {prompt_len}-tok prompts, "
                   f"{quantize or 'bf16'} weights)",
@@ -313,9 +352,40 @@ def headline() -> dict:
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
         "total_tok_s": round(total_all / elapsed, 1),
     }
+    if prefix_blocks:
+        # Timed-run deltas (the warmup's cold misses are the cache
+        # filling, not the steady state the preset measures).
+        ps1 = eng.prefix_stats()
+        lookups = ps1["lookups"] - ps0["lookups"]
+        hits = ps1["hits"] - ps0["hits"]
+        prefilled = ps1["prefill_tokens"] - ps0["prefill_tokens"]
+        saved = (ps1["prefill_tokens_saved"]
+                 - ps0["prefill_tokens_saved"])
+        out["prefix_hit_rate"] = round(hits / lookups, 3) if lookups \
+            else 0.0
+        out["prefill_tokens_saved"] = saved
+        out["prefill_tokens"] = prefilled
+        log(f"prefix cache: hit rate {out['prefix_hit_rate']}, "
+            f"{saved} prompt tokens saved vs {prefilled} prefilled")
+    return out
 
 
 def main() -> None:
+    # A typo'd preset must fail LOUDLY: silently running the default
+    # shapes under the requested label would record a mislabeled
+    # artifact the next round trusts. ("" = no preset — extra_rows pins
+    # it empty so a parent preset can't leak into child rows.)
+    preset = os.environ.get("BENCH_PRESET", "")
+    if preset and preset not in PRESETS:
+        print(json.dumps({
+            "metric": "bench-preset",
+            "value": 0.0,
+            "unit": "",
+            "ok": False,
+            "reason": f"unknown BENCH_PRESET {preset!r}; "
+                      f"valid: {sorted(PRESETS)}",
+        }))
+        sys.exit(2)
     if os.environ.get("BENCH_NO_PROBE", "0") != "1":
         ok, detail = probe_backend(
             attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "4")),

@@ -40,6 +40,7 @@ import base64
 import hashlib
 import hmac
 import json
+import logging
 import re
 import threading
 import time
@@ -47,6 +48,8 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any
+
+_LOG = logging.getLogger(__name__)
 
 from copilot_for_consensus_tpu.bus.base import (
     EventCallback,
@@ -305,6 +308,14 @@ class AzureServiceBusSubscriber(EventSubscriber):
         self._routes: dict[str, EventCallback] = {}
         self._subs: dict[str, str] = {}      # rk -> subscription name
         self._stop = threading.Event()
+        #: optional MetricsCollector, assigned by wiring code AFTER
+        #: construction (services/runner.py) — deliberately NOT read
+        #: from ``cfg``: the config mapping carries plain data, and a
+        #: stray "metrics" key there must not masquerade as a collector
+        self.metrics = None
+        #: messages deleted by the $Default-window guard because their
+        #: stamped key matched no local route (see _dispatch)
+        self.misroute_dropped = 0
 
     # -- wiring ---------------------------------------------------------
 
@@ -411,13 +422,40 @@ class AzureServiceBusSubscriber(EventSubscriber):
         # the create-subscription -> delete-$Default window carries
         # whatever routing key the publisher STAMPED (the same custom
         # property the SQL rule filters on). Route by the stamp, not
-        # the subscription: a mismatch is completed (dropped), never
-        # delivered to the wrong callback. Unstamped messages (foreign
-        # publishers) are not checkable and dispatch as before.
+        # the subscription: when this consumer has a route for the
+        # stamped key the message dispatches LOCALLY to that callback.
+        # If the stamped key's own subscription already existed at
+        # publish time it got its own copy and the handler runs twice —
+        # an at-least-once duplicate the pipeline's idempotent-replay
+        # design already absorbs; but if that subscription did NOT yet
+        # exist (the same half-provisioned window, one key later in the
+        # subscribe batch), this $Default copy is the ONLY delivery and
+        # dropping it would LOSE the message. Loss is the failure mode
+        # the guard must never convert a duplicate into. A stamped key
+        # with no local route is completed (dropped) with a log line +
+        # counter so the window leak is observable, never delivered to
+        # the wrong callback. Unstamped messages (foreign publishers)
+        # are not checkable and dispatch as before.
         stamped = msg.get("stamped_rk")
         if stamped is not None and stamped != rk:
-            self._complete(msg)
-            return
+            local = self._routes.get(stamped)
+            if local is None:
+                self.misroute_dropped += 1
+                _LOG.warning(
+                    "servicebus $Default-window guard dropped message: "
+                    "stamped routing key %r arrived on subscription for "
+                    "%r with no local route", stamped, rk)
+                try:
+                    if self.metrics is not None:
+                        self.metrics.increment(
+                            "bus_misroute_dropped",
+                            labels={"stamped": stamped,
+                                    "subscription": rk})
+                except Exception:
+                    pass   # metrics must never take the consumer down
+                self._complete(msg)
+                return
+            rk, cb = stamped, local
         stop_renew = threading.Event()
         if self.auto_renew:
             interval = max(self.lock_duration_s / 2.0, 0.05)

@@ -77,7 +77,13 @@ class Handle:
             if not self._event.is_set():
                 self._callbacks.append(fn)
                 return
-        fn(self)
+        # Already resolved: fire now, under the SAME containment as
+        # _finish — whether an observer error is swallowed must not
+        # depend on the registration/resolution race.
+        try:
+            fn(self)
+        except Exception:
+            pass    # a broken observer must not kill the caller
 
     def _resolve(self, completion: Completion) -> None:
         self._completion = completion
@@ -103,7 +109,7 @@ class AsyncEngineRunner:
 
     def __init__(self, engine: GenerationEngine):
         self.engine = engine
-        self._pending: list[tuple[list[int], int, Handle]] = []
+        self._pending: list[tuple[list[int], int, int | None, Handle]] = []
         self._handles: dict[int, Handle] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -132,8 +138,11 @@ class AsyncEngineRunner:
             self._thread = None
 
     def submit(self, prompt: list[int],
-               max_new_tokens: int = 256) -> Handle:
-        """Thread-safe enqueue; returns a waitable handle."""
+               max_new_tokens: int = 256, *,
+               cache_eligible_tokens: int | None = None) -> Handle:
+        """Thread-safe enqueue; returns a waitable handle.
+        ``cache_eligible_tokens`` plumbs through to
+        ``GenerationEngine.submit`` (prefix-cache publish cap)."""
         if self._thread is None:
             raise RuntimeError("runner not started")
         h = Handle()
@@ -142,9 +151,15 @@ class AsyncEngineRunner:
                 # a submit racing stop() must not enqueue a handle the
                 # (exiting) dispatcher will never resolve
                 raise RuntimeError("runner stopped")
-            self._pending.append((prompt, max_new_tokens, h))
+            self._pending.append((prompt, max_new_tokens,
+                                  cache_eligible_tokens, h))
             self._work.notify()
         return h
+
+    def prefix_stats(self) -> dict:
+        """Prefix-cache counters passthrough (counter reads are atomic
+        enough for metrics; no engine lock is taken)."""
+        return self.engine.prefix_stats()
 
     # -- dispatcher side ------------------------------------------------
 
@@ -161,7 +176,7 @@ class AsyncEngineRunner:
                     # blocked in result() must not sit out its full
                     # timeout just because the runner was stopped.
                     exc = RuntimeError("runner stopped")
-                    for _, _, h in self._pending:
+                    for _, _, _, h in self._pending:
                         h._fail(exc)
                     for h in self._handles.values():
                         h._fail(exc)
@@ -175,9 +190,12 @@ class AsyncEngineRunner:
             # A bad request (e.g. empty prompt) fails ITS handle, not
             # the loop — an unhandled exception here would kill the
             # dispatcher and hang every outstanding and future handle.
-            for prompt, mnt, h in fresh:
+            for prompt, mnt, ce, h in fresh:
                 try:
-                    rid = eng.submit(prompt, mnt)
+                    # kwarg only when set: duck-typed engine stands-in
+                    # (tests, shims) keep their 2-arg submit signature
+                    rid = eng.submit(prompt, mnt) if ce is None else \
+                        eng.submit(prompt, mnt, cache_eligible_tokens=ce)
                 except Exception as exc:
                     h._fail(exc)
                     continue

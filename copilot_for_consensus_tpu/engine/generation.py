@@ -17,6 +17,11 @@ DOMINANT LATENCY" in SURVEY.md §3.2). Design:
 * **Sharding.** Params shard over the mesh per ``models.decoder
   .logical_axes`` (tp over heads/ffn/vocab); the cache shards its slot
   axis over dp and kv-head axis over tp. Collectives are emitted by XLA.
+* **Prefix KV-cache reuse** (``prefix_cache_blocks`` > 0): a radix trie
+  over token-block hashes maps each prompt's longest cached prefix to
+  device-resident KV blocks; admission seeds the slot cache from the
+  pool and prefills only the suffix, and completions publish their
+  prompt-prefix blocks back. Design: ``docs/ENGINE_PREFIX_CACHE.md``.
 
 The engine is synchronous and single-owner: services drive it through
 ``submit()`` + ``step()`` (or ``generate()`` for batch use) from their
@@ -56,6 +61,15 @@ class Request:
     max_new_tokens: int
     submitted_at: float = field(default_factory=time.monotonic)
     decode_started_at: float = 0.0
+    #: prefix-cache publish cap: how many LEADING prompt tokens may be
+    #: published to the shared block pool on completion (None = whole
+    #: prompt, 0 = never publish this request). Lookup/reuse is always
+    #: unrestricted — this only bounds what the request contributes.
+    cache_eligible_tokens: int | None = None
+    #: memoized block digests (PrefixCache.prompt_digests) — the
+    #: admission router re-checks every queued request every step, and
+    #: hashing is the only per-token host cost on that path
+    block_digests: list | None = None
 
 
 @dataclass
@@ -143,6 +157,7 @@ class GenerationEngine:
         prefill_rows: int = 4,
         piggyback_min_prompt: int = 10**9,
         admit_hold_strict: bool = False,
+        prefix_cache_blocks: int = 0,
         profile_dir: str | None = None,
         int4_pallas_max_extent: int | None = 1536,
     ):
@@ -331,6 +346,95 @@ class GenerationEngine:
             return first, cache
 
         self._admit_fn = jax.jit(_admit_fused, donate_argnums=(3,))
+
+        # ---- prefix KV cache (cross-request reuse) ---------------------
+        # Radix trie + device block pool (engine/prefix_cache.py). On a
+        # hit the admission wave gathers the reused blocks from the
+        # pool, scatters them into the slot's cache prefix, and
+        # prefills ONLY the suffix — TTFT and admission FLOPs drop by
+        # the shared-prefix fraction. Block size = prefill_chunk.
+        self._prefix = None
+        self._prefix_pins: dict[int, Any] = {}   # request_id → PrefixMatch
+        #: prompt tokens actually prefilled / skipped via prefix reuse —
+        #: the bench's savings accounting (prefix_stats()).
+        self.prefill_tokens = 0
+        self.prefill_tokens_saved = 0
+        if prefix_cache_blocks:
+            if mesh is not None:
+                raise ValueError(
+                    "prefix_cache_blocks requires mesh=None: the block "
+                    "pool and a dp-sharded slot cache would live on "
+                    "different shards")
+            if cfg.sliding_window and cfg.sliding_window < self.max_len:
+                raise ValueError(
+                    "prefix_cache_blocks requires full attention: a "
+                    "reused prefix under a sliding window needs "
+                    "absolute-timeline window masking the seeded "
+                    "prefill path does not implement")
+            from copilot_for_consensus_tpu.engine.prefix_cache import (
+                PrefixCache,
+            )
+            self._prefix = PrefixCache(
+                cfg, num_blocks=prefix_cache_blocks,
+                block_size=self.prefill_chunk, kv_dtype=self.kv_dtype)
+
+        def _admit_seeded(params, tokens, lengths, pool_k, pool_v,
+                          bids_flat, pref_lens, cache, slots, key):
+            """Admission wave with prefix-cache hits: gather reused
+            blocks from the pool, seed them into the slot cache, prefill
+            only the suffix (RoPE/attention offset by pref_lens), insert
+            the suffix KV at the per-row offset, sample first tokens —
+            still ONE program and one host sync per wave.
+
+            tokens: [N, Sbuc] right-padded suffixes; bids_flat: [N*NB]
+            pool block ids row-major (pad = pool size → gather clamps,
+            scatter drops); pref_lens: [N] matched prefix tokens (0 =
+            miss row — the same program serves mixed waves)."""
+            n_l, hkv, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+            n, sbuc = tokens.shape
+            nb = bids_flat.shape[0] // n
+            blk = pool_k.shape[3]
+            pk_flat = pool_k[:, bids_flat]     # [L, N*NB, Hkv, B, Dh]
+            pv_flat = pool_v[:, bids_flat]
+            pk = pk_flat.reshape(n_l, n, nb, hkv, blk, dh).transpose(
+                0, 1, 3, 2, 4, 5).reshape(n_l, n, hkv, nb * blk, dh)
+            pv = pv_flat.reshape(n_l, n, nb, hkv, blk, dh).transpose(
+                0, 1, 3, 2, 4, 5).reshape(n_l, n, hkv, nb * blk, dh)
+            scratch = decoder.init_cache(cfg, n, sbuc,
+                                         dtype=self.kv_dtype)
+            logits, scratch = decoder.prefill_seeded(
+                params, tokens, lengths, pk, pv, pref_lens, cfg,
+                scratch)
+            # seed the reused prefix blocks into the slot cache: block
+            # j of row i lands at positions [j*blk, (j+1)*blk) of
+            # slots[i]; pad entries (OOB bid) get an OOB slot and drop.
+            m = n * nb
+            valid = bids_flat < pool_k.shape[1]
+            sidx_b = jnp.where(valid, jnp.repeat(slots, nb),
+                               self.num_slots)
+            sidx_b = jnp.broadcast_to(sidx_b[:, None], (m, blk))
+            pidx_b = (jnp.tile(jnp.arange(nb), n) * blk)[:, None] \
+                + jnp.arange(blk)[None, :]
+            upd_k = pk_flat.transpose(1, 3, 0, 2, 4)  # [M, B, L, H, D]
+            upd_v = pv_flat.transpose(1, 3, 0, 2, 4)
+            ck = cache["k"].at[:, sidx_b, :, pidx_b, :].set(
+                upd_k.astype(cache["k"].dtype), mode="drop")
+            cv = cache["v"].at[:, sidx_b, :, pidx_b, :].set(
+                upd_v.astype(cache["v"].dtype), mode="drop")
+            # insert the fresh suffix KV at the per-row prefix offset
+            sidx_s = jnp.broadcast_to(slots[:, None], (n, sbuc))
+            pidx_s = pref_lens[:, None] + jnp.arange(sbuc)[None, :]
+            ck = ck.at[:, sidx_s, :, pidx_s, :].set(
+                scratch["k"].transpose(1, 3, 0, 2, 4).astype(ck.dtype),
+                mode="drop")
+            cv = cv.at[:, sidx_s, :, pidx_s, :].set(
+                scratch["v"].transpose(1, 3, 0, 2, 4).astype(cv.dtype),
+                mode="drop")
+            first = sample(logits, key, self.sampling)
+            return first, {"k": ck, "v": cv}
+
+        self._admit_seeded_fn = jax.jit(_admit_seeded,
+                                        donate_argnums=(7,))
 
         def _decode(params, tokens, positions, cache, key, *, kv_len,
                     n_windows=1):
@@ -552,8 +656,14 @@ class GenerationEngine:
         engine (``engine/longctx.py``)."""
         return min(self.max_len - self._dispatch_steps, self.buckets[-1])
 
-    def submit(self, prompt: list[int], max_new_tokens: int = 256) -> int:
-        """Enqueue a tokenized prompt; returns a request id."""
+    def submit(self, prompt: list[int], max_new_tokens: int = 256, *,
+               cache_eligible_tokens: int | None = None) -> int:
+        """Enqueue a tokenized prompt; returns a request id.
+
+        ``cache_eligible_tokens`` caps how many leading prompt tokens
+        the prefix cache may publish when this request completes (the
+        summarization path marks its shared-template span here); None
+        publishes the whole block-aligned prompt prefix."""
         if not prompt:
             raise ValueError("empty prompt")
         limit = self.prompt_limit
@@ -561,9 +671,15 @@ class GenerationEngine:
             # Keep the tail: instructions/questions sit at the end of RAG
             # prompts. The orchestrator budgets context to avoid this.
             prompt = prompt[-limit:]
+            # the publish cap indexed the ORIGINAL prompt; the truncated
+            # head no longer matches any cacheable span
+            cache_eligible_tokens = 0 if cache_eligible_tokens \
+                is not None else None
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(Request(rid, list(prompt), max_new_tokens))
+        self._queue.append(Request(
+            rid, list(prompt), max_new_tokens,
+            cache_eligible_tokens=cache_eligible_tokens))
         return rid
 
     def step(self) -> list[Completion]:
@@ -575,13 +691,17 @@ class GenerationEngine:
         return self._drain_done()
 
     def generate(self, prompts: list[list[int]],
-                 max_new_tokens: int = 256) -> list[Completion]:
+                 max_new_tokens: int = 256, *,
+                 cache_eligible_tokens: int | None = None
+                 ) -> list[Completion]:
         """Batch convenience: submit all, run to completion, return in
         submission order. Captures a jax.profiler trace when the engine
         was built with ``profile_dir``."""
         from copilot_for_consensus_tpu.obs.profile import maybe_profile
 
-        ids = [self.submit(p, max_new_tokens) for p in prompts]
+        ids = [self.submit(p, max_new_tokens,
+                           cache_eligible_tokens=cache_eligible_tokens)
+               for p in prompts]
         results: dict[int, Completion] = {}
         with maybe_profile(self.profile_dir):
             while len(results) < len(ids):
@@ -595,6 +715,22 @@ class GenerationEngine:
             [tokenizer.encode(p, add_bos=True) for p in prompts],
             max_new_tokens)
         return [tokenizer.decode(c.tokens) for c in comps]
+
+    def prefix_stats(self) -> dict:
+        """Prefix-cache counters for benches/metrics. ``hit_rate`` is
+        over admission lookups; ``prefill_tokens``/``..._saved`` are
+        engine-wide prompt-token accounting (wave + piggyback paths)."""
+        out = {
+            "enabled": self._prefix is not None,
+            "prefill_tokens": self.prefill_tokens,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+        }
+        if self._prefix is not None:
+            s = self._prefix.stats
+            out.update(s.as_dict())
+            out["hit_rate"] = s.hits / s.lookups if s.lookups else 0.0
+            out["blocks_in_use"] = self._prefix.blocks_in_use
+        return out
 
     @property
     def queue_depth(self) -> int:
@@ -636,6 +772,18 @@ class GenerationEngine:
             keep = []
             for req in self._queue:
                 plen = len(req.prompt)
+                # Prefix-cache integration with the piggyback path:
+                # requests whose prefix is cached route to the SEEDED
+                # admission wave instead — the piggyback chunk grid
+                # attends only its own dispatch buffer, so a hit riding
+                # it would re-prefill the cached span anyway. Misses
+                # still piggyback, and their completions still publish.
+                if (self._prefix is not None
+                        and self._prefix.match_tokens(
+                            req.prompt,
+                            digests=self._req_digests(req)) > 0):
+                    keep.append(req)
+                    continue
                 if (self.piggyback_min_prompt <= plen <= cap
                         and plen <= budget):
                     # whole prompts only: the packer places each row as
@@ -665,6 +813,7 @@ class GenerationEngine:
             return
         t0 = time.monotonic()
         batch: list[tuple[int, Request]] = []
+        matches: list[Any] = []      # PrefixMatch | None, aligned w/ batch
         # Cap one admission wave at 128 rows AND ~16k prompt tokens:
         # prefill scratch + activations scale with rows × bucket (the
         # f32 swiglu transient is rows·bucket·d_ff·4 bytes — 0.9 GB at
@@ -672,15 +821,37 @@ class GenerationEngine:
         # padded into one wave), and each extra wave costs a full
         # weight pass. 128×128 keeps the bench's all-at-once arrival in
         # one wave; long-prompt (RAG) waves chunk by token budget.
+        # With the prefix cache the budget counts SUFFIX tokens — the
+        # cached span never enters the prefill transient, which is
+        # exactly why a shared-prefix wave packs more rows per dispatch.
         longest = 0
         while self._queue and self._free and len(batch) < 128:
-            longest = max(longest, len(self._queue[0].prompt))
+            head = self._queue[0]
+            suffix = len(head.prompt)
+            digs = None
+            if self._prefix is not None:
+                # stat-free peek for the budget decision: a request the
+                # budget defers would otherwise be looked up (and
+                # counted in hits/tokens_matched) once per wave it
+                # waits — inflating the stats the bench reports
+                digs = self._req_digests(head)
+                suffix -= self._prefix.match_tokens(head.prompt,
+                                                    digests=digs)
+            longest = max(longest, suffix)
             if batch and (len(batch) + 1) * _next_bucket(
                     longest, self.buckets) > self.admission_token_budget:
                 break
+            m = None
+            if self._prefix is not None:
+                m = self._prefix.lookup(head.prompt, digests=digs)
+                if m.tokens == 0:       # miss: nothing pinned
+                    m = None
             batch.append((self._free.pop(0), self._queue.pop(0)))
+            matches.append(m)
         plens = [len(req.prompt) for _, req in batch]
-        bucket = _next_bucket(max(plens), self.buckets)
+        suffix_lens = [plens[i] - (matches[i].tokens if matches[i]
+                                   else 0) for i in range(len(batch))]
+        bucket = _next_bucket(max(suffix_lens), self.buckets)
         # Pad N to the next power of two: bounds compile-shape count at
         # log2(num_slots) per bucket. Padded rows prefill garbage and are
         # dropped by the out-of-range slot id in the insert.
@@ -690,19 +861,53 @@ class GenerationEngine:
         tokens = np.zeros((n, bucket), dtype=np.int32)
         lengths = np.ones((n,), dtype=np.int32)
         slots = np.full((n,), self.num_slots, dtype=np.int32)  # OOB pad
-        for i, (slot, req) in enumerate(batch):
-            tokens[i, :plens[i]] = req.prompt
-            lengths[i] = plens[i]
-            slots[i] = slot
         self._key, sub = jax.random.split(self._key)
-        first_dev, self._cache = self._admit_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-            self._cache, jnp.asarray(slots), sub)
+        if any(m is not None for m in matches):
+            # Seeded wave: rows prefill only their suffix; the matched
+            # blocks gather from the pool inside the same program. NB
+            # pads to a power of two (same compile-count bounding as N).
+            nb = 1
+            while nb < max(len(m.block_ids) for m in matches
+                           if m is not None):
+                nb *= 2
+            bids = np.full((n, nb), self._prefix.num_blocks,
+                           dtype=np.int32)               # OOB pad
+            pref_lens = np.zeros((n,), dtype=np.int32)
+            for i, (slot, req) in enumerate(batch):
+                suf = req.prompt[plens[i] - suffix_lens[i]:]
+                tokens[i, :len(suf)] = suf
+                lengths[i] = len(suf)
+                slots[i] = slot
+                if matches[i] is not None:
+                    bids[i, :len(matches[i].block_ids)] = \
+                        matches[i].block_ids
+                    pref_lens[i] = matches[i].tokens
+            first_dev, self._cache = self._admit_seeded_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                self._prefix.pool["k"], self._prefix.pool["v"],
+                jnp.asarray(bids.reshape(-1)), jnp.asarray(pref_lens),
+                self._cache, jnp.asarray(slots), sub)
+        else:
+            for i, (slot, req) in enumerate(batch):
+                tokens[i, :plens[i]] = req.prompt
+                lengths[i] = plens[i]
+                slots[i] = slot
+            first_dev, self._cache = self._admit_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                self._cache, jnp.asarray(slots), sub)
         first = _host_fetch(first_dev)         # the ONE host sync
         prefill_s = time.monotonic() - t0
         self.admitted_s += prefill_s
+        self.prefill_tokens += sum(suffix_lens)
+        self.prefill_tokens_saved += sum(
+            m.tokens for m in matches if m is not None)
         for i, (slot, req) in enumerate(batch):
             tok = int(first[i])
+            if matches[i] is not None:
+                # pinned until retirement: an active slot's seeded
+                # prefix blocks must not be evicted out from under a
+                # publish that will re-walk the same path
+                self._prefix_pins[req.request_id] = matches[i]
             self._active[slot] = req
             self._generated[slot] = [tok]
             self._positions[slot] = plens[i]
@@ -712,6 +917,11 @@ class GenerationEngine:
             if tok in self._eos_set or req.max_new_tokens <= 1:
                 self._retire(slot,
                              "eos" if tok in self._eos_set else "length")
+
+    def _req_digests(self, req: Request) -> list:
+        if req.block_digests is None:
+            req.block_digests = self._prefix.prompt_digests(req.prompt)
+        return req.block_digests
 
     def _kv_bucket(self) -> int:
         """Static attention extent for the next decode dispatch: the
@@ -840,6 +1050,7 @@ class GenerationEngine:
             placed.append((slot, req, started, len(placed)))
             self.piggy_rows += 1
             self.piggy_tokens += plen
+            self.prefill_tokens += plen
         self._prefilling = deferred
         return (pre_tok, rope_base, kv_begin, kv_len, sel_rel, sel_w,
                 sel_p, sidx, pidx, placed)
@@ -890,6 +1101,19 @@ class GenerationEngine:
     def _retire(self, slot: int, reason: str) -> None:
         self._positions[slot] = self.max_len   # park OOB (see __init__)
         req = self._active.pop(slot)
+        if self._prefix is not None:
+            # Publish BEFORE the slot returns to the free list: the
+            # cache still holds this prompt's KV at [0, plen). Prompt
+            # KV is temperature-independent (it never saw a sampled
+            # token), so it is safe to share across sampling configs.
+            try:
+                self._prefix.publish(
+                    req.prompt, self._cache, slot,
+                    eligible_tokens=req.cache_eligible_tokens)
+            finally:
+                m = self._prefix_pins.pop(req.request_id, None)
+                if m is not None:
+                    self._prefix.release(m)
         gen = self._generated.pop(slot)
         if gen and gen[-1] in self._eos_set:
             gen = gen[:-1]

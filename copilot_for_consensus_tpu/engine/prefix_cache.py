@@ -1,0 +1,328 @@
+"""Cross-request prefix KV-cache reuse (radix prefix cache).
+
+The serving workload is prefix-heavy: every summarization prompt opens
+with the same system prompt + template head, and thread re-summarization
+re-sends a mostly-identical context prefix. The engine's admission path
+nevertheless prefilled every prompt from token zero. This module is the
+vLLM-automatic-prefix-caching / SGLang-RadixAttention idea rebuilt for
+this engine's contiguous slot cache:
+
+* **Host-side radix trie.** Prompts are cut into fixed token blocks
+  (block size = the engine's ``prefill_chunk``). Each trie node is one
+  block, keyed by a CHAINED digest (``tokenizer.stable_block_hash``) so
+  a node commits to its entire token prefix — longest-prefix match is a
+  hash walk from the root, no token comparisons on the hot path.
+* **Bounded device block pool.** Node KV lives in a device-resident
+  pool ``[L, num_blocks, Hkv, block, Dh]`` in the serving cache dtype.
+  The pool is fixed-size; when full, the least-recently-used *leaf*
+  with refcount 0 is evicted (leaves only: an interior eviction would
+  orphan descendants that can then never be matched — the standard
+  radix-cache discipline).
+* **Refcount pinning.** ``lookup`` pins every matched node until the
+  request retires (``release``); ``publish`` temp-pins the path while
+  it allocates, so eviction can never free a block an admission wave is
+  about to gather or a publish is mid-way through chaining.
+* **Publish on completion.** When a request retires, the block-aligned
+  prefix of its PROMPT (never generated tokens — those depend on
+  sampling; prompt KV is temperature-independent) is inserted into the
+  trie and its KV copied cache→pool in one jitted scatter. Callers may
+  cap eligibility (``eligible_tokens``) to e.g. the shared template
+  span so thread-unique tails don't churn the bounded pool.
+
+The trie/accounting is pure host Python; the only device code is the
+publish copy here and the seeded admission gather in
+``GenerationEngine`` — everything is exercisable on CPU
+(``JAX_PLATFORMS=cpu``), which is how the correctness and token-savings
+tests run (``tests/test_engine_prefix_cache.py``).
+
+Scope: single-process engines (``mesh=None``). A dp-sharded slot cache
+would put pool blocks and slots on different shards; cross-shard block
+copies are future work and the engine refuses the combination loudly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from copilot_for_consensus_tpu.engine.tokenizer import stable_block_hash
+
+
+class _Node:
+    """One cached block: a radix-trie edge + its pool block id."""
+
+    __slots__ = ("digest", "parent", "children", "block_id", "refcount",
+                 "last_used")
+
+    def __init__(self, digest: bytes, parent: "_Node | None",
+                 block_id: int):
+        self.digest = digest
+        self.parent = parent
+        self.children: dict[bytes, _Node] = {}
+        self.block_id = block_id
+        self.refcount = 0
+        self.last_used = 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"_Node(block={self.block_id}, ref={self.refcount}, "
+                f"children={len(self.children)})")
+
+
+@dataclass
+class PrefixMatch:
+    """A pinned longest-prefix match. Hold it while the request is
+    active; hand it back through ``PrefixCache.release`` on retire."""
+
+    nodes: list[_Node]
+    block_ids: list[int]
+    tokens: int                     # == len(block_ids) * block_size
+
+
+@dataclass
+class PrefixCacheStats:
+    lookups: int = 0
+    hits: int = 0                   # lookups matching >= 1 block
+    misses: int = 0
+    tokens_matched: int = 0         # prompt tokens NOT re-prefilled
+    blocks_published: int = 0
+    blocks_evicted: int = 0
+    publish_skips: int = 0          # pool full of pinned/interior blocks
+
+    def as_dict(self) -> dict:
+        return {f: getattr(self, f) for f in self.__dataclass_fields__}
+
+
+@dataclass
+class _PoolPrograms:
+    """Jitted device programs, built once per (shape, dtype)."""
+
+    publish: object = field(default=None)
+
+
+class PrefixCache:
+    """Radix trie + bounded device block pool + LRU/refcount policy."""
+
+    def __init__(self, cfg, *, num_blocks: int, block_size: int,
+                 kv_dtype=jnp.bfloat16):
+        if num_blocks < 1:
+            raise ValueError("prefix cache needs num_blocks >= 1")
+        if block_size < 1:
+            raise ValueError("prefix cache needs block_size >= 1")
+        self.cfg = cfg
+        self.block = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.kv_dtype = kv_dtype
+        shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads, block_size,
+                 cfg.head_dim)
+        #: device-resident KV blocks; ``num_blocks`` is the OOB sentinel
+        #: id (gathers clamp, scatters drop).
+        self.pool = {"k": jnp.zeros(shape, kv_dtype),
+                     "v": jnp.zeros(shape, kv_dtype)}
+        self._free: list[int] = list(range(num_blocks))
+        self._root = _Node(b"", None, -1)
+        self._nodes: list[_Node] = []       # every live non-root node
+        self._tick = 0
+        self.stats = PrefixCacheStats()
+
+        def _publish(pool, cache_k, cache_v, bids, sidx, pidx):
+            """Copy M blocks out of the slot cache into pool rows.
+
+            bids: [M] destination block ids (pad = num_blocks → drop);
+            sidx/pidx: [M, B] source (slot, position) per block column.
+            Advanced indices on cache axes 1 and 3 put the [M, B] index
+            shape in front: gather result [M, B, L, Hkv, Dh].
+            """
+            blk_k = cache_k[:, sidx, :, pidx, :]
+            blk_v = cache_v[:, sidx, :, pidx, :]
+            k = pool["k"].at[:, bids].set(
+                blk_k.transpose(2, 0, 3, 1, 4).astype(pool["k"].dtype),
+                mode="drop")
+            v = pool["v"].at[:, bids].set(
+                blk_v.transpose(2, 0, 3, 1, 4).astype(pool["v"].dtype),
+                mode="drop")
+            return {"k": k, "v": v}
+
+        # Donating the pool makes the scatter an in-place update — the
+        # pool is the long-lived resident allocation and must not
+        # double-buffer on every publish.
+        self._publish_fn = jax.jit(_publish, donate_argnums=(0,))
+
+    # -- introspection --------------------------------------------------
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def node_count(self) -> int:
+        return len(self._nodes)
+
+    # -- hashing / matching ---------------------------------------------
+
+    def _block_digests(self, tokens, n_blocks: int):
+        """Yield the chained digest of each of the first n_blocks."""
+        prev = b""
+        for j in range(n_blocks):
+            prev = stable_block_hash(
+                prev, tokens[j * self.block:(j + 1) * self.block])
+            yield prev
+
+    def prompt_digests(self, tokens) -> list[bytes]:
+        """Every matchable block digest for a prompt (the last token is
+        never matchable — see lookup). Hashing is the only per-token
+        host cost on the admission path, so callers compute this ONCE
+        per request and pass it to match_tokens/lookup; the engine
+        memoizes it on the Request (the router re-checks every queued
+        request every step while it waits)."""
+        cap = (len(tokens) - 1) // self.block
+        return list(self._block_digests(tokens, cap))
+
+    def _walk(self, digests) -> list[_Node]:
+        node = self._root
+        nodes: list[_Node] = []
+        for digest in digests:
+            child = node.children.get(digest)
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+        return nodes
+
+    def match_tokens(self, tokens, digests=None) -> int:
+        """Peek: longest cached prefix length in tokens. No pinning, no
+        LRU touch, no stats — the admission router uses this to decide
+        which path a request takes before committing to a wave."""
+        if digests is None:
+            digests = self.prompt_digests(tokens)
+        return len(self._walk(digests)) * self.block
+
+    def lookup(self, tokens, digests=None) -> PrefixMatch:
+        """Longest-prefix match, PINNED. Always leaves >= 1 prompt token
+        for the suffix prefill (the admission wave samples the first
+        generated token from the last prompt position, so a whole-prompt
+        hit would have nothing to run the lm_head on).
+
+        Every matched node's refcount is incremented; the caller MUST
+        ``release`` the match when the request retires. A zero-token
+        match (miss) needs no release."""
+        self._tick += 1
+        self.stats.lookups += 1
+        if digests is None:
+            digests = self.prompt_digests(tokens)
+        nodes = self._walk(digests)
+        for n in nodes:
+            n.last_used = self._tick
+            n.refcount += 1
+        if nodes:
+            self.stats.hits += 1
+            self.stats.tokens_matched += len(nodes) * self.block
+        else:
+            self.stats.misses += 1
+        return PrefixMatch(nodes=nodes,
+                           block_ids=[n.block_id for n in nodes],
+                           tokens=len(nodes) * self.block)
+
+    def release(self, match: PrefixMatch) -> None:
+        for n in match.nodes:
+            n.refcount -= 1
+            assert n.refcount >= 0, "prefix-cache refcount underflow"
+        match.nodes = []
+        match.block_ids = []
+
+    # -- eviction / allocation -------------------------------------------
+
+    def _evict_one(self) -> bool:
+        """Free the least-recently-used unpinned LEAF. Returns False if
+        every node is pinned or interior (nothing evictable)."""
+        victim: _Node | None = None
+        for n in self._nodes:
+            if n.children or n.refcount > 0:
+                continue
+            if victim is None or n.last_used < victim.last_used:
+                victim = n
+        if victim is None:
+            return False
+        victim.parent.children.pop(victim.digest, None)
+        self._nodes.remove(victim)
+        self._free.append(victim.block_id)
+        self.stats.blocks_evicted += 1
+        return True
+
+    def _alloc(self) -> int | None:
+        if not self._free and not self._evict_one():
+            return None
+        return self._free.pop()
+
+    # -- publish ----------------------------------------------------------
+
+    def publish(self, tokens, cache: dict, slot: int,
+                eligible_tokens: int | None = None) -> int:
+        """Insert the block-aligned prefix of ``tokens`` into the trie,
+        copying KV for NEW blocks out of ``cache[:, slot]`` (which must
+        hold the prompt's KV at positions [0, len(tokens))). Returns the
+        number of blocks newly published.
+
+        ``eligible_tokens`` caps how deep the publish goes — the
+        summarization service passes the shared-template span here so a
+        small pool isn't churned by thread-unique context tails.
+        Dedup is free: blocks already in the trie are just LRU-touched.
+        """
+        self._tick += 1
+        limit = len(tokens)
+        if eligible_tokens is not None:
+            limit = min(limit, max(0, int(eligible_tokens)))
+        n_blocks = limit // self.block
+        if n_blocks == 0:
+            return 0
+        node = self._root
+        path: list[_Node] = []      # temp-pinned while we allocate
+        new_rows: list[tuple[int, int]] = []   # (block_id, start_pos)
+        try:
+            for j, digest in enumerate(
+                    self._block_digests(tokens, n_blocks)):
+                child = node.children.get(digest)
+                if child is None:
+                    bid = self._alloc()
+                    if bid is None:
+                        self.stats.publish_skips += 1
+                        break
+                    child = _Node(digest, node, bid)
+                    node.children[digest] = child
+                    self._nodes.append(child)
+                    new_rows.append((bid, j * self.block))
+                child.last_used = self._tick
+                # Temp-pin: a later _alloc in THIS walk may evict, and a
+                # just-created node is an unpinned leaf — without the pin
+                # it could evict its own path's tail.
+                child.refcount += 1
+                path.append(child)
+                node = child
+        finally:
+            for n in path:
+                n.refcount -= 1
+        if new_rows:
+            self._copy_blocks(cache, slot, new_rows)
+            self.stats.blocks_published += len(new_rows)
+        return len(new_rows)
+
+    def _copy_blocks(self, cache: dict, slot: int,
+                     rows: list[tuple[int, int]]) -> None:
+        """One jitted cache→pool scatter for all new blocks of one
+        publish. M pads to a power of two so compile count stays
+        log-bounded; pad rows carry the OOB block id and drop."""
+        m = 1
+        while m < len(rows):
+            m *= 2
+        bids = np.full((m,), self.num_blocks, dtype=np.int32)
+        sidx = np.zeros((m, self.block), dtype=np.int32)
+        pidx = np.zeros((m, self.block), dtype=np.int32)
+        for i, (bid, start) in enumerate(rows):
+            bids[i] = bid
+            sidx[i, :] = slot
+            pidx[i, :] = start + np.arange(self.block)
+        self.pool = self._publish_fn(
+            self.pool, cache["k"], cache["v"], jnp.asarray(bids),
+            jnp.asarray(sidx), jnp.asarray(pidx))

@@ -27,6 +27,22 @@ EOS_ID = 2
 N_SPECIALS = 3
 
 
+def stable_block_hash(prev: bytes, tokens) -> bytes:
+    """Chained hash of one token block for the prefix KV cache.
+
+    ``prev`` is the parent block's digest (``b""`` for the first block),
+    so a digest commits to the ENTIRE token prefix, not just its own
+    block — two prompts share a radix-trie node iff every token from
+    position zero matches. Uses blake2b over the explicit little-endian
+    token bytes, NOT Python's ``hash()``: the digest keys cross-request
+    (and potentially cross-process / on-disk) reuse, so it must not
+    change between interpreter runs (PYTHONHASHSEED) or platforms."""
+    h = hashlib.blake2b(prev, digest_size=16)
+    h.update(b"".join(int(t).to_bytes(8, "little", signed=True)
+                      for t in tokens))
+    return h.digest()
+
+
 class Tokenizer(abc.ABC):
     pad_id = PAD_ID
     bos_id = BOS_ID

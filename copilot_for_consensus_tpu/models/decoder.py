@@ -204,6 +204,47 @@ def prefill(params: Params, tokens: jax.Array, lengths: jax.Array,
     return _unembed(x_last, params, cfg)[:, 0], {"k": k_new, "v": v_new}
 
 
+def prefill_seeded(params: Params, tokens: jax.Array, lengths: jax.Array,
+                   k_pref: jax.Array, v_pref: jax.Array,
+                   prefix_lens: jax.Array, cfg: DecoderConfig,
+                   cache: Params) -> tuple[jax.Array, Params]:
+    """Suffix prompt pass against seeded prefix KV (prefix cache hits).
+
+    tokens: [B, S] right-padded SUFFIX tokens — row b's token i sits at
+    absolute position ``prefix_lens[b] + i``; lengths: [B] suffix
+    lengths (>= 1: the first generated token samples from the last
+    suffix position). k_pref/v_pref: [L, B, Hkv, P, Dh] reused prefix
+    KV gathered from the block pool (zero-padded past prefix_lens —
+    masked in attention). Writes SUFFIX kv into scratch positions
+    [0, S) (the engine scatters them into the slot cache at the
+    per-row offset) and returns (last-valid-position logits [B, V]
+    fp32, scratch). Rows with prefix_lens 0 compute exactly what
+    ``prefill`` computes — mixed hit/miss admission waves run as one
+    program."""
+    x = params["tok_emb"][tokens]
+
+    def body(x, scanned):
+        layer, k_pref_l, v_pref_l, k_cache, v_cache = scanned
+        h, k, v = L.attn_prefill_seeded(
+            L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+            layer, cfg, k_pref_l, v_pref_l, prefix_lens,
+            lengths=lengths)
+        x = x + h
+        x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                     layer, cfg)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), 0, axis=2)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), 0, axis=2)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], k_pref, v_pref,
+                  cache["k"], cache["v"]))
+    x_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    return _unembed(x_last, params, cfg)[:, 0], {"k": k_new, "v": v_new}
+
+
 def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
                 cfg: DecoderConfig, cache: Params,
                 kv_len: int | None = None

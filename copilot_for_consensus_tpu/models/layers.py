@@ -165,6 +165,35 @@ def attn_prefill(x: jax.Array, layer: dict, cfg: DecoderConfig,
     return qmatmul(o, layer["wo"]), k, v
 
 
+def attn_prefill_seeded(x: jax.Array, layer: dict, cfg: DecoderConfig,
+                        k_pref: jax.Array, v_pref: jax.Array,
+                        prefix_lens: jax.Array,
+                        lengths: jax.Array | None = None):
+    """Suffix-prefill attention against a seeded prefix (prefix KV
+    cache admission). Row b's tokens sit at absolute positions
+    ``prefix_lens[b] + i`` — RoPE rotates with that offset — and attend
+    (reused prefix KV ++ fresh causal suffix) in one joint softmax
+    (``ops.attention.prefill_attention_seeded``). k_pref/v_pref:
+    [B, Hkv, P, Dh]; rows with prefix_lens 0 reduce exactly to
+    ``attn_prefill``. Returns (out [B,S,D_model], k, v) with fresh
+    SUFFIX k/v in [B, Hkv, S, Dh] for cache insertion at the offset.
+
+    Sliding-window models are routed away by the engine (a reused
+    prefix inside the window would need window masking against the
+    absolute timeline, which this path doesn't implement)."""
+    from copilot_for_consensus_tpu.ops.attention import (
+        prefill_attention_seeded,
+    )
+
+    b, s, _ = x.shape
+    positions = prefix_lens[:, None] + jnp.arange(s)[None, :]
+    q, k, v = _project_qkv(x, layer, cfg, positions)
+    o = prefill_attention_seeded(q, k, v, k_pref, v_pref,
+                                 prefix_lens, kv_lengths=lengths)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim)
+    return qmatmul(o, layer["wo"]), k, v
+
+
 def attn_decode_stacked(x: jax.Array, layer: dict, cfg: DecoderConfig,
                         positions: jax.Array, k_cache: jax.Array,
                         v_cache: jax.Array, li: jax.Array,

@@ -125,6 +125,66 @@ def attention(
     )
 
 
+def prefill_attention_seeded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    k_pref: jax.Array,
+    v_pref: jax.Array,
+    prefix_lens: jax.Array,
+    kv_lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Suffix-prefill attention over (seeded prefix KV ++ fresh suffix KV).
+
+    The prefix-cache admission path (``GenerationEngine``) prefills only
+    the un-cached tail of a prompt; its queries sit at absolute
+    positions ``prefix_lens[b] + i`` and must attend both the reused
+    prefix KV (gathered from the device block pool, already
+    RoPE-rotated at its original absolute positions — prefixes always
+    start at position 0, so reuse needs no re-rotation) and the fresh
+    suffix KV causally. One joint softmax over the concatenated pieces
+    keeps the math elementwise-identical to a monolithic prefill over
+    the full prompt: identical logits in identical order, with padding
+    masked to -inf exactly as the full pass masks its bucket padding.
+
+    q/k/v: [B, Hq|Hkv, S, D] fresh suffix projections; k_pref/v_pref:
+    [B, Hkv, P, D] (any dtype — cast to q's); prefix_lens: [B] valid
+    prefix per row (rows with 0 are plain misses); kv_lengths: [B]
+    valid SUFFIX length per row (masks bucket padding).
+
+    XLA only (einsum + mask): the admission wave is MXU-bound and the
+    engine's q_offset prefill path already routes off the flash kernel;
+    a seeded flash variant is future work.
+    """
+    b, hq, s, d = q.shape
+    p = k_pref.shape[2]
+    k_all = jnp.concatenate(
+        [_gqa_expand(k_pref.astype(q.dtype), hq), _gqa_expand(k, hq)],
+        axis=2)
+    v_all = jnp.concatenate(
+        [_gqa_expand(v_pref.astype(q.dtype), hq), _gqa_expand(v, hq)],
+        axis=2)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_all,
+        preferred_element_type=jnp.float32) * (d ** -0.5)
+    # prefix piece: kv position j valid iff j < prefix_lens[b] (causality
+    # is implied: every suffix query sits at position >= prefix_lens[b])
+    jpos = jnp.arange(p)[None, None, :]                       # [1,1,P]
+    mask_pref = jnp.broadcast_to(
+        jpos < prefix_lens[:, None, None], (b, s, p))
+    # suffix piece: plain causal within the suffix block (+ pad mask)
+    iq = jnp.arange(s)[:, None]
+    jk = jnp.arange(s)[None, :]
+    mask_suf = jnp.broadcast_to((jk <= iq)[None], (b, s, s))
+    if kv_lengths is not None:
+        mask_suf = mask_suf & (jk[None] < kv_lengths[:, None, None])
+    mask = jnp.concatenate([mask_pref, mask_suf], axis=-1)[:, None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked rows
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_all.dtype), v_all)
+
+
 @functools.partial(jax.jit, static_argnames=("window", "kv_len"))
 def decode_attention(
     q: jax.Array,

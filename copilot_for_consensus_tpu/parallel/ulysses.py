@@ -19,7 +19,10 @@ from __future__ import annotations
 import functools
 
 import jax
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:   # jax < 0.5 exports it under experimental only
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from copilot_for_consensus_tpu.ops.attention import attention_xla

@@ -106,6 +106,11 @@ class ParsingService(BaseService):
                 "message_ids": [m.message_id for m in members],
                 "message_doc_ids": [doc_ids[i] for i in th.message_indices],
                 "participants": th.participants,
+                # denormalized count: participant-range filters and
+                # sorts push down to the store (SQL/Cosmos operators
+                # can't take len() of a JSON list — reporting.get_threads
+                # materialized the whole collection per page without it)
+                "participant_count": len(th.participants or []),
                 "message_count": len(members),
                 "first_message_date": th.first_date,
                 "last_message_date": th.last_date,

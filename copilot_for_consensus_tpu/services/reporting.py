@@ -34,6 +34,7 @@ class ReportingService(BaseService):
         self.webhook_sender = webhook_sender or self._post_json
         self.embedding_provider = embedding_provider
         self.vector_store = vector_store
+        self._participants_backfilled = False
 
     # ---- write path ----------------------------------------------------
 
@@ -165,35 +166,61 @@ class ReportingService(BaseService):
             flt["message_count"] = rng
         if sort_by not in self.THREAD_SORTS:
             sort_by = "message_count"
-        participant_work = (min_participants is not None
-                            or max_participants is not None
-                            or sort_by == "participant_count")
-        if not participant_work:
-            # keep limit/skip pushed into the store: the common
-            # no-participant-filter browse must not materialize the
-            # whole collection per page (the same SLO reasoning as
-            # get_reports at the 100k corpus)
-            return self.store.query_documents(
-                "threads", flt,
-                sort=[(sort_by, -1 if descending else 1)],
-                limit=limit or None, skip=offset)
-        # participant ranges/sort derive from a list-typed field — no
-        # store operator for len(); fetch matching rows once, then
-        # filter/sort/paginate here
-        if sort_by == "participant_count":
-            rows = self.store.query_documents("threads", flt)
-            rows.sort(key=lambda r: len(r.get("participants") or []),
-                      reverse=descending)
-        else:
-            rows = self.store.query_documents(
-                "threads", flt, sort=[(sort_by, -1 if descending else 1)])
+        # participant ranges/sort hit the DENORMALIZED participant_count
+        # integer the parsing service stamps on every thread doc — the
+        # filter/sort/limit/skip all push down to the store, so a
+        # participant-filtered page view no longer materializes the
+        # whole collection (the 100k-corpus reporting-API SLO killer).
+        rng = {}
         if min_participants is not None:
-            rows = [r for r in rows
-                    if len(r.get("participants") or []) >= min_participants]
+            rng["$gte"] = min_participants
         if max_participants is not None:
-            rows = [r for r in rows
-                    if len(r.get("participants") or []) <= max_participants]
-        return rows[offset:offset + limit] if limit else rows[offset:]
+            rng["$lte"] = max_participants
+        if rng or sort_by == "participant_count":
+            self._backfill_participant_counts()
+        if rng:
+            flt["participant_count"] = rng
+        return self.store.query_documents(
+            "threads", flt,
+            sort=[(sort_by, -1 if descending else 1)],
+            limit=limit or None, skip=offset)
+
+    def _backfill_participant_counts(self) -> None:
+        """One-time lazy migration: thread docs written before the
+        parse-time denormalization lack participant_count, and a
+        pushed-down range filter (or Cosmos ORDER BY) would silently
+        exclude them. Paid only on the first participant-filtered call
+        per process, and only for the missing docs — a re-parse also
+        heals them, this just doesn't require one."""
+        if self._participants_backfilled:
+            return
+        # Batched sweep: memory stays bounded at a large corpus (the
+        # 100k-thread store would otherwise materialize every legacy
+        # doc, message_ids and all, in one list). The one-time write
+        # cost per legacy doc is unavoidable; after the sweep the hot
+        # path is pure pushdown. Each batch re-queries $exists:False,
+        # so updated docs fall out of the result — no skip arithmetic.
+        total = 0
+        while True:
+            stale = self.store.query_documents(
+                "threads", {"participant_count": {"$exists": False}},
+                limit=1000)
+            if not stale:
+                break
+            for doc in stale:
+                self.store.update_document(
+                    "threads", doc["thread_id"],
+                    {"participant_count":
+                     len(doc.get("participants") or [])})
+            total += len(stale)
+        # Flag only AFTER the sweep completes: a mid-backfill store
+        # error must surface to the caller and retry next request, not
+        # silently disable the migration (= wrong filter results) for
+        # the rest of the process lifetime.
+        self._participants_backfilled = True
+        if total:
+            self.logger.info("backfilled participant_count",
+                             threads=total)
 
     def get_thread(self, thread_id: str) -> dict | None:
         return self.store.get_document("threads", thread_id)

@@ -364,6 +364,11 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
             )
 
             sub = create_subscriber({**bus_cfg, "group": svc.name})
+            if hasattr(sub, "metrics"):
+                # drivers with consumer-side counters (e.g. the
+                # servicebus bus_misroute_dropped guard) share the
+                # pipeline's collector
+                sub.metrics = pipeline.metrics
             sub.subscribe(svc.routing_keys(), svc.handle_envelope)
             pipeline.ext_subscribers.append(sub)
         else:

@@ -233,6 +233,20 @@ class SummarizationService(BaseService):
                                    {"summary_id": summary_id})
         self.metrics.observe("summarization_latency_seconds", latency)
         self.metrics.increment("summarization_summaries_total")
+        # Prefix-cache visibility: when the summarizer serves from the
+        # in-process engine, surface its cross-request KV reuse so the
+        # ops dashboards can see the shared-template hit rate (and an
+        # eviction-thrashing pool shows up as a falling rate, not as an
+        # unexplained TTFT regression).
+        eng = getattr(self.summarizer, "engine", None)
+        if eng is not None and hasattr(eng, "prefix_stats"):
+            ps = eng.prefix_stats()
+            if ps.get("enabled"):
+                self.metrics.gauge("summarization_prefix_hit_rate",
+                                   ps["hit_rate"])
+                self.metrics.gauge(
+                    "summarization_prefill_tokens_saved",
+                    ps["prefill_tokens_saved"])
         self.publisher.publish(ev.SummaryComplete(
             summary_id=summary_id, thread_id=thread_id,
             correlation_id=correlation_id))

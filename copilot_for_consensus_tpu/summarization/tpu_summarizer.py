@@ -45,6 +45,30 @@ def build_prompt(thread: ThreadContext, template: str = DEFAULT_TEMPLATE,
     )
 
 
+#: per-thread template variables, in any order — everything BEFORE the
+#: first of these in the template is byte-identical across threads
+_THREAD_FIELDS = ("subject", "thread_id", "participants",
+                  "message_count", "email_chunks")
+
+
+def shared_template_head(template: str = DEFAULT_TEMPLATE,
+                         system: str = DEFAULT_SYSTEM) -> str:
+    """The rendered prompt span shared by EVERY thread's prompt: the
+    template head up to the first per-thread placeholder, with the
+    (deployment-constant) system prompt substituted. This is the span
+    the summarizer marks as prefix-cache-eligible — guaranteed to
+    repeat across requests, so publishing it can never pollute the
+    bounded block pool with thread-unique KV."""
+    cut = len(template)
+    for fld in _THREAD_FIELDS:
+        i = template.find("{" + fld + "}")
+        if i >= 0:
+            cut = min(cut, i)
+    # only {system} may appear in the head; replace (not .format) so
+    # stray braces in a custom template cannot raise
+    return template[:cut].replace("{system}", system)
+
+
 class TPUSummarizer(Summarizer):
     def __init__(self, model: str = "mistral-7b", *, engine=None,
                  tokenizer=None, max_new_tokens: int = 256,
@@ -54,6 +78,7 @@ class TPUSummarizer(Summarizer):
                  checkpoint: str | None = None, long_engine=None,
                  long_context: bool = False, kv_dtype: str | None = None,
                  quantize: bool | str = "int8",
+                 cache_scope: str = "full",
                  profile_dir: str | None = None):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
@@ -137,6 +162,33 @@ class TPUSummarizer(Summarizer):
             max(259, self.engine.cfg.vocab_size))
         if self.tokenizer.vocab_size > self.engine.cfg.vocab_size:
             raise ValueError("tokenizer vocab exceeds model vocab")
+        # Prefix-cache publish scope — how much of each prompt this
+        # summarizer marks cache-eligible (GenerationEngine.submit's
+        # cache_eligible_tokens):
+        #   "full"     — whole prompt; thread re-summarization re-sends
+        #                a near-identical context prefix, so the engine
+        #                may reuse past the template (LRU handles churn);
+        #   "template" — only the shared template head (every prompt
+        #                opens with it); right for small block pools
+        #                where thread-unique context KV would evict the
+        #                always-hot template blocks;
+        #   "off"      — never publish from this summarizer.
+        if cache_scope not in ("full", "template", "off"):
+            raise ValueError(f"unknown cache_scope {cache_scope!r}")
+        self.cache_scope = cache_scope
+        if cache_scope == "template":
+            # Token count of the span shared across ALL prompts. BPE
+            # merges at the boundary may differ between encoding the
+            # head alone and a full prompt; the publish cap is
+            # block-aligned anyway, so shaving one boundary token keeps
+            # the marked span strictly inside the shared bytes.
+            head = shared_template_head(self.template, self.system)
+            self._cache_eligible = max(
+                0, len(self.tokenizer.encode(head, add_bos=True)) - 1)
+        elif cache_scope == "off":
+            self._cache_eligible = 0
+        else:
+            self._cache_eligible = None
 
     @property
     def _short_limit(self) -> int:
@@ -153,8 +205,11 @@ class TPUSummarizer(Summarizer):
         from their own thread."""
         runner = getattr(self, "_runner", None)
         if runner is None:
-            return self.engine.generate(prompts, self.max_new_tokens)
-        handles = [runner.submit(p, self.max_new_tokens)
+            return self.engine.generate(
+                prompts, self.max_new_tokens,
+                cache_eligible_tokens=self._cache_eligible)
+        handles = [runner.submit(p, self.max_new_tokens,
+                                 cache_eligible_tokens=self._cache_eligible)
                    for p in prompts]
         return [h.result(timeout=600.0) for h in handles]
 
@@ -192,7 +247,9 @@ class TPUSummarizer(Summarizer):
             return lambda timeout=None: summary
         if getattr(self, "_runner", None) is None:
             self._runner = AsyncEngineRunner(self.engine).start()
-        handle = self._runner.submit(prompt, self.max_new_tokens)
+        handle = self._runner.submit(
+            prompt, self.max_new_tokens,
+            cache_eligible_tokens=self._cache_eligible)
 
         def wait(timeout: float | None = 600.0) -> Summary:
             comp = handle.result(timeout)

@@ -619,6 +619,52 @@ def test_default_rule_window_message_not_misrouted(mock_sb):
     assert [e["event_id"] for e in got] == ["ok1"]
 
 
+def test_default_rule_window_message_dispatches_locally_when_routed(
+        mock_sb):
+    """When the stamped key has a LOCAL route, the $Default-window guard
+    reroutes the message to that callback instead of dropping it — and
+    a drop (no local route) is observable: log line, instance counter,
+    bus_misroute_dropped metric."""
+    from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+
+    endpoint, _ = mock_sb
+    pub = AzureServiceBusPublisher(_cfg(endpoint))
+    got_summary, got_archive = [], []
+    sub = AzureServiceBusSubscriber(_cfg(endpoint, group="g2"))
+    sub.metrics = InMemoryMetrics()
+    # half-provisioned window again: only the match-all $Default rule
+    name = entity_name("summary.complete", "g2")
+    sub._t.ensure_topic(sub.topic)
+    sub._t.request(
+        "PUT", f"/{sub.topic}/subscriptions/{name}",
+        body=(b'<entry xmlns="http://www.w3.org/2005/Atom">'
+              b'<content type="application/xml"><SubscriptionDescription'
+              b' xmlns="http://schemas.microsoft.com/netservices/2010/10/'
+              b'servicebus/connect"><LockDuration>PT60S</LockDuration>'
+              b"<MaxDeliveryCount>4</MaxDeliveryCount>"
+              b"</SubscriptionDescription></content></entry>"),
+        content_type="application/atom+xml", ok=(201, 409))
+    sub._routes["summary.complete"] = got_summary.append
+    sub._subs["summary.complete"] = name
+    # this consumer ALSO consumes archive.ingested → reroute, not drop
+    sub._routes["archive.ingested"] = got_archive.append
+    pub.publish_envelope({"event_type": "ArchiveIngested",
+                          "event_id": "rerouted", "payload": {}},
+                         "archive.ingested")
+    # unroutable stamped key → dropped + counted
+    pub.publish_envelope({"event_type": "SummaryComplete",
+                          "event_id": "stray", "payload": {}},
+                         "chunking.complete")
+    assert sub.drain() == 2
+    assert [e["event_id"] for e in got_archive] == ["rerouted"]
+    assert not got_summary
+    assert sub.misroute_dropped == 1
+    assert sub.metrics.counter_value(
+        "bus_misroute_dropped",
+        {"stamped": "chunking.complete",
+         "subscription": "summary.complete"}) == 1
+
+
 def test_override_routing_key_publish_still_delivered(mock_sb):
     """publish_envelope(env, routing_key=override) is a supported bus
     shape: the misroute guard compares the STAMPED key (which equals

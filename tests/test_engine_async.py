@@ -170,3 +170,27 @@ def test_done_callbacks_fire_without_polling():
         assert h3.result(120).tokens       # dispatcher still alive
     finally:
         runner.stop()
+
+
+def test_immediate_fire_callback_errors_are_contained():
+    """Regression (ADVICE r5): a raising observer registered AFTER
+    resolution fired on the caller's stack UNwrapped, while the same
+    observer registered before resolution was contained by _finish —
+    whether the error escaped depended on the registration/resolution
+    race. Both paths must swallow observer errors identically."""
+    from copilot_for_consensus_tpu.engine.async_runner import Handle
+    from copilot_for_consensus_tpu.engine.generation import Completion
+
+    h = Handle()
+    h.request_id = 1
+    h._resolve(Completion(request_id=1, prompt_len=3, tokens=[4],
+                          finish_reason="length"))
+    fired = []
+    # already resolved → fires immediately — and must NOT raise
+    h.add_done_callback(lambda hh: (fired.append(hh.request_id),
+                                    1 / 0))
+    assert fired == [1]
+    # same containment on the failure-resolved path
+    h2 = Handle()
+    h2._fail(RuntimeError("boom"))
+    h2.add_done_callback(lambda hh: 1 / 0)   # must not raise either

@@ -1,0 +1,338 @@
+# Cross-request prefix KV-cache reuse: radix trie matching, refcount
+# pinning vs LRU eviction, hit/miss accounting, and end-to-end identity
+# of seeded-admission outputs vs the cache-disabled engine.
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.engine.prefix_cache import PrefixCache
+from copilot_for_consensus_tpu.engine.tokenizer import stable_block_hash
+from copilot_for_consensus_tpu.models.configs import decoder_config
+
+CFG = decoder_config("tiny")
+BLOCK = 4
+
+
+def _cache(num_blocks=8):
+    return PrefixCache(CFG, num_blocks=num_blocks, block_size=BLOCK,
+                       kv_dtype=jnp.float32)
+
+
+def _slot_cache(num_slots=2, max_len=32, fill=None):
+    """A fake engine slot cache with recognizable per-position values."""
+    shape = (CFG.n_layers, num_slots, CFG.n_kv_heads, max_len,
+             CFG.head_dim)
+    if fill is None:
+        base = np.arange(max_len, dtype=np.float32)
+        arr = np.broadcast_to(
+            base[None, None, None, :, None], shape).copy()
+    else:
+        arr = np.full(shape, fill, dtype=np.float32)
+    return {"k": jnp.asarray(arr), "v": jnp.asarray(arr) * 2.0}
+
+
+# ---------------------------------------------------------------------------
+# hashing
+# ---------------------------------------------------------------------------
+
+
+def test_stable_block_hash_is_chained_and_stable():
+    a = stable_block_hash(b"", [1, 2, 3, 4])
+    assert a == stable_block_hash(b"", [1, 2, 3, 4])   # deterministic
+    assert a != stable_block_hash(b"", [1, 2, 3, 5])
+    # chaining: same block under a different parent is a different node
+    assert stable_block_hash(a, [9, 9]) != stable_block_hash(b"x", [9, 9])
+    # not concat-ambiguous with list vs tuple / np ints
+    assert a == stable_block_hash(b"", (np.int32(1), 2, 3, 4))
+
+
+# ---------------------------------------------------------------------------
+# trie matching + accounting
+# ---------------------------------------------------------------------------
+
+
+def test_longest_prefix_match_and_accounting():
+    pc = _cache()
+    cache = _slot_cache()
+    prompt = list(range(10, 10 + 3 * BLOCK))           # 3 full blocks
+    assert pc.publish(prompt, cache, slot=0) == 3
+    assert pc.blocks_in_use == 3
+
+    # full 3-block match — but lookup must leave >= 1 suffix token, so
+    # an IDENTICAL prompt matches only 2 blocks (12 of 12 tokens would
+    # leave nothing to sample the first generated token from)
+    m = pc.lookup(prompt)
+    assert m.tokens == 2 * BLOCK
+    pc.release(m)
+
+    # one extra token past the blocks: now all 3 blocks match
+    m = pc.lookup(prompt + [99])
+    assert m.tokens == 3 * BLOCK
+    assert len(m.block_ids) == 3
+    pc.release(m)
+
+    # diverging second block matches only the first
+    div = prompt[:BLOCK] + [0] * (2 * BLOCK)
+    m = pc.lookup(div)
+    assert m.tokens == BLOCK
+    pc.release(m)
+
+    # total miss
+    m = pc.lookup([7] * (3 * BLOCK))
+    assert m.tokens == 0 and not m.nodes
+
+    s = pc.stats
+    assert s.lookups == 4
+    assert s.hits == 3 and s.misses == 1
+    assert s.tokens_matched == 2 * BLOCK + 3 * BLOCK + BLOCK
+
+
+def test_publish_dedup_and_extension():
+    pc = _cache()
+    cache = _slot_cache()
+    p = list(range(50, 50 + 2 * BLOCK))
+    assert pc.publish(p, cache, 0) == 2
+    # re-publishing the same prompt allocates nothing new
+    assert pc.publish(p, cache, 1) == 0
+    assert pc.blocks_in_use == 2
+    # a longer prompt with the same head only adds the tail block
+    assert pc.publish(p + list(range(4)), cache, 0) == 1
+    assert pc.blocks_in_use == 3
+
+
+def test_publish_eligibility_cap_is_block_aligned():
+    pc = _cache()
+    cache = _slot_cache()
+    p = list(range(3 * BLOCK))
+    # cap mid-block: only the fully-covered blocks publish
+    assert pc.publish(p, cache, 0, eligible_tokens=2 * BLOCK + 1) == 2
+    assert pc.publish(p, cache, 0, eligible_tokens=0) == 0
+    assert pc.blocks_in_use == 2
+
+
+def test_published_kv_matches_cache_contents():
+    """The pool block for positions [B, 2B) must hold slot 1's cache
+    values at those positions (k and v, k != v)."""
+    pc = _cache()
+    cache = _slot_cache(num_slots=3)
+    p = list(range(2 * BLOCK))
+    pc.publish(p, cache, slot=1)
+    m = pc.lookup(p + [1])
+    assert m.tokens == 2 * BLOCK
+    k2 = np.asarray(pc.pool["k"][:, m.block_ids[1]])   # [L, Hkv, B, Dh]
+    v2 = np.asarray(pc.pool["v"][:, m.block_ids[1]])
+    want_k = np.asarray(cache["k"][:, 1, :, BLOCK:2 * BLOCK, :])
+    want_v = np.asarray(cache["v"][:, 1, :, BLOCK:2 * BLOCK, :])
+    np.testing.assert_array_equal(k2, want_k)
+    np.testing.assert_array_equal(v2, want_v)
+    pc.release(m)
+
+
+# ---------------------------------------------------------------------------
+# refcount pinning vs LRU eviction
+# ---------------------------------------------------------------------------
+
+
+def test_lru_evicts_least_recently_used_leaf():
+    pc = _cache(num_blocks=2)
+    cache = _slot_cache()
+    a = [1] * BLOCK + [1]
+    b = [2] * BLOCK + [2]
+    c = [3] * BLOCK + [3]
+    assert pc.publish(a, cache, 0) == 1
+    assert pc.publish(b, cache, 0) == 1
+    # touch a so b becomes the LRU leaf
+    pc.release(pc.lookup(a))
+    assert pc.publish(c, cache, 0) == 1      # evicts b
+    assert pc.stats.blocks_evicted == 1
+    assert pc.lookup(a).tokens == BLOCK      # survived (leave pinned)
+    assert pc.lookup(b).tokens == 0          # evicted
+    assert pc.lookup(c).tokens == BLOCK
+
+
+def test_pinned_blocks_are_not_evicted():
+    pc = _cache(num_blocks=1)
+    cache = _slot_cache()
+    a = [1] * BLOCK + [1]
+    assert pc.publish(a, cache, 0) == 1
+    m = pc.lookup(a)                         # pins the only block
+    assert m.tokens == BLOCK
+    # pool full of pinned blocks: the new publish must SKIP, not evict
+    assert pc.publish([2] * BLOCK + [2], cache, 0) == 0
+    assert pc.stats.publish_skips == 1
+    m2 = pc.lookup(a)
+    assert m2.tokens == BLOCK                # still resident
+    pc.release(m2)
+    pc.release(m)                            # fully unpinned now
+    assert pc.publish([2] * BLOCK + [2], cache, 0) == 1   # evicts a
+    assert pc.lookup(a).tokens == 0
+
+
+def test_interior_nodes_survive_while_children_exist():
+    """Eviction is leaves-only: evicting an interior block would orphan
+    descendants that can then never be matched from the root."""
+    pc = _cache(num_blocks=3)
+    cache = _slot_cache()
+    long = list(range(3 * BLOCK))
+    assert pc.publish(long, cache, 0) == 3   # chain of 3 nodes
+    # pool is full; a new 1-block publish must evict the chain TAIL,
+    # not the root block
+    assert pc.publish([9] * BLOCK + [9], cache, 0) == 1
+    m = pc.lookup(long + [1])
+    assert m.tokens == 2 * BLOCK             # head survived, tail gone
+    pc.release(m)
+
+
+def test_shared_template_head_is_thread_independent():
+    from copilot_for_consensus_tpu.summarization.tpu_summarizer import (
+        DEFAULT_SYSTEM,
+        DEFAULT_TEMPLATE,
+        build_prompt,
+        shared_template_head,
+    )
+    from copilot_for_consensus_tpu.summarization.base import ThreadContext
+
+    head = shared_template_head(DEFAULT_TEMPLATE, DEFAULT_SYSTEM)
+    assert DEFAULT_SYSTEM in head
+    assert "{" not in head                       # fully rendered
+    for tid in ("t1", "t2"):
+        ctx = ThreadContext(thread_id=tid, subject=f"subj-{tid}",
+                            participants=[f"{tid}@x"], message_count=2,
+                            chunks=[{"chunk_id": "c", "text": tid * 5}])
+        assert build_prompt(ctx).startswith(head)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the engine (CPU)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestEngineEndToEnd:
+    CHUNK = 64
+    SHARED = 256            # acceptance: >= 256-token shared prefix
+
+    def _engines(self):
+        from copilot_for_consensus_tpu.engine.generation import (
+            GenerationEngine,
+        )
+        from copilot_for_consensus_tpu.models import decoder
+
+        params = decoder.init_params(jax.random.PRNGKey(7), CFG,
+                                     dtype=jnp.float32)
+        kw = dict(num_slots=4, max_len=384,
+                  prefill_buckets=(64, 128, 320),
+                  dtype=jnp.float32, kv_dtype=jnp.float32,
+                  attn_impl="xla", decode_window=4,
+                  prefill_chunk=self.CHUNK)
+        return (GenerationEngine(CFG, params, **kw),
+                GenerationEngine(CFG, params, prefix_cache_blocks=32,
+                                 **kw))
+
+    def test_shared_prefix_batch_identical_outputs_and_savings(self):
+        plain, cached = self._engines()
+        rng = np.random.default_rng(0)
+        shared = rng.integers(3, CFG.vocab_size,
+                              size=self.SHARED).tolist()
+        prompts = [shared + rng.integers(3, CFG.vocab_size,
+                                         size=40).tolist()
+                   for _ in range(8)]
+
+        want = plain.generate(prompts, max_new_tokens=8)
+        got = cached.generate(prompts, max_new_tokens=8)
+        # bit-identical generations (greedy sampling, f32 cache)
+        for w, g in zip(want, got):
+            assert w.tokens == g.tokens
+            assert w.finish_reason == g.finish_reason
+
+        # second pass: every prompt now fully cached
+        want2 = plain.generate(prompts, max_new_tokens=8)
+        got2 = cached.generate(prompts, max_new_tokens=8)
+        for w, g in zip(want2, got2):
+            assert w.tokens == g.tokens
+
+        stats = cached.prefix_stats()
+        assert stats["enabled"]
+        assert stats["hits"] >= 8                 # whole second pass
+        # acceptance: accounted prefilled tokens drop >= 50% vs the
+        # cache-disabled engine over the same workload
+        assert plain.prefill_tokens == 2 * 8 * len(prompts[0])
+        assert stats["prefill_tokens"] <= plain.prefill_tokens // 2
+        assert stats["prefill_tokens_saved"] >= 8 * self.SHARED
+
+    def test_mixed_hit_miss_wave_and_divergent_prefixes(self):
+        plain, cached = self._engines()
+        rng = np.random.default_rng(1)
+        shared = rng.integers(3, CFG.vocab_size, size=self.SHARED).tolist()
+        batch1 = [shared + rng.integers(3, CFG.vocab_size,
+                                        size=24).tolist()
+                  for _ in range(3)]
+        cached.generate(batch1, max_new_tokens=4)   # warm the cache
+        # second batch mixes: full hits, a diverging prefix (matches
+        # only part of the chain), and a cold miss — one seeded wave
+        divergent = shared[:self.CHUNK] + rng.integers(
+            3, CFG.vocab_size, size=self.SHARED).tolist()
+        cold = rng.integers(3, CFG.vocab_size,
+                            size=self.SHARED).tolist()
+        batch2 = [batch1[0], divergent, cold]
+        plain.generate(batch1, max_new_tokens=4)
+        want = plain.generate(batch2, max_new_tokens=4)
+        got = cached.generate(batch2, max_new_tokens=4)
+        for w, g in zip(want, got):
+            assert w.tokens == g.tokens
+
+    def test_async_runner_with_prefix_cache(self):
+        from copilot_for_consensus_tpu.engine.async_runner import (
+            AsyncEngineRunner,
+        )
+
+        plain, cached = self._engines()
+        rng = np.random.default_rng(2)
+        shared = rng.integers(3, CFG.vocab_size, size=self.SHARED).tolist()
+        prompts = [shared + [10 + i] * 16 for i in range(6)]
+        want = plain.generate(prompts, max_new_tokens=5)
+        runner = AsyncEngineRunner(cached).start()
+        try:
+            hs = [runner.submit(list(p), 5) for p in prompts]
+            for w, h in zip(want, hs):
+                assert h.result(timeout=300).tokens == w.tokens
+            hs = [runner.submit(list(p), 5,
+                                cache_eligible_tokens=len(shared))
+                  for p in prompts]
+            for w, h in zip(want, hs):
+                assert h.result(timeout=300).tokens == w.tokens
+        finally:
+            runner.stop()
+        assert cached.prefix_stats()["hits"] > 0
+
+    def test_summarizer_template_scope_hits_across_threads(self):
+        """cache_scope='template' publishes only the shared template
+        head; a second thread's prompt still hits on that span."""
+        from copilot_for_consensus_tpu.summarization.base import (
+            ThreadContext,
+        )
+        from copilot_for_consensus_tpu.summarization.tpu_summarizer import (
+            TPUSummarizer,
+        )
+
+        _, cached = self._engines()
+        summ = TPUSummarizer(engine=cached, max_new_tokens=4,
+                             cache_scope="template")
+        assert 0 < summ._cache_eligible
+        threads = [
+            ThreadContext(thread_id=f"t{i}", subject=f"subject {i}",
+                          participants=[f"p{i}@x"], message_count=3,
+                          chunks=[{"chunk_id": f"c{i}",
+                                   "text": f"body {i} " * 8}])
+            for i in range(3)
+        ]
+        summ.summarize(threads[0])
+        summ.summarize_batch(threads[1:])
+        stats = cached.prefix_stats()
+        # later threads reused the template head published by the first
+        assert stats["hits"] >= 1
+        assert stats["prefill_tokens_saved"] > 0
+        # template scope: nothing beyond the shared span was published
+        assert stats["blocks_published"] <= \
+            summ._cache_eligible // self.CHUNK + 1

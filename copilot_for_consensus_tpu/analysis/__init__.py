@@ -1,0 +1,211 @@
+"""jaxlint — first-party static analysis for the serving stack.
+
+``python -m copilot_for_consensus_tpu.analysis`` runs every rule group
+over the repo and exits non-zero on any non-baselined finding:
+
+* ``jax`` group (jax_rules.py): host-sync-in-jit, retrace-hazard,
+  donation, prng-reuse, collective-axis — the invariants that keep the
+  engine's jitted hot paths fast and correct.
+* ``concurrency`` group (concurrency.py): blocking-call — handler-thread
+  hygiene for the bus and services.
+* ``policy`` group (policy.py): the original validate_python lane
+  (syntax, import smoke, mutable defaults, unused imports, bare except).
+
+Suppression: inline ``# jaxlint: disable=<rule>`` with a justification,
+or an entry in ``jaxlint_baseline.json`` (every entry must carry a
+written justification). Workflow docs: ``docs/STATIC_ANALYSIS.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from copilot_for_consensus_tpu.analysis import (
+    concurrency,
+    jax_rules,
+    policy,
+)
+from copilot_for_consensus_tpu.analysis.base import (
+    DEFAULT_BASELINE,
+    Finding,
+    Module,
+    PACKAGE,
+    apply_baseline,
+    baseline_entries_for,
+    load_baseline,
+    rel,
+)
+
+#: group name → (per-module check, default scan roots)
+GROUPS = {
+    "jax": jax_rules.check,
+    "concurrency": concurrency.check,
+    "policy": policy.check,
+}
+
+#: every individual rule id → its group (for ``--rules`` filtering and
+#: docs; keep in sync with docs/STATIC_ANALYSIS.md)
+RULES = {
+    "host-sync-in-jit": "jax",
+    "retrace-hazard": "jax",
+    "donation": "jax",
+    "prng-reuse": "jax",
+    "collective-axis": "jax",
+    "blocking-call": "concurrency",
+    "policy-syntax": "policy",
+    "policy-mutable-default": "policy",
+    "policy-bare-except": "policy",
+    "policy-unused-import": "policy",
+    "policy-import-smoke": "policy",
+}
+
+
+def _package_files() -> list[pathlib.Path]:
+    return [p for p in sorted(PACKAGE.rglob("*.py"))
+            if "__pycache__" not in p.parts]
+
+
+def _expand(paths: list[str]) -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            out.extend(q for q in sorted(path.rglob("*.py"))
+                       if "__pycache__" not in q.parts)
+        else:
+            out.append(path)
+    return out
+
+
+def _selected_groups(rules_arg: str | None) -> tuple[set[str], set[str]]:
+    """('groups to run', 'individual rules to keep' — empty = all)."""
+    if not rules_arg:
+        return set(GROUPS), set()
+    groups: set[str] = set()
+    rules: set[str] = set()
+    for tok in rules_arg.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok in GROUPS:
+            groups.add(tok)
+        elif tok in RULES:
+            groups.add(RULES[tok])
+            rules.add(tok)
+        else:
+            raise SystemExit(f"unknown rule or group {tok!r}; "
+                             f"known: {sorted(GROUPS) + sorted(RULES)}")
+    return groups, rules
+
+
+def analyze_files(paths: list[pathlib.Path],
+                  groups: set[str] | None = None) -> list[Finding]:
+    """Run the per-file rule groups over explicit files (no import
+    smoke). The API the tests drive fixtures through."""
+    groups = set(GROUPS) if groups is None else groups
+    findings: list[Finding] = []
+    for path in paths:
+        mod = Module(path)
+        for g in sorted(groups):
+            findings.extend(GROUPS[g](mod))
+    return _dedupe(findings)
+
+
+def _dedupe(findings: list[Finding]) -> list[Finding]:
+    seen: set[tuple] = set()
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule,
+                                             f.message)):
+        k = (f.rule, f.path, f.line, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m copilot_for_consensus_tpu.analysis",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the package "
+                         "for jax/concurrency rules, the legacy "
+                         "validate_python set for policy rules)")
+    ap.add_argument("--fast", action="store_true",
+                    help="skip the import-smoke stage")
+    ap.add_argument("--rules",
+                    help="comma list of rule ids or groups "
+                         f"({', '.join(sorted(GROUPS))}) to run")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline file (default: jaxlint_baseline.json "
+                         "at the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="print current findings as baseline JSON "
+                         "(justifications left as TODO) and exit 0")
+    args = ap.parse_args(argv)
+
+    groups, only_rules = _selected_groups(args.rules)
+    findings: list[Finding] = []
+    if args.paths:
+        analyzed = _expand(args.paths)
+        missing = [p for p in analyzed if not p.is_file()]
+        if missing:
+            for p in missing:
+                print(f"jaxlint: no such file: {p}", file=sys.stderr)
+            return 2
+        findings = analyze_files(analyzed, groups)
+    else:
+        # package files get every selected group in ONE parse; the
+        # policy extras (scripts/tools/root entry files) get policy only
+        pkg = _package_files()
+        analyzed = list(pkg)
+        findings.extend(analyze_files(pkg, groups))
+        if "policy" in groups:
+            extras = [p for p in policy.policy_files()
+                      if PACKAGE not in p.resolve().parents]
+            analyzed += extras
+            findings.extend(analyze_files(extras, {"policy"}))
+            if not args.fast:
+                findings.extend(policy.check_import_smoke())
+        findings = _dedupe(findings)
+    if only_rules:
+        findings = [f for f in findings if f.rule in only_rules]
+
+    errors: list[str] = []
+    if args.write_baseline:
+        print(json.dumps(baseline_entries_for(findings), indent=2))
+        return 0
+    if not args.no_baseline:
+        entries, errors = load_baseline(pathlib.Path(args.baseline))
+        # a filtered run can only judge entries for the rules it ran
+        entries = [e for e in entries
+                   if RULES.get(e.get("rule"), e.get("rule")) in groups
+                   and (not only_rules or e.get("rule") in only_rules)]
+        if not errors:
+            findings, stale = apply_baseline(findings, entries)
+            # staleness is only judgeable for files this run analyzed —
+            # a scoped run must not tell maintainers to prune entries
+            # that still match the rest of the repo
+            analyzed_rel = {rel(p) for p in analyzed}
+            for e in stale:
+                if e["path"] not in analyzed_rel:
+                    continue
+                print(f"jaxlint: stale baseline entry (no longer "
+                      f"matches): {e['rule']} in {e['path']} "
+                      f"[{e['context']}]", file=sys.stderr)
+
+    for e in errors:
+        print(e)
+    for f in findings:
+        print(f.render())
+    verdict = ("CLEAN" if not (findings or errors)
+               else f"{len(findings) + len(errors)} finding(s)")
+    print(f"jaxlint: checked {len(analyzed)} file(s) "
+          f"[{','.join(sorted(groups))}]: {verdict}", file=sys.stderr)
+    return 1 if (findings or errors) else 0

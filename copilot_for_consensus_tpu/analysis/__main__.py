@@ -1,0 +1,4 @@
+from copilot_for_consensus_tpu.analysis import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,233 @@
+"""jaxlint plumbing: findings, inline suppressions, the baseline file.
+
+The ast rule groups never import the code they check, so they run
+identically on a TPU host, a CPU CI runner, or a laptop without jax
+installed (the one exception is the policy group's import-smoke stage,
+which imports every package module in a subprocess — ``--fast`` skips
+it). Everything here is shared by the rule groups in ``jax_rules.py``
+/ ``concurrency.py`` / ``policy.py``.
+
+Suppression surfaces, in precedence order:
+
+1. ``# jaxlint: disable=<rule>[,<rule>...]`` — inline, on the offending
+   line or on a comment-only line directly above it. Use for findings
+   that are deliberate AND local (put the justification in the same
+   comment).
+2. The committed baseline file (``jaxlint_baseline.json`` at the repo
+   root) — for grandfathered findings. Every entry MUST carry a
+   non-empty ``justification``; an unjustified entry fails the run, so
+   the baseline cannot silently become a dumping ground. Entries match
+   on (rule, path, context, message) — never on line numbers, which
+   drift with every edit.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import dataclass
+
+#: repo root (the directory holding ``copilot_for_consensus_tpu/``)
+ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+PACKAGE = ROOT / "copilot_for_consensus_tpu"
+DEFAULT_BASELINE = ROOT / "jaxlint_baseline.json"
+
+_DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable=([\w\-, ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative posix path (or absolute if outside)
+    line: int
+    message: str
+    context: str = ""  # enclosing function/class qualname; "" = module
+
+    def render(self) -> str:
+        ctx = f" [in {self.context}]" if self.context else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{ctx}"
+
+    def key(self) -> tuple[str, str, str, str]:
+        """Line-number-free identity used for baseline matching."""
+        return (self.rule, self.path, self.context, self.message)
+
+
+def rel(path: pathlib.Path) -> str:
+    """Stable path spelling for findings and baseline entries."""
+    try:
+        return path.resolve().relative_to(ROOT).as_posix()
+    except ValueError:
+        return path.resolve().as_posix()
+
+
+class Suppressions:
+    """Per-line ``# jaxlint: disable=...`` map for one source file.
+
+    A trailing comment suppresses its own line; a comment-only line
+    suppresses the next line (so multi-rule justifications fit)."""
+
+    def __init__(self, source: str):
+        self._by_line: dict[int, set[str]] = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _DISABLE_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self._by_line.setdefault(i, set()).update(rules)
+            if text.lstrip().startswith("#"):     # comment-only line
+                self._by_line.setdefault(i + 1, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        return rule in self._by_line.get(line, ())
+
+
+class Module:
+    """A parsed source file plus the lookups every checker needs."""
+
+    def __init__(self, path: pathlib.Path, source: str | None = None):
+        self.path = path
+        self.relpath = rel(path)
+        self.source = path.read_text() if source is None else source
+        self.lines = self.source.splitlines()
+        self.tree: ast.Module | None = None
+        self.syntax_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(self.source, filename=str(path))
+        except SyntaxError as exc:   # policy-syntax owns reporting this
+            self.syntax_error = exc
+            self.suppressions = Suppressions(self.source)
+            return
+        self.suppressions = Suppressions(self.source)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted name of the enclosing defs/classes (for context)."""
+        names: list[str] = []
+        cur: ast.AST | None = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+            elif isinstance(cur, ast.Lambda):
+                names.append("<lambda>")
+            cur = self._parents.get(cur)
+        return ".".join(reversed(names))
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                context: str | None = None) -> Finding | None:
+        """Build a Finding unless an inline suppression covers it."""
+        line = getattr(node, "lineno", 1)
+        if self.suppressions.is_suppressed(rule, line):
+            return None
+        ctx = self.qualname(node) if context is None else context
+        return Finding(rule, self.relpath, line, message, ctx)
+
+
+# ---------------------------------------------------------------------------
+# baseline file
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: pathlib.Path) -> tuple[list[dict], list[str]]:
+    """Returns (entries, errors). An unreadable file or an entry with a
+    missing/empty justification is an error — the lane fails rather than
+    silently accepting an unaccounted-for suppression."""
+    if not path.exists():
+        return [], []
+    try:
+        entries = json.loads(path.read_text())
+    except (OSError, ValueError) as exc:
+        return [], [f"baseline {path}: unreadable: {exc}"]
+    if not isinstance(entries, list):
+        return [], [f"baseline {path}: expected a JSON list"]
+    errors = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or not all(
+                isinstance(e.get(k), str)
+                for k in ("rule", "path", "context", "message")):
+            errors.append(f"baseline {path}: entry {i} malformed "
+                          "(need rule/path/context/message strings)")
+            continue
+        if not str(e.get("justification", "")).strip():
+            errors.append(
+                f"baseline {path}: entry {i} ({e['rule']} in {e['path']}) "
+                "has no justification — every grandfathered finding must "
+                "say WHY it is deliberate")
+    return entries, errors
+
+
+def apply_baseline(findings: list[Finding], entries: list[dict]
+                   ) -> tuple[list[Finding], list[dict]]:
+    """Returns (non-baselined findings, stale entries). Matching is by
+    Finding.key(); one entry may cover several findings (e.g. the same
+    message at two call sites of one function)."""
+    keyed = {(e["rule"], e["path"], e["context"], e["message"]): e
+             for e in entries}
+    used: set[tuple] = set()
+    out = []
+    for f in findings:
+        if f.key() in keyed:
+            used.add(f.key())
+        else:
+            out.append(f)
+    stale = [e for k, e in keyed.items() if k not in used]
+    return out, stale
+
+
+def baseline_entries_for(findings: list[Finding]) -> list[dict]:
+    """Render findings as baseline entries (for ``--write-baseline``).
+    Justifications are intentionally unusable until a human fills them."""
+    seen: set[tuple] = set()
+    out = []
+    for f in findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        out.append({"rule": f.rule, "path": f.path, "context": f.context,
+                    "message": f.message,
+                    "justification": "TODO: explain why this is deliberate"})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers shared by the rule groups
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.psum' for Attribute/Name chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_constants(node: ast.AST) -> list[str]:
+    """Every string literal anywhere under ``node``."""
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def int_constants(node: ast.AST) -> list[int]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, int)
+            and not isinstance(n.value, bool)]
+
+
+def kw(call: ast.Call, name: str) -> ast.expr | None:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None

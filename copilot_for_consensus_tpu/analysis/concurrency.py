@@ -1,0 +1,109 @@
+"""Rule group ``blocking-call``: bus/service handler-thread hygiene.
+
+The bus consumer threads (``BrokerSubscriber.start_consuming``, the
+service runner threads) and the engine's single-owner consumer thread
+are the system's availability surface: a bare ``time.sleep`` there is
+(a) uninterruptible — shutdown waits out the sleep — and (b) dead time
+the thread could spend draining its queue. The audited pattern is an
+``Event.wait(timeout)`` (stop-aware) or the exponential-backoff retry
+helpers the checker allowlists below.
+
+Two checks:
+
+* ``time.sleep(...)`` anywhere in the package outside the audited retry
+  helpers. CLI parking loops and deliberate backoffs carry an inline
+  ``# jaxlint: disable=blocking-call`` with the justification.
+* a ``publish``-family call made while holding a lock (``with <lock>:``
+  around ``*.publish*(...)``): publish is a network round trip with
+  broker confirms — holding a lock across it serializes every producer
+  behind one slow confirm.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from copilot_for_consensus_tpu.analysis.base import (
+    Finding,
+    Module,
+    dotted_name,
+)
+
+#: (path suffix, function name) pairs of the audited retry helpers —
+#: exponential-backoff loops whose sleeps are the documented contract
+#: (transient-error retry with backoff; see docs/STATIC_ANALYSIS.md).
+AUDITED_RETRY_HELPERS = (
+    ("bus/azure_servicebus.py", "request"),
+    ("security/keyvault_signer.py", "_request"),
+    ("core/retry.py", "run"),
+)
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _enclosing_function_name(mod: Module, node: ast.AST) -> str:
+    cur = mod.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = mod.parent(cur)
+    return ""
+
+
+def _is_audited(mod: Module, node: ast.AST) -> bool:
+    fname = _enclosing_function_name(mod, node)
+    return any(mod.relpath.endswith(suffix) and fname == func
+               for suffix, func in AUDITED_RETRY_HELPERS)
+
+
+def _lockish_with(item: ast.withitem) -> bool:
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr).lower()
+    # token match, not substring: `blockchain`/`clock` are not locks
+    tokens = set(re.split(r"[^a-z0-9]+", name))
+    return bool(tokens & set(_LOCKISH))
+
+
+def check(mod: Module) -> list[Finding]:
+    if mod.tree is None:
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and dotted_name(
+                node.func) == "time.sleep":
+            if _is_audited(mod, node):
+                continue
+            f = mod.finding(
+                "blocking-call", node,
+                "`time.sleep` blocks the thread uninterruptibly — use a "
+                "stop Event's `.wait(timeout)` (shutdown-aware) or route "
+                "backoff through the audited retry helpers")
+            if f is not None:
+                out.append(f)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            if not any(_lockish_with(i) for i in node.items):
+                continue
+            # stop at nested function boundaries: a callback DEFINED
+            # under the lock does not publish under the lock
+            stack: list[ast.AST] = list(node.body)
+            while stack:
+                sub = stack.pop()
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                stack.extend(ast.iter_child_nodes(sub))
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr.startswith("publish")):
+                    f = mod.finding(
+                        "blocking-call", sub,
+                        f"`.{sub.func.attr}()` (a broker round trip with "
+                        "confirms) is called while holding a lock — "
+                        "every producer serializes behind one slow "
+                        "confirm; publish outside the critical section")
+                    if f is not None:
+                        out.append(f)
+    return out

@@ -1,0 +1,788 @@
+"""JAX/TPU-aware rules: the invariants that keep the serving engine fast.
+
+Five rules share one per-module model of "which functions are traced":
+
+* ``host-sync-in-jit`` — ``.item()`` / ``np.asarray`` / ``jax.device_get``
+  / ``.block_until_ready()`` reachable inside a jitted / shard_mapped /
+  scan-body function. Each is a device→host round trip: inside a traced
+  hot path it either breaks tracing outright or (worse) silently turns a
+  fused dispatch into a per-step sync — the exact failure mode the
+  engine's windowed-decode design exists to avoid (docs/PERF.md).
+* ``retrace-hazard`` — Python ``if``/``while`` branching on a
+  tracer-derived value (ConcretizationTypeError at best, a retrace per
+  distinct value at worst), and unhashable static-arg defaults.
+* ``donation`` — a jitted function taking a cache/pool device buffer
+  without ``donate_argnums``. An undonated KV cache double-allocates on
+  every dispatch (2x cache HBM) — the engine donates its cache in every
+  decode/admit program (engine/generation.py).
+* ``prng-reuse`` — one PRNG key consumed by two random ops without an
+  intervening ``jax.random.split`` (correlated samples), or a key
+  consumed again after being split.
+* ``collective-axis`` — a collective (``psum``/``ppermute``/...) naming
+  an axis, as a string literal, that no mesh/shard_map declaration in
+  the module binds.
+
+Tracing contexts are found statically: ``@jax.jit`` /
+``@functools.partial(jax.jit, ...)`` decorators, ``jax.jit(fn, ...)`` /
+``shard_map(fn, ...)`` call sites, loop-body functions handed to
+``jax.lax.scan``/``fori_loop``/``while_loop``/``cond``/``switch``, every
+function lexically nested in a context, and every same-module function a
+context calls by name. Cross-module propagation is out of scope (v1):
+the engine's programs and their same-file helpers are covered; shared
+layers in ``models/`` are exercised through the engine's fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from copilot_for_consensus_tpu.analysis.base import (
+    Finding,
+    Module,
+    dotted_name,
+    int_constants,
+    kw,
+    str_constants,
+)
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+SHARD_NAMES = {"shard_map", "jax.experimental.shard_map.shard_map",
+               "jax.shard_map"}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+#: structured-control-flow combinators whose function args trace
+LOOP_NAMES = {"jax.lax.scan", "lax.scan", "jax.lax.fori_loop",
+              "lax.fori_loop", "jax.lax.while_loop", "lax.while_loop",
+              "jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch",
+              "jax.lax.map", "lax.map", "jax.lax.associative_scan",
+              "lax.associative_scan"}
+
+#: positional-param name tokens that mark a large mutable device buffer
+#: on the serving hot path (the KV slot cache, the prefix-cache block
+#: pool). Token match on "_"-split names: ``cache``, ``kv_cache``,
+#: ``cache_k``, ``pool_k`` hit; ``kv_len`` (a static int) does not.
+BUFFER_TOKENS = {"cache", "pool"}
+
+#: calls whose result is never a tracer regardless of arguments
+UNTAINT_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "id",
+                 "callable", "repr", "str.format"}
+#: attribute reads that are static even on a tracer
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "itemsize"}
+
+#: device→host sync surfaces (method names / dotted callables)
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+SYNC_CALLS = {"jax.device_get", "jax.block_until_ready",
+              "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+              "np.frombuffer", "numpy.frombuffer"}
+
+_PRNG_PREFIXES = ("jax.random.", "random.", "jrandom.", "jr.")
+_PRNG_NONCONSUMING = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                      "wrap_key_data", "key_impl", "clone"}
+#: repo idiom: ``sample(logits, key, cfg)`` draws from the key
+SAMPLE_LIKE = {"sample"}
+
+COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+               "ppermute": 1, "pshuffle": 1, "all_gather": 1,
+               "all_to_all": 1, "psum_scatter": 1, "pcast": 1,
+               "axis_index": 0, "pbroadcast": 1}
+_COLLECTIVE_PREFIXES = ("jax.lax.", "lax.")
+
+
+@dataclass
+class _Reg:
+    """One jit/shard_map registration of a function."""
+
+    kind: str                       # "jit" | "shard_map" | "loop-body"
+    line: int
+    static_names: set[str] = field(default_factory=set)
+    static_nums: set[int] = field(default_factory=set)
+    donated_nums: set[int] = field(default_factory=set)
+    donated_names: set[str] = field(default_factory=set)
+    bound_names: set[str] = field(default_factory=set)  # partial kwargs
+
+
+@dataclass
+class _Fn:
+    node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    qualname: str
+    regs: list[_Reg] = field(default_factory=list)
+    in_context: bool = False
+
+    @property
+    def pos_params(self) -> list[str]:
+        """FULL positional list, self/cls included — jax's own
+        donate_argnums/static_argnums count self on methods, so indices
+        must line up with the real signature."""
+        a = self.node.args
+        return [p.arg for p in (a.posonlyargs + a.args)]
+
+    @property
+    def all_params(self) -> list[str]:
+        return self.pos_params + [p.arg for p in self.node.args.kwonlyargs]
+
+    def static_params(self) -> set[str]:
+        pos = self.pos_params
+        out: set[str] = set()
+        for r in self.regs:
+            out |= r.static_names | r.bound_names
+            out |= {pos[i] for i in r.static_nums if i < len(pos)}
+        return out
+
+
+def _reg_from_call(call: ast.Call, kind: str) -> _Reg:
+    reg = _Reg(kind, call.lineno)
+    for name, bucket in (("static_argnames", reg.static_names),
+                         ("donate_argnames", reg.donated_names)):
+        val = kw(call, name)
+        if val is not None:
+            bucket.update(str_constants(val))
+    for name, bucket in (("static_argnums", reg.static_nums),
+                         ("donate_argnums", reg.donated_nums)):
+        val = kw(call, name)
+        if val is not None:
+            bucket.update(int_constants(val))
+    return reg
+
+
+class _ModuleModel:
+    """Functions, jit registrations, and jit-reachable contexts."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.fns: dict[ast.AST, _Fn] = {}
+        self.by_name: dict[str, list[_Fn]] = {}
+        assert mod.tree is not None
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                fn = _Fn(node, mod.qualname(node))
+                self.fns[node] = fn
+                if not isinstance(node, ast.Lambda):
+                    self.by_name.setdefault(node.name, []).append(fn)
+        self._collect_decorators()
+        self._collect_call_sites(mod.tree)
+        self._propagate()
+
+    # -- registration discovery ---------------------------------------
+
+    def _collect_decorators(self) -> None:
+        def kind_of(head: str) -> str:
+            return "jit" if head in JIT_NAMES else "shard_map"
+
+        for node, fn in self.fns.items():
+            if isinstance(node, ast.Lambda):
+                continue
+            for deco in node.decorator_list:
+                head = dotted_name(deco)
+                if head in JIT_NAMES | SHARD_NAMES:
+                    fn.regs.append(_Reg(kind_of(head), deco.lineno))
+                elif isinstance(deco, ast.Call):
+                    head = dotted_name(deco.func)
+                    if head in JIT_NAMES | SHARD_NAMES:
+                        fn.regs.append(
+                            _reg_from_call(deco, kind_of(head)))
+                    elif (head in PARTIAL_NAMES and deco.args):
+                        inner = dotted_name(deco.args[0])
+                        if inner in JIT_NAMES | SHARD_NAMES:
+                            fn.regs.append(
+                                _reg_from_call(deco, kind_of(inner)))
+
+    def _resolve(self, node: ast.AST) -> tuple[_Fn | None, set[str]]:
+        """A function argument at a jit/shard_map/loop call site: a bare
+        Name, a lambda, or functools.partial(Name, **static)."""
+        if isinstance(node, ast.Lambda):
+            return self.fns.get(node), set()
+        if isinstance(node, ast.Name):
+            cands = self.by_name.get(node.id, [])
+            return (cands[0] if cands else None), set()
+        if (isinstance(node, ast.Call)
+                and dotted_name(node.func) in PARTIAL_NAMES and node.args):
+            fn, _ = self._resolve(node.args[0])
+            bound = {k.arg for k in node.keywords if k.arg}
+            return fn, bound
+        return None, set()
+
+    def _collect_call_sites(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            head = dotted_name(node.func)
+            if head in JIT_NAMES | SHARD_NAMES and node.args:
+                fn, bound = self._resolve(node.args[0])
+                if fn is not None:
+                    reg = _reg_from_call(
+                        node, "jit" if head in JIT_NAMES else "shard_map")
+                    reg.bound_names |= bound
+                    fn.regs.append(reg)
+            elif head in LOOP_NAMES:
+                for arg in node.args:
+                    fn, bound = self._resolve(arg)
+                    if fn is not None:
+                        reg = _Reg("loop-body", node.lineno)
+                        reg.bound_names |= bound
+                        fn.regs.append(reg)
+
+    # -- reachability --------------------------------------------------
+
+    def _propagate(self) -> None:
+        work = [fn for fn in self.fns.values() if fn.regs]
+        for fn in work:
+            fn.in_context = True
+        while work:
+            fn = work.pop()
+            # lexically nested defs trace with their parent
+            for node in ast.walk(fn.node):
+                sub = self.fns.get(node)
+                if sub is not None and not sub.in_context:
+                    sub.in_context = True
+                    work.append(sub)
+            # same-module functions called by bare name
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name):
+                    for callee in self.by_name.get(node.func.id, []):
+                        if not callee.in_context:
+                            callee.in_context = True
+                            work.append(callee)
+
+    def contexts(self):
+        return [fn for fn in self.fns.values() if fn.in_context]
+
+    def own_body(self, fn: _Fn):
+        """Walk fn's body but stop at nested function boundaries (each
+        nested def is its own context and reports its own findings)."""
+        body = (fn.node.body if isinstance(fn.node.body, list)
+                else [fn.node.body])
+        stack: list[ast.AST] = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# taint: "could this expression hold a tracer-dependent value?"
+# ---------------------------------------------------------------------------
+
+
+def _tainted(node: ast.AST, names: set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in names
+    if isinstance(node, ast.Constant):
+        return False
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        # `x is None` resolves at trace time (a tracer is never None):
+        # a structure check, not a value branch
+        return False
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return _tainted(node.value, names)
+    if isinstance(node, ast.Call):
+        head = dotted_name(node.func)
+        if head in UNTAINT_CALLS:
+            return False
+        if head.endswith("axis_index"):     # per-device varying value
+            return True
+        return any(_tainted(a, names) for a in node.args) or any(
+            _tainted(k.value, names) for k in node.keywords)
+    if isinstance(node, ast.Lambda):
+        return False
+    return any(_tainted(c, names) for c in ast.iter_child_nodes(node)
+               if isinstance(c, ast.expr))
+
+
+def _assign_names(target: ast.expr) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for el in target.elts:
+            out.extend(_assign_names(el))
+        return out
+    return []
+
+
+class _TaintWalk:
+    """Statement-order taint pass over one context's own body; collects
+    retrace-hazard (tainted if/while tests) and host-sync (int/float on
+    tainted values) findings along the way."""
+
+    def __init__(self, mod: Module, fn: _Fn):
+        self.mod = mod
+        self.fn = fn
+        self.findings: list[Finding] = []
+        statics = fn.static_params()
+        # Only functions with a DIRECT registration (jit/shard_map
+        # decorator or call site, or a lax.scan/cond body) have params
+        # we KNOW are tracers. Contexts reached through the call graph
+        # or lexical nesting often receive static closure values — their
+        # params stay untainted (axis_index-derived values still taint).
+        self.tainted: set[str] = (
+            {p for p in fn.all_params
+             if p not in statics and p not in ("self", "cls")}
+            if fn.regs else set())
+
+    def run(self) -> list[Finding]:
+        body = (self.fn.node.body
+                if isinstance(self.fn.node.body, list)
+                else [])           # a Lambda body has no statements
+        self._stmts(body)
+        return self.findings
+
+    def _stmts(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return                            # nested contexts walk alone
+        # cast-scan only the expressions evaluated AT this statement —
+        # compound bodies are scanned statement-by-statement below, with
+        # the taint state current at each one
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_casts(stmt.test)
+        elif isinstance(stmt, ast.For):
+            self._scan_casts(stmt.iter)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_casts(item.context_expr)
+        elif not isinstance(stmt, (ast.Try, ast.ClassDef)):
+            self._scan_casts(stmt)
+        if isinstance(stmt, (ast.If, ast.While)):
+            if _tainted(stmt.test, self.tainted):
+                word = "if" if isinstance(stmt, ast.If) else "while"
+                self._emit(
+                    "retrace-hazard", stmt,
+                    f"Python `{word}` branches on a traced value — a "
+                    "retrace per distinct value (or a Concretization"
+                    "TypeError); use jnp.where/lax.cond/lax.while_loop, "
+                    "or mark the operand static")
+            before = set(self.tainted)
+            self._stmts(stmt.body)
+            after_body = self.tainted
+            self.tainted = set(before)
+            self._stmts(stmt.orelse)
+            self.tainted |= after_body
+        elif isinstance(stmt, ast.For):
+            for n in _assign_names(stmt.target):
+                if _tainted(stmt.iter, self.tainted):
+                    self.tainted.add(n)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._stmts(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        elif isinstance(stmt, ast.Assign):
+            val = _tainted(stmt.value, self.tainted)
+            for t in stmt.targets:
+                for n in _assign_names(t):
+                    (self.tainted.add if val
+                     else self.tainted.discard)(n)
+        elif isinstance(stmt, ast.AugAssign):
+            if _tainted(stmt.value, self.tainted):
+                for n in _assign_names(stmt.target):
+                    self.tainted.add(n)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            val = _tainted(stmt.value, self.tainted)
+            for n in _assign_names(stmt.target):
+                (self.tainted.add if val else self.tainted.discard)(n)
+
+    def _scan_casts(self, root: ast.AST) -> None:
+        """int()/float()/bool() on a tracer force a host sync; on static
+        values they are fine — so only tainted operands flag. Nested
+        function subtrees are skipped entirely (they walk alone)."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue                      # do not descend
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float", "bool")
+                    and len(node.args) == 1
+                    and _tainted(node.args[0], self.tainted)):
+                self._emit(
+                    "host-sync-in-jit", node,
+                    f"`{node.func.id}()` on a traced value forces a "
+                    "device→host sync (or a ConcretizationTypeError) "
+                    "inside a traced function")
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        f = self.mod.finding(rule, node, message, context=self.fn.qualname)
+        if f is not None:
+            self.findings.append(f)
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+
+def _check_host_sync(mod: Module, model: _ModuleModel) -> list[Finding]:
+    out: list[Finding] = []
+    for fn in model.contexts():
+        for node in model.own_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            head = dotted_name(node.func)
+            msg = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SYNC_METHODS):
+                msg = (f"`.{node.func.attr}()` is a device→host sync "
+                       "inside a traced function")
+            elif head in SYNC_CALLS:
+                msg = (f"`{head}()` materializes on the host inside a "
+                       "traced function — hoist it out of the jitted "
+                       "program")
+            if msg:
+                f = mod.finding("host-sync-in-jit", node, msg,
+                                context=fn.qualname)
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+def _check_taint_rules(mod: Module, model: _ModuleModel) -> list[Finding]:
+    out: list[Finding] = []
+    for fn in model.contexts():
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        out.extend(_TaintWalk(mod, fn).run())
+    # unhashable static-arg defaults (retrace hazard family)
+    for fn in model.contexts():
+        statics = fn.static_params()
+        if not statics or isinstance(fn.node, ast.Lambda):
+            continue
+        a = fn.node.args
+        params = a.posonlyargs + a.args
+        defaults = [None] * (len(params) - len(a.defaults)) + list(
+            a.defaults)
+        pairs = list(zip(params, defaults)) + list(
+            zip(a.kwonlyargs, a.kw_defaults))
+        for p, d in pairs:
+            if p.arg in statics and isinstance(
+                    d, (ast.List, ast.Dict, ast.Set)):
+                f = mod.finding(
+                    "retrace-hazard", d,
+                    f"static arg '{p.arg}' of '{fn.node.name}' defaults "
+                    "to an unhashable container — jit static args must "
+                    "hash (use a tuple/frozen value)",
+                    context=fn.qualname)
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+def _check_donation(mod: Module, model: _ModuleModel) -> list[Finding]:
+    out: list[Finding] = []
+    for fn in model.fns.values():
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        pos = fn.pos_params
+        for reg in fn.regs:
+            if reg.kind != "jit":
+                continue          # scan bodies / shard_map can't donate
+            for i, pname in enumerate(pos):
+                if pname in ("self", "cls"):
+                    continue
+                tokens = set(pname.lower().split("_"))
+                if not tokens & BUFFER_TOKENS:
+                    continue
+                if i in reg.donated_nums or pname in reg.donated_names:
+                    continue
+                if mod.suppressions.is_suppressed("donation", reg.line):
+                    continue
+                out.append(Finding(
+                    "donation", mod.relpath, reg.line,
+                    f"jitted function '{fn.node.name}' takes device "
+                    f"buffer '{pname}' (positional arg {i}) without "
+                    "donating it — the input buffer stays live across "
+                    "the dispatch, double-allocating it "
+                    "(donate_argnums)", fn.qualname))
+    return out
+
+
+def _prng_call(node: ast.Call) -> tuple[str, bool] | None:
+    """(op, consuming) when the call is a jax.random-family op."""
+    head = dotted_name(node.func)
+    for pref in _PRNG_PREFIXES:
+        if head.startswith(pref):
+            op = head[len(pref):]
+            if "." in op:
+                return None
+            return op, op not in _PRNG_NONCONSUMING
+    return None
+
+
+class _PrngWalk:
+    """Per-function key lifecycle: fresh → (used | split-dead | escaped).
+    Loop bodies run twice so a consume-without-resplit across iterations
+    surfaces; findings dedupe on (line, message)."""
+
+    #: param-name tokens that mark an incoming PRNG key
+    KEY_TOKENS = {"key", "rng", "prng"}
+
+    def __init__(self, mod: Module, fn_node, qualname: str):
+        self.mod = mod
+        self.qualname = qualname
+        self.node = fn_node
+        self.state: dict[str, str] = {}
+        a = fn_node.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if set(p.arg.lower().split("_")) & self.KEY_TOKENS:
+                self.state[p.arg] = "fresh"
+        self.findings: dict[tuple, Finding] = {}
+
+    def run(self) -> list[Finding]:
+        self._stmts(self.node.body)
+        return list(self.findings.values())
+
+    # -- helpers -------------------------------------------------------
+
+    def _emit(self, node: ast.AST, message: str) -> None:
+        f = self.mod.finding("prng-reuse", node, message,
+                             context=self.qualname)
+        if f is not None:
+            self.findings[(f.line, f.message)] = f
+
+    def _handle_calls(self, stmt_value: ast.AST) -> None:
+        for node in ast.walk(stmt_value):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            prng = _prng_call(node)
+            key_args = [a for a in node.args
+                        if isinstance(a, ast.Name)
+                        and a.id in self.state]
+            if prng is not None:
+                op, consuming = prng
+                for a in key_args:
+                    st = self.state.get(a.id)
+                    if consuming:
+                        if st == "used":
+                            self._emit(node, (
+                                f"key '{a.id}' consumed by a second "
+                                "random op without an intervening "
+                                "jax.random.split — draws are "
+                                "correlated"))
+                        elif st == "split":
+                            self._emit(node, (
+                                f"key '{a.id}' was already split; "
+                                "consuming it again reuses the same "
+                                "randomness as its children"))
+                        self.state[a.id] = "used"
+                    elif op == "split":
+                        if self.state.get(a.id) == "split":
+                            self._emit(node, (
+                                f"key '{a.id}' split twice — both "
+                                "splits yield identical children"))
+                        self.state[a.id] = "split"
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in SAMPLE_LIKE):
+                for a in key_args:
+                    if self.state.get(a.id) == "used":
+                        self._emit(node, (
+                            f"key '{a.id}' consumed by a second random "
+                            "op without an intervening jax.random.split"
+                            " — draws are correlated"))
+                    self.state[a.id] = "used"
+            else:
+                # key escapes into an unknown callee: stop tracking
+                for a in key_args:
+                    self.state.pop(a.id, None)
+
+    def _assign(self, targets: list[ast.expr], value: ast.AST) -> None:
+        names: list[str] = []
+        for t in targets:
+            names.extend(_assign_names(t))
+        fresh = False
+        if isinstance(value, ast.Call):
+            prng = _prng_call(value)
+            if prng is not None and prng[0] in ("PRNGKey", "key", "split",
+                                                "fold_in",
+                                                "wrap_key_data"):
+                fresh = True
+        for n in names:
+            if fresh:
+                self.state[n] = "fresh"
+            else:
+                self.state.pop(n, None)
+
+    # -- statement walk ------------------------------------------------
+    # _stmts/_stmt return True when the block is GUARANTEED to leave the
+    # function (return/raise) — a terminated branch's key state must not
+    # merge into the fall-through path (early returns make branch-local
+    # consumes exclusive, not sequential).
+
+    def _merge(self, other: dict[str, str]) -> None:
+        order = {"fresh": 0, "split": 1, "used": 2}
+        for k, v in other.items():
+            cur = self.state.get(k)
+            if cur is None or order.get(v, 0) > order.get(cur, 0):
+                self.state[k] = v
+
+    def _stmts(self, stmts: list[ast.stmt]) -> bool:
+        for stmt in stmts:
+            if self._stmt(stmt):
+                return True
+        return False
+
+    def _stmt(self, stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return False
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            if getattr(stmt, "value", None) is not None:
+                self._handle_calls(stmt.value)
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                self._handle_calls(stmt.exc)
+            return True
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if getattr(stmt, "value", None) is not None:
+                self._handle_calls(stmt.value)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            if getattr(stmt, "value", None) is not None:
+                self._assign(targets, stmt.value)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._handle_calls(stmt.test)
+            before = dict(self.state)
+            rounds = 2 if isinstance(stmt, ast.While) else 1
+            body_term = False
+            for _ in range(rounds):
+                body_term = self._stmts(stmt.body)
+            body_state = self.state
+            self.state = dict(before)
+            else_term = self._stmts(stmt.orelse)
+            if not body_term:
+                if else_term:
+                    self.state = dict(body_state)
+                else:
+                    self._merge(body_state)
+            return body_term and else_term
+        elif isinstance(stmt, ast.For):
+            self._handle_calls(stmt.iter)
+            for _ in range(2):
+                if self._stmts(stmt.body):
+                    break
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._handle_calls(item.context_expr)
+            return self._stmts(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            term = self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            fin = self._stmts(stmt.finalbody)
+            return fin or (term and not stmt.handlers)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._handle_calls(child)
+        return False
+
+
+def _check_prng(mod: Module, model: _ModuleModel) -> list[Finding]:
+    out: list[Finding] = []
+    for fn in model.fns.values():
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        out.extend(_PrngWalk(mod, fn.node, fn.qualname).run())
+    return out
+
+
+def _declared_axes(mod: Module) -> set[str]:
+    """Axis names any mesh/shard_map surface in this module binds."""
+    assert mod.tree is not None
+    declared: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            head = dotted_name(node.func)
+            tail = head.rsplit(".", 1)[-1]
+            if ("mesh" in tail.lower()
+                    or tail in ("PartitionSpec", "P", "NamedSharding")):
+                declared.update(str_constants(node))
+            for k in node.keywords:
+                # NOT the singular `axis_name` — that is the collectives'
+                # own kwarg, which must be checked, not declared
+                if k.arg in ("axis_names", "axis_resources",
+                             "in_specs", "out_specs"):
+                    declared.update(str_constants(k.value))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = a.posonlyargs + a.args
+            defaults = [None] * (len(params) - len(a.defaults)) + list(
+                a.defaults)
+            for p, d in list(zip(params, defaults)) + list(
+                    zip(a.kwonlyargs, a.kw_defaults)):
+                if d is not None and (
+                        p.arg in ("axis", "axis_name")
+                        or p.arg.endswith("_axis")):
+                    declared.update(str_constants(d))
+    return declared
+
+
+def _check_collective_axes(mod: Module, model: _ModuleModel
+                           ) -> list[Finding]:
+    declared = _declared_axes(mod)
+    if not declared:
+        # No mesh/spec surface in this module: literal axes are bound by
+        # a caller's mesh we cannot see — stay silent rather than guess.
+        return []
+    assert mod.tree is not None
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        head = dotted_name(node.func)
+        if not head.startswith(_COLLECTIVE_PREFIXES):
+            continue
+        op = head.rsplit(".", 1)[-1]
+        if op not in COLLECTIVES:
+            continue
+        pos = COLLECTIVES[op]
+        axis_expr = kw(node, "axis_name") or kw(node, "axis")
+        if axis_expr is None and len(node.args) > pos:
+            axis_expr = node.args[pos]
+        if axis_expr is None:
+            continue
+        for name in str_constants(axis_expr):
+            if name not in declared:
+                f = mod.finding(
+                    "collective-axis", node,
+                    f"collective `{op}` names axis '{name}', which no "
+                    "mesh/shard_map/PartitionSpec declaration in this "
+                    f"module binds (declared: {sorted(declared)})")
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+def check(mod: Module) -> list[Finding]:
+    """All JAX rules for one module. Syntax errors are policy's job."""
+    if mod.tree is None:
+        return []
+    model = _ModuleModel(mod)
+    out: list[Finding] = []
+    out.extend(_check_host_sync(mod, model))
+    out.extend(_check_taint_rules(mod, model))
+    out.extend(_check_donation(mod, model))
+    out.extend(_check_prng(mod, model))
+    out.extend(_check_collective_axes(mod, model))
+    return out

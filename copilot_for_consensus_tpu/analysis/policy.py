@@ -1,0 +1,182 @@
+"""Rule group ``policy``: the original first-party lint lane.
+
+This is ``scripts/validate_python.py`` folded into the analyzer (the
+script remains as a thin shim for existing callers). Same checks, same
+exemptions, one entry point:
+
+* ``policy-syntax`` — every file compiles;
+* ``policy-mutable-default`` — no list/dict/set literals or
+  ``list()``/``dict()``/``set()`` constructor calls as parameter
+  defaults;
+* ``policy-bare-except`` — no ``except:`` (swallows
+  KeyboardInterrupt/SystemExit);
+* ``policy-unused-import`` — imported names never referenced
+  (``__init__.py`` re-exports, ``noqa`` lines, and string/`__all__`
+  references are exempt);
+* ``policy-import-smoke`` — every package module imports in isolation
+  (skipped under ``--fast``; the test suite already imports everything).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import subprocess
+import sys
+
+from copilot_for_consensus_tpu.analysis.base import (
+    Finding,
+    Module,
+    PACKAGE,
+    ROOT,
+    rel,
+)
+
+#: the legacy scan set: package + scripts + tools + the root entry files
+#: (tests are exercised by pytest; fuzz harnesses intentionally do odd
+#: things)
+CHECKED_DIRS = (PACKAGE, ROOT / "scripts", ROOT / "tools")
+CHECKED_FILES = (ROOT / "bench.py", ROOT / "train.py",
+                 ROOT / "__graft_entry__.py")
+
+
+def policy_files() -> list[pathlib.Path]:
+    out = [p for d in CHECKED_DIRS if d.exists()
+           for p in sorted(d.rglob("*.py"))
+           if "__pycache__" not in p.parts]
+    out += [p for p in CHECKED_FILES if p.exists()]
+    return out
+
+
+def check_syntax(mod: Module) -> list[Finding]:
+    if mod.syntax_error is None:
+        return []
+    exc = mod.syntax_error
+    return [Finding("policy-syntax", mod.relpath, exc.lineno or 1,
+                    f"syntax: {exc.msg}")]
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("list", "dict", "set"))
+
+
+def check_mutable_defaults(mod: Module) -> list[Finding]:
+    if mod.tree is None:
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for default in (node.args.defaults
+                        + [d for d in node.args.kw_defaults if d]):
+            if _is_mutable_default(default):
+                f = mod.finding(
+                    "policy-mutable-default", default,
+                    f"mutable default in {node.name}() — shared across "
+                    "calls", context=mod.qualname(node))
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+def check_bare_except(mod: Module) -> list[Finding]:
+    if mod.tree is None:
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            f = mod.finding(
+                "policy-bare-except", node,
+                "bare 'except:' (swallows KeyboardInterrupt/SystemExit)")
+            if f is not None:
+                out.append(f)
+    return out
+
+
+class _ImportUse(ast.NodeVisitor):
+    def __init__(self):
+        self.imported: dict[str, int] = {}
+        self.used: set[str] = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self.imported[alias.asname or alias.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+
+def check_unused_imports(mod: Module) -> list[Finding]:
+    if mod.tree is None or mod.path.name == "__init__.py":
+        return []                         # __init__: re-export surface
+    visitor = _ImportUse()
+    visitor.visit(mod.tree)
+    out = []
+    for name, lineno in sorted(visitor.imported.items()):
+        if name in visitor.used or name == "annotations":
+            continue
+        line = mod.lines[lineno - 1] if lineno <= len(mod.lines) else ""
+        if "noqa" in line:
+            continue
+        if f"\"{name}\"" in mod.source or f"'{name}'" in mod.source:
+            continue                       # __all__ / string reference
+        if mod.suppressions.is_suppressed("policy-unused-import", lineno):
+            continue
+        out.append(Finding("policy-unused-import", mod.relpath, lineno,
+                           f"unused import '{name}'"))
+    return out
+
+
+def check_import_smoke() -> list[Finding]:
+    """Import every package module in ONE subprocess (isolated from the
+    caller, cheap enough for CI)."""
+    modules = []
+    for f in sorted(PACKAGE.rglob("*.py")):
+        if "__pycache__" in f.parts:
+            continue
+        parts = list(f.relative_to(ROOT).with_suffix("").parts)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        if parts[-1] == "__main__":
+            continue
+        modules.append(".".join(parts))
+    prog = (
+        "import importlib, sys\n"
+        "failed = []\n"
+        f"for m in {modules!r}:\n"
+        "    try:\n"
+        "        importlib.import_module(m)\n"
+        "    except Exception as exc:\n"
+        "        failed.append(f'{m}: {type(exc).__name__}: {exc}')\n"
+        "for f in failed:\n"
+        "    print(f)\n"
+        "sys.exit(1 if failed else 0)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", prog], cwd=ROOT,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode == 0:
+        return []
+    lines = proc.stdout.strip().splitlines() or [
+        proc.stderr.strip()[-200:]]
+    return [Finding("policy-import-smoke", rel(PACKAGE), 1,
+                    f"import smoke: {ln}") for ln in lines]
+
+
+def check(mod: Module) -> list[Finding]:
+    """Per-file policy checks (import smoke is run-level, not per-file)."""
+    out = check_syntax(mod)
+    out += check_mutable_defaults(mod)
+    out += check_bare_except(mod)
+    out += check_unused_imports(mod)
+    return out

@@ -310,7 +310,11 @@ class Broker:
                 except zmq.ZMQError as exc:
                     if exc.errno != zmq.EADDRINUSE or time.time() > deadline:
                         raise
-                    time.sleep(0.2)
+                    # stop-aware backoff: a broker stopped while waiting
+                    # out TIME_WAIT must exit, not finish the bind retry
+                    if self._stop.wait(0.2):
+                        sock.close()
+                        return
         self._bound.set()
         poller = zmq.Poller()
         poller.register(sock, zmq.POLLIN)

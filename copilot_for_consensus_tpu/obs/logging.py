@@ -96,6 +96,7 @@ class ShippingLogger(Logger):
         self._queue: "collections.deque[str]" = collections.deque(
             maxlen=queue_size)
         self._wake = threading.Event()
+        self._stop = threading.Event()
         self._sock = None
         self._thread = threading.Thread(target=self._pump, daemon=True,
                                         name="log-shipper")
@@ -113,7 +114,7 @@ class ShippingLogger(Logger):
     def _pump(self) -> None:
         import socket
 
-        while True:
+        while not self._stop.is_set():
             self._wake.wait(1.0)
             self._wake.clear()
             while self._queue:
@@ -130,10 +131,25 @@ class ShippingLogger(Logger):
                     finally:
                         self._sock = None
                     # put it back (front) and back off; the deque's
-                    # maxlen sheds oldest records under pressure
+                    # maxlen sheds oldest records under pressure. The
+                    # backoff is stop-aware so close() never waits out
+                    # a sleeping shipper thread.
                     self._queue.appendleft(line)
-                    time.sleep(1.0)
+                    if self._stop.wait(1.0):
+                        return
                     break
+
+    def close(self) -> None:
+        """Stop the shipper thread (unsent records are dropped — the
+        shipping contract is best-effort)."""
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=2)
+        # swap locally: a pump thread that outlived the join may still
+        # set self._sock = None in its error handler
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            sock.close()
 
 
 class MemoryLogger(Logger):

@@ -18,9 +18,27 @@ import time
 import uuid
 from typing import Any
 
+try:  # RSA signers need it; HS256 and token plumbing do not
+    import cryptography  # noqa: F401 (probe only; real imports are lazy)
+
+    HAS_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - environment without the wheel
+    HAS_CRYPTOGRAPHY = False
+
 
 class JWTError(Exception):
     pass
+
+
+def require_cryptography(feature: str) -> None:
+    """Fail with an actionable error (not a bare ModuleNotFoundError
+    deep in a lazy import) when an RSA feature is used without the
+    optional ``cryptography`` dependency installed."""
+    if not HAS_CRYPTOGRAPHY:
+        raise JWTError(
+            f"{feature} requires the optional 'cryptography' package "
+            "(RSA primitives); install it or configure the hs256 "
+            "shared-secret signer instead")
 
 
 def _b64url(data: bytes) -> str:
@@ -64,6 +82,7 @@ class LocalRS256Signer(JWTSigner):
 
     def __init__(self, private_pem: bytes | str | None = None,
                  key_size: int = 2048):
+        require_cryptography("the local_rs256 signer")
         from cryptography.hazmat.primitives.asymmetric import rsa
         from cryptography.hazmat.primitives.serialization import (
             load_pem_private_key,

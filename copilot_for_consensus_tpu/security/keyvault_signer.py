@@ -32,7 +32,11 @@ import urllib.parse
 import urllib.request
 from typing import Any
 
-from copilot_for_consensus_tpu.security.jwt import JWTError, JWTSigner
+from copilot_for_consensus_tpu.security.jwt import (
+    JWTError,
+    JWTSigner,
+    require_cryptography,
+)
 
 API_VERSION = "7.4"
 
@@ -193,6 +197,10 @@ class AzureKeyVaultSigner(JWTSigner):
         # _lock while we hold _load_lock)
         if self._pub is not None:
             return
+        # before any wire traffic: local verification needs the RSA
+        # primitives, and the failure should be actionable, not a
+        # ModuleNotFoundError mid-request
+        require_cryptography("the azure_keyvault signer")
         with self._load_lock:
             if self._pub is not None:
                 return

@@ -156,6 +156,10 @@ def main(argv: list[str] | None = None) -> int:
           flush=True)
     try:
         while True:
+            # main-thread parking loop of a standalone CLI exporter —
+            # nothing to drain and Ctrl-C interrupts it; not a bus or
+            # service handler thread.
+            # jaxlint: disable=blocking-call
             time.sleep(3600)
     except KeyboardInterrupt:
         return 0

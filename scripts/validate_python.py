@@ -1,217 +1,60 @@
-"""First-party static-analysis lane (executable policy).
+"""Thin shim over the first-party analyzer's ``policy`` rule group.
 
-The reference gates CI on ruff/mypy/pyright/pylint plus custom AST
-checks (``scripts/validate_python.py:1`` 219 LoC,
-``scripts/check_mutable_defaults.py:1``). This image ships none of
-those tools and installs are off-limits, so this is the same policy as
-a first-party stdlib implementation — the checks that catch real bugs
-rather than style:
-
-1. **syntax**: every file compiles (py_compile);
-2. **import smoke**: every package module imports in isolation (the
-   reference's import-smoke stage — catches circular imports and
-   module-level landmines);
-3. **mutable defaults**: no list/dict/set literals or ``list()``/
-   ``dict()``/``set()`` constructor calls as parameter defaults (the
-   classic shared-state bug the reference dedicates a whole script
-   to);
-4. **unused imports**: imported names never referenced (dead
-   dependencies rot into real confusion; `__init__.py` re-exports and
-   explicit ``noqa`` lines are exempt);
-5. **bare except**: ``except:`` swallows KeyboardInterrupt/SystemExit
-   — always a bug in long-running services.
-
-Exit 0 = clean. Run: ``python scripts/validate_python.py [--fast]``.
-``--fast`` skips the import smoke (the full suite already imports
-everything); CI runs the full set.
+The checks that used to live here (syntax, import smoke, mutable
+defaults, unused imports, bare except) are now
+``copilot_for_consensus_tpu/analysis/policy.py`` — one entry point
+(``python -m copilot_for_consensus_tpu.analysis``) runs them alongside
+the JAX/TPU rules (see ``docs/STATIC_ANALYSIS.md``). This script keeps
+the old CLI (``python scripts/validate_python.py [--fast]``) and the
+old importable surface (``check_syntax`` & co returning
+``path:line: ...`` strings) for existing callers.
 """
 
 from __future__ import annotations
 
-import argparse
-import ast
 import pathlib
-import subprocess
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-PACKAGE = ROOT / "copilot_for_consensus_tpu"
-#: directories whose .py files are policy-checked (tests are exercised
-#: by pytest itself; fuzz harnesses intentionally do odd things)
-CHECKED_DIRS = (PACKAGE, ROOT / "scripts", ROOT / "tools")
-CHECKED_FILES = (ROOT / "bench.py", ROOT / "train.py",
-                 ROOT / "__graft_entry__.py")
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from copilot_for_consensus_tpu.analysis import main as _analysis_main  # noqa: E402
+from copilot_for_consensus_tpu.analysis import policy as _policy  # noqa: E402
+from copilot_for_consensus_tpu.analysis.base import Module  # noqa: E402
 
 
-def _files() -> list[pathlib.Path]:
-    out = [p for d in CHECKED_DIRS if d.exists()
-           for p in sorted(d.rglob("*.py"))
-           if "__pycache__" not in p.parts]
-    out += [p for p in CHECKED_FILES if p.exists()]
-    return out
+def _render(findings) -> list[str]:
+    return [f.render() for f in findings]
 
 
 def check_syntax(files) -> list[str]:
-    errs = []
-    for f in files:
-        try:
-            compile(f.read_text(), str(f), "exec")
-        except SyntaxError as exc:
-            errs.append(f"{f}:{exc.lineno}: syntax: {exc.msg}")
-    return errs
-
-
-def _parse(f: pathlib.Path):
-    """ast.parse that returns None on syntax errors — check_syntax owns
-    reporting those; the AST checks must not crash the lane on the one
-    condition it exists to report."""
-    try:
-        return ast.parse(f.read_text(), filename=str(f))
-    except SyntaxError:
-        return None
-
-
-def _is_mutable_default(node) -> bool:
-    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
-        return True
-    return (isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id in ("list", "dict", "set"))
+    return _render([f for p in files
+                    for f in _policy.check_syntax(Module(pathlib.Path(p)))])
 
 
 def check_mutable_defaults(files) -> list[str]:
-    errs = []
-    for f in files:
-        tree = _parse(f)
-        if tree is None:
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                continue
-            for default in (node.args.defaults
-                            + [d for d in node.args.kw_defaults if d]):
-                if _is_mutable_default(default):
-                    errs.append(
-                        f"{f}:{default.lineno}: mutable default in "
-                        f"{node.name}() — shared across calls")
-    return errs
+    return _render([f for p in files for f in
+                    _policy.check_mutable_defaults(Module(pathlib.Path(p)))])
 
 
 def check_bare_except(files) -> list[str]:
-    errs = []
-    for f in files:
-        tree = _parse(f)
-        if tree is None:
-            continue
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ExceptHandler) and node.type is None:
-                errs.append(
-                    f"{f}:{node.lineno}: bare 'except:' (swallows "
-                    "KeyboardInterrupt/SystemExit)")
-    return errs
-
-
-class _ImportUse(ast.NodeVisitor):
-    def __init__(self):
-        self.imported: dict[str, tuple[int, str]] = {}
-        self.used: set[str] = set()
-
-    def visit_Import(self, node):
-        for alias in node.names:
-            name = alias.asname or alias.name.split(".")[0]
-            self.imported[name] = (node.lineno, alias.name)
-
-    def visit_ImportFrom(self, node):
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            name = alias.asname or alias.name
-            self.imported[name] = (node.lineno, alias.name)
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
+    return _render([f for p in files for f in
+                    _policy.check_bare_except(Module(pathlib.Path(p)))])
 
 
 def check_unused_imports(files) -> list[str]:
-    errs = []
-    for f in files:
-        if f.name == "__init__.py":       # re-export surface
-            continue
-        src = f.read_text()
-        lines = src.splitlines()
-        tree = _parse(f)
-        if tree is None:
-            continue
-        visitor = _ImportUse()
-        visitor.visit(tree)
-        # names in __all__, docstring references, or noqa lines pass
-        for name, (lineno, _) in sorted(visitor.imported.items()):
-            if name in visitor.used or name == "annotations":
-                continue
-            line = lines[lineno - 1] if lineno <= len(lines) else ""
-            if "noqa" in line:
-                continue
-            if f"\"{name}\"" in src or f"'{name}'" in src:
-                continue                   # __all__ / string reference
-            errs.append(f"{f}:{lineno}: unused import '{name}'")
-    return errs
+    return _render([f for p in files for f in
+                    _policy.check_unused_imports(Module(pathlib.Path(p)))])
 
 
 def check_import_smoke() -> list[str]:
-    """Import every package module in ONE subprocess (isolated from
-    the caller, cheap enough for CI)."""
-    modules = []
-    for f in sorted(PACKAGE.rglob("*.py")):
-        if "__pycache__" in f.parts:
-            continue
-        rel = f.relative_to(ROOT).with_suffix("")
-        parts = list(rel.parts)
-        if parts[-1] == "__init__":
-            parts = parts[:-1]
-        if parts[-1] == "__main__":
-            continue
-        modules.append(".".join(parts))
-    prog = (
-        "import importlib, sys\n"
-        "failed = []\n"
-        f"for m in {modules!r}:\n"
-        "    try:\n"
-        "        importlib.import_module(m)\n"
-        "    except Exception as exc:\n"
-        "        failed.append(f'{m}: {type(exc).__name__}: {exc}')\n"
-        "for f in failed:\n"
-        "    print(f)\n"
-        "sys.exit(1 if failed else 0)\n"
-    )
-    proc = subprocess.run([sys.executable, "-c", prog], cwd=ROOT,
-                          capture_output=True, text=True, timeout=600)
-    if proc.returncode != 0:
-        return [f"import smoke: {ln}"
-                for ln in proc.stdout.strip().splitlines() or
-                [proc.stderr.strip()[-200:]]]
-    return []
+    return _render(_policy.check_import_smoke())
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--fast", action="store_true",
-                    help="skip the import smoke stage")
-    args = ap.parse_args(argv)
-    files = _files()
-    errs = []
-    errs += check_syntax(files)
-    errs += check_mutable_defaults(files)
-    errs += check_bare_except(files)
-    errs += check_unused_imports(files)
-    if not args.fast:
-        errs += check_import_smoke()
-    for e in errs:
-        print(e)
-    print(f"checked {len(files)} files: "
-          f"{'CLEAN' if not errs else f'{len(errs)} finding(s)'}",
-          file=sys.stderr)
-    return 1 if errs else 0
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    return _analysis_main(["--rules", "policy"] + argv)
 
 
 if __name__ == "__main__":

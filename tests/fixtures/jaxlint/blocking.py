@@ -1,0 +1,36 @@
+# jaxlint fixture: blocking-call — handler-thread hygiene.
+import threading
+import time
+
+
+class BadConsumer:
+    def __init__(self, bus):
+        self.bus = bus
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def run(self):
+        while True:
+            time.sleep(0.5)                    # uninterruptible poll
+            with self._lock:
+                self.bus.publish_envelope({})  # broker RTT under lock
+
+    def run_suppressed(self):
+        # deliberate one-off pause with a written justification
+        # jaxlint: disable=blocking-call
+        time.sleep(0.01)
+
+
+class GoodConsumer:
+    def __init__(self, bus):
+        self.bus = bus
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.5)               # stop-aware pause
+            with self._lock:
+                batch = list(self.bus.queue)
+            for env in batch:                  # publish OUTSIDE the lock
+                self.bus.publish_envelope(env)

@@ -1,0 +1,20 @@
+# jaxlint fixture: collective-axis — axis literals vs the module's mesh
+# declarations.
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+
+def bad_body(x):
+    return jax.lax.psum(x, "tp")          # 'tp' not on any mesh here
+
+
+def bad_permute(x):
+    return jax.lax.ppermute(x, axis_name="model", perm=[(0, 1)])
+
+
+def good_body(x):
+    idx = jax.lax.axis_index("dp")
+    return jax.lax.psum(x, "dp") + idx

@@ -1,0 +1,17 @@
+# jaxlint fixture: donation — a jitted hot-path function taking a KV
+# cache without donate_argnums (positive) and with it (negative).
+import jax
+
+
+def _step_bad(params, cache, tok):
+    cache = cache.at[:, 0].set(tok)
+    return tok + 1, cache
+
+
+def _step_good(params, cache, tok):
+    cache = cache.at[:, 0].set(tok)
+    return tok + 1, cache
+
+
+bad_fn = jax.jit(_step_bad)                       # cache not donated
+good_fn = jax.jit(_step_good, donate_argnums=(1,))

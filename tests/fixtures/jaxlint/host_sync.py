@@ -1,0 +1,20 @@
+# jaxlint fixture: host-sync-in-jit — one positive, one negative.
+# Never imported; the analyzer reads it as text.
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_sync(x):
+    n = x.sum().item()            # device→host sync inside jit
+    arr = np.asarray(x)           # host materialization inside jit
+    jax.device_get(x)             # explicit host fetch inside jit
+    x.block_until_ready()         # sync barrier inside jit
+    return n + float(x[0]) + arr.sum()   # float() on a tracer
+
+
+def good_sync(x):
+    """The same operations OUTSIDE the traced program are the normal
+    harvest path — no findings."""
+    y = jax.jit(lambda t: t * 2)(x)
+    return float(np.asarray(jax.device_get(y))[0])

@@ -1,0 +1,36 @@
+# jaxlint fixture: prng-reuse — key reuse positives and the split
+# discipline negative.
+import jax
+
+
+def bad_double_use(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))     # same key, second draw
+    return a + b
+
+
+def bad_use_after_split(key):
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(key, (2,))      # parent key reused after split
+    return x, k1, k2
+
+
+def bad_loop_reuse(key):
+    out = 0.0
+    for _ in range(3):
+        out = out + jax.random.normal(key, ())   # no per-iter split
+    return out
+
+
+def good_split_discipline(key):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (2,))
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, (2,))
+    return a + b
+
+
+def good_exclusive_branches(key, flag):
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))   # other branch: not a reuse

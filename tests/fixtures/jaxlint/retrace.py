@@ -1,0 +1,34 @@
+# jaxlint fixture: retrace-hazard — positives and negatives.
+import functools
+
+import jax
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:                     # branches on a tracer
+        return x + 1
+    return x - 1
+
+
+@jax.jit
+def bad_loop(x):
+    while x < 10:                 # loops on a tracer
+        x = x + 1
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("sizes",))
+def bad_static_default(x, sizes=[64, 128]):   # unhashable static default
+    return x[: sizes[0]]
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def good_branch(x, n):
+    if n > 2:                     # static arg: resolved at trace time
+        return x * n
+    if x.shape[0] > 1:            # shape: static on a tracer
+        return x
+    if x is None:                 # structure check: trace-time
+        return x
+    return x + 1

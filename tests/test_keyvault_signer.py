@@ -8,6 +8,13 @@ import json as _json
 
 import pytest
 
+# the mock vault holds the RSA private key server-side, so the whole
+# module needs the optional dependency — skip cleanly without it
+pytest.importorskip(
+    "cryptography",
+    reason="optional 'cryptography' package not installed (RSA "
+           "primitives for the mock vault and local verification)")
+
 from copilot_for_consensus_tpu.security.jwt import (
     JWTError,
     JWTManager,

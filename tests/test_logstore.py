@@ -66,6 +66,7 @@ def test_shipping_survives_sink_down_and_recovers():
     for i in range(5):
         log.info(f"m{i}")
     assert len(mem.records) == 5            # tee side never blocked
+    log.close()
     # now point a fresh shipper at a real store mid-life
     srv = LogStoreServer(LogStore(), port=0, http_port=0).start()
     try:
@@ -74,6 +75,25 @@ def test_shipping_survives_sink_down_and_recovers():
         assert _wait(lambda: srv.store.count() >= 1)
     finally:
         srv.stop()
+
+
+def test_shipping_close_is_stop_aware():
+    """close() must interrupt the shipper's reconnect backoff, not wait
+    it out — the jaxlint blocking-call rule exists because bare sleeps
+    on background threads make shutdown hang (docs/STATIC_ANALYSIS.md)."""
+    import time as _time
+
+    log = ShippingLogger(MemoryLogger(), "127.0.0.1", 1)  # sink down
+    log.info("m")
+    # give the pump time to pop the record, fail the connect (port 1
+    # refuses instantly), and enter its backoff wait — the record goes
+    # straight back on the queue, so polling the queue can't observe it
+    _time.sleep(0.3)
+    t0 = _time.monotonic()
+    log.close()
+    took = _time.monotonic() - t0
+    assert not log._thread.is_alive(), "shipper thread survived close()"
+    assert took < 1.0, f"close() waited out the backoff ({took:.2f}s)"
 
 
 def test_hostile_ingest_line_does_not_kill_sink():

@@ -3,13 +3,24 @@ import json
 import pathlib
 import urllib.request
 
+import pytest
+
+from copilot_for_consensus_tpu.security.jwt import HAS_CRYPTOGRAPHY
 from copilot_for_consensus_tpu.services.bootstrap import serve_pipeline
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 SPEC_PATH = (REPO / "copilot_for_consensus_tpu" / "schemas" /
              "openapi.json")
 
+# building the live router instantiates the auth stack's default
+# local_rs256 signer, which needs the optional 'cryptography' wheel
+requires_crypto = pytest.mark.skipif(
+    not HAS_CRYPTOGRAPHY,
+    reason="optional 'cryptography' package not installed (the router's "
+           "default RS256 auth signer needs RSA primitives)")
 
+
+@requires_crypto
 def test_committed_spec_matches_router():
     """The committed spec must equal what the live router generates —
     same single-source contract as the event-schema sync test."""
@@ -81,6 +92,7 @@ def test_ui_asset_traversal_rejected():
         server.stop()
 
 
+@requires_crypto
 def test_ui_public_but_api_guarded_when_auth_on():
     server = serve_pipeline({
         "auth": {"require_auth": True, "allow_insecure_mock": True},
@@ -101,6 +113,7 @@ def test_ui_public_but_api_guarded_when_auth_on():
 import urllib.error  # noqa: E402  (used in except clauses above)
 
 
+@requires_crypto
 def test_committed_service_specs_match_router():
     """Per-service OpenAPI slices (scripts/generate_service_openapi.py)
     must tile the unified spec exactly and stay fresh."""

@@ -4,6 +4,7 @@ import time
 import pytest
 
 from copilot_for_consensus_tpu.security.jwt import (
+    HAS_CRYPTOGRAPHY,
     HS256Signer,
     JWTError,
     JWTManager,
@@ -11,9 +12,19 @@ from copilot_for_consensus_tpu.security.jwt import (
     create_jwt_signer,
 )
 
+# RS256 needs the optional 'cryptography' wheel; HS256 and the claim /
+# middleware plumbing are stdlib and still run without it
+requires_crypto = pytest.mark.skipif(
+    not HAS_CRYPTOGRAPHY,
+    reason="optional 'cryptography' package not installed "
+           "(RSA primitives)")
+
 
 @pytest.fixture(scope="module")
 def rs_manager():
+    if not HAS_CRYPTOGRAPHY:
+        pytest.skip("optional 'cryptography' package not installed "
+                    "(RSA primitives)")
     return JWTManager(LocalRS256Signer(), issuer="iss", audience="aud")
 
 
@@ -63,6 +74,7 @@ def test_hs256_roundtrip_and_cross_secret():
         b.verify(token)
 
 
+@requires_crypto
 def test_alg_confusion_rejected():
     # HS256 token must not verify against an RS256 manager (alg pinning).
     hs = JWTManager(HS256Signer("s"), issuer="copilot")
@@ -71,6 +83,7 @@ def test_alg_confusion_rejected():
         rs.verify(hs.mint("u"))
 
 
+@requires_crypto
 def test_pem_persistence_roundtrip():
     signer = LocalRS256Signer()
     restored = LocalRS256Signer(private_pem=signer.private_pem())
@@ -82,9 +95,20 @@ def test_pem_persistence_roundtrip():
 
 def test_factory():
     assert create_jwt_signer({"driver": "hs256", "secret": "x"}).alg == "HS256"
-    assert create_jwt_signer().alg == "RS256"
+    if HAS_CRYPTOGRAPHY:
+        assert create_jwt_signer().alg == "RS256"
     with pytest.raises(ValueError):
         create_jwt_signer({"driver": "nope"})
+
+
+def test_missing_cryptography_is_actionable():
+    """Without the optional wheel, RSA signers must raise a JWTError
+    that names the dependency — not a ModuleNotFoundError from a lazy
+    import deep inside a request."""
+    if HAS_CRYPTOGRAPHY:
+        pytest.skip("cryptography installed: the guard never fires")
+    with pytest.raises(JWTError, match="cryptography"):
+        LocalRS256Signer()
 
 
 def test_jwt_middleware_revocation_cache():

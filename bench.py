@@ -84,6 +84,79 @@ PRESETS = {
 }
 
 
+#: contract modules whose jitted entrypoints each preset exercises —
+#: the shardcheck preflight traces exactly these before the timed run.
+PRESET_CONTRACT_MODULES = {
+    "": ["copilot_for_consensus_tpu.engine.generation"],
+    "rag2k": ["copilot_for_consensus_tpu.engine.generation"],
+    "cap3072": ["copilot_for_consensus_tpu.engine.generation"],
+    "shared_prefix": ["copilot_for_consensus_tpu.engine.generation",
+                      "copilot_for_consensus_tpu.engine.prefix_cache"],
+}
+
+
+def shardcheck_preflight() -> dict | None:
+    """Trace-verify the selected preset's engine entrypoints on CPU
+    (analysis/shardcheck.py: donation aliasing, KV-cache layout
+    agreement, bucket coverage) BEFORE burning TPU time. A contract
+    violation returns an ok:false artifact dict (the caller exits 2,
+    matching the unknown-BENCH_PRESET behavior) — a broken donation
+    alias or mismatched cache layout would otherwise surface as an OOM
+    or 2x memory halfway through the timed run. Infra failures
+    (missing jax, timeout) warn and let the bench proceed: the gate
+    must never be the thing that eats the artifact."""
+    if os.environ.get("BENCH_PREFLIGHT", "1") != "1":
+        return None
+    preset = os.environ.get("BENCH_PRESET", "")
+    modules = os.environ.get("BENCH_SHARDCHECK_MODULES")
+    if modules:
+        modules = [m.strip() for m in modules.split(",") if m.strip()]
+    else:
+        if preset not in PRESET_CONTRACT_MODULES:
+            # tests pin the map to the preset table; this is the loud
+            # runtime fallback should they ever drift anyway
+            log(f"shardcheck preflight: no contract-module map for "
+                f"preset {preset!r}; tracing the default set")
+        modules = PRESET_CONTRACT_MODULES.get(
+            preset, PRESET_CONTRACT_MODULES[""])
+    log(f"shardcheck preflight: {', '.join(modules)}")
+    from copilot_for_consensus_tpu.analysis import shardcheck
+
+    data, detail = shardcheck.run_worker(
+        modules, baseline=os.path.join(REPO, "jaxlint_baseline.json"),
+        timeout=600)
+    if data is None:
+        log(f"shardcheck preflight: {detail}; continuing")
+        return None
+    findings = data.get("findings", [])
+    # Worker infra trouble (jax itself unusable in the subprocess) is
+    # reported as a shard-contract finding with path "jax" so CI fails
+    # loudly — but for the bench it is environment, not contract, and
+    # must warn-and-continue like a probe hiccup.
+    infra = [f for f in findings if f.get("path") == "jax"]
+    findings = [f for f in findings if f.get("path") != "jax"]
+    for f in infra:
+        log(f"shardcheck preflight infra failure ({f['message']}); "
+            f"continuing")
+    if not findings:
+        if not infra:          # infra runs traced nothing — not CLEAN
+            log("shardcheck preflight: CLEAN")
+        return None
+    rendered = [f"{f['path']}:{f['line']}: {f['rule']}: {f['message']}"
+                for f in findings[:20]]
+    for ln in rendered:
+        log(f"shardcheck preflight: {ln}")
+    return {
+        "metric": "shardcheck-preflight",
+        "value": 0.0,
+        "unit": "",
+        "ok": False,
+        "reason": f"shardcheck preflight failed: {len(findings)} "
+                  f"contract violation(s) in {', '.join(modules)}",
+        "findings": rendered,
+    }
+
+
 # -- backend probe ------------------------------------------------------
 
 _PROBE_SRC = """
@@ -166,8 +239,11 @@ def extra_rows() -> list[dict]:
     # BENCH_PRESET is pinned EMPTY so a parent-level preset cannot leak
     # into a differently-labeled child row (children get their preset
     # geometry as explicit values below).
+    # BENCH_PREFLIGHT is pinned off for children: the parent already
+    # ran the contract checks once; a per-row re-run would pay the jax
+    # import 4 extra times for the same verdict.
     no_extra = {"BENCH_EXTRA": "0", "BENCH_NO_PROBE": "1",
-                "BENCH_PRESET": ""}
+                "BENCH_PRESET": "", "BENCH_PREFLIGHT": "0"}
     # Preset geometry is passed as EXPLICIT env values (not just
     # BENCH_PRESET): the row label promises a specific configuration,
     # so an inherited user knob (e.g. BENCH_SLOTS) must not re-shape it.
@@ -385,6 +461,14 @@ def main() -> None:
             "reason": f"unknown BENCH_PRESET {preset!r}; "
                       f"valid: {sorted(PRESETS)}",
         }))
+        sys.exit(2)
+    # Semantic contract preflight (CPU, subprocess): fail fast with a
+    # structured artifact — same rc-2/ok:false shape as a bad preset —
+    # rather than discovering a dropped donation alias or KV-layout
+    # mismatch as an OOM mid-run on the TPU.
+    preflight_artifact = shardcheck_preflight()
+    if preflight_artifact is not None:
+        print(json.dumps(preflight_artifact))
         sys.exit(2)
     if os.environ.get("BENCH_NO_PROBE", "0") != "1":
         ok, detail = probe_backend(

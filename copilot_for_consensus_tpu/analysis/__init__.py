@@ -10,6 +10,12 @@ over the repo and exits non-zero on any non-baselined finding:
   hygiene for the bus and services.
 * ``policy`` group (policy.py): the original validate_python lane
   (syntax, import smoke, mutable defaults, unused imports, bare except).
+* ``shard`` group (shardcheck.py): the SEMANTIC pass — traces the
+  contract-declared jitted entrypoints with ``jax.eval_shape`` under
+  the declared meshes (CPU, virtual devices) and verifies sharding
+  rules, collective axis binding, donation aliasing, KV-cache layout
+  agreement, and padding-bucket coverage. Skipped under ``--fast`` and
+  for explicit-path runs (it is registry-wide, not per-file).
 
 Suppression: inline ``# jaxlint: disable=<rule>`` with a justification,
 or an entry in ``jaxlint_baseline.json`` (every entry must carry a
@@ -19,10 +25,17 @@ written justification). Workflow docs: ``docs/STATIC_ANALYSIS.md``.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import pathlib
 import sys
 
+# NOTE: shardcheck is imported lazily (inside main) so that
+# ``python -m copilot_for_consensus_tpu.analysis.shardcheck`` doesn't
+# trip runpy's already-imported warning. The engine modules' top-level
+# ``analysis.contracts`` import still executes this package body — the
+# three ast rule groups below are stdlib-only and cheap — but never
+# pulls jax or spawns anything.
 from copilot_for_consensus_tpu.analysis import (
     concurrency,
     jax_rules,
@@ -39,12 +52,16 @@ from copilot_for_consensus_tpu.analysis.base import (
     rel,
 )
 
-#: group name → (per-module check, default scan roots)
+#: ast group name → per-module check (run per parsed file)
 GROUPS = {
     "jax": jax_rules.check,
     "concurrency": concurrency.check,
     "policy": policy.check,
 }
+
+#: groups that run once per invocation, not per file
+SEMANTIC_GROUPS = {"shard"}
+ALL_GROUPS = set(GROUPS) | SEMANTIC_GROUPS
 
 #: every individual rule id → its group (for ``--rules`` filtering and
 #: docs; keep in sync with docs/STATIC_ANALYSIS.md)
@@ -61,6 +78,16 @@ RULES = {
     "policy-unused-import": "policy",
     "policy-import-smoke": "policy",
 }
+# keep in sync with shardcheck.RULES (test_shardcheck.py enforces it)
+RULES.update({rule: "shard" for rule in (
+    "shard-rule-axis",
+    "shard-divisibility",
+    "shard-collective",
+    "shard-donation",
+    "shard-kv-layout",
+    "shard-bucket",
+    "shard-contract",
+)})
 
 
 def _package_files() -> list[pathlib.Path]:
@@ -83,29 +110,30 @@ def _expand(paths: list[str]) -> list[pathlib.Path]:
 def _selected_groups(rules_arg: str | None) -> tuple[set[str], set[str]]:
     """('groups to run', 'individual rules to keep' — empty = all)."""
     if not rules_arg:
-        return set(GROUPS), set()
+        return set(ALL_GROUPS), set()
     groups: set[str] = set()
     rules: set[str] = set()
     for tok in rules_arg.split(","):
         tok = tok.strip()
         if not tok:
             continue
-        if tok in GROUPS:
+        if tok in ALL_GROUPS:
             groups.add(tok)
         elif tok in RULES:
             groups.add(RULES[tok])
             rules.add(tok)
         else:
             raise SystemExit(f"unknown rule or group {tok!r}; "
-                             f"known: {sorted(GROUPS) + sorted(RULES)}")
+                             f"known: {sorted(ALL_GROUPS) + sorted(RULES)}")
     return groups, rules
 
 
 def analyze_files(paths: list[pathlib.Path],
                   groups: set[str] | None = None) -> list[Finding]:
     """Run the per-file rule groups over explicit files (no import
-    smoke). The API the tests drive fixtures through."""
-    groups = set(GROUPS) if groups is None else groups
+    smoke, no semantic pass). The API the tests drive fixtures
+    through."""
+    groups = set(GROUPS) if groups is None else groups & set(GROUPS)
     findings: list[Finding] = []
     for path in paths:
         mod = Module(path)
@@ -134,12 +162,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the package "
                          "for jax/concurrency rules, the legacy "
-                         "validate_python set for policy rules)")
+                         "validate_python set for policy rules; "
+                         "explicit paths skip the shard group)")
     ap.add_argument("--fast", action="store_true",
-                    help="skip the import-smoke stage")
+                    help="skip the import-smoke stage and the semantic "
+                         "(shard) pass")
     ap.add_argument("--rules",
                     help="comma list of rule ids or groups "
-                         f"({', '.join(sorted(GROUPS))}) to run")
+                         f"({', '.join(sorted(ALL_GROUPS))}) to run")
     ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
                     help="baseline file (default: jaxlint_baseline.json "
                          "at the repo root)")
@@ -148,6 +178,18 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="print current findings as baseline JSON "
                          "(justifications left as TODO) and exit 0")
+    ap.add_argument("--strict", action="store_true",
+                    help="stale baseline entries are failures, not "
+                         "warnings (CI uses this so the baseline "
+                         "shrinks instead of rotting)")
+    ap.add_argument("--format", choices=("text", "github"),
+                    default="text",
+                    help="finding output format; 'github' emits GitHub "
+                         "Actions ::error annotations for inline PR "
+                         "review")
+    ap.add_argument("--output-json",
+                    help="also write findings/errors as JSON to this "
+                         "path (CI uploads it as a build artifact)")
     args = ap.parse_args(argv)
 
     groups, only_rules = _selected_groups(args.rules)
@@ -160,10 +202,31 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"jaxlint: no such file: {p}", file=sys.stderr)
             return 2
         findings = analyze_files(analyzed, groups)
+        if "shard" in groups:
+            print("jaxlint: shard group only runs on full-repo "
+                  "invocations (it traces the contract registry, not "
+                  "files); skipped", file=sys.stderr)
+            # a skipped group must not judge baseline entries: keeping
+            # 'shard' here would mark still-valid shard entries stale
+            groups = groups - {"shard"}
     else:
-        # package files get every selected group in ONE parse; the
-        # policy extras (scripts/tools/root entry files) get policy only
-        pkg = _package_files()
+        # The semantic worker is spawned FIRST so its ~10s jax-import +
+        # trace pass overlaps the ast groups and the import-smoke
+        # subprocess instead of serializing after them.
+        shard_proc = None
+        if "shard" in groups:
+            if args.fast:
+                print("jaxlint: shard group skipped under --fast",
+                      file=sys.stderr)
+                groups = groups - {"shard"}   # don't judge its baseline
+            else:
+                from copilot_for_consensus_tpu.analysis import shardcheck
+
+                shard_proc = shardcheck.spawn_worker()
+        # package files get every selected ast group in ONE parse; the
+        # policy extras (scripts/tools/root entry files) get policy
+        # only; a semantic-only run parses nothing
+        pkg = _package_files() if groups & set(GROUPS) else []
         analyzed = list(pkg)
         findings.extend(analyze_files(pkg, groups))
         if "policy" in groups:
@@ -173,6 +236,12 @@ def main(argv: list[str] | None = None) -> int:
             findings.extend(analyze_files(extras, {"policy"}))
             if not args.fast:
                 findings.extend(policy.check_import_smoke())
+        if shard_proc is not None:
+            sem, sem_checked = shardcheck.check_semantic(proc=shard_proc)
+            findings.extend(sem)
+            seen = {p.resolve() for p in analyzed}
+            analyzed += [p for p in sem_checked
+                         if p.resolve() not in seen]
         findings = _dedupe(findings)
     if only_rules:
         findings = [f for f in findings if f.rule in only_rules]
@@ -196,14 +265,33 @@ def main(argv: list[str] | None = None) -> int:
             for e in stale:
                 if e["path"] not in analyzed_rel:
                     continue
-                print(f"jaxlint: stale baseline entry (no longer "
-                      f"matches): {e['rule']} in {e['path']} "
-                      f"[{e['context']}]", file=sys.stderr)
+                msg = (f"stale baseline entry (no longer matches): "
+                       f"{e['rule']} in {e['path']} [{e['context']}]")
+                if args.strict:
+                    errors.append(f"jaxlint --strict: {msg}")
+                else:
+                    print(f"jaxlint: {msg}", file=sys.stderr)
 
-    for e in errors:
-        print(e)
-    for f in findings:
-        print(f.render())
+    if args.output_json:
+        payload = {
+            "findings": [dataclasses.asdict(f) for f in findings],
+            "errors": errors,
+            "checked_files": len(analyzed),
+            "groups": sorted(groups),
+        }
+        pathlib.Path(args.output_json).write_text(
+            json.dumps(payload, indent=2) + "\n")
+
+    if args.format == "github":
+        for e in errors:
+            print(f"::error title=jaxlint::{e}")
+        for f in findings:
+            print(f.render_github())
+    else:
+        for e in errors:
+            print(e)
+        for f in findings:
+            print(f.render())
     verdict = ("CLEAN" if not (findings or errors)
                else f"{len(findings) + len(errors)} finding(s)")
     print(f"jaxlint: checked {len(analyzed)} file(s) "

@@ -49,6 +49,16 @@ class Finding:
         ctx = f" [in {self.context}]" if self.context else ""
         return f"{self.path}:{self.line}: {self.rule}: {self.message}{ctx}"
 
+    def render_github(self) -> str:
+        """GitHub Actions annotation line (``--format=github``): shows
+        the finding inline on the PR diff. Properties/message need the
+        runner's %-escapes for newlines."""
+        ctx = f" [in {self.context}]" if self.context else ""
+        msg = (self.message + ctx).replace("%", "%25") \
+            .replace("\r", "").replace("\n", "%0A")
+        return (f"::error file={self.path},line={self.line},"
+                f"title=jaxlint {self.rule}::{msg}")
+
     def key(self) -> tuple[str, str, str, str]:
         """Line-number-free identity used for baseline matching."""
         return (self.rule, self.path, self.context, self.message)

@@ -1,0 +1,169 @@
+"""shardcheck contract registry: modules declare their jitted entrypoints.
+
+The syntactic jaxlint rules (``jax_rules.py``) never import the code
+they check; the semantic ``shard`` group (``shardcheck.py``) does the
+opposite — it abstract-interprets the REAL jitted programs with
+``jax.eval_shape`` under the real declared meshes, on CPU. The bridge
+between the two worlds is this registry: each checked module keeps a
+``SHARDCHECK_CONTRACTS`` table of *contract factories* declaring its
+entrypoints with representative ``ShapeDtypeStruct`` inputs and mesh
+configs.
+
+Design constraints, in order:
+
+* **Importing this module must stay free.** No jax at import time —
+  engine/parallel modules import :func:`checkable` at module top, and
+  the analysis CLI imports the registry to know what to check even on a
+  machine without jax. All jax objects are built lazily inside factory
+  bodies, which only run when shardcheck executes them.
+* **Declaring must stay cheap.** A factory is registered, not called,
+  at import time; a contract costs one decorated function per module.
+* **The declaration is the contract.** ``donate_argnums``, the kv-cache
+  group, the padding-bucket table are restated here ON PURPOSE: the
+  declaration says what the module *promises* (this buffer aliases an
+  output; these four programs share one KV layout; these buckets cover
+  these shapes) and shardcheck verifies the traced program keeps the
+  promise. Drift between promise and program is exactly the bug class
+  the pass exists to catch.
+
+Declaring a contract::
+
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        ContractCase, checkable,
+    )
+
+    @checkable("my-program")
+    def _shardcheck_my_program():
+        import jax, jax.numpy as jnp
+        S = jax.ShapeDtypeStruct
+        return ContractCase(
+            fn=my_jitted_fn,
+            args=(S((4, 128), jnp.int32), ...),
+            donate_argnums=(1,),
+        )
+
+A factory may return one :class:`ContractCase` or a list of them (use
+``label`` to tell them apart), and may raise :class:`ContractSkip` when
+the environment cannot host the check (e.g. too few virtual devices —
+see :func:`require_devices`). Suppression: a ``# jaxlint:
+disable=<rule>`` comment on (or directly above) the ``@checkable`` line
+covers every finding the contract emits, and the committed baseline
+matches on (rule, path, context=contract name, message) as usual.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Sequence
+
+#: every module whose SHARDCHECK_CONTRACTS table the semantic pass runs
+#: by default (``python -m copilot_for_consensus_tpu.analysis.shardcheck``).
+#: Keep in sync with docs/STATIC_ANALYSIS.md.
+CONTRACT_MODULES = (
+    "copilot_for_consensus_tpu.parallel.mesh",
+    "copilot_for_consensus_tpu.parallel.sharding",
+    "copilot_for_consensus_tpu.parallel.ring",
+    "copilot_for_consensus_tpu.parallel.ulysses",
+    "copilot_for_consensus_tpu.parallel.pipeline",
+    "copilot_for_consensus_tpu.engine.generation",
+    "copilot_for_consensus_tpu.engine.prefix_cache",
+    "copilot_for_consensus_tpu.engine.longctx",
+    "copilot_for_consensus_tpu.vectorstore.tpu",
+)
+
+
+class ContractSkip(Exception):
+    """Raised by a factory when the environment cannot host the check
+    (too few virtual devices, missing optional dep). The case is
+    reported as skipped, never as a finding."""
+
+
+def require_devices(n: int) -> None:
+    """Factories that build real meshes call this first; the shardcheck
+    worker always runs under ``--xla_force_host_platform_device_count=8``
+    so skips only happen in ad-hoc in-process use."""
+    import jax
+
+    have = len(jax.devices())
+    if have < n:
+        raise ContractSkip(
+            f"needs {n} devices, have {have} (run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n})")
+
+
+@dataclass
+class ContractCase:
+    """One verifiable claim about one program. Every field is optional;
+    a case only exercises the rule families its fields feed:
+
+    * ``fn``/``args``/``kwargs`` → the program is traced with
+      ``jax.eval_shape`` (``shard-collective`` on an axis/mesh trace
+      failure — an unbound collective axis name fails exactly here).
+      Bind static jit args concretely with ``functools.partial``.
+    * ``donate_argnums`` → every donated input leaf must have a
+      shape/dtype-matching output leaf, or XLA silently drops the alias
+      and the buffer double-allocates (``shard-donation``).
+    * ``rules``+``mesh`` → every rule target must be a real mesh axis
+      (``shard-rule-axis``).
+    * ``logical`` (sequence of ``(label, aval_tree, logical_axes_tree)``)
+      +``rules``+``mesh`` → every spec'd dimension must divide evenly by
+      its mesh axes (``shard-divisibility``).
+    * ``kv_group``+``kv_caches`` (sequence of ``(label, pytree)``) → all
+      cases sharing a group must agree on one KV layout signature
+      ``(n_layers, n_kv_heads, head_dim, dtype)`` extracted from the
+      ``[L, *, Hkv, *, Dh]`` cache convention (``shard-kv-layout``).
+    * ``buckets``+``bucket_covers`` → every declared input length must
+      fit the padding-bucket table, bounding retrace count
+      (``shard-bucket``).
+    """
+
+    label: str = ""
+    fn: Callable[..., Any] | None = None
+    args: tuple = ()
+    kwargs: Mapping[str, Any] = field(default_factory=dict)
+    donate_argnums: Sequence[int] = ()
+    mesh: Any = None
+    rules: Mapping[str, Any] | None = None
+    logical: Sequence[tuple] = ()
+    kv_group: str = ""
+    kv_caches: Sequence[tuple] = ()
+    buckets: Sequence[int] | None = None
+    bucket_covers: Sequence[int] = ()
+
+
+@dataclass(frozen=True)
+class Contract:
+    """A registered (but not yet materialized) contract declaration."""
+
+    name: str
+    factory: Callable[[], Any]
+    lineno: int               # declaration line, for inline suppression
+    module: str = ""          # dotted module of the declaring factory
+
+
+def contract(name: str, factory: Callable[[], Any]) -> Contract:
+    """Build a Contract entry for an explicit SHARDCHECK_CONTRACTS
+    table (fixtures use this; package modules use ``@checkable``)."""
+    code = getattr(factory, "__code__", None)
+    return Contract(name, factory,
+                    code.co_firstlineno if code is not None else 1,
+                    getattr(factory, "__module__", "") or "")
+
+
+def checkable(name: str | None = None):
+    """Decorator: register a contract factory in the defining module's
+    ``SHARDCHECK_CONTRACTS`` table (created on first use)."""
+
+    def deco(fn: Callable[[], Any]) -> Callable[[], Any]:
+        entry = contract(name or fn.__name__.lstrip("_"), fn)
+        mod = sys.modules.get(fn.__module__)
+        if mod is not None:
+            table = getattr(mod, "SHARDCHECK_CONTRACTS", None)
+            if table is None:
+                table = []
+                mod.SHARDCHECK_CONTRACTS = table
+            table.append(entry)
+        return fn
+
+    return deco

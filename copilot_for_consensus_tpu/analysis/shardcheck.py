@@ -1,0 +1,631 @@
+"""shardcheck — the semantic rule family of the jaxlint lane.
+
+Where ``jax_rules.py`` parses, this module *traces*: it imports the
+registered contract modules (``contracts.CONTRACT_MODULES``), runs each
+``SHARDCHECK_CONTRACTS`` factory, and abstract-interprets the declared
+jitted entrypoints with ``jax.eval_shape`` under the declared meshes —
+all on CPU, with a virtual 8-device platform, before any TPU time is
+spent. The bug class this catches is invisible to the syntactic pass:
+
+* ``shard-rule-axis`` — a logical-axis rule (``parallel/sharding.py``
+  style) whose target names a mesh axis the mesh doesn't have. The
+  weight silently replicates: a memory blow-up, not an error.
+* ``shard-divisibility`` — a spec'd dimension that doesn't divide
+  evenly by its mesh axes (silent padding/replication per shard).
+* ``shard-collective`` — a collective inside a traced program naming an
+  axis that doesn't exist in the mesh it runs under (ring / ulysses /
+  pipeline shard_map bodies). Surfaces as the trace failure it is.
+* ``shard-donation`` — a ``donate_argnums`` entry with no shape/dtype-
+  matching output: XLA drops the alias with only a warning and the
+  buffer double-allocates (2x cache HBM on the decode path).
+* ``shard-kv-layout`` — the engine programs that hand the KV cache to
+  each other (admit / seeded admit / decode / piggyback / prefix-pool
+  publish) disagreeing on the one cache layout
+  ``(n_layers, n_kv_heads, head_dim, dtype)``.
+* ``shard-bucket`` — a declared input length the padding-bucket table
+  doesn't cover: an unbounded retrace (or silent truncation) hazard.
+* ``shard-contract`` — the contract itself is broken (module doesn't
+  import, factory raises, non-mesh trace failure): the registry must
+  not rot silently.
+
+Run it alone (``python -m copilot_for_consensus_tpu.analysis.shardcheck``)
+or let the main CLI fold it in (``python -m
+copilot_for_consensus_tpu.analysis`` runs both passes; the semantic one
+is skipped under ``--fast`` and for explicit-path runs). In-process,
+:func:`check_modules` is the API the tests drive fixtures and mutated
+modules through. Findings flow through the same inline-suppression and
+justified-baseline machinery as every other jaxlint rule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+from collections import Counter
+
+from copilot_for_consensus_tpu.analysis.base import (
+    DEFAULT_BASELINE,
+    Finding,
+    ROOT,
+    Suppressions,
+    rel,
+)
+from copilot_for_consensus_tpu.analysis.contracts import (
+    CONTRACT_MODULES,
+    Contract,
+    ContractCase,
+    ContractSkip,
+)
+
+RULES = (
+    "shard-rule-axis",
+    "shard-divisibility",
+    "shard-collective",
+    "shard-donation",
+    "shard-kv-layout",
+    "shard-bucket",
+    "shard-contract",
+)
+
+#: virtual CPU device count the semantic pass runs under — enough for a
+#: dp2×tp4 / sp4×tp2 / pp2×tp2 mesh, matching tests/conftest.py.
+DEVICE_COUNT = 8
+
+
+# ---------------------------------------------------------------------------
+# contract collection
+# ---------------------------------------------------------------------------
+
+
+def load_contract_module(spec: str):
+    """Import a contract module by dotted name, or by ``.py`` path (the
+    fixture / mutated-module route: the file is executed under a
+    synthetic module name so its absolute package imports still work)."""
+    if spec.endswith(".py") or "/" in spec or "\\" in spec:
+        import importlib.util
+
+        path = pathlib.Path(spec).resolve()
+        name = f"_shardcheck_mod_{path.stem}"
+        mspec = importlib.util.spec_from_file_location(name, path)
+        if mspec is None or mspec.loader is None:
+            raise ImportError(f"cannot load {spec}")
+        mod = importlib.util.module_from_spec(mspec)
+        sys.modules[name] = mod       # before exec: @checkable needs it
+        mspec.loader.exec_module(mod)
+        return mod
+    import importlib
+
+    return importlib.import_module(spec)
+
+
+def _spec_path(spec: str) -> str:
+    """Repo-relative file path for a module spec, so findings for a
+    module that fails to IMPORT still anchor to its source file (the
+    baseline/stale/--format=github machinery all assume file paths).
+    Falls back to the spec string when nothing resolves."""
+    try:
+        if spec.endswith(".py") or "/" in spec or "\\" in spec:
+            return rel(pathlib.Path(spec))
+        import importlib.util
+
+        mspec = importlib.util.find_spec(spec)
+        if mspec is not None and mspec.origin:
+            return rel(pathlib.Path(mspec.origin))
+    except Exception:
+        pass
+    return spec
+
+
+def collect(modules=None):
+    """Import the contract modules and read their tables.
+
+    Returns ``(entries, findings)`` where entries are
+    ``(Contract, module_path)`` pairs and findings cover modules that
+    fail to import or declare no contracts (both mean the registry —
+    the thing CI trusts to cover the engine — has silently rotted)."""
+    specs = CONTRACT_MODULES if modules is None else modules
+    entries: list[tuple[Contract, pathlib.Path]] = []
+    findings: list[Finding] = []
+    for spec in specs:
+        try:
+            mod = load_contract_module(str(spec))
+        except Exception as exc:
+            findings.append(Finding(
+                "shard-contract", _spec_path(str(spec)), 1,
+                f"contract module failed to import: "
+                f"{type(exc).__name__}: {_oneline(exc)}"))
+            continue
+        path = pathlib.Path(mod.__file__)
+        table = getattr(mod, "SHARDCHECK_CONTRACTS", None)
+        if not table:
+            findings.append(Finding(
+                "shard-contract", rel(path), 1,
+                "module declares no SHARDCHECK_CONTRACTS — the semantic "
+                "pass no longer covers it"))
+            continue
+        entries.extend((c, path) for c in table)
+    return entries, findings
+
+
+# ---------------------------------------------------------------------------
+# per-case checks
+# ---------------------------------------------------------------------------
+
+
+def _oneline(exc, limit: int = 300) -> str:
+    msg = " ".join(str(exc).split())
+    return msg[:limit] + ("..." if len(msg) > limit else "")
+
+
+def _leaf_sig(leaf) -> tuple:
+    return (tuple(leaf.shape), str(leaf.dtype))
+
+
+def _check_rules_table(case: ContractCase) -> list[tuple[str, str]]:
+    """Every rule target must name a real mesh axis."""
+    if case.rules is None or case.mesh is None:
+        return []
+    axes = set(case.mesh.axis_names)
+    shape = dict(case.mesh.shape)
+    out = []
+    for logical, target in sorted(case.rules.items()):
+        targets = target if isinstance(target, tuple) else (target,)
+        for t in targets:
+            if t is not None and t not in axes:
+                out.append((
+                    "shard-rule-axis",
+                    f"rule '{logical}' -> mesh axis '{t}', which mesh "
+                    f"{shape} does not have — the array would silently "
+                    f"replicate"))
+    return out
+
+
+def _check_logical(case: ContractCase) -> list[tuple[str, str]]:
+    """Every spec'd dimension must divide evenly by its mesh axes."""
+    if not case.logical or case.mesh is None:
+        return []
+    import jax
+
+    from copilot_for_consensus_tpu.parallel import sharding as _sharding
+
+    mesh_shape = dict(case.mesh.shape)
+    axis_names = set(case.mesh.axis_names)
+    out = []
+    for label, avals, axes_tree in case.logical:
+        try:
+            specs = _sharding.spec_tree(axes_tree, case.rules)
+        except KeyError as exc:
+            out.append(("shard-rule-axis",
+                        f"{label}: {_oneline(exc)}"))
+            continue
+        flat_avals = jax.tree_util.tree_flatten_with_path(avals)[0]
+        flat_specs = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: isinstance(
+                s, jax.sharding.PartitionSpec))
+        if len(flat_avals) != len(flat_specs):
+            out.append(("shard-contract",
+                        f"{label}: aval tree and logical-axes tree "
+                        f"disagree ({len(flat_avals)} vs "
+                        f"{len(flat_specs)} leaves)"))
+            continue
+        for (path, aval), spec in zip(flat_avals, flat_specs):
+            leaf = jax.tree_util.keystr(path)
+            for dim, entry in enumerate(spec):
+                if dim >= len(aval.shape):
+                    # a spec longer than the leaf's rank means the
+                    # logical-axes tuple drifted from the array shape
+                    out.append((
+                        "shard-contract",
+                        f"{label}{leaf}: spec has {len(spec)} entries "
+                        f"but the leaf is rank {len(aval.shape)} — "
+                        f"logical axes drifted from the array shape"))
+                    break
+                if entry is None:
+                    continue
+                names = entry if isinstance(entry, tuple) else (entry,)
+                unknown = [n for n in names if n not in axis_names]
+                if unknown:
+                    out.append((
+                        "shard-rule-axis",
+                        f"{label}{leaf}: dim {dim} spec'd over "
+                        f"{unknown}, not axes of mesh {mesh_shape}"))
+                    continue
+                size = 1
+                for n in names:
+                    size *= mesh_shape[n]
+                if size > 1 and aval.shape[dim] % size:
+                    out.append((
+                        "shard-divisibility",
+                        f"{label}{leaf}: dim {dim} ({aval.shape[dim]}) "
+                        f"not divisible by mesh axes "
+                        f"{'x'.join(names)} (size {size}) — silent "
+                        f"padding/replication per shard"))
+    return out
+
+
+def _check_trace(case: ContractCase):
+    """eval_shape the program; returns (findings, out_avals | None)."""
+    if case.fn is None:
+        return [], None
+    import jax
+
+    try:
+        out = jax.eval_shape(case.fn, *case.args, **dict(case.kwargs))
+        return [], out
+    except ContractSkip:
+        raise
+    except Exception as exc:
+        msg = f"{type(exc).__name__}: {_oneline(exc)}"
+        text = str(exc).lower()
+        # Classify narrowly: axis-binding failures surface as jax's
+        # "unbound axis name" / "axis name" errors, or as a bare
+        # NameError/KeyError on the axis string when specs resolve
+        # against a declared mesh. Anything else (TypeError from a
+        # drifted signature, a stray "axis out of bounds") is the
+        # CONTRACT rotting, and must say so — a collective label there
+        # would invite baselining genuine registry rot away.
+        if ("unbound axis" in text or "axis name" in text
+                or (case.mesh is not None
+                    and isinstance(exc, (NameError, KeyError)))):
+            return [("shard-collective",
+                     f"tracing under the declared mesh failed: {msg}")], \
+                None
+        return [("shard-contract", f"tracing failed: {msg}")], None
+
+
+def _check_donation(case: ContractCase, out_avals) -> list[tuple[str, str]]:
+    """Every donated input leaf needs a shape/dtype-matching output leaf
+    or XLA drops the alias (the donated buffer double-allocates)."""
+    if not case.donate_argnums or out_avals is None:
+        return []
+    import jax
+
+    pool = Counter(_leaf_sig(leaf)
+                   for leaf in jax.tree_util.tree_leaves(out_avals))
+    out = []
+    for argnum in case.donate_argnums:
+        if argnum >= len(case.args):
+            out.append(("shard-contract",
+                        f"donate_argnums entry {argnum} out of range for "
+                        f"{len(case.args)} declared args"))
+            continue
+        for leaf in jax.tree_util.tree_leaves(case.args[argnum]):
+            sig = _leaf_sig(leaf)
+            if pool[sig] > 0:
+                pool[sig] -= 1
+            else:
+                shape, dtype = sig
+                out.append((
+                    "shard-donation",
+                    f"donated arg {argnum} leaf {list(shape)}/{dtype} "
+                    f"has no shape/dtype-matching output — XLA drops "
+                    f"the alias and the buffer double-allocates"))
+    return out
+
+
+def _kv_signatures(tree) -> set[tuple]:
+    """Layout signatures of a cache pytree under the engine-wide
+    ``[L, batch/slots/blocks, Hkv, seq/block, Dh]`` convention."""
+    import jax
+
+    sigs = set()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if len(leaf.shape) != 5:
+            sigs.add(("non-5d", tuple(leaf.shape), str(leaf.dtype)))
+            continue
+        sigs.add((leaf.shape[0], leaf.shape[2], leaf.shape[4],
+                  str(leaf.dtype)))
+    return sigs
+
+
+def _check_buckets(case: ContractCase) -> list[tuple[str, str]]:
+    if case.buckets is None:
+        return []
+    buckets = sorted(case.buckets)
+    if not buckets:
+        return [("shard-bucket", "empty padding-bucket table — every "
+                 "shape compiles its own program")]
+    out = []
+    for need in case.bucket_covers:
+        if need > buckets[-1]:
+            out.append((
+                "shard-bucket",
+                f"declared input length {need} exceeds the largest "
+                f"padding bucket ({buckets[-1]}; table {buckets}) — "
+                f"unbounded retrace or silent truncation"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+
+def check_modules(modules=None):
+    """Collect and verify contracts. Returns
+    ``(findings, checked_paths, skips)`` — findings already filtered
+    through inline ``# jaxlint: disable=`` suppressions at the contract
+    declaration line; ``skips`` are ``(context, reason)`` notes for
+    ContractSkip factories (environment, not code, problems)."""
+    entries, findings = collect(modules)
+    checked: list[pathlib.Path] = []
+    seen_paths: set[pathlib.Path] = set()
+    skips: list[tuple[str, str]] = []
+    suppressions: dict[pathlib.Path, Suppressions] = {}
+    # kv groups accumulate across every contract in the run
+    kv_groups: dict[str, list[tuple]] = {}
+
+    def suppressed(path: pathlib.Path, rule: str, line: int) -> bool:
+        if path not in suppressions:
+            try:
+                suppressions[path] = Suppressions(path.read_text())
+            except OSError:
+                suppressions[path] = Suppressions("")
+        return suppressions[path].is_suppressed(rule, line)
+
+    def emit(path, lineno, context, results):
+        for rule, message in results:
+            if not suppressed(path, rule, lineno):
+                findings.append(Finding(rule, rel(path), lineno,
+                                        message, context))
+
+    for con, path in entries:
+        if path not in seen_paths:
+            seen_paths.add(path)
+            checked.append(path)
+        try:
+            produced = con.factory()
+        except ContractSkip as skip:
+            skips.append((con.name, str(skip)))
+            continue
+        except Exception as exc:
+            emit(path, con.lineno, con.name,
+                 [("shard-contract",
+                   f"contract factory raised {type(exc).__name__}: "
+                   f"{_oneline(exc)}")])
+            continue
+        cases = produced if isinstance(produced, (list, tuple)) \
+            else [produced]
+        for case in cases:
+            if not isinstance(case, ContractCase):
+                emit(path, con.lineno, con.name,
+                     [("shard-contract",
+                       f"factory returned {type(case).__name__}, "
+                       f"expected ContractCase")])
+                continue
+            context = f"{con.name}:{case.label}" if case.label \
+                else con.name
+            results = []
+            results += _check_rules_table(case)
+            results += _check_logical(case)
+            results += _check_buckets(case)
+            try:
+                trace_findings, out_avals = _check_trace(case)
+            except ContractSkip as skip:
+                skips.append((context, str(skip)))
+                emit(path, con.lineno, context, results)
+                continue
+            results += trace_findings
+            results += _check_donation(case, out_avals)
+            if case.kv_group:
+                for label, tree in case.kv_caches:
+                    kv_groups.setdefault(case.kv_group, []).append(
+                        (path, con.lineno, context, label,
+                         frozenset(_kv_signatures(tree))))
+            emit(path, con.lineno, context, results)
+
+    # kv-layout agreement: every member of a group must carry exactly
+    # the reference signature (the group's first declaration wins the
+    # role of reference; the message names both sides).
+    for group, members in sorted(kv_groups.items()):
+        ref_path, ref_line, ref_ctx, ref_label, ref_sig = members[0]
+        if len(ref_sig) != 1:
+            emit(ref_path, ref_line, ref_ctx,
+                 [("shard-kv-layout",
+                   f"kv group '{group}': '{ref_label}' mixes layouts "
+                   f"{sorted(ref_sig)} within one cache")])
+        for path, lineno, ctx, label, sig in members[1:]:
+            if sig != ref_sig:
+                emit(path, lineno, ctx,
+                     [("shard-kv-layout",
+                       f"kv group '{group}': '{label}' layout "
+                       f"{sorted(sig)} != '{ref_label}' layout "
+                       f"{sorted(ref_sig)} (declared in {ref_ctx}) — "
+                       f"the programs do not share one KV-cache "
+                       f"layout")])
+    return findings, checked, skips
+
+
+# ---------------------------------------------------------------------------
+# subprocess runner (what the main CLI and bench preflight call)
+# ---------------------------------------------------------------------------
+
+
+_DEVICE_FLAG_RE = re.compile(
+    r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def _force_cpu_env(env) -> None:
+    """Force the CPU platform and AT LEAST the virtual device count the
+    contracts need, in place. A pre-existing lower count (e.g. a shell
+    that exports =4 for other tests) must be RAISED, not preserved —
+    otherwise every require_devices(8) contract silently skips and the
+    pass reports CLEAN with most of its coverage gone. A higher count
+    is kept."""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    m = _DEVICE_FLAG_RE.search(flags)
+    if m and int(m.group(1)) >= DEVICE_COUNT:
+        return
+    if m:
+        flags = _DEVICE_FLAG_RE.sub("", flags).strip()
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count"
+                f"={DEVICE_COUNT}").strip()
+
+
+def worker_env() -> dict:
+    """Env for the semantic-pass subprocess: CPU platform, ≥8 virtual
+    devices (same virtualization as tests/conftest.py)."""
+    env = dict(os.environ)
+    _force_cpu_env(env)
+    return env
+
+
+def spawn_worker(modules=None, baseline=None) -> subprocess.Popen:
+    """Start the worker subprocess (jax must initialize with the CPU
+    platform and the virtual device count BEFORE any backend touch —
+    same reason the policy group's import smoke is a subprocess).
+    Spawn early and :func:`finish_worker` late to overlap the ~10s
+    trace pass with other work (the main CLI overlaps it with the ast
+    groups + import smoke). ``baseline=None`` disables the worker's
+    own baseline application — callers who apply the baseline
+    themselves (the main CLI) must not have it applied twice."""
+    cmd = [sys.executable, "-m",
+           "copilot_for_consensus_tpu.analysis.shardcheck", "--json"]
+    if modules:
+        cmd += ["--modules", ",".join(str(m) for m in modules)]
+    if baseline:
+        cmd += ["--baseline", str(baseline)]
+    else:
+        cmd += ["--no-baseline"]
+    return subprocess.Popen(cmd, cwd=ROOT, env=worker_env(),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def finish_worker(proc: subprocess.Popen, timeout: float = 900.0):
+    """Collect a spawned worker and parse its one JSON result line.
+    Returns ``(data, detail)``: the worker's result dict or None, with
+    ``detail`` the error summary when None."""
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None, f"semantic pass timed out after {timeout:.0f}s"
+    for line in reversed((stdout or "").strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line), ""
+            except json.JSONDecodeError:
+                continue
+    tail = (stderr or stdout or "").strip().splitlines()
+    detail = tail[-1] if tail else f"rc={proc.returncode}"
+    return None, f"semantic pass produced no result: {detail[:300]}"
+
+
+def run_worker(modules=None, baseline=None, timeout: float = 900.0):
+    """spawn + finish in one call (the bench preflight route)."""
+    return finish_worker(spawn_worker(modules, baseline), timeout)
+
+
+def check_semantic(modules=None, timeout: float = 900.0, proc=None):
+    """Run the semantic pass in a subprocess (or collect an
+    already-spawned ``proc``). Returns ``(findings, checked_paths)``;
+    an infra failure is itself a ``shard-contract`` finding, never a
+    silent pass."""
+    self_path = rel(pathlib.Path(__file__))
+    if proc is None:
+        proc = spawn_worker(modules)
+    data, detail = finish_worker(proc, timeout)
+    if data is None:
+        return [Finding("shard-contract", self_path, 1, detail)], []
+    for ctx, reason in data.get("skips", ()):
+        print(f"jaxlint: shardcheck skipped {ctx}: {reason}",
+              file=sys.stderr)
+    findings = [Finding(d["rule"], d["path"], d["line"], d["message"],
+                        d.get("context", ""))
+                for d in data.get("findings", ())]
+    checked = [ROOT / p for p in data.get("checked", ())]
+    return findings, checked
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m copilot_for_consensus_tpu.analysis.shardcheck",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--modules",
+                    help="comma list of dotted modules or .py paths "
+                         "(default: the full contract registry)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON line")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="apply this jaxlint baseline file (entries "
+                         "with shard-* rules) before reporting "
+                         "(default: jaxlint_baseline.json at the repo "
+                         "root — so the standalone run agrees with "
+                         "the main CLI)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything); "
+                         "the main CLI spawns the worker with this, "
+                         "as it applies the baseline itself")
+    args = ap.parse_args(argv)
+
+    # Force the CPU platform even when a sitecustomize pre-imported jax
+    # for a TPU plugin: this is a static-analysis pass, it must never
+    # grab (or hang on) an accelerator. Setting the virtual device
+    # count here works as long as the backend is still uninitialized
+    # (XLA reads XLA_FLAGS at CPU-client creation, not at jax import);
+    # spawning via spawn_worker()/worker_env() guarantees it.
+    _force_cpu_env(os.environ)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as exc:
+        msg = f"jax unavailable: {type(exc).__name__}: {_oneline(exc)}"
+        if args.json:
+            print(json.dumps({"findings": [
+                {"rule": "shard-contract", "path": "jax", "line": 1,
+                 "message": msg, "context": ""}], "checked": [],
+                "skips": []}))
+        else:
+            print(msg, file=sys.stderr)
+        return 1
+
+    modules = [m.strip() for m in args.modules.split(",")
+               if m.strip()] if args.modules else None
+    findings, checked, skips = check_modules(modules)
+    if not args.no_baseline:
+        from copilot_for_consensus_tpu.analysis.base import (
+            apply_baseline,
+            load_baseline,
+        )
+
+        entries, errors = load_baseline(pathlib.Path(args.baseline))
+        for err in errors:
+            print(f"shardcheck: {err}", file=sys.stderr)
+        if not errors:
+            entries = [e for e in entries
+                       if str(e.get("rule", "")).startswith("shard-")]
+            findings, _ = apply_baseline(findings, entries)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message, "context": f.context}
+                         for f in findings],
+            "checked": [rel(p) for p in checked],
+            "skips": list(skips),
+        }))
+    else:
+        for ctx, reason in skips:
+            print(f"shardcheck: skipped {ctx}: {reason}",
+                  file=sys.stderr)
+        for f in findings:
+            print(f.render())
+        verdict = "CLEAN" if not findings else f"{len(findings)} finding(s)"
+        print(f"shardcheck: {len(checked)} contract module(s): {verdict}",
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

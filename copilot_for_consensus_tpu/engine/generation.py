@@ -39,6 +39,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    checkable,
+)
 from copilot_for_consensus_tpu.engine.sampling import SamplingConfig, sample
 from copilot_for_consensus_tpu.engine.tokenizer import Tokenizer
 from copilot_for_consensus_tpu.models import decoder, quant
@@ -1131,3 +1135,92 @@ class GenerationEngine:
         out = list(self._done.values())
         self._done.clear()
         return out
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("generation-engine")
+def _shardcheck_generation_engine():
+    """Declare the engine's jitted programs on a tiny config (CPU-built
+    in well under a second) and verify, by tracing:
+
+    * every ``donate_argnums`` entry aliases a shape/dtype-matching
+      output (an undonated slot cache double-allocates per dispatch);
+    * admit / seeded admit / decode / piggyback / prefix-pool publish
+      all agree on ONE KV-cache layout (L, Hkv, Dh, dtype) — the cache
+      is handed between these five programs every serving step;
+    * the prefill bucket table covers the longest admissible prompt
+      (``prompt_limit``), bounding compile count.
+
+    The tiny shapes don't weaken the checks: layout agreement, alias
+    feasibility, and bucket coverage are shape-RELATION properties, and
+    the relations here are the same ones the serving-size engine
+    builds."""
+    import functools
+
+    from copilot_for_consensus_tpu.models.configs import DecoderConfig
+
+    cfg = DecoderConfig(name="shardcheck-tiny", vocab_size=64,
+                        d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                        d_ff=64, max_seq_len=128)
+    eng = GenerationEngine(cfg, num_slots=4, max_len=64,
+                           prefill_buckets=(16, 32), decode_window=4,
+                           windows_per_dispatch=1, prefill_chunk=8,
+                           prefill_rows=2, prefix_cache_blocks=4)
+
+    def aval(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    cache = aval(eng._cache)
+    pool = aval(eng._prefix.pool)
+    key = jax.random.PRNGKey(0)
+    n, bucket, w, p, chunk = 4, 16, eng.decode_window, eng.prefill_rows, \
+        eng.prefill_chunk
+    group = "engine.generation-kv"
+    return [
+        ContractCase(
+            label="admit", fn=eng._admit_fn,
+            args=(eng.params, S((n, bucket), i32), S((n,), i32), cache,
+                  S((n,), i32), key),
+            donate_argnums=(3,), kv_group=group,
+            kv_caches=(("slot-cache", cache),),
+            buckets=eng.buckets, bucket_covers=(eng.prompt_limit,)),
+        ContractCase(
+            label="admit-seeded", fn=eng._admit_seeded_fn,
+            args=(eng.params, S((n, bucket), i32), S((n,), i32),
+                  pool["k"], pool["v"], S((n * 2,), i32), S((n,), i32),
+                  cache, S((n,), i32), key),
+            donate_argnums=(7,), kv_group=group,
+            kv_caches=(("slot-cache", cache), ("prefix-pool", pool))),
+        ContractCase(
+            label="decode",
+            fn=functools.partial(eng._decode_fn, kv_len=eng.max_len,
+                                 n_windows=1),
+            args=(eng.params, S((eng.num_slots,), i32),
+                  S((eng.num_slots,), i32), cache, key),
+            donate_argnums=(3,), kv_group=group,
+            kv_caches=(("slot-cache", cache),)),
+        ContractCase(
+            label="piggyback",
+            fn=functools.partial(eng._piggy_fn, kv_len=eng.max_len),
+            args=(eng.params, S((eng.num_slots,), i32),
+                  S((eng.num_slots,), i32), cache, key,
+                  S((w, p, chunk), i32), S((w, p), i32), S((w, p), i32),
+                  S((w, p), i32), S((w, p), i32), S((w * p,), i32),
+                  S((w * p,), i32), S((p, w * chunk), i32),
+                  S((p, w * chunk), i32)),
+            donate_argnums=(3,), kv_group=group,
+            kv_caches=(("slot-cache", cache),)),
+        ContractCase(
+            label="prefix-publish", fn=eng._prefix._publish_fn,
+            args=(pool, cache["k"], cache["v"], S((2,), i32),
+                  S((2, chunk), i32), S((2, chunk), i32)),
+            donate_argnums=(0,), kv_group=group,
+            kv_caches=(("prefix-pool", pool),)),
+    ]

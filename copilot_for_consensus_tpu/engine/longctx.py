@@ -35,6 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    checkable,
+    require_devices,
+)
 from copilot_for_consensus_tpu.engine.generation import Completion
 from copilot_for_consensus_tpu.engine.sampling import SamplingConfig, sample
 from copilot_for_consensus_tpu.models import decoder, layers as L, quant
@@ -372,3 +377,63 @@ class LongContextEngine:
         comp = self.generate(tokenizer.encode(prompt, add_bos=True),
                              max_new_tokens)
         return tokenizer.decode(comp.tokens)
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("longctx-engine")
+def _shardcheck_longctx_engine():
+    """Build a tiny long-context engine on the real sp mesh (both SP
+    strategies route through here, ring by default) and trace its two
+    programs: prefill exercises the ring collectives under the engine's
+    OWN mesh/axis plumbing, decode exercises the GSPMD distributed-
+    prefix attention plus the donated suffix buffer (which must alias
+    the output — it is re-dispatched every window). The prefix and
+    suffix caches must share one KV layout: decode's online-softmax
+    merge reads both every token."""
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    require_devices(8)
+    cfg = DecoderConfig(name="shardcheck-tiny", vocab_size=64,
+                        d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                        d_ff=64, max_seq_len=256)
+    mesh = build_mesh(MeshConfig(sp=4), devices=jax.devices()[:8])
+    eng = LongContextEngine(cfg, mesh=mesh, max_new_tokens=16,
+                            decode_window=4, ctx_block=16)
+    s_ctx = eng.ctx_quantum                     # one prefill bucket
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    hkv, dh = cfg.n_kv_heads, cfg.head_dim
+    prefix = {
+        "k": S((cfg.n_layers, 1, hkv, s_ctx, dh), eng.dtype),
+        "v": S((cfg.n_layers, 1, hkv, s_ctx, dh), eng.dtype),
+    }
+    suffix = {
+        "k": S((cfg.n_layers, 1, hkv, eng.suffix_len, dh), eng.dtype),
+        "v": S((cfg.n_layers, 1, hkv, eng.suffix_len, dh), eng.dtype),
+    }
+    key = jax.random.PRNGKey(0)
+    group = "engine.longctx-kv"
+    return [
+        ContractCase(
+            label="prefill", fn=eng._build_prefill(s_ctx),
+            args=(eng.params, S((1, s_ctx), i32), S((1,), i32)),
+            mesh=mesh, rules=eng._param_rules(),
+            logical=(("params",
+                      jax.tree.map(lambda x: S(x.shape, x.dtype),
+                                   eng.params),
+                      decoder.logical_axes(cfg)),)),
+        ContractCase(
+            label="decode", fn=eng._build_decode(),
+            args=(eng.params, S((1,), i32), S((), i32), prefix,
+                  S((), i32), suffix, S((), i32), key),
+            donate_argnums=(5,), mesh=mesh, kv_group=group,
+            kv_caches=(("sp-prefix", prefix),
+                       ("suffix-buffer", suffix))),
+    ]

@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from copilot_for_consensus_tpu.analysis.contracts import checkable
 from copilot_for_consensus_tpu.engine.tokenizer import stable_block_hash
 
 
@@ -326,3 +327,41 @@ class PrefixCache:
         self.pool = self._publish_fn(
             self.pool, cache["k"], cache["v"], jnp.asarray(bids),
             jnp.asarray(sidx), jnp.asarray(pidx))
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("prefix-publish")
+def _shardcheck_prefix_publish():
+    """The cache→pool publish scatter, standalone: the donated pool must
+    alias the output (this is the long-lived resident allocation — a
+    dropped alias means a full second pool per publish), and the pool's
+    k/v halves must share one block layout with the slot cache they
+    gather from. The engine-level agreement with admit/decode programs
+    is declared in ``engine/generation.py``."""
+    from copilot_for_consensus_tpu.analysis.contracts import ContractCase
+    from copilot_for_consensus_tpu.models.configs import DecoderConfig
+
+    cfg = DecoderConfig(name="shardcheck-tiny", vocab_size=64,
+                        d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                        d_ff=64, max_seq_len=128)
+    pc = PrefixCache(cfg, num_blocks=4, block_size=8,
+                     kv_dtype=jnp.bfloat16)
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    pool = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), pc.pool)
+    cache_leaf = S((cfg.n_layers, 2, cfg.n_kv_heads, 32, cfg.head_dim),
+                   jnp.bfloat16)
+    return ContractCase(
+        fn=pc._publish_fn,
+        args=(pool, cache_leaf, cache_leaf, S((2,), i32),
+              S((2, pc.block), i32), S((2, pc.block), i32)),
+        donate_argnums=(0,),
+        kv_group="engine.prefix-cache-kv",
+        kv_caches=(("pool", pool),
+                   ("slot-cache", {"k": cache_leaf, "v": cache_leaf})),
+    )

@@ -8,6 +8,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from copilot_for_consensus_tpu.analysis.contracts import checkable
+
 MESH_AXES = ("dp", "pp", "sp", "ep", "tp")
 
 
@@ -92,3 +94,35 @@ def auto_mesh_for_serving(n_devices: int | None = None) -> Mesh:
         tp //= 2
     return build_mesh(MeshConfig(dp=n // tp, tp=tp),
                       devices=jax.devices()[:n])
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("serving-meshes")
+def _shardcheck_serving_meshes():
+    """Every mesh this module can build for serving must carry every
+    axis the sharding rules target — MESH_AXES and
+    ``sharding.DEFAULT_RULES`` are maintained in different files, and a
+    rename on either side must fail CI, not replicate weights."""
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        ContractCase,
+        require_devices,
+    )
+    from copilot_for_consensus_tpu.parallel.sharding import DEFAULT_RULES
+
+    require_devices(8)
+    devs = jax.devices()[:8]
+    return [
+        ContractCase(label="serving-default",
+                     mesh=build_mesh(MeshConfig(), devices=devs),
+                     rules=DEFAULT_RULES),
+        ContractCase(label="auto-serving",
+                     mesh=auto_mesh_for_serving(8),
+                     rules=DEFAULT_RULES),
+        ContractCase(label="sp4",
+                     mesh=build_mesh(MeshConfig(sp=4), devices=devs),
+                     rules=DEFAULT_RULES),
+    ]

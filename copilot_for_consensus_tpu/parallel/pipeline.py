@@ -38,6 +38,7 @@ except ImportError:   # jax < 0.5 exports it under experimental only
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from copilot_for_consensus_tpu.analysis.contracts import checkable
 from copilot_for_consensus_tpu.models import decoder
 from copilot_for_consensus_tpu.models.configs import DecoderConfig
 from copilot_for_consensus_tpu.parallel.sharding import (
@@ -262,3 +263,55 @@ def make_pipeline_train_step(cfg: DecoderConfig, optimizer, mesh: Mesh,
 
     return train.make_train_step(cfg, optimizer, attn_impl=attn_impl,
                                  forward_fn=fwd)
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("pipeline-forward")
+def _shardcheck_pipeline_forward():
+    """Trace the SPMD pipeline under a real pp(×tp) mesh: the
+    axis_index / ppermute / psum collectives in ``_pp_shard`` (and the
+    per-layer tp psums of ``_block_tp``) must bind axes the mesh has,
+    and the PIPELINE_RULES layer-stack sharding must divide the layer
+    leaves evenly. Param shapes come from eval_shape — nothing is
+    allocated."""
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        ContractCase,
+        require_devices,
+    )
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    require_devices(8)
+    cfg = DecoderConfig(name="shardcheck-tiny", vocab_size=64,
+                        d_model=32, n_layers=4, n_heads=4, n_kv_heads=2,
+                        d_ff=64, max_seq_len=64)
+    params = jax.eval_shape(
+        lambda key: decoder.init_params(key, cfg), jax.random.PRNGKey(0))
+    # pp2×tp2 (dp auto-fills to 2): layers 4 / pp 2, heads 4 & kv 2 &
+    # ffn 64 / tp 2 — the divisibilities pipeline_forward relies on.
+    mesh = build_mesh(MeshConfig(dp=0, pp=2, tp=2),
+                      devices=jax.devices()[:8])
+    tokens = jax.ShapeDtypeStruct((4, 32), jnp.int32)
+    return [
+        ContractCase(
+            label="pp-only", mesh=mesh, rules=PIPELINE_RULES,
+            logical=(("pipeline-params", params,
+                      pipeline_logical_axes(cfg)),),
+            fn=lambda p, t: pipeline_forward(
+                p, t, cfg, mesh, n_microbatches=2, attn_impl="xla"),
+            args=(params, tokens),
+        ),
+        ContractCase(
+            label="pp-x-tp", mesh=mesh,
+            fn=lambda p, t: pipeline_forward(
+                p, t, cfg, mesh, n_microbatches=2, tp_axis="tp",
+                attn_impl="xla"),
+            args=(params, tokens),
+        ),
+    ]

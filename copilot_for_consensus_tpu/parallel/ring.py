@@ -30,6 +30,8 @@ except ImportError:   # jax < 0.5 exports it under experimental only
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from copilot_for_consensus_tpu.analysis.contracts import checkable
+
 NEG_INF = -1e30
 
 
@@ -135,3 +137,38 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp"):
     """Bind mesh/axis → a callable usable as ``attn_impl`` in the model
     forward passes (``models.decoder.forward(..., attn_impl=fn)``)."""
     return functools.partial(ring_attention, mesh=mesh, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("ring-attention")
+def _shardcheck_ring_attention():
+    """Trace the shard_map'd ring under the real sp mesh: the psum /
+    axis_index / ppermute collectives inside ``_ring_shard`` must bind
+    the module's default axis on a mesh that actually has it, with the
+    sequence divisible by the ring size. Uses the module defaults on
+    purpose — an axis-name typo here IS the bug this catches."""
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        ContractCase,
+        require_devices,
+    )
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    require_devices(8)
+    mesh = build_mesh(MeshConfig(sp=4), devices=jax.devices()[:8])
+    S = jax.ShapeDtypeStruct
+    b, hq, hkv, s, d = 1, 8, 4, 256, 64
+    q = S((b, hq, s, d), jnp.bfloat16)
+    kv = S((b, hkv, s, d), jnp.bfloat16)
+    return ContractCase(
+        fn=functools.partial(ring_attention, mesh=mesh),
+        args=(q, kv, kv),
+        kwargs={"kv_lengths": S((b,), jnp.int32)},
+        mesh=mesh,
+    )

@@ -14,6 +14,12 @@ from typing import Any, Mapping, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    checkable,
+    require_devices,
+)
+
 LogicalAxisRules = Mapping[str, str | tuple[str, ...] | None]
 
 # Default serving layout: megatron-style TP over heads/ffn/vocab, batch on
@@ -69,3 +75,41 @@ def shard_pytree(tree: Any, logical_tree: Any, mesh: Mesh,
         tree,
         specs,
     )
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("serving-rules")
+def _shardcheck_serving_rules():
+    """DEFAULT_RULES must resolve to real axes of the serving meshes,
+    and a Mistral-7B-class param tree (shapes via eval_shape — no
+    memory) must divide evenly under them. A rule target the mesh
+    lacks, or a dimension tp doesn't divide, silently replicates the
+    weight instead of sharding it — the 2x-HBM bug class."""
+    from copilot_for_consensus_tpu.models import decoder, decoder_config
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    require_devices(8)
+    cfg = decoder_config("mistral-7b")
+    params = jax.eval_shape(
+        lambda key: decoder.init_params(key, cfg), jax.random.PRNGKey(0))
+    cache = jax.eval_shape(
+        lambda: decoder.init_cache(cfg, 8, 256))
+    devs = jax.devices()[:8]
+    cases = []
+    for label, mc in (("tp8", MeshConfig()),
+                      ("dp2xtp4", MeshConfig(dp=2, tp=4))):
+        mesh = build_mesh(mc, devices=devs)
+        cases.append(ContractCase(
+            label=label, mesh=mesh, rules=DEFAULT_RULES,
+            logical=(
+                ("params", params, decoder.logical_axes(cfg)),
+                ("kv-cache", cache, decoder.cache_logical_axes()),
+            )))
+    return cases

@@ -25,6 +25,7 @@ except ImportError:   # jax < 0.5 exports it under experimental only
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from copilot_for_consensus_tpu.analysis.contracts import checkable
 from copilot_for_consensus_tpu.ops.attention import attention_xla
 
 
@@ -92,3 +93,40 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp"):
     """Bind mesh/axis → a callable usable as ``attn_impl`` in the model
     forward passes, interchangeable with ``make_ring_attention``."""
     return functools.partial(ulysses_attention, mesh=mesh, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("ulysses-attention")
+def _shardcheck_ulysses_attention():
+    """Trace the double all-to-all under the real sp mesh with the
+    module's DEFAULT axis binding: the all_to_all collectives in
+    ``_ulysses_shard`` must name an axis the mesh has, heads must
+    divide by it (the head↔sequence reshard pairs head groups across
+    ranks), and the sequence must divide for the seq-sharded specs."""
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        ContractCase,
+        require_devices,
+    )
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    require_devices(8)
+    mesh = build_mesh(MeshConfig(sp=4), devices=jax.devices()[:8])
+    S = jax.ShapeDtypeStruct
+    b, hq, hkv, s, d = 1, 8, 4, 256, 64
+    q = S((b, hq, s, d), jnp.bfloat16)
+    kv = S((b, hkv, s, d), jnp.bfloat16)
+    return ContractCase(
+        fn=functools.partial(ulysses_attention, mesh=mesh),
+        args=(q, kv, kv),
+        kwargs={"kv_lengths": S((b,), jnp.int32)},
+        mesh=mesh,
+    )

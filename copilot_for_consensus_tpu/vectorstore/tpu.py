@@ -22,6 +22,10 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    checkable,
+)
 from copilot_for_consensus_tpu.storage.base import matches_filter
 from copilot_for_consensus_tpu.vectorstore._inverted import InvertedIndexMixin
 from copilot_for_consensus_tpu.vectorstore.base import (
@@ -355,3 +359,43 @@ class TPUVectorStore(InvertedIndexMixin, VectorStore):
                     continue
                 self.add_embedding(str(vid), vectors[i], meta)
             return len(self._ids)
+
+
+# ---------------------------------------------------------------------------
+# shardcheck contracts (analysis/shardcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("tpu-vectorstore")
+def _shardcheck_tpu_vectorstore():
+    """Build a tiny store far enough to materialize its two lazily-jitted
+    programs (an upsert after the first flush builds the patch program,
+    a query builds the batched search) and verify the patch program's
+    donated HBM matrix aliases its output — this is the store's one
+    long-lived device allocation, and a dropped alias would double it
+    on every small flush."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    dim = 8
+    store = TPUVectorStore({"dimension": dim})
+    store.add_embeddings([(f"v{i}", np.eye(dim)[i % dim], {"i": i})
+                          for i in range(3)])
+    store.add_embedding("v0", np.arange(dim, dtype=np.float32), {"i": 0})
+    store.query([1.0] * dim, top_k=2)
+    S = jax.ShapeDtypeStruct
+    capacity = store._device.shape[0]
+    matrix = S((capacity, dim), store._device.dtype)
+    return [
+        ContractCase(
+            label="patch", fn=store._patch_fn,
+            args=(matrix, S((1, dim), jnp.float32),
+                  S((1,), jnp.int32)),
+            donate_argnums=(0,)),
+        ContractCase(
+            label="batch-query",
+            fn=functools.partial(store._batch_query_fn, k=4),
+            args=(matrix, S((2, dim), jnp.float32))),
+    ]

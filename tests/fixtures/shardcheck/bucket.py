@@ -1,0 +1,22 @@
+"""shardcheck fixture: shard-bucket — a declared input length the
+padding-bucket table does not cover (unbounded retrace / silent
+truncation), plus a covering table."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    contract,
+)
+
+
+def bad_bucket():
+    return ContractCase(buckets=(64, 128), bucket_covers=(256,))
+
+
+def good_bucket():
+    return ContractCase(buckets=(64, 128, 256), bucket_covers=(256, 96))
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_bucket", bad_bucket),
+    contract("good_bucket", good_bucket),
+]

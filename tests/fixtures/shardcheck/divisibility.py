@@ -1,0 +1,43 @@
+"""shardcheck fixture: shard-divisibility — a spec'd dimension that the
+mesh axis does not divide evenly (silent per-shard padding), plus the
+clean divisible shape."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    contract,
+    require_devices,
+)
+
+RULES = {"heads": "tp", "embed": None}
+
+
+def _case(head_dim_total):
+    import jax
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    require_devices(8)
+    mesh = build_mesh(MeshConfig(dp=2, tp=4), devices=jax.devices()[:8])
+    w = jax.ShapeDtypeStruct((32, head_dim_total), jnp.bfloat16)
+    return ContractCase(
+        mesh=mesh, rules=RULES,
+        logical=(("weights", {"wq": w},
+                  {"wq": ("embed", "heads")}),))
+
+
+def bad_divisibility():
+    return _case(6)        # 6 heads-width over tp=4: 2 ranks pad
+
+
+def good_divisibility():
+    return _case(8)
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_divisibility", bad_divisibility),
+    contract("good_divisibility", good_divisibility),
+]

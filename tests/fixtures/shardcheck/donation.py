@@ -1,0 +1,43 @@
+"""shardcheck fixture: shard-donation — a donated buffer with no
+shape/dtype-matching output (XLA drops the alias; the buffer
+double-allocates), plus the clean in-place update shape."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    contract,
+)
+
+
+def bad_donation():
+    import jax
+    import jax.numpy as jnp
+
+    def step(cache, x):
+        # output is [1, 8] f32 — nothing matches the donated [4, 8] bf16
+        return (cache[:1] + x).astype(jnp.float32)
+
+    S = jax.ShapeDtypeStruct
+    return ContractCase(
+        fn=jax.jit(step, donate_argnums=(0,)),
+        args=(S((4, 8), jnp.bfloat16), S((1, 8), jnp.bfloat16)),
+        donate_argnums=(0,))
+
+
+def good_donation():
+    import jax
+    import jax.numpy as jnp
+
+    def step(cache, x):
+        return cache.at[0].set(x[0])
+
+    S = jax.ShapeDtypeStruct
+    return ContractCase(
+        fn=jax.jit(step, donate_argnums=(0,)),
+        args=(S((4, 8), jnp.bfloat16), S((1, 8), jnp.bfloat16)),
+        donate_argnums=(0,))
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_donation", bad_donation),
+    contract("good_donation", good_donation),
+]

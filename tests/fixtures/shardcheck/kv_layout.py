@@ -1,0 +1,41 @@
+"""shardcheck fixture: shard-kv-layout — two programs in one kv group
+declaring caches with different layouts (here: dtype), plus a group
+that agrees."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    contract,
+)
+
+
+def _cache(dtype_name):
+    import jax
+    import jax.numpy as jnp
+
+    dt = getattr(jnp, dtype_name)
+    leaf = jax.ShapeDtypeStruct((2, 4, 2, 16, 8), dt)
+    return {"k": leaf, "v": leaf}
+
+
+def bad_kv_layout():
+    return [
+        ContractCase(label="writer", kv_group="fixture-kv-bad",
+                     kv_caches=(("cache", _cache("bfloat16")),)),
+        ContractCase(label="reader", kv_group="fixture-kv-bad",
+                     kv_caches=(("cache", _cache("float32")),)),
+    ]
+
+
+def good_kv_layout():
+    return [
+        ContractCase(label="writer", kv_group="fixture-kv-good",
+                     kv_caches=(("cache", _cache("bfloat16")),)),
+        ContractCase(label="reader", kv_group="fixture-kv-good",
+                     kv_caches=(("cache", _cache("bfloat16")),)),
+    ]
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_kv_layout", bad_kv_layout),
+    contract("good_kv_layout", good_kv_layout),
+]

@@ -1,0 +1,39 @@
+"""shardcheck fixture: shard-rule-axis — a logical-axis rule whose
+target names a mesh axis the mesh does not have (the weight would
+silently replicate), plus the clean spelling."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    contract,
+    require_devices,
+)
+
+
+def _mesh():
+    import jax
+
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    require_devices(8)
+    return build_mesh(MeshConfig(dp=2, tp=4), devices=jax.devices()[:8])
+
+
+def bad_rule_axis():
+    # "model" is the megatron spelling; this mesh calls the axis "tp"
+    return ContractCase(mesh=_mesh(),
+                        rules={"heads": "model", "batch": "dp"})
+
+
+def good_rule_axis():
+    return ContractCase(mesh=_mesh(),
+                        rules={"heads": "tp", "batch": "dp",
+                               "embed": None})
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_rule_axis", bad_rule_axis),
+    contract("good_rule_axis", good_rule_axis),
+]

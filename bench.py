@@ -25,7 +25,10 @@ Poisson sustained serving, int4 capacity (32x3072), embedding texts/s.
 ``BENCH_EXTRA=0`` skips them.
 
 Env knobs: BENCH_MODEL (default mistral-7b), BENCH_SLOTS, BENCH_MAX_LEN,
-BENCH_PROMPT_LEN, BENCH_NEW_TOKENS.
+BENCH_PROMPT_LEN, BENCH_NEW_TOKENS, BENCH_PROBE_BUDGET (total
+wall-clock cap across probe attempts + backoff, default 180 s),
+BENCH_SPEC_DECODE (speculative decoding; BENCH_PRESET=spec_decode sets
+it with copy-heavy prompts).
 """
 
 from __future__ import annotations
@@ -81,6 +84,19 @@ PRESETS = {
                       "BENCH_PREFIX_BLOCKS": "64",
                       "BENCH_DECODE_WINDOW": "32",
                       "BENCH_WINDOWS_PER_DISPATCH": "1"},
+    # Speculative decoding (engine spec_decode): copy-heavy
+    # summarization-shaped prompts — each prompt's back half repeats
+    # spans of its front half, the way abstractive summaries and RAG
+    # answers copy quotes/names/draft identifiers verbatim — so the
+    # prompt-lookup index drafts from the stream's own context and the
+    # verify dispatch scores k+1 positions per weight pass. The
+    # artifact adds draft_hit_rate, mean_accepted_per_step and
+    # tokens_per_weight_pass (timed-run deltas) next to throughput.
+    "spec_decode": {"BENCH_PROMPT_LEN": "512", "BENCH_MAX_LEN": "896",
+                    "BENCH_NEW_TOKENS": "192", "BENCH_SLOTS": "32",
+                    "BENCH_SPEC_DECODE": "1",
+                    "BENCH_DECODE_WINDOW": "8",
+                    "BENCH_WINDOWS_PER_DISPATCH": "1"},
 }
 
 
@@ -92,6 +108,9 @@ PRESET_CONTRACT_MODULES = {
     "cap3072": ["copilot_for_consensus_tpu.engine.generation"],
     "shared_prefix": ["copilot_for_consensus_tpu.engine.generation",
                       "copilot_for_consensus_tpu.engine.prefix_cache"],
+    # the generation contract already declares the _verify entrypoint
+    # (donation alias, kv-layout group, draft-length bucket coverage)
+    "spec_decode": ["copilot_for_consensus_tpu.engine.generation"],
 }
 
 
@@ -170,37 +189,77 @@ print("PROBE_OK", d.platform, d.device_kind, flush=True)
 
 def probe_backend(attempts: int = 4, probe_timeout: float = 120.0,
                   waits: tuple[float, ...] = (0.0, 15.0, 45.0, 90.0),
-                  ) -> tuple[bool, str]:
+                  budget: float | None = None) -> tuple[bool, dict]:
     """Check the device backend comes up, in a subprocess with a timeout.
 
     A down tunnel makes the first device op HANG (not raise) — observed
     by both builder and judge in round 4 — so an in-process check could
     wedge the driver. Each attempt is an isolated interpreter; retries
     back off to ride out a transient tunnel blip.
-    Returns (ok, detail).
+
+    ``budget`` caps the TOTAL wall clock across attempts AND backoff
+    (``BENCH_PROBE_BUDGET``, default 180 s): r05 burned ~8.5 minutes of
+    snapshot time on 4×120 s timeouts + 150 s of backoff before
+    emitting the exact same ok:false artifact a 3-minute probe run
+    proves. A hung probe is indistinguishable from a down tunnel after
+    the first couple of minutes, so the remaining attempts are
+    short-circuited and the artifact ships early. Per-attempt outcomes
+    and durations land in the returned detail dict so the artifact
+    shows WHERE the budget went.
+
+    Returns (ok, detail) — detail: {"summary", "attempts": [...],
+    "budget_s"}.
     """
-    detail = ""
+    if budget is None:
+        budget = float(os.environ.get("BENCH_PROBE_BUDGET", "180"))
+    t0 = time.monotonic()
+    attempt_log: list[dict] = []
+    summary = ""
     for i in range(attempts):
-        if waits[min(i, len(waits) - 1)] and i > 0:
-            w = waits[min(i, len(waits) - 1)]
+        w = waits[min(i, len(waits) - 1)] if i > 0 else 0.0
+        spent = time.monotonic() - t0
+        if spent + w >= budget:
+            summary = (f"probe budget ({budget:.0f}s) exhausted after "
+                       f"{spent:.0f}s and {i} attempt(s)"
+                       + (f"; last error: {summary}" if summary else ""))
+            log(f"backend probe: {summary}")
+            attempt_log.append({
+                "attempt": i + 1, "outcome": "skipped: budget exhausted",
+                "duration_s": 0.0})
+            break
+        if w:
             log(f"backend probe retry {i + 1}/{attempts} in {w:.0f}s...")
             time.sleep(w)
+        ta = time.monotonic()
+        # an attempt never runs past the budget either — a 120 s probe
+        # timeout with 30 s of budget left is a 30 s probe
+        t_limit = min(probe_timeout, budget - (time.monotonic() - t0))
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True, timeout=probe_timeout,
+                capture_output=True, text=True, timeout=t_limit,
                 cwd=REPO)
         except subprocess.TimeoutExpired:
-            detail = f"probe timed out after {probe_timeout:.0f}s"
-            log(f"backend probe attempt {i + 1}/{attempts}: {detail}")
+            summary = f"probe timed out after {t_limit:.0f}s"
+            log(f"backend probe attempt {i + 1}/{attempts}: {summary}")
+            attempt_log.append({
+                "attempt": i + 1, "outcome": summary,
+                "duration_s": round(time.monotonic() - ta, 1)})
             continue
+        dur = round(time.monotonic() - ta, 1)
         if r.returncode == 0 and "PROBE_OK" in r.stdout:
             log(f"backend probe ok: {r.stdout.strip()}")
-            return True, r.stdout.strip()
+            attempt_log.append({"attempt": i + 1, "outcome": "ok",
+                                "duration_s": dur})
+            return True, {"summary": r.stdout.strip(),
+                          "attempts": attempt_log, "budget_s": budget}
         tail = (r.stderr or r.stdout).strip().splitlines()[-1:]
-        detail = tail[0] if tail else f"rc={r.returncode}"
-        log(f"backend probe attempt {i + 1}/{attempts} failed: {detail}")
-    return False, detail
+        summary = tail[0] if tail else f"rc={r.returncode}"
+        log(f"backend probe attempt {i + 1}/{attempts} failed: {summary}")
+        attempt_log.append({"attempt": i + 1, "outcome": summary,
+                            "duration_s": dur})
+    return False, {"summary": summary, "attempts": attempt_log,
+                   "budget_s": budget}
 
 
 # -- extra rows (subprocess each, fault-isolated) -----------------------
@@ -314,6 +373,9 @@ def headline() -> dict:
     shared_prefix = int(knob("BENCH_SHARED_PREFIX", "0"))
     prefix_blocks = int(knob("BENCH_PREFIX_BLOCKS",
                              "64" if shared_prefix else "0"))
+    # Speculative decoding (spec_decode preset): prompt-lookup drafts
+    # + multi-token verify dispatch; prompts are built copy-heavy.
+    spec_on = knob("BENCH_SPEC_DECODE", "0") == "1"
     # Chaining windows in-program amortizes the per-dispatch host sync
     # (expensive over the tunnel) while keeping the efficient 32-step
     # window buffers; 3×32 = the full 96-token run in ONE dispatch.
@@ -371,6 +433,7 @@ def headline() -> dict:
         piggyback_min_prompt=(
             10**9 if knob("BENCH_PIGGYBACK", "0") != "1"
             else int(knob("BENCH_PIGGYBACK_MIN", "512"))),
+        spec_decode=spec_on,
     )
     log(f"engine built (random {model} weights, "
         f"{quantize or 'bf16'}) in {time.monotonic() - t0:.1f}s")
@@ -385,6 +448,20 @@ def headline() -> dict:
                 size=prompt_len - shared_prefix).tolist()
             for _ in range(slots)
         ]
+    elif spec_on:
+        # Copy-heavy: the back half of each prompt re-quotes spans of
+        # its front half (per-stream unique content), so the n-gram
+        # index has verbatim copies to draft from — the
+        # summarization/RAG workload shape speculation targets.
+        half = prompt_len // 2
+        prompts = []
+        for _ in range(slots):
+            head = rng.integers(3, cfg.vocab_size, size=half).tolist()
+            tail = []
+            while len(tail) < prompt_len - half:
+                s0 = int(rng.integers(0, max(1, half - 32)))
+                tail.extend(head[s0:s0 + 32])
+            prompts.append(head + tail[:prompt_len - half])
     else:
         prompts = [
             rng.integers(3, cfg.vocab_size, size=prompt_len).tolist()
@@ -407,6 +484,7 @@ def headline() -> dict:
     # Timed run: keep all slots busy for `new_tokens` decode steps each.
     admit_s0 = eng.admitted_s
     ps0 = eng.prefix_stats()
+    ss0 = eng.spec_stats()
     t0 = time.monotonic()
     comps = eng.generate(prompts, max_new_tokens=new_tokens)
     elapsed = time.monotonic() - t0
@@ -443,6 +521,24 @@ def headline() -> dict:
         out["prefill_tokens"] = prefilled
         log(f"prefix cache: hit rate {out['prefix_hit_rate']}, "
             f"{saved} prompt tokens saved vs {prefilled} prefilled")
+    if spec_on:
+        # Timed-run deltas (warmup compiles both verify buckets and
+        # fills the draft indexes' early misses).
+        ss1 = eng.spec_stats()
+        lookups = ss1["lookups"] - ss0["lookups"]
+        hits = ss1["hits"] - ss0["hits"]
+        acc = ss1["accepted_tokens"] - ss0["accepted_tokens"]
+        rows = ss1["verify_rows"] - ss0["verify_rows"]
+        rt = ss1["weight_row_tokens"] - ss0["weight_row_tokens"]
+        rp = ss1["weight_row_passes"] - ss0["weight_row_passes"]
+        out["draft_hit_rate"] = round(hits / lookups, 3) if lookups \
+            else 0.0
+        out["mean_accepted_per_step"] = round(acc / rows, 3) if rows \
+            else 0.0
+        out["tokens_per_weight_pass"] = round(rt / rp, 3) if rp else 0.0
+        log(f"spec decode: draft hit rate {out['draft_hit_rate']}, "
+            f"{out['mean_accepted_per_step']} accepted/step, "
+            f"{out['tokens_per_weight_pass']} tokens/weight-pass")
     return out
 
 

@@ -50,6 +50,7 @@ from copilot_for_consensus_tpu.analysis.base import (
     baseline_entries_for,
     load_baseline,
     rel,
+    unjustified_entries,
 )
 
 #: ast group name → per-module check (run per parsed file)
@@ -257,6 +258,21 @@ def main(argv: list[str] | None = None) -> int:
                    if RULES.get(e.get("rule"), e.get("rule")) in groups
                    and (not only_rules or e.get("rule") in only_rules)]
         if not errors:
+            # A justification that still starts with the
+            # --write-baseline TODO placeholder is not a justification:
+            # warn always, fail under --strict (finding id
+            # baseline-unjustified). The entries still APPLY either way
+            # — one placeholder must surface as one clear error, not as
+            # a flood of resurfaced properly-baselined findings.
+            for e in unjustified_entries(entries):
+                msg = (f"baseline-unjustified: {e['rule']} in "
+                       f"{e['path']} [{e['context']}]: justification "
+                       f"still starts with TODO — explain why this "
+                       f"finding is deliberate")
+                if args.strict:
+                    errors.append(f"jaxlint --strict: {msg}")
+                else:
+                    print(f"jaxlint: {msg}", file=sys.stderr)
             findings, stale = apply_baseline(findings, entries)
             # staleness is only judgeable for files this run analyzed —
             # a scoped run must not tell maintainers to prune entries

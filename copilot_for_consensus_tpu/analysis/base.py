@@ -174,6 +174,18 @@ def load_baseline(path: pathlib.Path) -> tuple[list[dict], list[str]]:
     return entries, errors
 
 
+def unjustified_entries(entries: list[dict]) -> list[dict]:
+    """Entries whose justification is still the ``--write-baseline``
+    placeholder (starts with TODO). A non-empty placeholder passes the
+    load-time emptiness check, so without this the generated TODO text
+    could sit in the baseline forever looking like an explanation;
+    ``--strict`` (CI) turns these into failures (finding id
+    ``baseline-unjustified``)."""
+    return [e for e in entries
+            if str(e.get("justification", "")).strip().lower()
+            .startswith("todo")]
+
+
 def apply_baseline(findings: list[Finding], entries: list[dict]
                    ) -> tuple[list[Finding], list[dict]]:
     """Returns (non-baselined findings, stale entries). Matching is by

@@ -115,7 +115,9 @@ class ContractCase:
       ``[L, *, Hkv, *, Dh]`` cache convention (``shard-kv-layout``).
     * ``buckets``+``bucket_covers`` → every declared input length must
       fit the padding-bucket table, bounding retrace count
-      (``shard-bucket``).
+      (``shard-bucket``). The table need not be prompt padding: the
+      engine's verify contract declares its speculative draft-length
+      set (token width per verify program) through the same fields.
     """
 
     label: str = ""

@@ -22,6 +22,15 @@ DOMINANT LATENCY" in SURVEY.md §3.2). Design:
   device-resident KV blocks; admission seeds the slot cache from the
   pool and prefills only the suffix, and completions publish their
   prompt-prefix blocks back. Design: ``docs/ENGINE_PREFIX_CACHE.md``.
+* **Speculative decoding** (``spec_decode=True``): decode is pinned at
+  the HBM weight-read wall (docs/PERF.md r3), so the only way past it
+  is more tokens per weight pass. A host-side prompt-lookup n-gram
+  index per stream (``tokenizer.NgramDraftIndex``) drafts copied
+  spans from the stream's own context for free, and one ``_verify``
+  dispatch — a short seeded prefill over the decode slots — scores
+  k+1 positions per stream in a single weight pass, accepting exactly
+  (greedy bit-identical; sampled via the rejection rule in
+  ``sampling.verify_draft``). Design: ``docs/SPEC_DECODE.md``.
 
 The engine is synchronous and single-owner: services drive it through
 ``submit()`` + ``step()`` (or ``generate()`` for batch use) from their
@@ -43,8 +52,15 @@ from copilot_for_consensus_tpu.analysis.contracts import (
     ContractCase,
     checkable,
 )
-from copilot_for_consensus_tpu.engine.sampling import SamplingConfig, sample
-from copilot_for_consensus_tpu.engine.tokenizer import Tokenizer
+from copilot_for_consensus_tpu.engine.sampling import (
+    SamplingConfig,
+    sample,
+    verify_draft,
+)
+from copilot_for_consensus_tpu.engine.tokenizer import (
+    NgramDraftIndex,
+    Tokenizer,
+)
 from copilot_for_consensus_tpu.models import decoder, quant
 from copilot_for_consensus_tpu.models.configs import DecoderConfig
 from copilot_for_consensus_tpu.parallel.sharding import (
@@ -162,6 +178,10 @@ class GenerationEngine:
         piggyback_min_prompt: int = 10**9,
         admit_hold_strict: bool = False,
         prefix_cache_blocks: int = 0,
+        spec_decode: bool = False,
+        spec_draft_lens: tuple[int, ...] = (0, 4, 8),
+        spec_ngram: int = 3,
+        spec_min_ngram: int = 2,
         profile_dir: str | None = None,
         int4_pallas_max_extent: int | None = 1536,
     ):
@@ -595,6 +615,72 @@ class GenerationEngine:
         self._piggy_fn = jax.jit(_decode_piggyback, donate_argnums=(3,),
                                  static_argnames=("kv_len",))
 
+        # ---- speculative decoding (prompt-lookup drafts) ---------------
+        # Decode pays one full weight read per generated token; the
+        # verify dispatch amortizes that read over k drafted tokens
+        # scored in ONE pass. Draft lengths come from a STATIC bucket
+        # set so retrace count stays bounded (one program per nonzero
+        # bucket × kv bucket): a wave's k_max is the largest per-slot
+        # bucketed draft, and slots with no hit ride the same program
+        # in the k=0 lane (one real token, masked padding).
+        self.spec_decode = bool(spec_decode)
+        self.spec_draft_lens = tuple(sorted(
+            {int(k) for k in spec_draft_lens} | {0}))
+        if any(k < 0 for k in self.spec_draft_lens):
+            raise ValueError(
+                f"spec_draft_lens must be >= 0, got {spec_draft_lens}")
+        self._spec_max_draft = max(self.spec_draft_lens)
+        self.spec_ngram = int(spec_ngram)
+        self.spec_min_ngram = int(spec_min_ngram)
+        if self.spec_decode:
+            if cfg.sliding_window and cfg.sliding_window < self.max_len:
+                raise ValueError(
+                    "spec_decode requires full attention: the verify "
+                    "pass rides prefill_attention_seeded, which does "
+                    "not implement absolute-timeline window masking")
+            if self._spec_max_draft + 1 >= self.max_len:
+                raise ValueError(
+                    f"spec_draft_lens {spec_draft_lens} leave no cache "
+                    f"room in max_len {self.max_len}")
+        #: slot → NgramDraftIndex over (prompt + emitted tokens); built
+        #: at admission, extended as tokens are accepted, dropped at
+        #: retirement. Pure host state — the drafting side costs zero
+        #: device work.
+        self._draft_index: dict[int, NgramDraftIndex] = {}
+
+        def _verify(params, tokens, qlens, positions, cache, key, *,
+                    kv_len):
+            """Score k+1 positions per slot in ONE weight pass and
+            accept drafts exactly — the speculative-decoding dispatch.
+
+            tokens: [B, S] (S = k_max+1): each row is the slot's
+            committed next token followed by its drafted continuation,
+            right-padded; qlens: [B] valid tokens per row (draft len
+            + 1; 1 = the k=0 lane); positions: [B] committed cache
+            prefix (free slots park out of range — their scatter rows
+            drop). A short seeded prefill (``decoder.verify_seeded``)
+            reads the slot cache as the seeded prefix, fresh KV for
+            all S fed tokens scatters into the cache at the per-row
+            offset in one ``merge_window`` (columns past the accept
+            point are dead by the prefix-length masking and get
+            overwritten by the next write at those positions — the
+            same invalidation discipline the prefix-cache publish
+            relies on), and ``verify_draft`` applies greedy
+            (bit-identical) or rejection-rule (distribution-exact)
+            acceptance in-program, so the host fetches only
+            [B, S] + [B] ints."""
+            logits, k_new, v_new = decoder.verify_seeded(
+                params, tokens, qlens, positions, cfg, cache,
+                kv_len=kv_len)
+            cache = decoder.merge_window(cache, k_new, v_new, positions,
+                                         steps=tokens.shape[1])
+            out, n_accept = verify_draft(logits, tokens[:, 1:],
+                                         qlens - 1, key, self.sampling)
+            return out, n_accept, cache
+
+        self._verify_fn = jax.jit(_verify, donate_argnums=(4,),
+                                  static_argnames=("kv_len",))
+
         # ---- host-side slot state --------------------------------------
         self._free = list(range(num_slots))
         self._active: dict[int, Request] = {}          # slot → request
@@ -624,6 +710,25 @@ class GenerationEngine:
         self.plain_dispatches = 0
         self.piggy_rows = 0
         self.piggy_tokens = 0
+        #: speculative-decoding accounting (spec_stats()): lookups/hits
+        #: count draft-index probes; drafted/accepted count draft
+        #: tokens through verify; rows counts (slot, verify-dispatch)
+        #: pairs; emitted counts tokens harvested from verify. The
+        #: ``_row_*`` pair is the per-stream weight-pass ledger across
+        #: BOTH decode paths (a verify dispatch is one weight pass per
+        #: row; a plain dispatch is one per row per step), from which
+        #: tokens_per_weight_pass — the number speculation exists to
+        #: move — is computed.
+        self.spec_lookups = 0
+        self.spec_hits = 0
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_dispatches = 0
+        self.spec_rows = 0
+        self.spec_emitted_tokens = 0
+        self.spec_s = 0.0
+        self._row_tokens = 0
+        self._row_passes = 0
 
     # ------------------------------------------------------------------
     # public API
@@ -734,6 +839,39 @@ class GenerationEngine:
             out.update(s.as_dict())
             out["hit_rate"] = s.hits / s.lookups if s.lookups else 0.0
             out["blocks_in_use"] = self._prefix.blocks_in_use
+        return out
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding counters for benches/metrics (mirrors
+        ``prefix_stats``). ``draft_hit_rate`` is over draft-index
+        probes; ``acceptance_rate`` over drafted tokens;
+        ``mean_accepted_per_step`` is the per-row average accepted
+        draft tokens per verify dispatch; ``tokens_per_weight_pass``
+        is the per-stream decode ledger across BOTH paths (1.0 is the
+        vanilla wall, >1 is what speculation buys)."""
+        out = {
+            "enabled": self.spec_decode,
+            "lookups": self.spec_lookups,
+            "hits": self.spec_hits,
+            "draft_hit_rate": (self.spec_hits / self.spec_lookups
+                               if self.spec_lookups else 0.0),
+            "drafted_tokens": self.spec_drafted_tokens,
+            "accepted_tokens": self.spec_accepted_tokens,
+            "acceptance_rate": (
+                self.spec_accepted_tokens / self.spec_drafted_tokens
+                if self.spec_drafted_tokens else 0.0),
+            "verify_dispatches": self.spec_dispatches,
+            "verify_rows": self.spec_rows,
+            "emitted_tokens": self.spec_emitted_tokens,
+            "mean_accepted_per_step": (
+                self.spec_accepted_tokens / self.spec_rows
+                if self.spec_rows else 0.0),
+            "weight_row_passes": self._row_passes,
+            "weight_row_tokens": self._row_tokens,
+            "tokens_per_weight_pass": (
+                self._row_tokens / self._row_passes
+                if self._row_passes else 0.0),
+        }
         return out
 
     @property
@@ -914,6 +1052,7 @@ class GenerationEngine:
                 self._prefix_pins[req.request_id] = matches[i]
             self._active[slot] = req
             self._generated[slot] = [tok]
+            self._spec_track(slot, req, tok)
             self._positions[slot] = plens[i]
             self._next_tok[slot] = tok
             self._t_prefill[slot] = prefill_s
@@ -951,6 +1090,20 @@ class GenerationEngine:
 
     def _decode_once(self) -> None:
         window = self._dispatch_steps
+        # Speculation routes a step to the verify dispatch whenever any
+        # active slot's draft index hits (the no-hit slots ride the
+        # same program in the k=0 lane). Steps with piggyback chunks
+        # pending keep the piggyback dispatch — its chunk grid and the
+        # verify suffix cannot share one program — and draft-less
+        # steps keep the plain windowed path: a window amortizes the
+        # host sync over ``decode_window`` tokens, which beats a
+        # 1-token verify dispatch when there is nothing to verify.
+        if (self.spec_decode and self._active
+                and not (self._prefilling and self._free)):
+            drafts = self._spec_drafts()
+            if drafts:
+                self._dispatch_verify(drafts)
+                return
         self._key, sub = jax.random.split(self._key)
         # Snapshot BEFORE dispatch: rows the piggyback path activates
         # mid-call were prefilling during this window — their decode
@@ -981,6 +1134,7 @@ class GenerationEngine:
             self.plain_dispatches += 1
         for slot, req in active_before:
             gen = self._generated[slot]
+            harvested0 = len(gen)
             finished = None
             for step in range(window):
                 tok = int(toks[step, slot])
@@ -991,12 +1145,140 @@ class GenerationEngine:
                 if len(gen) >= req.max_new_tokens:
                     finished = "length"
                     break
+            if self.spec_decode:
+                # weight-pass ledger + draft index upkeep: a plain
+                # window costs one weight pass PER STEP per row
+                self._row_tokens += len(gen) - harvested0
+                self._row_passes += window
+                idx = self._draft_index.get(slot)
+                if idx is not None:
+                    idx.extend(gen[harvested0:])
             self._positions[slot] += window
             self._next_tok[slot] = int(toks[window - 1, slot])
             # Keep a full window of cache headroom: the next window writes
             # positions [pos, pos+window).
             if (finished is None
                     and self._positions[slot] + window > self.max_len - 1):
+                finished = "length"
+            if finished:
+                self._retire(slot, finished)
+
+    def _spec_track(self, slot: int, req: Request, first_tok: int
+                    ) -> None:
+        """Build the stream's draft index at activation (spec engines):
+        once over the full context (prompt + first generated token),
+        extended per accepted token from then on."""
+        if not self.spec_decode:
+            return
+        idx = NgramDraftIndex(req.prompt, ngram=self.spec_ngram,
+                              min_ngram=self.spec_min_ngram)
+        idx.extend([first_tok])
+        self._draft_index[slot] = idx
+
+    def _spec_bucket(self, n: int) -> int:
+        """Largest declared draft length <= n (0 = no draft). Buckets
+        are the retrace bound: every verify program's token width is
+        some declared length + 1."""
+        best = 0
+        for k in self.spec_draft_lens:
+            if k <= n:
+                best = max(best, k)
+        return best
+
+    def _spec_drafts(self) -> dict[int, list[int]]:
+        """Prompt-lookup drafts for the next verify dispatch: per
+        active slot, probe its n-gram index and clamp to the cache
+        headroom (the verify writes KV at [pos, pos+k]). The DISPATCH
+        width snaps to the declared bucket set (that is the retrace
+        bound: the program shape is the width, not the per-row
+        lengths), and only fires when some slot's draft reaches a
+        nonzero bucket — but once it fires, shorter drafts ride the
+        same program for free via the per-row qlens masking, so a
+        3-token draft still earns its tokens on an 8-wide wave.
+        Empty dict = the step falls through to the plain windowed
+        path (a window amortizes the host sync; a 1-token verify
+        doesn't)."""
+        cands: dict[int, list[int]] = {}
+        k_max = 0
+        for slot in self._active:
+            idx = self._draft_index.get(slot)
+            if idx is None:
+                continue
+            self.spec_lookups += 1
+            d = idx.draft(self._spec_max_draft)
+            room = self.max_len - 1 - int(self._positions[slot])
+            d = d[:max(0, room)]
+            if d:
+                cands[slot] = d
+                k_max = max(k_max, self._spec_bucket(len(d)))
+        if k_max == 0:
+            return {}
+        drafts = {}
+        for slot, d in cands.items():
+            drafts[slot] = d[:k_max]
+            self.spec_hits += 1
+            self.spec_drafted_tokens += len(drafts[slot])
+        return drafts
+
+    def _dispatch_verify(self, drafts: dict[int, list[int]]) -> None:
+        """One verify dispatch: every active slot's committed next
+        token plus its (possibly empty) draft, one weight pass,
+        exact accept/rewind on the host side."""
+        k_max = max(len(d) for d in drafts.values())
+        s = k_max + 1
+        active_before = list(self._active.items())
+        tokens = np.zeros((self.num_slots, s), dtype=np.int32)
+        tokens[:, 0] = self._next_tok
+        qlens = np.ones((self.num_slots,), dtype=np.int32)
+        for slot, d in drafts.items():
+            tokens[slot, 1:1 + len(d)] = d
+            qlens[slot] = len(d) + 1
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.monotonic()
+        with quant.pallas_qmatmul_override(self._decode_pallas_override):
+            out_dev, acc_dev, self._cache = self._verify_fn(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(qlens),
+                jnp.asarray(self._positions),
+                self._cache,
+                sub,
+                kv_len=self._kv_bucket(),
+            )
+        out = _host_fetch(out_dev)                     # [slots, S]
+        acc = _host_fetch(acc_dev)                     # [slots]
+        self.spec_s += time.monotonic() - t0
+        self.spec_dispatches += 1
+        for slot, req in active_before:
+            m = int(acc[slot]) + 1        # emitted: accepts + 1 sample
+            self.spec_accepted_tokens += m - 1
+            self.spec_rows += 1
+            self._row_passes += 1
+            gen = self._generated[slot]
+            emitted = [int(t) for t in out[slot, :m]]
+            finished = None
+            kept = 0
+            for tok in emitted:
+                gen.append(tok)
+                kept += 1
+                if tok in self._eos_set:
+                    finished = "eos"
+                    break
+                if len(gen) >= req.max_new_tokens:
+                    finished = "length"
+                    break
+            self.spec_emitted_tokens += kept
+            self._row_tokens += kept
+            self._draft_index[slot].extend(emitted[:kept])
+            # Rewind/advance the committed length to the accept point:
+            # cache columns [pos+m, pos+k_max] hold rejected-draft KV,
+            # dead by the prefix-length masking until the next write
+            # lands on them (see _verify).
+            self._positions[slot] += m
+            self._next_tok[slot] = emitted[m - 1]
+            if (finished is None
+                    and self._positions[slot] + self._dispatch_steps
+                    > self.max_len - 1):
                 finished = "length"
             if finished:
                 self._retire(slot, finished)
@@ -1093,6 +1375,7 @@ class GenerationEngine:
             tok = int(first[i])
             self._active[slot] = req
             self._generated[slot] = [tok]
+            self._spec_track(slot, req, tok)
             self._positions[slot] = len(req.prompt)
             self._next_tok[slot] = tok
             self._t_prefill[slot] = now - started
@@ -1104,6 +1387,7 @@ class GenerationEngine:
 
     def _retire(self, slot: int, reason: str) -> None:
         self._positions[slot] = self.max_len   # park OOB (see __init__)
+        self._draft_index.pop(slot, None)
         req = self._active.pop(slot)
         if self._prefix is not None:
             # Publish BEFORE the slot returns to the free list: the
@@ -1149,11 +1433,14 @@ def _shardcheck_generation_engine():
 
     * every ``donate_argnums`` entry aliases a shape/dtype-matching
       output (an undonated slot cache double-allocates per dispatch);
-    * admit / seeded admit / decode / piggyback / prefix-pool publish
-      all agree on ONE KV-cache layout (L, Hkv, Dh, dtype) — the cache
-      is handed between these five programs every serving step;
+    * admit / seeded admit / decode / piggyback / verify / prefix-pool
+      publish all agree on ONE KV-cache layout (L, Hkv, Dh, dtype) —
+      the cache is handed between these six programs every serving
+      step;
     * the prefill bucket table covers the longest admissible prompt
-      (``prompt_limit``), bounding compile count.
+      (``prompt_limit``), and the verify dispatch's token-width table
+      covers every declared speculative draft length, both bounding
+      compile count.
 
     The tiny shapes don't weaken the checks: layout agreement, alias
     feasibility, and bucket coverage are shape-RELATION properties, and
@@ -1169,7 +1456,8 @@ def _shardcheck_generation_engine():
     eng = GenerationEngine(cfg, num_slots=4, max_len=64,
                            prefill_buckets=(16, 32), decode_window=4,
                            windows_per_dispatch=1, prefill_chunk=8,
-                           prefill_rows=2, prefix_cache_blocks=4)
+                           prefill_rows=2, prefix_cache_blocks=4,
+                           spec_decode=True, spec_draft_lens=(0, 2, 4))
 
     def aval(tree):
         return jax.tree.map(
@@ -1206,6 +1494,21 @@ def _shardcheck_generation_engine():
                   S((eng.num_slots,), i32), cache, key),
             donate_argnums=(3,), kv_group=group,
             kv_caches=(("slot-cache", cache),)),
+        ContractCase(
+            label="verify",
+            # token width = largest declared draft length + 1 (the
+            # committed next token); the bucket table is the declared
+            # draft-length set so a new spec_draft_lens entry must be
+            # covered here or the lane goes red
+            fn=functools.partial(eng._verify_fn, kv_len=eng.max_len),
+            args=(eng.params,
+                  S((eng.num_slots, max(eng.spec_draft_lens) + 1), i32),
+                  S((eng.num_slots,), i32), S((eng.num_slots,), i32),
+                  cache, key),
+            donate_argnums=(4,), kv_group=group,
+            kv_caches=(("slot-cache", cache),),
+            buckets=tuple(k + 1 for k in eng.spec_draft_lens),
+            bucket_covers=(max(eng.spec_draft_lens) + 1,)),
         ContractCase(
             label="piggyback",
             fn=functools.partial(eng._piggy_fn, kv_len=eng.max_len),

@@ -266,6 +266,14 @@ class PrefixCache:
         hold the prompt's KV at positions [0, len(tokens))). Returns the
         number of blocks newly published.
 
+        The invariant this relies on — cache columns below a slot's
+        committed length are exactly the prompt/accepted-token KV, and
+        columns at or past it are dead (masked by every attention read
+        and overwritten before they can matter) — is the same
+        invalidation discipline the engine's speculative verify
+        dispatch uses to rewind past rejected draft tokens, so a
+        publish after a speculative run copies only committed KV.
+
         ``eligible_tokens`` caps how deep the publish goes — the
         summarization service passes the shared-template span here so a
         small pool isn't churned by thread-unique context tails.

@@ -43,6 +43,84 @@ def stable_block_hash(prev: bytes, tokens) -> bytes:
     return h.digest()
 
 
+class NgramDraftIndex:
+    """Prompt-lookup draft index for speculative decoding (one stream).
+
+    The n-gram analogue of the radix hashing above, but WITHIN one
+    stream instead of across requests: the last ``min_ngram``–``ngram``
+    tokens of the stream's context key a map to the position where the
+    same n-gram last occurred WITH a continuation, and the tokens that
+    followed it become the draft (Saxena, *Prompt Lookup Decoding*,
+    2023). Summaries and RAG answers copy long prompt spans verbatim
+    (quotes, names, draft identifiers, header fields), so drafts come
+    from the stream's own context with zero extra model and zero extra
+    HBM — the drafting side of ``GenerationEngine``'s ``_verify``
+    dispatch.
+
+    Unlike the prefix cache this index never leaves the host or the
+    request: plain tuple keys are correct (no cross-process stability
+    requirement), longest-n wins (a 3-gram match is a stronger copy
+    signal than a 2-gram one), and the EARLIEST occurrence wins within
+    an n — the PLD scan order, and the one that maximizes the
+    available continuation: a tail-adjacent match can only draft as
+    far as the repetition period, while a prompt-side match drafts the
+    whole remembered span. An n-gram is only indexed once at least one
+    token follows it, so the context's own tail can never match itself
+    into an empty draft.
+
+    Cost: O(ngram - min_ngram + 1) dict inserts per appended token —
+    the only per-token host cost speculation adds to the decode path,
+    mirroring how ``prompt_digests`` is the only one on admission.
+    """
+
+    def __init__(self, tokens=(), *, ngram: int = 3, min_ngram: int = 2):
+        if min_ngram < 1 or ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= ngram, got {min_ngram}..{ngram}")
+        self.ngram = int(ngram)
+        self.min_ngram = int(min_ngram)
+        self._tokens: list[int] = []
+        self._maps: dict[int, dict[tuple, int]] = {
+            n: {} for n in range(self.min_ngram, self.ngram + 1)}
+        self._next_end = 0   # first n-gram end position not yet indexed
+        if tokens:
+            self.extend(tokens)
+
+    def __len__(self) -> int:
+        return len(self._tokens)
+
+    def extend(self, tokens) -> None:
+        """Append accepted tokens and index every n-gram that now has a
+        continuation (the n-gram ending at the new tail stays
+        unindexed until the NEXT extend gives it a continuation)."""
+        self._tokens.extend(int(t) for t in tokens)
+        t = self._tokens
+        for end in range(self._next_end, len(t)):
+            # ``end`` is the exclusive end of the n-gram and t[end] its
+            # continuation — the n-gram ending AT len(t) has none yet
+            # and waits for the next extend
+            for n, m in self._maps.items():
+                if end >= n:
+                    m.setdefault(tuple(t[end - n:end]), end)
+        self._next_end = len(t)
+
+    def draft(self, max_tokens: int) -> list[int]:
+        """Up to ``max_tokens`` drafted continuations of the current
+        tail, or ``[]`` when no indexed n-gram matches. Longest n
+        first; the returned span is a verbatim copy of the context
+        after the matched occurrence."""
+        if max_tokens <= 0:
+            return []
+        t = self._tokens
+        for n in range(self.ngram, self.min_ngram - 1, -1):
+            if len(t) <= n:
+                continue
+            end = self._maps[n].get(tuple(t[-n:]))
+            if end is not None:
+                return t[end:end + max_tokens]
+        return []
+
+
 class Tokenizer(abc.ABC):
     pad_id = PAD_ID
     bos_id = BOS_ID

@@ -245,6 +245,58 @@ def prefill_seeded(params: Params, tokens: jax.Array, lengths: jax.Array,
     return _unembed(x_last, params, cfg)[:, 0], {"k": k_new, "v": v_new}
 
 
+def verify_seeded(params: Params, tokens: jax.Array, lengths: jax.Array,
+                  prefix_lens: jax.Array, cfg: DecoderConfig,
+                  cache: Params, kv_len: int | None = None
+                  ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Multi-token verification pass for speculative decoding.
+
+    A short seeded prefill over DECODE SLOTS: row b's S = k+1 tokens
+    (the committed next token plus its k drafted continuations) sit at
+    absolute positions ``prefix_lens[b] + i`` and attend (slot cache
+    prefix ++ fresh causal suffix) through the same
+    ``attn_prefill_seeded`` machinery the prefix-cache admission wave
+    uses — one weight pass scores all k+1 positions of every slot,
+    which is the entire point (decode is pinned at the HBM weight-read
+    wall; see docs/SPEC_DECODE.md).
+
+    Differences from :func:`prefill_seeded`: the seeded prefix is the
+    engine's own slot cache ``[L, B, Hkv, S_max, Dh]`` read in place
+    (sliced to the static ``kv_len`` bucket, streamed per layer as
+    read-only scan xs — never in the carry, the same discipline as
+    ``decode_step_windowed``), and logits come back for EVERY position
+    (acceptance needs all k+1 distributions, not just the last).
+    Positions at or past ``prefix_lens[b]`` are masked, so KV left over
+    from a previous dispatch's rejected drafts is dead by construction.
+
+    tokens: [B, S] right-padded; lengths: [B] valid tokens per row
+    (>= 1); prefix_lens: [B] committed cache prefix per slot (free
+    slots park out of range and produce garbage that the engine's
+    scatter drops). Returns (logits [B, S, V] fp32, k_new, v_new
+    [L, B, Hkv, S, Dh] — ``merge_window`` layout, for the engine's
+    single end-of-dispatch scatter at the per-row offset)."""
+    x = params["tok_emb"][tokens]
+    k_pref, v_pref = cache["k"], cache["v"]
+    if kv_len is not None and kv_len < k_pref.shape[3]:
+        k_pref = k_pref[:, :, :, :kv_len]
+        v_pref = v_pref[:, :, :, :kv_len]
+
+    def body(x, scanned):
+        layer, k_pref_l, v_pref_l = scanned
+        h, k, v = L.attn_prefill_seeded(
+            L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+            layer, cfg, k_pref_l, v_pref_l, prefix_lens,
+            lengths=lengths)
+        x = x + h
+        x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                     layer, cfg)
+        return x, (k, v)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], k_pref, v_pref))
+    return _unembed(x, params, cfg), k_new, v_new
+
+
 def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
                 cfg: DecoderConfig, cache: Params,
                 kv_len: int | None = None

@@ -136,16 +136,28 @@ def prefill_attention_seeded(
 ) -> jax.Array:
     """Suffix-prefill attention over (seeded prefix KV ++ fresh suffix KV).
 
-    The prefix-cache admission path (``GenerationEngine``) prefills only
-    the un-cached tail of a prompt; its queries sit at absolute
-    positions ``prefix_lens[b] + i`` and must attend both the reused
-    prefix KV (gathered from the device block pool, already
-    RoPE-rotated at its original absolute positions — prefixes always
-    start at position 0, so reuse needs no re-rotation) and the fresh
-    suffix KV causally. One joint softmax over the concatenated pieces
-    keeps the math elementwise-identical to a monolithic prefill over
-    the full prompt: identical logits in identical order, with padding
-    masked to -inf exactly as the full pass masks its bucket padding.
+    Two engine paths run on this op:
+
+    * The prefix-cache admission path (``GenerationEngine``) prefills
+      only the un-cached tail of a prompt; its queries sit at absolute
+      positions ``prefix_lens[b] + i`` and must attend both the reused
+      prefix KV (gathered from the device block pool, already
+      RoPE-rotated at its original absolute positions — prefixes always
+      start at position 0, so reuse needs no re-rotation) and the fresh
+      suffix KV causally.
+    * The speculative-decoding verify dispatch (``decoder
+      .verify_seeded``) scores k+1 draft positions per decode slot with
+      the slot's own cache as the seeded prefix. The strict
+      ``j < prefix_lens[b]`` prefix mask below is what that path's
+      invalidation discipline rests on: cache columns at or past a
+      slot's committed length — e.g. KV from a previous dispatch's
+      REJECTED draft tokens — are structurally unreadable and simply
+      get overwritten by the next write at those positions.
+
+    One joint softmax over the concatenated pieces keeps the math
+    elementwise-identical to a monolithic prefill over the full prompt:
+    identical logits in identical order, with padding masked to -inf
+    exactly as the full pass masks its bucket padding.
 
     q/k/v: [B, Hq|Hkv, S, D] fresh suffix projections; k_pref/v_pref:
     [B, Hkv, P, D] (any dtype — cast to q's); prefix_lens: [B] valid

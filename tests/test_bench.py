@@ -1,0 +1,67 @@
+# bench.py host-side plumbing: the backend probe must fail FAST within
+# its wall-clock budget (r05 burned ~8.5 min of snapshot time proving a
+# down tunnel four times over) and record per-attempt outcomes and
+# durations for the artifact detail.
+import time
+
+import bench
+
+
+def test_probe_budget_short_circuits_remaining_attempts(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "import time; time.sleep(60)")
+    t0 = time.monotonic()
+    ok, detail = bench.probe_backend(
+        attempts=4, probe_timeout=30.0, waits=(0.0, 30.0, 30.0, 30.0),
+        budget=4.0)
+    elapsed = time.monotonic() - t0
+    assert not ok
+    assert elapsed < 20.0, elapsed          # not 4 x 30s + backoff
+    assert "budget" in detail["summary"]
+    assert detail["budget_s"] == 4.0
+    outcomes = [a["outcome"] for a in detail["attempts"]]
+    assert any("budget exhausted" in o for o in outcomes)
+    assert all("duration_s" in a for a in detail["attempts"])
+
+
+def test_probe_attempt_timeout_clamped_to_remaining_budget(monkeypatch):
+    """With 3s of budget left, a 120s probe timeout must become a ~3s
+    one — a single attempt can't blow the budget either."""
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "import time; time.sleep(60)")
+    t0 = time.monotonic()
+    ok, detail = bench.probe_backend(
+        attempts=1, probe_timeout=120.0, waits=(0.0,), budget=3.0)
+    assert not ok
+    assert time.monotonic() - t0 < 15.0
+    assert "timed out" in detail["attempts"][0]["outcome"]
+
+
+def test_probe_failure_records_every_attempt(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "raise SystemExit('tunnel down')")
+    ok, detail = bench.probe_backend(
+        attempts=2, probe_timeout=30.0, waits=(0.0, 0.1), budget=60.0)
+    assert not ok
+    assert len(detail["attempts"]) == 2
+    assert all(a["duration_s"] >= 0 for a in detail["attempts"])
+    assert detail["summary"]                # last error surfaced
+
+
+def test_probe_success_reports_ok_attempt(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC",
+                        "print('PROBE_OK fake cpu', flush=True)")
+    ok, detail = bench.probe_backend(
+        attempts=2, probe_timeout=30.0, budget=60.0)
+    assert ok
+    assert "PROBE_OK" in detail["summary"]
+    assert detail["attempts"][-1]["outcome"] == "ok"
+
+
+def test_spec_decode_preset_registered():
+    assert "spec_decode" in bench.PRESETS
+    assert bench.PRESETS["spec_decode"]["BENCH_SPEC_DECODE"] == "1"
+    # the shardcheck preflight must trace the engine whose _verify
+    # entrypoint the preset exercises
+    assert "copilot_for_consensus_tpu.engine.generation" in \
+        bench.PRESET_CONTRACT_MODULES["spec_decode"]

@@ -1,0 +1,177 @@
+# Sampling invariants (engine/sampling.py): the filtered distribution
+# every decode path draws from, plus exact speculative verification —
+# greedy acceptance must reproduce the argmax chain bit for bit, and
+# the rejection rule must leave the emitted distribution unchanged.
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.engine.sampling import (
+    SamplingConfig,
+    _filter_logits,
+    sample,
+    verify_draft,
+)
+
+
+def _logits(seed, b=4, v=32):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(b, v)).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# sample() properties
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("temp", [0.3, 0.7, 1.0, 2.5])
+def test_top_k_one_matches_greedy_at_any_temperature(temp):
+    for seed in range(5):
+        lg = _logits(seed)
+        greedy = sample(lg, jax.random.PRNGKey(0), SamplingConfig())
+        got = sample(lg, jax.random.PRNGKey(seed),
+                     SamplingConfig(temperature=temp, top_k=1))
+        assert (np.asarray(got) == np.asarray(greedy)).all()
+
+
+@pytest.mark.parametrize("top_p", [0.01, 0.1, 0.5, 0.9, 0.999])
+def test_top_p_never_masks_the_argmax_token(top_p):
+    for seed in range(5):
+        lg = _logits(seed)
+        f = _filter_logits(lg, SamplingConfig(temperature=1.0,
+                                              top_p=top_p))
+        kept = jnp.take_along_axis(f, jnp.argmax(lg, -1)[:, None], -1)
+        assert bool(jnp.all(jnp.isfinite(kept))), (top_p, seed)
+
+
+def test_top_k_beyond_vocab_degrades_to_plain_sampling():
+    """top_k > vocab must behave as top_k disabled (keep everything),
+    not mis-index the sorted logits."""
+    lg = _logits(0, v=16)
+    key = jax.random.PRNGKey(1)
+    cfg_plain = SamplingConfig(temperature=0.8)
+    cfg_huge = SamplingConfig(temperature=0.8, top_k=99)
+    f = _filter_logits(lg, cfg_huge)
+    assert bool(jnp.all(jnp.isfinite(f)))          # nothing masked
+    want = sample(lg, key, cfg_plain)
+    got = sample(lg, key, cfg_huge)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_top_k_exactly_vocab_keeps_everything():
+    lg = _logits(3, v=16)
+    f = _filter_logits(lg, SamplingConfig(temperature=1.0, top_k=16))
+    assert bool(jnp.all(jnp.isfinite(f)))
+
+
+def test_sample_greedy_is_argmax():
+    lg = _logits(2)
+    got = sample(lg, jax.random.PRNGKey(0), SamplingConfig())
+    assert (np.asarray(got) == np.asarray(jnp.argmax(lg, -1))).all()
+
+
+# ---------------------------------------------------------------------------
+# verify_draft: greedy acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_verify_draft_greedy_accepts_matching_prefix():
+    lg = jnp.concatenate([_logits(i, b=5, v=32)[None] for i in range(3)])
+    # lg: [3, 5, 32]; argmax chain per row
+    am = np.asarray(jnp.argmax(lg, -1))            # [3, 5]
+    draft = np.zeros((3, 4), np.int32)
+    draft[0] = am[0, :4]                           # full match
+    draft[1] = am[1, :4]
+    draft[1, 2] = (am[1, 2] + 1) % 32              # diverge at j=2
+    draft[2] = am[2, :4]                           # match, but len 0
+    lens = np.asarray([4, 4, 0], np.int32)
+    out, acc = verify_draft(jnp.asarray(lg), jnp.asarray(draft),
+                            jnp.asarray(lens), jax.random.PRNGKey(0),
+                            SamplingConfig())
+    out, acc = np.asarray(out), np.asarray(acc)
+    assert (out == am).all()           # greedy emits the argmax chain
+    assert list(acc) == [4, 2, 0]
+    # emitted tokens = accepted draft + one correction/bonus token
+    assert list(out[0, :5]) == list(am[0, :5])
+    assert list(out[1, :3]) == list(am[1, :3])
+    assert list(out[2, :1]) == list(am[2, :1])
+
+
+def test_verify_draft_greedy_never_accepts_past_draft_len():
+    lg = _logits(9, b=5, v=16)[None]               # [1, 5, 16]
+    am = np.asarray(jnp.argmax(lg, -1))
+    draft = am[:, :4].astype(np.int32)             # would all match
+    out, acc = verify_draft(lg, jnp.asarray(draft),
+                            jnp.asarray([2], np.int32),
+                            jax.random.PRNGKey(0), SamplingConfig())
+    assert int(acc[0]) == 2
+
+
+# ---------------------------------------------------------------------------
+# verify_draft: the rejection rule preserves the sampling distribution
+# ---------------------------------------------------------------------------
+
+
+def _empirical_first_token(lg, draft, lens, cfg, n=20000):
+    keys = jax.random.split(jax.random.PRNGKey(42), n)
+    fn = jax.jit(jax.vmap(
+        lambda k: verify_draft(lg, draft, lens, k, cfg)))
+    out, _ = fn(keys)
+    first = np.asarray(out)[:, 0, 0]
+    v = lg.shape[-1]
+    return np.bincount(first, minlength=v) / n
+
+
+@pytest.mark.parametrize("draft_tok", [0, 3])
+def test_verify_draft_rejection_preserves_distribution(draft_tok):
+    """The first emitted token's marginal must equal the serving
+    distribution p regardless of what the draft proposed — the whole
+    point of the rejection rule. draft_tok 3 is p's mode (high accept
+    rate), 0 a tail token (high rejection rate): both must come out
+    distribution-exact."""
+    v = 8
+    rng = np.random.default_rng(7)
+    lg = jnp.asarray(rng.normal(size=(1, 3, v)).astype(np.float32))
+    lg = lg.at[0, 0, 3].add(2.0)                   # make 3 the mode
+    cfg = SamplingConfig(temperature=1.0)
+    p = np.asarray(jax.nn.softmax(lg[0, 0] / cfg.temperature))
+    draft = jnp.full((1, 2), draft_tok, dtype=jnp.int32)
+    lens = jnp.asarray([2], dtype=jnp.int32)
+    emp = _empirical_first_token(lg, draft, lens, cfg)
+    assert np.abs(emp - p).max() < 0.02, (emp, p)
+
+
+def test_verify_draft_accepts_sure_tokens():
+    """A drafted token carrying ~all filtered probability mass is
+    always accepted (p(d) = 1 → the rejection branch is dead)."""
+    v = 8
+    lg = jnp.full((1, 3, v), -30.0)
+    lg = lg.at[0, :, 5].set(30.0)                  # token 5 is certain
+    draft = jnp.full((1, 2), 5, dtype=jnp.int32)
+    lens = jnp.asarray([2], dtype=jnp.int32)
+    cfg = SamplingConfig(temperature=1.0)
+    for seed in range(16):
+        out, acc = verify_draft(lg, draft, lens,
+                                jax.random.PRNGKey(seed), cfg)
+        assert int(acc[0]) == 2
+        assert np.asarray(out)[0, :3].tolist() == [5, 5, 5]
+
+
+def test_verify_draft_zero_len_rows_emit_one_plain_sample():
+    """A 0-draft row (the k=0 lane of a mixed verify wave) must emit a
+    token from the plain serving distribution."""
+    v = 8
+    rng = np.random.default_rng(3)
+    lg = jnp.asarray(rng.normal(size=(1, 3, v)).astype(np.float32))
+    cfg = SamplingConfig(temperature=1.0)
+    p = np.asarray(jax.nn.softmax(lg[0, 0] / cfg.temperature))
+    draft = jnp.zeros((1, 2), dtype=jnp.int32)
+    lens = jnp.zeros((1,), dtype=jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(11), 20000)
+    out, acc = jax.jit(jax.vmap(
+        lambda k: verify_draft(lg, draft, lens, k, cfg)))(keys)
+    assert int(np.asarray(acc).max()) == 0
+    first = np.asarray(out)[:, 0, 0]
+    emp = np.bincount(first, minlength=v) / len(keys)
+    assert np.abs(emp - p).max() < 0.02, (emp, p)

@@ -164,6 +164,39 @@ def test_shape_mismatched_donated_arg_fails_the_lane(tmp_path):
     assert any(f.rule == "shard-donation" for f in findings), findings
 
 
+def test_cast_verify_cache_output_fails_the_lane(tmp_path):
+    """Cast the speculative verify dispatch's cache output: its donated
+    slot cache loses the aliasable output and the verify program would
+    double-allocate the cache every dispatch."""
+    needle = "            return out, n_accept, cache"
+    findings = _mutated_findings(
+        tmp_path, _GEN, needle,
+        "            return out, n_accept, jax.tree.map("
+        "lambda x: x.astype(jnp.float32), cache)",
+        "generation_verify_mutated")
+    assert any(f.rule == "shard-donation"
+               and "generation-engine:verify" in f.context
+               for f in findings), findings
+
+
+def test_generation_contract_declares_verify_entrypoint():
+    """The acceptance contract for speculative decoding: the _verify
+    program is registered with the cache donation, rides the one
+    engine KV-layout group, and its token-width bucket table covers
+    every declared draft length (so the shardcheck preflight guards
+    the spec_decode bench preset)."""
+    from copilot_for_consensus_tpu.engine import generation
+
+    con = next(c for c in generation.SHARDCHECK_CONTRACTS
+               if c.name == "generation-engine")
+    cases = {c.label: c for c in con.factory()}
+    assert "verify" in cases, sorted(cases)
+    vc = cases["verify"]
+    assert tuple(vc.donate_argnums) == (4,)
+    assert vc.kv_group == "engine.generation-kv"
+    assert vc.buckets and max(vc.bucket_covers) <= max(vc.buckets)
+
+
 # ---------------------------------------------------------------------------
 # the real registry is clean, and the CLI glue holds
 # ---------------------------------------------------------------------------

@@ -212,16 +212,44 @@ def test_baseline_silences_matching_finding(tmp_path, capsys):
     assert rc == 0, capsys.readouterr().out
 
 
+def test_strict_rejects_todo_justification(tmp_path, capsys):
+    """A justification still starting with the --write-baseline TODO
+    placeholder warns on a normal run but fails under --strict
+    (finding id baseline-unjustified) — the placeholder must not
+    calcify into the record."""
+    found = [f for f in analyze_files([FIXTURES / "donation.py"])
+             if f.rule == "donation"]
+    assert found
+    entries = [{"rule": f.rule, "path": f.path, "context": f.context,
+                "message": f.message,
+                "justification": "TODO: explain why this is deliberate"}
+               for f in found]
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(entries))
+    args = ["--rules", "donation", "--baseline", str(bl),
+            str(FIXTURES / "donation.py")]
+    assert jaxlint_main(args) == 0          # non-strict: warn only
+    assert "baseline-unjustified" in capsys.readouterr().err
+    rc = jaxlint_main(["--strict"] + args)
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "baseline-unjustified" in out.out
+
+
 def test_repo_baseline_entries_all_justified():
     from copilot_for_consensus_tpu.analysis.base import (
         DEFAULT_BASELINE,
         load_baseline,
     )
 
+    from copilot_for_consensus_tpu.analysis.base import unjustified_entries
+
     entries, errors = load_baseline(DEFAULT_BASELINE)
     assert errors == []
     assert all(len(e["justification"]) > 40 for e in entries), (
         "baseline justifications must actually explain the decision")
+    assert unjustified_entries(entries) == [], (
+        "committed baseline entries must not carry the TODO placeholder")
 
 
 def test_repo_is_clean_end_to_end():

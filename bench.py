@@ -28,7 +28,9 @@ Env knobs: BENCH_MODEL (default mistral-7b), BENCH_SLOTS, BENCH_MAX_LEN,
 BENCH_PROMPT_LEN, BENCH_NEW_TOKENS, BENCH_PROBE_BUDGET (total
 wall-clock cap across probe attempts + backoff, default 180 s),
 BENCH_SPEC_DECODE (speculative decoding; BENCH_PRESET=spec_decode sets
-it with copy-heavy prompts).
+it with copy-heavy prompts), BENCH_TELEMETRY (engine flight recorder,
+default 1 — the artifact's TTFT/ITL/occupancy columns come from it;
+set 0 for the overhead-measurement arm of BENCH_PRESET=decode_heavy).
 """
 
 from __future__ import annotations
@@ -97,6 +99,17 @@ PRESETS = {
                     "BENCH_SPEC_DECODE": "1",
                     "BENCH_DECODE_WINDOW": "8",
                     "BENCH_WINDOWS_PER_DISPATCH": "1"},
+    # Decode-dominated shape: short prompts, long generations — the
+    # workload where per-dispatch host overhead (and therefore the
+    # telemetry layer's host-side bookkeeping) is the largest fraction
+    # of wall time. This is the telemetry-overhead gate's preset: run
+    # it with BENCH_TELEMETRY=1 (default) vs 0 and the tok/s delta is
+    # the recorder's true cost; the budget is <1%
+    # (docs/OBSERVABILITY.md).
+    "decode_heavy": {"BENCH_PROMPT_LEN": "64", "BENCH_MAX_LEN": "512",
+                     "BENCH_NEW_TOKENS": "384",
+                     "BENCH_DECODE_WINDOW": "32",
+                     "BENCH_WINDOWS_PER_DISPATCH": "1"},
 }
 
 
@@ -111,7 +124,68 @@ PRESET_CONTRACT_MODULES = {
     # the generation contract already declares the _verify entrypoint
     # (donation alias, kv-layout group, draft-length bucket coverage)
     "spec_decode": ["copilot_for_consensus_tpu.engine.generation"],
+    "decode_heavy": ["copilot_for_consensus_tpu.engine.generation"],
 }
+
+
+# -- artifact columns ---------------------------------------------------
+#
+# Each preset's extra columns are assembled by a dedicated helper so the
+# column set is a TESTABLE contract (tests/test_bench.py): the telemetry
+# tentpole must not rename or drop the columns earlier rounds' artifacts
+# established (prefix_hit_rate / draft_hit_rate / ...), and the new
+# flight-recorder columns must keep their names for the next round.
+
+
+def prefix_columns(ps0: dict, ps1: dict) -> dict:
+    """shared_prefix columns: timed-run deltas of the engine's
+    prefix-cache ledger (the warmup's cold misses are the cache
+    filling, not the steady state the preset measures)."""
+    lookups = ps1["lookups"] - ps0["lookups"]
+    hits = ps1["hits"] - ps0["hits"]
+    return {
+        "prefix_hit_rate": round(hits / lookups, 3) if lookups else 0.0,
+        "prefill_tokens_saved": (ps1["prefill_tokens_saved"]
+                                 - ps0["prefill_tokens_saved"]),
+        "prefill_tokens": ps1["prefill_tokens"] - ps0["prefill_tokens"],
+    }
+
+
+def spec_columns(ss0: dict, ss1: dict) -> dict:
+    """spec_decode columns: timed-run deltas of the engine's
+    speculative-decoding ledger."""
+    lookups = ss1["lookups"] - ss0["lookups"]
+    hits = ss1["hits"] - ss0["hits"]
+    acc = ss1["accepted_tokens"] - ss0["accepted_tokens"]
+    rows = ss1["verify_rows"] - ss0["verify_rows"]
+    rt = ss1["weight_row_tokens"] - ss0["weight_row_tokens"]
+    rp = ss1["weight_row_passes"] - ss0["weight_row_passes"]
+    return {
+        "draft_hit_rate": round(hits / lookups, 3) if lookups else 0.0,
+        "mean_accepted_per_step": round(acc / rows, 3) if rows else 0.0,
+        "tokens_per_weight_pass": round(rt / rp, 3) if rp else 0.0,
+    }
+
+
+def telemetry_columns(eng, last_n: int | None = None) -> dict:
+    """Flight-recorder latency columns (engine/telemetry.py), sourced
+    from the engine's OWN request spans and step records instead of
+    ad-hoc bench timers — the same numbers the Prometheus exposition
+    serves, so a dashboard regression and a bench artifact disagree
+    never. ``last_n`` restricts the percentiles to the timed run's
+    completions. Empty dict when the engine was built with
+    telemetry=False (BENCH_TELEMETRY=0, the overhead-measurement arm)."""
+    tele = getattr(eng, "telemetry", None)
+    if tele is None:
+        return {}
+    s = tele.latency_summary(last_n=last_n)
+    return {
+        "ttft_p50_s": s["ttft_p50_s"],
+        "ttft_p95_s": s["ttft_p95_s"],
+        "ttft_p99_s": s["ttft_p99_s"],
+        "itl_mean_s": s["itl_mean_s"],
+        "mean_occupancy": s["mean_occupancy"],
+    }
 
 
 def shardcheck_preflight() -> dict | None:
@@ -376,6 +450,11 @@ def headline() -> dict:
     # Speculative decoding (spec_decode preset): prompt-lookup drafts
     # + multi-token verify dispatch; prompts are built copy-heavy.
     spec_on = knob("BENCH_SPEC_DECODE", "0") == "1"
+    # Flight recorder / telemetry (engine/telemetry.py): default ON —
+    # the artifact's TTFT/ITL/occupancy columns come from it.
+    # BENCH_TELEMETRY=0 is the overhead-measurement arm (run
+    # decode_heavy both ways; budget <1%).
+    tele_on = knob("BENCH_TELEMETRY", "1") == "1"
     # Chaining windows in-program amortizes the per-dispatch host sync
     # (expensive over the tunnel) while keeping the efficient 32-step
     # window buffers; 3×32 = the full 96-token run in ONE dispatch.
@@ -434,6 +513,7 @@ def headline() -> dict:
             10**9 if knob("BENCH_PIGGYBACK", "0") != "1"
             else int(knob("BENCH_PIGGYBACK_MIN", "512"))),
         spec_decode=spec_on,
+        telemetry=tele_on,
     )
     log(f"engine built (random {model} weights, "
         f"{quantize or 'bf16'}) in {time.monotonic() - t0:.1f}s")
@@ -506,36 +586,27 @@ def headline() -> dict:
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
         "total_tok_s": round(total_all / elapsed, 1),
     }
+    # Flight-recorder columns: TTFT percentiles / mean ITL over the
+    # timed run's completions (one per slot), occupancy from the step
+    # records — the recorder, not ad-hoc timers, is the source.
+    tcols = telemetry_columns(eng, last_n=slots)
+    out.update(tcols)
+    if tcols:
+        log(f"telemetry: TTFT p50/p95/p99 {tcols['ttft_p50_s']}/"
+            f"{tcols['ttft_p95_s']}/{tcols['ttft_p99_s']}s, "
+            f"ITL {tcols['itl_mean_s']}s, "
+            f"occupancy {tcols['mean_occupancy']}")
     if prefix_blocks:
         # Timed-run deltas (the warmup's cold misses are the cache
         # filling, not the steady state the preset measures).
-        ps1 = eng.prefix_stats()
-        lookups = ps1["lookups"] - ps0["lookups"]
-        hits = ps1["hits"] - ps0["hits"]
-        prefilled = ps1["prefill_tokens"] - ps0["prefill_tokens"]
-        saved = (ps1["prefill_tokens_saved"]
-                 - ps0["prefill_tokens_saved"])
-        out["prefix_hit_rate"] = round(hits / lookups, 3) if lookups \
-            else 0.0
-        out["prefill_tokens_saved"] = saved
-        out["prefill_tokens"] = prefilled
+        out.update(prefix_columns(ps0, eng.prefix_stats()))
         log(f"prefix cache: hit rate {out['prefix_hit_rate']}, "
-            f"{saved} prompt tokens saved vs {prefilled} prefilled")
+            f"{out['prefill_tokens_saved']} prompt tokens saved vs "
+            f"{out['prefill_tokens']} prefilled")
     if spec_on:
         # Timed-run deltas (warmup compiles both verify buckets and
         # fills the draft indexes' early misses).
-        ss1 = eng.spec_stats()
-        lookups = ss1["lookups"] - ss0["lookups"]
-        hits = ss1["hits"] - ss0["hits"]
-        acc = ss1["accepted_tokens"] - ss0["accepted_tokens"]
-        rows = ss1["verify_rows"] - ss0["verify_rows"]
-        rt = ss1["weight_row_tokens"] - ss0["weight_row_tokens"]
-        rp = ss1["weight_row_passes"] - ss0["weight_row_passes"]
-        out["draft_hit_rate"] = round(hits / lookups, 3) if lookups \
-            else 0.0
-        out["mean_accepted_per_step"] = round(acc / rows, 3) if rows \
-            else 0.0
-        out["tokens_per_weight_pass"] = round(rt / rp, 3) if rp else 0.0
+        out.update(spec_columns(ss0, eng.spec_stats()))
         log(f"spec decode: draft hit rate {out['draft_hit_rate']}, "
             f"{out['mean_accepted_per_step']} accepted/step, "
             f"{out['tokens_per_weight_pass']} tokens/weight-pass")

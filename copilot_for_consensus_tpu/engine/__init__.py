@@ -6,6 +6,12 @@ engine in the role of Ollama / llama.cpp, and a cross-text-batching
 embedding engine in the role of sentence-transformers.
 """
 
+from copilot_for_consensus_tpu.engine.telemetry import (
+    EngineTelemetry,
+    FlightRecorder,
+    RequestTrace,
+    StepRecord,
+)
 from copilot_for_consensus_tpu.engine.tokenizer import (
     ByteTokenizer,
     HashWordTokenizer,
@@ -18,4 +24,8 @@ __all__ = [
     "ByteTokenizer",
     "HashWordTokenizer",
     "create_tokenizer",
+    "EngineTelemetry",
+    "FlightRecorder",
+    "RequestTrace",
+    "StepRecord",
 ]

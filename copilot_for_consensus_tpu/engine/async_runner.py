@@ -105,11 +105,20 @@ class Handle:
 
 
 class AsyncEngineRunner:
-    """Dispatcher thread owning a ``GenerationEngine``'s device calls."""
+    """Dispatcher thread owning a ``GenerationEngine``'s device calls.
 
-    def __init__(self, engine: GenerationEngine):
+    ``error_reporter`` (``obs/errors.py``) receives engine failures
+    with the flight-recorder context: the correlation ids of the
+    requests that were in flight and the dump path when the engine's
+    telemetry wrote one — an engine error report that cannot name its
+    victims is a post-mortem with the body missing."""
+
+    def __init__(self, engine: GenerationEngine, *,
+                 error_reporter=None):
         self.engine = engine
-        self._pending: list[tuple[list[int], int, int | None, Handle]] = []
+        self.error_reporter = error_reporter
+        self._pending: list[
+            tuple[list[int], int, int | None, str, Handle]] = []
         self._handles: dict[int, Handle] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -139,10 +148,12 @@ class AsyncEngineRunner:
 
     def submit(self, prompt: list[int],
                max_new_tokens: int = 256, *,
-               cache_eligible_tokens: int | None = None) -> Handle:
+               cache_eligible_tokens: int | None = None,
+               correlation_id: str = "") -> Handle:
         """Thread-safe enqueue; returns a waitable handle.
         ``cache_eligible_tokens`` plumbs through to
-        ``GenerationEngine.submit`` (prefix-cache publish cap)."""
+        ``GenerationEngine.submit`` (prefix-cache publish cap);
+        ``correlation_id`` tags the request's telemetry span."""
         if self._thread is None:
             raise RuntimeError("runner not started")
         h = Handle()
@@ -152,7 +163,8 @@ class AsyncEngineRunner:
                 # (exiting) dispatcher will never resolve
                 raise RuntimeError("runner stopped")
             self._pending.append((prompt, max_new_tokens,
-                                  cache_eligible_tokens, h))
+                                  cache_eligible_tokens,
+                                  correlation_id, h))
             self._work.notify()
         return h
 
@@ -176,7 +188,7 @@ class AsyncEngineRunner:
                     # blocked in result() must not sit out its full
                     # timeout just because the runner was stopped.
                     exc = RuntimeError("runner stopped")
-                    for _, _, _, h in self._pending:
+                    for _, _, _, _, h in self._pending:
                         h._fail(exc)
                     for h in self._handles.values():
                         h._fail(exc)
@@ -190,12 +202,16 @@ class AsyncEngineRunner:
             # A bad request (e.g. empty prompt) fails ITS handle, not
             # the loop — an unhandled exception here would kill the
             # dispatcher and hang every outstanding and future handle.
-            for prompt, mnt, ce, h in fresh:
+            for prompt, mnt, ce, corr, h in fresh:
                 try:
-                    # kwarg only when set: duck-typed engine stands-in
+                    # kwargs only when set: duck-typed engine stands-in
                     # (tests, shims) keep their 2-arg submit signature
-                    rid = eng.submit(prompt, mnt) if ce is None else \
-                        eng.submit(prompt, mnt, cache_eligible_tokens=ce)
+                    kw = {}
+                    if ce is not None:
+                        kw["cache_eligible_tokens"] = ce
+                    if corr:
+                        kw["correlation_id"] = corr
+                    rid = eng.submit(prompt, mnt, **kw)
                 except Exception as exc:
                     h._fail(exc)
                     continue
@@ -207,7 +223,11 @@ class AsyncEngineRunner:
             except Exception as exc:
                 # Device/engine failure: every in-flight request is
                 # lost — surface the error on each handle and keep the
-                # dispatcher alive for new work.
+                # dispatcher alive for new work. The flight recorder
+                # dumps FIRST (it names the requests in flight by
+                # correlation id), then the error reporter gets the
+                # dump context.
+                self._report_engine_error(exc)
                 for h in self._handles.values():
                     h._fail(exc)
                 self._handles.clear()
@@ -219,3 +239,28 @@ class AsyncEngineRunner:
                 h = self._handles.pop(c.request_id, None)
                 if h is not None:
                     h._resolve(c)
+
+    def _report_engine_error(self, exc: BaseException) -> None:
+        """Flight-recorder dump + error report for a failed dispatch.
+        Best-effort on both counts — observability must never mask or
+        amplify the engine failure it is describing."""
+        tele = getattr(self.engine, "telemetry", None)
+        dump = None
+        if tele is not None:
+            try:
+                dump = tele.record_error(exc)
+            except Exception:
+                pass
+        if self.error_reporter is None:
+            return
+        context: dict = {"component": "engine-dispatch"}
+        if dump is not None:
+            context["correlation_ids"] = dump.get("correlation_ids", [])
+            context["requests_in_flight"] = len(dump.get("in_flight",
+                                                         []))
+            if "dump_path" in dump:
+                context["flight_record"] = dump["dump_path"]
+        try:
+            self.error_reporter.report(exc, context)
+        except Exception:
+            pass

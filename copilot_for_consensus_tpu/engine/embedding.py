@@ -11,18 +11,21 @@ the encoder in single MXU passes; the dp mesh axis shards the batch.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from copilot_for_consensus_tpu.engine.telemetry import resolve_telemetry
 from copilot_for_consensus_tpu.engine.tokenizer import (
     HashWordTokenizer,
     Tokenizer,
 )
 from copilot_for_consensus_tpu.models import encoder
 from copilot_for_consensus_tpu.models.configs import EncoderConfig
+from copilot_for_consensus_tpu.obs.profile import step_annotation
 from copilot_for_consensus_tpu.parallel.sharding import shard_pytree
 
 
@@ -41,9 +44,16 @@ class EmbeddingEngine:
         seed: int = 0,
         dtype=jnp.bfloat16,
         attn_impl: str = "auto",
+        telemetry: Any = True,
     ):
         self.cfg = cfg
         self.mesh = mesh
+        # Step telemetry (engine/telemetry.py): one StepRecord per
+        # encode dispatch (kind="embed") with tile occupancy and
+        # bucket-padding waste — the embedding engine has no request
+        # lifecycle, so spans stay on the generation side.
+        self.telemetry = resolve_telemetry(telemetry, engine="embedding",
+                                           num_slots=batch_size)
         self.batch_size = batch_size
         self.buckets = tuple(sorted(set(
             min(b, cfg.max_positions) for b in buckets)))
@@ -139,7 +149,18 @@ class EmbeddingEngine:
                     ids = encoded[i]
                     tokens[row, :len(ids)] = ids
                     lengths[row] = len(ids)
-                vecs = self._encode_fn(self.params, jnp.asarray(tokens),
-                                       jnp.asarray(lengths))
-                out[group] = np.asarray(jax.device_get(vecs))[:n]
+                seq = self.telemetry.next_step() \
+                    if self.telemetry is not None else None
+                t0 = time.monotonic()
+                with step_annotation("embed", seq):
+                    vecs = self._encode_fn(self.params,
+                                           jnp.asarray(tokens),
+                                           jnp.asarray(lengths))
+                    out[group] = np.asarray(jax.device_get(vecs))[:n]
+                if self.telemetry is not None:
+                    self.telemetry.record_step(
+                        "embed", time.monotonic() - t0, seq=seq,
+                        rows=n, batch=self.batch_size,
+                        tokens=int(lengths[:n].sum()),
+                        padded_tokens=self.batch_size * bucket)
         return out

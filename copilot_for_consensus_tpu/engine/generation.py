@@ -57,10 +57,12 @@ from copilot_for_consensus_tpu.engine.sampling import (
     sample,
     verify_draft,
 )
+from copilot_for_consensus_tpu.engine.telemetry import resolve_telemetry
 from copilot_for_consensus_tpu.engine.tokenizer import (
     NgramDraftIndex,
     Tokenizer,
 )
+from copilot_for_consensus_tpu.obs.profile import step_annotation
 from copilot_for_consensus_tpu.models import decoder, quant
 from copilot_for_consensus_tpu.models.configs import DecoderConfig
 from copilot_for_consensus_tpu.parallel.sharding import (
@@ -90,6 +92,10 @@ class Request:
     #: admission router re-checks every queued request every step, and
     #: hashing is the only per-token host cost on that path
     block_digests: list | None = None
+    #: pipeline correlation id, carried end-to-end through the
+    #: request's telemetry span and into flight-recorder dumps / error
+    #: reports (engine/telemetry.py)
+    correlation_id: str = ""
 
 
 @dataclass
@@ -184,8 +190,16 @@ class GenerationEngine:
         spec_min_ngram: int = 2,
         profile_dir: str | None = None,
         int4_pallas_max_extent: int | None = 1536,
+        telemetry: Any = True,
     ):
         self.profile_dir = profile_dir
+        # Flight recorder + request-lifecycle spans + Prometheus export
+        # (engine/telemetry.py). Default ON: pure host-side bookkeeping
+        # around dispatches the engine already syncs on (<1% measured —
+        # docs/OBSERVABILITY.md). False disables; an EngineTelemetry or
+        # MetricsCollector shares a collector across engines/services.
+        self.telemetry = resolve_telemetry(telemetry, engine="generation",
+                                           num_slots=num_slots)
         self.cfg = cfg
         self.mesh = mesh
         self.num_slots = num_slots
@@ -766,13 +780,17 @@ class GenerationEngine:
         return min(self.max_len - self._dispatch_steps, self.buckets[-1])
 
     def submit(self, prompt: list[int], max_new_tokens: int = 256, *,
-               cache_eligible_tokens: int | None = None) -> int:
+               cache_eligible_tokens: int | None = None,
+               correlation_id: str = "") -> int:
         """Enqueue a tokenized prompt; returns a request id.
 
         ``cache_eligible_tokens`` caps how many leading prompt tokens
         the prefix cache may publish when this request completes (the
         summarization path marks its shared-template span here); None
-        publishes the whole block-aligned prompt prefix."""
+        publishes the whole block-aligned prompt prefix.
+        ``correlation_id`` tags the request's telemetry span (and any
+        flight-recorder dump / error report naming it) with the
+        pipeline event id that caused it."""
         if not prompt:
             raise ValueError("empty prompt")
         limit = self.prompt_limit
@@ -788,7 +806,10 @@ class GenerationEngine:
         self._next_id += 1
         self._queue.append(Request(
             rid, list(prompt), max_new_tokens,
-            cache_eligible_tokens=cache_eligible_tokens))
+            cache_eligible_tokens=cache_eligible_tokens,
+            correlation_id=correlation_id))
+        if self.telemetry is not None:
+            self.telemetry.on_submit(rid, len(prompt), correlation_id)
         return rid
 
     def step(self) -> list[Completion]:
@@ -797,6 +818,9 @@ class GenerationEngine:
         self._admit()
         if self._active or self._prefilling:
             self._decode_once()
+        if self.telemetry is not None:
+            self.telemetry.gauge_queue(self.queue_depth,
+                                       len(self._active))
         return self._drain_done()
 
     def generate(self, prompts: list[list[int]],
@@ -813,9 +837,17 @@ class GenerationEngine:
                for p in prompts]
         results: dict[int, Completion] = {}
         with maybe_profile(self.profile_dir):
-            while len(results) < len(ids):
-                for c in self.step():
-                    results[c.request_id] = c
+            try:
+                while len(results) < len(ids):
+                    for c in self.step():
+                        results[c.request_id] = c
+            except Exception as exc:
+                # post-mortem before the stack unwinds: the flight
+                # recorder names the in-flight requests (correlation
+                # ids included) and the last N dispatches
+                if self.telemetry is not None:
+                    self.telemetry.record_error(exc)
+                raise
         return [results[i] for i in ids]
 
     def generate_text(self, prompts: list[str], tokenizer: Tokenizer,
@@ -1004,42 +1036,56 @@ class GenerationEngine:
         lengths = np.ones((n,), dtype=np.int32)
         slots = np.full((n,), self.num_slots, dtype=np.int32)  # OOB pad
         self._key, sub = jax.random.split(self._key)
-        if any(m is not None for m in matches):
-            # Seeded wave: rows prefill only their suffix; the matched
-            # blocks gather from the pool inside the same program. NB
-            # pads to a power of two (same compile-count bounding as N).
-            nb = 1
-            while nb < max(len(m.block_ids) for m in matches
-                           if m is not None):
-                nb *= 2
-            bids = np.full((n, nb), self._prefix.num_blocks,
-                           dtype=np.int32)               # OOB pad
-            pref_lens = np.zeros((n,), dtype=np.int32)
-            for i, (slot, req) in enumerate(batch):
-                suf = req.prompt[plens[i] - suffix_lens[i]:]
-                tokens[i, :len(suf)] = suf
-                lengths[i] = len(suf)
-                slots[i] = slot
-                if matches[i] is not None:
-                    bids[i, :len(matches[i].block_ids)] = \
-                        matches[i].block_ids
-                    pref_lens[i] = matches[i].tokens
-            first_dev, self._cache = self._admit_seeded_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-                self._prefix.pool["k"], self._prefix.pool["v"],
-                jnp.asarray(bids.reshape(-1)), jnp.asarray(pref_lens),
-                self._cache, jnp.asarray(slots), sub)
-        else:
-            for i, (slot, req) in enumerate(batch):
-                tokens[i, :plens[i]] = req.prompt
-                lengths[i] = plens[i]
-                slots[i] = slot
-            first_dev, self._cache = self._admit_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-                self._cache, jnp.asarray(slots), sub)
-        first = _host_fetch(first_dev)         # the ONE host sync
+        seeded = any(m is not None for m in matches)
+        wave_kind = "prefill_seeded" if seeded else "prefill"
+        seq = self.telemetry.next_step() if self.telemetry is not None \
+            else None
+        with step_annotation(wave_kind, seq):
+            if seeded:
+                # Seeded wave: rows prefill only their suffix; the
+                # matched blocks gather from the pool inside the same
+                # program. NB pads to a power of two (same
+                # compile-count bounding as N).
+                nb = 1
+                while nb < max(len(m.block_ids) for m in matches
+                               if m is not None):
+                    nb *= 2
+                bids = np.full((n, nb), self._prefix.num_blocks,
+                               dtype=np.int32)               # OOB pad
+                pref_lens = np.zeros((n,), dtype=np.int32)
+                for i, (slot, req) in enumerate(batch):
+                    suf = req.prompt[plens[i] - suffix_lens[i]:]
+                    tokens[i, :len(suf)] = suf
+                    lengths[i] = len(suf)
+                    slots[i] = slot
+                    if matches[i] is not None:
+                        bids[i, :len(matches[i].block_ids)] = \
+                            matches[i].block_ids
+                        pref_lens[i] = matches[i].tokens
+                first_dev, self._cache = self._admit_seeded_fn(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(lengths),
+                    self._prefix.pool["k"], self._prefix.pool["v"],
+                    jnp.asarray(bids.reshape(-1)),
+                    jnp.asarray(pref_lens),
+                    self._cache, jnp.asarray(slots), sub)
+            else:
+                for i, (slot, req) in enumerate(batch):
+                    tokens[i, :plens[i]] = req.prompt
+                    lengths[i] = plens[i]
+                    slots[i] = slot
+                first_dev, self._cache = self._admit_fn(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(lengths),
+                    self._cache, jnp.asarray(slots), sub)
+            first = _host_fetch(first_dev)         # the ONE host sync
         prefill_s = time.monotonic() - t0
         self.admitted_s += prefill_s
+        if self.telemetry is not None:
+            self.telemetry.record_step(
+                wave_kind, prefill_s, seq=seq, rows=len(batch),
+                batch=n, tokens=sum(suffix_lens),
+                padded_tokens=n * bucket)
         self.prefill_tokens += sum(suffix_lens)
         self.prefill_tokens_saved += sum(
             m.tokens for m in matches if m is not None)
@@ -1050,6 +1096,14 @@ class GenerationEngine:
                 # prefix blocks must not be evicted out from under a
                 # publish that will re-walk the same path
                 self._prefix_pins[req.request_id] = matches[i]
+            if self.telemetry is not None:
+                self.telemetry.on_admit(
+                    req.request_id, wave_start=t0,
+                    admit_kind="seeded" if matches[i] is not None
+                    else "wave",
+                    prefix_hit_tokens=(matches[i].tokens
+                                       if matches[i] is not None
+                                       else 0))
             self._active[slot] = req
             self._generated[slot] = [tok]
             self._spec_track(slot, req, tok)
@@ -1110,28 +1164,36 @@ class GenerationEngine:
         # lanes carried garbage and must not be harvested this round.
         active_before = list(self._active.items())
         t0 = time.monotonic()
-        if self._prefilling and self._free:
-            toks = self._dispatch_piggyback(sub)
-            self.piggy_s += time.monotonic() - t0
-            self.piggy_dispatches += 1
-        else:
-            # the override (if any) is read at TRACE time; holding it
-            # around the call bakes the qmatmul route into the decode
-            # program without touching other programs/engines
-            with quant.pallas_qmatmul_override(
-                    self._decode_pallas_override):
-                toks, self._cache = self._decode_fn(
-                    self.params,
-                    jnp.asarray(self._next_tok),
-                    jnp.asarray(self._positions),
-                    self._cache,
-                    sub,
-                    kv_len=self._kv_bucket(),
-                    n_windows=self.windows_per_dispatch,
-                )
-            toks = _host_fetch(toks)                 # [steps, slots]
-            self.plain_s += time.monotonic() - t0
-            self.plain_dispatches += 1
+        piggy = bool(self._prefilling and self._free)
+        step_kind = "piggyback" if piggy else "decode"
+        seq = self.telemetry.next_step() if self.telemetry is not None \
+            else None
+        piggy_tok0 = self.piggy_tokens
+        with step_annotation(step_kind, seq):
+            if piggy:
+                toks = self._dispatch_piggyback(sub)
+                self.piggy_s += time.monotonic() - t0
+                self.piggy_dispatches += 1
+            else:
+                # the override (if any) is read at TRACE time; holding
+                # it around the call bakes the qmatmul route into the
+                # decode program without touching other programs/engines
+                with quant.pallas_qmatmul_override(
+                        self._decode_pallas_override):
+                    toks, self._cache = self._decode_fn(
+                        self.params,
+                        jnp.asarray(self._next_tok),
+                        jnp.asarray(self._positions),
+                        self._cache,
+                        sub,
+                        kv_len=self._kv_bucket(),
+                        n_windows=self.windows_per_dispatch,
+                    )
+                toks = _host_fetch(toks)                 # [steps, slots]
+                self.plain_s += time.monotonic() - t0
+                self.plain_dispatches += 1
+        step_s = time.monotonic() - t0
+        harvested_total = 0
         for slot, req in active_before:
             gen = self._generated[slot]
             harvested0 = len(gen)
@@ -1145,6 +1207,7 @@ class GenerationEngine:
                 if len(gen) >= req.max_new_tokens:
                     finished = "length"
                     break
+            harvested_total += len(gen) - harvested0
             if self.spec_decode:
                 # weight-pass ledger + draft index upkeep: a plain
                 # window costs one weight pass PER STEP per row
@@ -1162,6 +1225,16 @@ class GenerationEngine:
                 finished = "length"
             if finished:
                 self._retire(slot, finished)
+        if self.telemetry is not None:
+            # tokens: harvested decode tokens + any prompt tokens the
+            # piggyback chunk grid prefilled this dispatch; the padded
+            # grid is window × slots (every row advances every step)
+            self.telemetry.record_step(
+                step_kind, step_s, seq=seq, rows=len(active_before),
+                batch=self.num_slots,
+                tokens=harvested_total
+                + (self.piggy_tokens - piggy_tok0),
+                padded_tokens=window * self.num_slots)
 
     def _spec_track(self, slot: int, req: Request, first_tok: int
                     ) -> None:
@@ -1235,20 +1308,27 @@ class GenerationEngine:
             qlens[slot] = len(d) + 1
         self._key, sub = jax.random.split(self._key)
         t0 = time.monotonic()
-        with quant.pallas_qmatmul_override(self._decode_pallas_override):
-            out_dev, acc_dev, self._cache = self._verify_fn(
-                self.params,
-                jnp.asarray(tokens),
-                jnp.asarray(qlens),
-                jnp.asarray(self._positions),
-                self._cache,
-                sub,
-                kv_len=self._kv_bucket(),
-            )
-        out = _host_fetch(out_dev)                     # [slots, S]
-        acc = _host_fetch(acc_dev)                     # [slots]
-        self.spec_s += time.monotonic() - t0
+        seq = self.telemetry.next_step() if self.telemetry is not None \
+            else None
+        with step_annotation("verify", seq):
+            with quant.pallas_qmatmul_override(
+                    self._decode_pallas_override):
+                out_dev, acc_dev, self._cache = self._verify_fn(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray(qlens),
+                    jnp.asarray(self._positions),
+                    self._cache,
+                    sub,
+                    kv_len=self._kv_bucket(),
+                )
+            out = _host_fetch(out_dev)                     # [slots, S]
+            acc = _host_fetch(acc_dev)                     # [slots]
+        step_s = time.monotonic() - t0
+        self.spec_s += step_s
         self.spec_dispatches += 1
+        accepted0 = self.spec_accepted_tokens
+        emitted0 = self.spec_emitted_tokens
         for slot, req in active_before:
             m = int(acc[slot]) + 1        # emitted: accepts + 1 sample
             self.spec_accepted_tokens += m - 1
@@ -1282,6 +1362,14 @@ class GenerationEngine:
                 finished = "length"
             if finished:
                 self._retire(slot, finished)
+        if self.telemetry is not None:
+            self.telemetry.record_step(
+                "verify", step_s, seq=seq, rows=len(active_before),
+                batch=self.num_slots,
+                tokens=self.spec_emitted_tokens - emitted0,
+                padded_tokens=s * self.num_slots,
+                draft_tokens=sum(len(d) for d in drafts.values()),
+                accepted_tokens=self.spec_accepted_tokens - accepted0)
 
     def _pack_prefill(self):
         """Pack whole pending prompts into the W×P chunk grid.
@@ -1373,6 +1461,10 @@ class GenerationEngine:
             # first generated token was sampled in-program from the
             # last prompt position
             tok = int(first[i])
+            if self.telemetry is not None:
+                self.telemetry.on_admit(req.request_id,
+                                        wave_start=started,
+                                        admit_kind="piggyback")
             self._active[slot] = req
             self._generated[slot] = [tok]
             self._spec_track(slot, req, tok)
@@ -1413,6 +1505,16 @@ class GenerationEngine:
             prefill_s=self._t_prefill.pop(slot, 0.0),
             decode_s=time.monotonic() - req.decode_started_at,
         )
+        if self.telemetry is not None:
+            self.telemetry.on_retire(req.request_id,
+                                     new_tokens=len(gen),
+                                     finish_reason=reason)
+            # ledger gauges at retire cadence: the stats are cumulative
+            # engine-wide counters, so per-step export buys nothing
+            self.telemetry.update_ledgers(
+                self.prefix_stats() if self._prefix is not None
+                else None,
+                self.spec_stats() if self.spec_decode else None)
         self._free.append(slot)
 
     def _drain_done(self) -> list[Completion]:

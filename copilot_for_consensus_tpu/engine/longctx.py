@@ -42,6 +42,8 @@ from copilot_for_consensus_tpu.analysis.contracts import (
 )
 from copilot_for_consensus_tpu.engine.generation import Completion
 from copilot_for_consensus_tpu.engine.sampling import SamplingConfig, sample
+from copilot_for_consensus_tpu.engine.telemetry import resolve_telemetry
+from copilot_for_consensus_tpu.obs.profile import step_annotation
 from copilot_for_consensus_tpu.models import decoder, layers as L, quant
 from copilot_for_consensus_tpu.models.configs import DecoderConfig
 from copilot_for_consensus_tpu.parallel.ring import make_ring_attention
@@ -77,9 +79,16 @@ class LongContextEngine:
         ctx_block: int = 64,
         profile_dir: str | None = None,
         sp_impl: str = "ring",
+        telemetry: Any = True,
     ):
         self.cfg = cfg
         self.profile_dir = profile_dir
+        # Flight recorder + spans (engine/telemetry.py): one span per
+        # generate() call, one StepRecord per prefill/decode dispatch.
+        # The single-request engine has batch width 1 by construction.
+        self.telemetry = resolve_telemetry(telemetry, engine="longctx",
+                                           num_slots=1)
+        self._tele_rid = 0
         self.sp_impl = sp_impl
         self.mesh = mesh
         self.axis = axis
@@ -294,19 +303,33 @@ class LongContextEngine:
     # ------------------------------------------------------------------
 
     def generate(self, prompt: list[int],
-                 max_new_tokens: int = 256) -> Completion:
+                 max_new_tokens: int = 256, *,
+                 correlation_id: str = "") -> Completion:
         """Generate against the FULL prompt, however long — no truncation.
         Returns the same Completion record as the batch engine. Captures
-        a jax.profiler trace when built with ``profile_dir``."""
+        a jax.profiler trace when built with ``profile_dir``.
+        ``correlation_id`` tags the telemetry span (and any flight-
+        recorder dump) with the pipeline event that asked."""
         from copilot_for_consensus_tpu.obs.profile import maybe_profile
 
         with maybe_profile(self.profile_dir):
-            return self._generate(prompt, max_new_tokens)
+            try:
+                return self._generate(prompt, max_new_tokens,
+                                      correlation_id)
+            except Exception as exc:
+                if self.telemetry is not None:
+                    self.telemetry.record_error(exc)
+                raise
 
-    def _generate(self, prompt: list[int],
-                  max_new_tokens: int) -> Completion:
+    def _generate(self, prompt: list[int], max_new_tokens: int,
+                  correlation_id: str = "") -> Completion:
         if not prompt:
             raise ValueError("empty prompt")
+        tele = self.telemetry
+        rid = self._tele_rid
+        self._tele_rid += 1
+        if tele is not None:
+            tele.on_submit(rid, len(prompt), correlation_id)
         max_new_tokens = min(max_new_tokens, self.suffix_len - 1)
         t0 = time.monotonic()
         s_ctx = _round_up(len(prompt), self.ctx_quantum)
@@ -315,18 +338,31 @@ class LongContextEngine:
         tokens = np.zeros((1, s_ctx), dtype=np.int32)
         tokens[0, :len(prompt)] = prompt
         length = jnp.asarray([len(prompt)], dtype=jnp.int32)
-        logits, prefix = self._prefill_jits[s_ctx](
-            self.params, jnp.asarray(tokens), length)
-        self._key, sub = jax.random.split(self._key)
-        first = int(jax.device_get(self._sample_fn(logits, sub))[0])
+        seq = tele.next_step() if tele is not None else None
+        with step_annotation("prefill", seq):
+            logits, prefix = self._prefill_jits[s_ctx](
+                self.params, jnp.asarray(tokens), length)
+            self._key, sub = jax.random.split(self._key)
+            first = int(jax.device_get(self._sample_fn(logits, sub))[0])
         prefill_s = time.monotonic() - t0
+        if tele is not None:
+            tele.record_step("prefill", prefill_s, seq=seq, rows=1,
+                             batch=1, tokens=len(prompt),
+                             padded_tokens=s_ctx)
+            tele.on_admit(rid, wave_start=t0, admit_kind="longctx")
 
         t1 = time.monotonic()
         generated = [first]
         if first in self._eos_set or max_new_tokens <= 1:
+            out_toks = [] if first in self._eos_set else [first]
+            if tele is not None:
+                tele.on_retire(rid, new_tokens=len(out_toks),
+                               finish_reason=("eos" if first in
+                                              self._eos_set
+                                              else "length"))
             return Completion(
                 request_id=0, prompt_len=len(prompt),
-                tokens=[] if first in self._eos_set else [first],
+                tokens=out_toks,
                 finish_reason=("eos" if first in self._eos_set
                                else "length"),
                 prefill_s=prefill_s, decode_s=0.0)
@@ -347,10 +383,18 @@ class LongContextEngine:
         finish = "length"
         while len(generated) < max_new_tokens:
             self._key, sub = jax.random.split(self._key)
-            toks, suffix = self._decode_jit(
-                self.params, tok, gpos, prefix, prefix_len, suffix,
-                suf_len, sub)
-            host = np.asarray(jax.device_get(toks))[:, 0]
+            td = time.monotonic()
+            seq = tele.next_step() if tele is not None else None
+            with step_annotation("decode", seq):
+                toks, suffix = self._decode_jit(
+                    self.params, tok, gpos, prefix, prefix_len, suffix,
+                    suf_len, sub)
+                host = np.asarray(jax.device_get(toks))[:, 0]
+            if tele is not None:
+                tele.record_step("decode", time.monotonic() - td,
+                                 seq=seq, rows=1, batch=1,
+                                 tokens=len(host),
+                                 padded_tokens=self.decode_window)
             done = False
             for t in host:
                 generated.append(int(t))
@@ -367,6 +411,9 @@ class LongContextEngine:
             suf_len = suf_len + self.decode_window
         if generated and generated[-1] in self._eos_set:
             generated = generated[:-1]
+        if tele is not None:
+            tele.on_retire(rid, new_tokens=len(generated),
+                           finish_reason=finish)
         return Completion(
             request_id=0, prompt_len=len(prompt), tokens=generated,
             finish_reason=finish, prefill_s=prefill_s,

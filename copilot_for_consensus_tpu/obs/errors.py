@@ -21,6 +21,32 @@ from typing import Any
 from copilot_for_consensus_tpu.obs.logging import Logger, get_logger
 
 
+def extract_correlation_ids(context: dict[str, Any] | None) -> list[str]:
+    """Normalize the correlation ids out of a report context: accepts
+    ``correlation_id`` (one) and/or ``correlation_ids`` (many) and
+    returns a de-duplicated, order-preserving list. Every reporter
+    driver uses this so an engine error names the requests in flight
+    the same way regardless of where the report lands."""
+    if not context:
+        return []
+    ids: list[str] = []
+    one = context.get("correlation_id")
+    if one:
+        ids.append(str(one))
+    many = context.get("correlation_ids")
+    if isinstance(many, (list, tuple)):
+        ids.extend(str(c) for c in many if c)
+    elif many:
+        ids.append(str(many))
+    seen: set[str] = set()
+    out = []
+    for c in ids:
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
 class ErrorReporter(abc.ABC):
     @abc.abstractmethod
     def report(self, exc: BaseException, context: dict[str, Any] | None = None) -> None: ...
@@ -107,7 +133,7 @@ class HTTPErrorReporter(ErrorReporter):
             self.suppressed += 1
             return
         self._last_sent[fp] = now
-        self._queue.append({
+        event = {
             "timestamp": now,
             "fingerprint": fp,
             "error_type": type(exc).__name__,
@@ -116,7 +142,15 @@ class HTTPErrorReporter(ErrorReporter):
             "release": self.release,
             "environment": self.environment,
             "tags": {k: str(v) for k, v in (context or {}).items()},
-        })
+        }
+        # Correlation ids are first-class on the event (not flattened
+        # into a tag string): the error tracker's UI joins them against
+        # the logstore, and an engine failure's ids name the requests
+        # that were in flight (engine/telemetry.py flight recorder).
+        ids = extract_correlation_ids(context)
+        if ids:
+            event["correlation_ids"] = ids
+        self._queue.append(event)
         self._wake.set()
 
     def _pump(self) -> None:

@@ -107,8 +107,25 @@ class InMemoryMetrics(MetricsCollector):
 
     @staticmethod
     def _escape(value: Any) -> str:
+        # Label-value escaping per the text exposition format: backslash
+        # FIRST (or the escapes it introduces get double-escaped), then
+        # quote and newline.
         return (str(value).replace("\\", "\\\\").replace('"', '\\"')
                 .replace("\n", "\\n"))
+
+    @staticmethod
+    def _fmt_value(value: float) -> str:
+        """Sample values per the text format: non-finite floats render
+        as ``+Inf``/``-Inf``/``NaN`` — Python's ``str(float('inf'))``
+        is ``inf``, which Prometheus rejects as unparsable and drops
+        the whole scrape."""
+        if value != value:                       # NaN
+            return "NaN"
+        if value == float("inf"):
+            return "+Inf"
+        if value == float("-inf"):
+            return "-Inf"
+        return str(value)
 
     def _fmt_labels(self, key: tuple, extra: Iterable[tuple] = ()) -> str:
         items = list(key) + list(extra)
@@ -124,16 +141,19 @@ class InMemoryMetrics(MetricsCollector):
             for name, series in sorted(self.counters.items()):
                 lines.append(f"# TYPE {ns}_{name} counter")
                 for key, value in series.items():
-                    lines.append(f"{ns}_{name}{self._fmt_labels(key)} {value}")
+                    lines.append(f"{ns}_{name}{self._fmt_labels(key)} "
+                                 f"{self._fmt_value(value)}")
             for name, series in sorted(self.gauges.items()):
                 lines.append(f"# TYPE {ns}_{name} gauge")
                 for key, value in series.items():
-                    lines.append(f"{ns}_{name}{self._fmt_labels(key)} {value}")
+                    lines.append(f"{ns}_{name}{self._fmt_labels(key)} "
+                                 f"{self._fmt_value(value)}")
             for name, series in sorted(self.histograms.items()):
                 lines.append(f"# TYPE {ns}_{name} histogram")
                 for key, (total, count, buckets) in series.items():
                     # observe() increments every bucket with bound >= value,
-                    # so the stored counts are already cumulative.
+                    # so the stored counts are already cumulative; the +Inf
+                    # bucket must equal _count exactly.
                     for bound, bcount in zip(self.buckets, buckets):
                         lines.append(
                             f'{ns}_{name}_bucket{self._fmt_labels(key, [("le", bound)])} {bcount}'
@@ -141,7 +161,8 @@ class InMemoryMetrics(MetricsCollector):
                     lines.append(
                         f'{ns}_{name}_bucket{self._fmt_labels(key, [("le", "+Inf")])} {count}'
                     )
-                    lines.append(f"{ns}_{name}_sum{self._fmt_labels(key)} {total}")
+                    lines.append(f"{ns}_{name}_sum{self._fmt_labels(key)} "
+                                 f"{self._fmt_value(total)}")
                     lines.append(f"{ns}_{name}_count{self._fmt_labels(key)} {count}")
         return "\n".join(lines) + "\n"
 

@@ -11,6 +11,15 @@ Traces are Perfetto/TensorBoard-compatible (``jax.profiler.trace``).
 Enable on the serving engines via config ``llm.profile_dir``
 (``GenerationEngine(profile_dir=...)``); the flag defaults off so
 production pays zero overhead.
+
+``step_annotation`` wraps each engine dispatch in a
+``jax.profiler.StepTraceAnnotation`` whose ``step_num`` is the flight
+recorder's step id (``engine/telemetry.py``) — a Perfetto device-trace
+row and a host-side ``StepRecord`` then name the SAME step, which is
+what makes "slow device step 1234" and "step 1234 was a 2-row padded
+prefill wave" one investigation. The annotation is a TraceMe that is
+near-free when no profiler session is active, so the engines keep it
+on unconditionally.
 """
 
 from __future__ import annotations
@@ -33,3 +42,20 @@ def maybe_profile(trace_dir: str | None, *, create_perfetto_link=False):
     with jax.profiler.trace(str(path),
                             create_perfetto_link=create_perfetto_link):
         yield str(path)
+
+
+def step_annotation(name: str, step_num: int | None = None):
+    """``StepTraceAnnotation`` context for one engine dispatch.
+
+    ``name`` is the wave kind (prefill/decode/verify/...), ``step_num``
+    the flight-recorder step id. Returns a no-op context when the
+    profiler API is unavailable (stripped-down jax builds) — callers
+    never branch."""
+    import jax
+
+    try:
+        if step_num is None:
+            return jax.profiler.StepTraceAnnotation(name)
+        return jax.profiler.StepTraceAnnotation(name, step_num=step_num)
+    except Exception:  # pragma: no cover - profiler API missing
+        return contextlib.nullcontext()

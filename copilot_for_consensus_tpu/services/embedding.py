@@ -31,6 +31,14 @@ class EmbeddingService(BaseService):
         self.provider = provider
         self.vector_store = vector_store
         self.batch_size = batch_size
+        # Engine flight-recorder wiring: a TPU provider's embed-step
+        # telemetry (engine/telemetry.py) exports into THIS service's
+        # collector so it reaches the gateway /metrics scrape.
+        from copilot_for_consensus_tpu.engine.telemetry import (
+            attach_service_collector,
+        )
+
+        attach_service_collector(provider, self.metrics)
 
     def on_ChunksPrepared(self, event: ev.ChunksPrepared) -> None:
         self.process_chunks(event.chunk_ids, event.correlation_id)

@@ -53,6 +53,36 @@ class SummarizationService(BaseService):
         # pipeline's existing recovery spine) instead of redelivery.
         self.pipelined = pipelined and hasattr(summarizer,
                                                "summarize_async")
+        # Capability probe ONCE, not per event: does summarize_async
+        # accept correlation_id (explicitly or via **kwargs)? Duck-typed
+        # stand-ins keep their 1-arg signature and simply lose the tag.
+        self._async_takes_corr = False
+        if self.pipelined:
+            import inspect
+
+            try:
+                self._async_takes_corr = any(
+                    p.name == "correlation_id"
+                    or p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in inspect.signature(
+                        summarizer.summarize_async).parameters.values())
+            except (TypeError, ValueError):
+                pass
+        # Engine flight-recorder wiring (engine/telemetry.py): the
+        # engines' copilot_engine_* observations must land on THIS
+        # service's collector — the one the gateway /metrics serves —
+        # or the serving dashboard/alert pack watches series nobody
+        # emits; and an engine dispatch failure must reach the
+        # service's error reporter naming its in-flight correlation
+        # ids (TPUSummarizer hands the reporter to its AsyncEngineRunner).
+        from copilot_for_consensus_tpu.engine.telemetry import (
+            attach_service_collector,
+        )
+
+        attach_service_collector(summarizer, self.metrics)
+        if self.error_reporter is not None and hasattr(summarizer,
+                                                       "error_reporter"):
+            summarizer.error_reporter = self.error_reporter
         import collections
         import threading
 
@@ -169,7 +199,12 @@ class SummarizationService(BaseService):
 
         t0 = time.monotonic()
         if self.pipelined:
-            wait = self.summarizer.summarize_async(context)
+            # correlation_id reaches the engine's telemetry span when
+            # the summarizer accepts it (capability probed once at
+            # construction).
+            kw = {"correlation_id": correlation_id} \
+                if self._async_takes_corr else {}
+            wait = self.summarizer.summarize_async(context, **kw)
 
             def finalize(summary, _t0=t0, _tid=thread_id,
                          _sid=summary_id, _chunks=selected_chunks,

@@ -90,6 +90,11 @@ class TPUSummarizer(Summarizer):
         self.max_new_tokens = max_new_tokens
         self.template = template
         self.system = system
+        #: obs/errors.py reporter for engine dispatch failures — set by
+        #: the owning service (SummarizationService wires its own); the
+        #: lazily-built AsyncEngineRunner picks it up so an engine
+        #: error reports with the flight-recorder dump + correlation ids
+        self.error_reporter = None
         if engine is None:
             import jax.numpy as jnp
 
@@ -213,14 +218,19 @@ class TPUSummarizer(Summarizer):
                    for p in prompts]
         return [h.result(timeout=600.0) for h in handles]
 
-    def summarize_async(self, thread: ThreadContext):
+    def summarize_async(self, thread: ThreadContext, *,
+                        correlation_id: str = ""):
         """Submit one thread into the continuous batch WITHOUT waiting:
         returns a zero-arg callable that blocks for and returns the
         Summary. Many in-flight submissions share the decode batch —
         this is what actually fills the engine's slots when callers
         (the summarization service) receive work one event at a time.
         Long-context prompts fall back to the synchronous path (the
-        sp-sharded engine is single-request by design)."""
+        sp-sharded engine is single-request by design).
+
+        ``correlation_id`` (the pipeline event id) tags the request's
+        engine telemetry span, so a flight-recorder dump or engine
+        error report names the pipeline event, not just a slot."""
         from copilot_for_consensus_tpu.engine.async_runner import (
             AsyncEngineRunner,
         )
@@ -235,7 +245,8 @@ class TPUSummarizer(Summarizer):
             # thread (self.engine must NOT be driven here: once a runner
             # exists it is the engine's single owner).
             comp = self.long_engine.generate(
-                prompt, max_new_tokens=self.max_new_tokens)
+                prompt, max_new_tokens=self.max_new_tokens,
+                correlation_id=correlation_id)
             summary = Summary(
                 thread_id=thread.thread_id,
                 summary_text=self.tokenizer.decode(comp.tokens).strip(),
@@ -246,10 +257,13 @@ class TPUSummarizer(Summarizer):
             )
             return lambda timeout=None: summary
         if getattr(self, "_runner", None) is None:
-            self._runner = AsyncEngineRunner(self.engine).start()
+            self._runner = AsyncEngineRunner(
+                self.engine,
+                error_reporter=self.error_reporter).start()
         handle = self._runner.submit(
             prompt, self.max_new_tokens,
-            cache_eligible_tokens=self._cache_eligible)
+            cache_eligible_tokens=self._cache_eligible,
+            correlation_id=correlation_id)
 
         def wait(timeout: float | None = 600.0) -> Summary:
             comp = handle.result(timeout)

@@ -34,3 +34,37 @@ FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures"
 @pytest.fixture
 def fixtures_dir() -> pathlib.Path:
     return FIXTURES
+
+
+# -- engine flight-recorder CI artifact --------------------------------
+#
+# When COPILOT_FLIGHT_RECORD_DIR is set (ci.yml exports it for the test
+# lanes), engine telemetry auto-dumps land there on engine errors, and
+# the hook below additionally dumps every live recorder when a test
+# FAILS — ci.yml uploads the directory as the engine-flight-records
+# artifact, so a red engine suite ships its post-mortem (per-dispatch
+# step records + in-flight correlation ids) instead of just a
+# traceback. The env read happens here in the harness, not in the
+# package (test_no_runtime_env_vars policy).
+_FLIGHT_DIR = os.environ.get("COPILOT_FLIGHT_RECORD_DIR", "")
+if _FLIGHT_DIR:
+    from copilot_for_consensus_tpu.engine import telemetry as _telemetry
+
+    _telemetry.set_default_dump_dir(_FLIGHT_DIR)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    if not _FLIGHT_DIR:
+        return
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed:
+        import re
+
+        from copilot_for_consensus_tpu.engine import (
+            telemetry as _telemetry,
+        )
+
+        tag = re.sub(r"[^A-Za-z0-9._-]+", "_", item.nodeid)[-80:]
+        _telemetry.dump_all(_FLIGHT_DIR, tag=tag)

@@ -65,3 +65,76 @@ def test_spec_decode_preset_registered():
     # entrypoint the preset exercises
     assert "copilot_for_consensus_tpu.engine.generation" in \
         bench.PRESET_CONTRACT_MODULES["spec_decode"]
+
+
+def test_decode_heavy_preset_registered():
+    """The telemetry-overhead gate's preset: decode-dominated shape,
+    contract-traced like every other preset."""
+    assert "decode_heavy" in bench.PRESETS
+    p = bench.PRESETS["decode_heavy"]
+    # decode-dominated: generated tokens dominate prompt tokens
+    assert int(p["BENCH_NEW_TOKENS"]) >= 4 * int(p["BENCH_PROMPT_LEN"])
+    assert "copilot_for_consensus_tpu.engine.generation" in \
+        bench.PRESET_CONTRACT_MODULES["decode_heavy"]
+
+
+def test_preset_artifact_columns_unchanged():
+    """The artifact column sets are a cross-round contract: the
+    telemetry tentpole must not rename the columns earlier rounds'
+    presets established, and its own columns are now part of it."""
+    ps0 = {"lookups": 0, "hits": 0, "prefill_tokens": 0,
+           "prefill_tokens_saved": 0}
+    ps1 = {"lookups": 10, "hits": 9, "prefill_tokens": 1280,
+           "prefill_tokens_saved": 3840}
+    cols = bench.prefix_columns(ps0, ps1)
+    assert set(cols) == {"prefix_hit_rate", "prefill_tokens_saved",
+                         "prefill_tokens"}
+    assert cols["prefix_hit_rate"] == 0.9
+    assert cols["prefill_tokens_saved"] == 3840
+
+    ss0 = {"lookups": 0, "hits": 0, "accepted_tokens": 0,
+           "verify_rows": 0, "weight_row_tokens": 0,
+           "weight_row_passes": 0}
+    ss1 = {"lookups": 8, "hits": 4, "accepted_tokens": 12,
+           "verify_rows": 4, "weight_row_tokens": 40,
+           "weight_row_passes": 10}
+    cols = bench.spec_columns(ss0, ss1)
+    assert set(cols) == {"draft_hit_rate", "mean_accepted_per_step",
+                         "tokens_per_weight_pass"}
+    assert cols["draft_hit_rate"] == 0.5
+    assert cols["tokens_per_weight_pass"] == 4.0
+    # zero-delta denominators must not divide by zero
+    assert bench.prefix_columns(ps0, ps0)["prefix_hit_rate"] == 0.0
+    assert bench.spec_columns(ss0, ss0)["tokens_per_weight_pass"] == 0.0
+
+
+def test_telemetry_columns_contract():
+    """Flight-recorder columns come from the engine's own telemetry;
+    a telemetry-disabled engine (BENCH_TELEMETRY=0 overhead arm)
+    yields NO columns rather than zeros that would look like a
+    regression."""
+    from copilot_for_consensus_tpu.engine.telemetry import (
+        EngineTelemetry,
+    )
+
+    class FakeEngine:
+        telemetry = EngineTelemetry(engine="generation", num_slots=4)
+
+    tele = FakeEngine.telemetry
+    for rid in range(3):
+        tele.on_submit(rid, prompt_len=8)
+        tele.on_admit(rid, wave_start=0.0)
+    tele.record_step("decode", 0.01, rows=3, batch=4, tokens=12,
+                     padded_tokens=32)
+    for rid in range(3):
+        tele.on_retire(rid, new_tokens=4, finish_reason="length")
+    cols = bench.telemetry_columns(FakeEngine(), last_n=3)
+    assert set(cols) == {"ttft_p50_s", "ttft_p95_s", "ttft_p99_s",
+                         "itl_mean_s", "mean_occupancy"}
+    assert cols["ttft_p50_s"] > 0
+    assert cols["mean_occupancy"] == 0.75
+
+    class Disabled:
+        telemetry = None
+
+    assert bench.telemetry_columns(Disabled()) == {}

@@ -1,0 +1,475 @@
+# Engine flight recorder (engine/telemetry.py): request-lifecycle
+# spans, step telemetry, Prometheus export, dump-on-error. Host-side
+# unit tests run in the fast lane; engine e2e tests (JAX compiles) are
+# slow-marked like the rest of the engine suite.
+import json
+
+import pytest
+
+from copilot_for_consensus_tpu.engine.telemetry import (
+    METRICS,
+    EngineTelemetry,
+    FlightRecorder,
+    StepRecord,
+    resolve_telemetry,
+)
+from copilot_for_consensus_tpu.obs.metrics import (
+    InMemoryMetrics,
+    NoopMetrics,
+)
+
+
+# -- host-side units (fast lane) ---------------------------------------
+
+
+def test_flight_recorder_ring_is_bounded_and_ordered():
+    fr = FlightRecorder(capacity=4)
+    for _ in range(10):
+        fr.record(StepRecord(seq=fr.next_seq(), kind="decode",
+                             t_wall=0.0, duration_s=0.001))
+    recs = fr.records()
+    assert len(recs) == 4
+    assert [r.seq for r in recs] == sorted(r.seq for r in recs)
+    assert recs[-1].seq == 10              # newest kept, oldest evicted
+
+
+def test_step_record_occupancy_and_padding_waste():
+    r = StepRecord(seq=1, kind="prefill", t_wall=0.0, duration_s=0.1,
+                   rows=3, batch=4, tokens=48, padded_tokens=4 * 64)
+    assert r.occupancy == 0.75
+    assert r.padding_waste == (256 - 48) / 256
+    d = r.as_dict()
+    assert d["kind"] == "prefill" and "occupancy" in d
+    # degenerate records must not divide by zero
+    z = StepRecord(seq=2, kind="decode", t_wall=0.0, duration_s=0.0)
+    assert z.occupancy == 0.0 and z.padding_waste == 0.0
+
+
+def test_span_lifecycle_math_and_metrics():
+    tele = EngineTelemetry(engine="generation", num_slots=8)
+    tr = tele.on_submit(7, prompt_len=100, correlation_id="corr-7")
+    tele.on_admit(7, wave_start=tr.enqueued_at + 0.0,
+                  admit_kind="seeded", prefix_hit_tokens=64)
+    done = tele.on_retire(7, new_tokens=5, finish_reason="eos")
+    assert done is tr
+    assert tr.ttft_s >= 0 and tr.e2e_s >= tr.ttft_s
+    assert tr.queue_wait_s >= 0 and tr.prefix_hit_tokens == 64
+    assert tr.finish_reason == "eos" and tr.correlation_id == "corr-7"
+    assert not tele.in_flight()
+    m = tele.metrics
+    assert m.counter_value("engine_requests_total",
+                           {"engine": "generation",
+                            "finish_reason": "eos"}) == 1
+    # prompt tokens split into prefilled vs prefix-cache-seeded
+    assert m.counter_value("engine_tokens_total",
+                           {"engine": "generation",
+                            "kind": "prompt"}) == 36
+    assert m.counter_value("engine_tokens_total",
+                           {"engine": "generation",
+                            "kind": "prompt_cached"}) == 64
+    assert m.histogram_stats("engine_ttft_seconds",
+                             {"engine": "generation"})["count"] == 1
+    # retiring an unknown id is a no-op, not a crash
+    assert tele.on_retire(999, new_tokens=0, finish_reason="eos") is None
+
+
+def test_latency_summary_percentiles_last_n():
+    tele = EngineTelemetry(engine="generation", num_slots=4)
+    for rid in range(10):
+        tele.on_submit(rid, prompt_len=8)
+        tele.on_admit(rid, wave_start=0.0)
+        tele.on_retire(rid, new_tokens=4, finish_reason="length")
+    s = tele.latency_summary(last_n=5)
+    assert s["requests"] == 5
+    assert s["ttft_p99_s"] >= s["ttft_p95_s"] >= s["ttft_p50_s"] > 0
+
+
+def test_dump_is_json_serializable_and_names_in_flight_requests(
+        tmp_path):
+    tele = EngineTelemetry(engine="generation", num_slots=2,
+                           dump_dir=str(tmp_path))
+    tele.on_submit(1, prompt_len=10, correlation_id="evt-abc")
+    tele.on_submit(2, prompt_len=20, correlation_id="evt-def")
+    tele.on_admit(1, wave_start=0.0)
+    tele.record_step("prefill", 0.01, rows=2, batch=2, tokens=30,
+                     padded_tokens=64)
+    dump = tele.record_error(RuntimeError("device fell over"),
+                             context={"where": "decode"})
+    assert dump["error"]["type"] == "RuntimeError"
+    assert set(dump["correlation_ids"]) == {"evt-abc", "evt-def"}
+    assert dump["where"] == "decode"
+    assert dump["steps"] and dump["steps"][0]["kind"] == "prefill"
+    # auto-dumped to the configured dir, and the file round-trips
+    path = dump["dump_path"]
+    on_disk = json.loads(open(path).read())
+    assert on_disk["engine"] == "generation"
+    assert {t["correlation_id"] for t in on_disk["in_flight"]} == \
+        {"evt-abc", "evt-def"}
+    assert tele.metrics.counter_value("engine_errors_total",
+                                      {"engine": "generation"}) == 1
+
+
+def test_resolve_telemetry_semantics():
+    assert resolve_telemetry(False, engine="x") is None
+    assert resolve_telemetry(None, engine="x") is None
+    t = resolve_telemetry(True, engine="x", num_slots=3)
+    assert isinstance(t, EngineTelemetry) and t.num_slots == 3
+    assert resolve_telemetry(t, engine="y") is t
+    shared = InMemoryMetrics(namespace="copilot")
+    t2 = resolve_telemetry(shared, engine="z")
+    assert t2.metrics is shared
+    with pytest.raises(ValueError, match="telemetry"):
+        resolve_telemetry(object(), engine="x")
+
+
+def test_registry_labels_are_exhaustive():
+    """Every label key the telemetry code attaches must be declared in
+    the registry entry — dashboards aggregate by these."""
+    tele = EngineTelemetry(engine="g", num_slots=2)
+    tele.on_submit(1, 4)
+    tele.on_admit(1, wave_start=0.0)
+    tele.record_step("decode", 0.01, rows=1, batch=2, tokens=1,
+                     padded_tokens=8)
+    tele.gauge_queue(0, active=1)
+    tele.on_retire(1, new_tokens=3, finish_reason="eos")
+    m = tele.metrics
+    for store in (m.counters, m.gauges, m.histograms):
+        for name, series in store.items():
+            declared = set(METRICS[name][1])
+            for key in series:
+                assert {k for k, _ in key} <= declared, (name, key)
+
+
+def test_record_step_is_cheap_enough_for_the_hot_loop():
+    """Lock-cheap claim: recording must be far below dispatch cost.
+    Generous bound (50µs/record) — this is a tripwire against
+    accidentally making the recorder do per-step O(ring) work, not a
+    microbenchmark."""
+    import time
+
+    tele = EngineTelemetry(engine="g", num_slots=8)
+    t0 = time.monotonic()
+    for _ in range(1000):
+        tele.record_step("decode", 0.001, rows=8, batch=8, tokens=64,
+                         padded_tokens=256)
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_async_runner_engine_error_dumps_and_reports(tmp_path):
+    """A failing dispatch must (1) fail the handles, (2) dump the
+    flight recorder, (3) hand the error reporter the in-flight
+    correlation ids + dump path — the post-mortem names its victims."""
+    import time
+
+    from copilot_for_consensus_tpu.engine.async_runner import (
+        AsyncEngineRunner,
+    )
+    from copilot_for_consensus_tpu.obs.errors import (
+        CollectingErrorReporter,
+    )
+
+    class ExplodingEngine:
+        def __init__(self):
+            self.telemetry = EngineTelemetry(engine="generation",
+                                             num_slots=2,
+                                             dump_dir=str(tmp_path))
+            self._active = {}
+            self._queue = []
+            self._rid = 0
+
+        def submit(self, prompt, max_new_tokens,
+                   correlation_id=""):
+            rid = self._rid
+            self._rid += 1
+            self._queue.append(rid)
+            self.telemetry.on_submit(rid, len(prompt), correlation_id)
+            return rid
+
+        def step(self):
+            raise RuntimeError("XLA ate the cache")
+
+    rep = CollectingErrorReporter()
+    eng = ExplodingEngine()
+    runner = AsyncEngineRunner(eng, error_reporter=rep).start()
+    try:
+        h = runner.submit([1, 2, 3], 4, correlation_id="evt-123")
+        with pytest.raises(RuntimeError, match="ate the cache"):
+            h.result(timeout=10.0)
+        deadline = time.monotonic() + 10.0
+        while not rep.reports and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rep.reports
+        _exc, ctx = rep.reports[0]
+        assert ctx["correlation_ids"] == ["evt-123"]
+        assert "flight_record" in ctx
+        on_disk = json.loads(open(ctx["flight_record"]).read())
+        assert on_disk["correlation_ids"] == ["evt-123"]
+    finally:
+        runner.stop()
+
+
+def test_record_error_abandons_in_flight_spans():
+    """A long-lived engine that keeps serving after a dispatch failure
+    (the async runner's containment) must not leak dead spans: the
+    dump names them, THEN they close with finish_reason="error" and
+    stop polluting the next post-mortem."""
+    tele = EngineTelemetry(engine="generation", num_slots=2)
+    tele.on_submit(1, 8, correlation_id="evt-a")
+    dump = tele.record_error(RuntimeError("boom"))
+    assert dump["correlation_ids"] == ["evt-a"]     # named in THIS dump
+    assert tele.in_flight() == []                   # then closed
+    assert tele.completed[-1].finish_reason == "error"
+    assert tele.metrics.counter_value(
+        "engine_requests_total",
+        {"engine": "generation", "finish_reason": "error"}) == 1
+    # aborted requests stay OUT of the latency histograms
+    assert tele.metrics.histogram_stats(
+        "engine_e2e_seconds", {"engine": "generation"}) is None
+    # the NEXT dump no longer lists them as in flight
+    assert tele.dump()["correlation_ids"] == []
+
+
+def test_error_dump_file_matches_returned_dict_with_context(tmp_path):
+    """record_error must write ONE dump including the caller's context
+    — the CI artifact and the in-memory dict must not diverge — and
+    must not burn flight-recorder step ids on filenames."""
+    tele = EngineTelemetry(engine="generation", num_slots=2,
+                           dump_dir=str(tmp_path))
+    tele.record_step("decode", 0.01, rows=1, batch=2, tokens=1)
+    seq_before = tele.recorder._seq
+    dump = tele.record_error(RuntimeError("x"), context={"who": "me"})
+    assert tele.recorder._seq == seq_before         # no seq hole
+    on_disk = json.loads(open(dump["dump_path"]).read())
+    assert on_disk["who"] == "me"
+    assert {k: v for k, v in dump.items() if k != "dump_path"} == \
+        {k: v for k, v in on_disk.items()}
+
+
+def test_latency_summary_occupancy_windowed_to_last_n():
+    """mean_occupancy must describe the same window as the
+    percentiles: steps older than the oldest counted request (warmup)
+    are excluded."""
+    import time
+
+    tele = EngineTelemetry(engine="generation", num_slots=4)
+    tele.record_step("decode", 0.01, rows=1, batch=4)   # "warmup", occ .25
+    time.sleep(0.02)
+    tele.on_submit(1, 8)
+    tele.on_admit(1, wave_start=0.0)
+    tele.record_step("decode", 0.01, rows=4, batch=4)   # timed, occ 1.0
+    tele.on_retire(1, new_tokens=4, finish_reason="length")
+    assert tele.latency_summary(last_n=1)["mean_occupancy"] == 1.0
+    # unwindowed view still averages everything
+    assert tele.latency_summary()["mean_occupancy"] == 0.625
+
+
+def test_attach_service_collector_production_wiring():
+    """The gap the contract tests cannot see: engine telemetry must be
+    re-pointed at the SERVICE's collector (what /metrics serves) or
+    every copilot_engine_* panel watches series nobody emits."""
+    from copilot_for_consensus_tpu.engine.telemetry import (
+        attach_service_collector,
+    )
+
+    class Eng:
+        telemetry = EngineTelemetry(engine="generation", num_slots=2)
+
+    class Holder:
+        engine = Eng()
+        long_engine = None
+
+    shared = InMemoryMetrics(namespace="copilot")
+    assert attach_service_collector(Holder(), shared) == 1
+    Holder.engine.telemetry.on_submit(1, 4)
+    Holder.engine.telemetry.on_admit(1, wave_start=0.0)
+    assert shared.histogram_stats("engine_ttft_seconds",
+                                  {"engine": "generation"})["count"] == 1
+    # a Noop collector must NOT replace the engine's renderable copy
+    fresh = EngineTelemetry(engine="g2", num_slots=1)
+
+    class H2:
+        engine = type("E", (), {"telemetry": fresh})()
+
+    assert attach_service_collector(H2(), NoopMetrics()) == 0
+    assert isinstance(fresh.metrics, InMemoryMetrics)
+
+
+def test_summarization_service_wires_engine_telemetry_and_reporter():
+    """End-to-end production wiring: constructing the service
+    re-points the summarizer's engine telemetry at the service
+    collector and hands the summarizer the error reporter."""
+    from copilot_for_consensus_tpu.obs.errors import (
+        CollectingErrorReporter,
+    )
+    from copilot_for_consensus_tpu.services.summarization import (
+        SummarizationService,
+    )
+
+    class FakeEngine:
+        telemetry = EngineTelemetry(engine="generation", num_slots=2)
+
+    class FakeSummarizer:
+        engine = FakeEngine()
+        long_engine = None
+        error_reporter = None
+
+        def summarize(self, context):
+            raise NotImplementedError
+
+    shared = InMemoryMetrics(namespace="copilot")
+    rep = CollectingErrorReporter()
+    summ = FakeSummarizer()
+    SummarizationService(publisher=None, store=None, summarizer=summ,
+                         metrics=shared, error_reporter=rep)
+    assert summ.engine.telemetry.metrics is shared
+    assert summ.error_reporter is rep
+
+
+# -- engine e2e (slow lane: JAX compiles) ------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    import jax
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu.models import decoder
+    from copilot_for_consensus_tpu.models.configs import decoder_config
+
+    cfg = decoder_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(7), cfg,
+                                 dtype=jnp.float32)
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu.engine.generation import (
+        GenerationEngine,
+    )
+
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", (16, 32))
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("attn_impl", "xla")
+    return GenerationEngine(cfg, params, **kw)
+
+
+@pytest.mark.slow
+def test_engine_telemetry_default_on_and_exports(tiny_engine_parts):
+    cfg, params = tiny_engine_parts
+    eng = _engine(cfg, params)
+    assert eng.telemetry is not None            # on by default
+    eng.submit([5, 6, 7], 6, correlation_id="evt-1")
+    eng.submit([8, 9, 10, 11], 6, correlation_id="evt-2")
+    for _ in range(30):
+        eng.step()
+        if not eng._active and not eng._queue:
+            break
+    tele = eng.telemetry
+    comps = [t for t in tele.completed]
+    assert {t.correlation_id for t in comps} == {"evt-1", "evt-2"}
+    for t in comps:
+        assert t.ttft_s > 0 and t.e2e_s >= t.ttft_s
+        assert t.admit_kind == "wave"
+        assert t.new_tokens > 0
+    kinds = {r.kind for r in tele.recorder.records()}
+    assert "prefill" in kinds and "decode" in kinds
+    body = tele.metrics.render_prometheus()
+    assert "copilot_engine_ttft_seconds_bucket" in body
+    assert 'copilot_engine_requests_total{engine="generation"' in body
+    assert "copilot_engine_queue_depth" in body
+
+
+@pytest.mark.slow
+def test_greedy_bit_identical_with_telemetry_on_vs_off(
+        tiny_engine_parts):
+    """The acceptance gate: the recorder is pure host-side observation
+    — PRNG stream, program count and tokens must be untouched."""
+    cfg, params = tiny_engine_parts
+    prompts = [[5, 9, 13], [40, 41, 42, 43, 44, 45, 46], [3, 4, 5]]
+    on = _engine(cfg, params, telemetry=True).generate(
+        prompts, max_new_tokens=8)
+    off_eng = _engine(cfg, params, telemetry=False)
+    assert off_eng.telemetry is None
+    off = off_eng.generate(prompts, max_new_tokens=8)
+    assert [c.tokens for c in on] == [c.tokens for c in off]
+    assert [c.finish_reason for c in on] == [c.finish_reason
+                                             for c in off]
+
+
+@pytest.mark.slow
+def test_prefix_cache_hits_show_in_spans(tiny_engine_parts):
+    cfg, params = tiny_engine_parts
+    eng = _engine(cfg, params, prefix_cache_blocks=8, prefill_chunk=8)
+    common = list(range(40, 56))                 # two full blocks
+    p1 = common + [7, 8, 9]
+    p2 = common + [10, 11, 12]
+    eng.generate([p1], max_new_tokens=4)         # miss: fills the pool
+    eng.generate([p2], max_new_tokens=4)         # hit: seeded admit
+    tr = list(eng.telemetry.completed)[-1]
+    assert tr.admit_kind == "seeded"
+    assert tr.prefix_hit_tokens >= 16
+    kinds = [r.kind for r in eng.telemetry.recorder.records()]
+    assert "prefill_seeded" in kinds
+    body = eng.telemetry.metrics.render_prometheus()
+    assert "copilot_engine_prefix_hit_rate" in body
+
+
+@pytest.mark.slow
+def test_spec_decode_verify_steps_recorded(tiny_engine_parts):
+    cfg, params = tiny_engine_parts
+    eng = _engine(cfg, params, spec_decode=True,
+                  spec_draft_lens=(0, 4), decode_window=4)
+    # copy-cycle prompt: the n-gram index drafts from the repetition
+    prompt = [5, 6, 7, 8] * 4
+    eng.generate([prompt], max_new_tokens=12)
+    recs = eng.telemetry.recorder.records()
+    verify = [r for r in recs if r.kind == "verify"]
+    if verify:                    # drafts hit on this toy model's output
+        assert all(r.draft_tokens >= r.accepted_tokens >= 0
+                   for r in verify)
+        body = eng.telemetry.metrics.render_prometheus()
+        assert "copilot_engine_spec_acceptance_rate" in body
+    # the ledger gauges export regardless of hit luck
+    assert eng.spec_stats()["enabled"]
+
+
+@pytest.mark.slow
+def test_embedding_engine_records_embed_steps():
+    from copilot_for_consensus_tpu.engine.embedding import (
+        EmbeddingEngine,
+    )
+    from copilot_for_consensus_tpu.models.configs import encoder_config
+
+    eng = EmbeddingEngine(encoder_config("tiny"), batch_size=4,
+                          buckets=(16, 32))
+    eng.embed_batch(["hello world", "a longer text about consensus",
+                     "third"])
+    recs = eng.telemetry.recorder.records()
+    assert recs and all(r.kind == "embed" for r in recs)
+    assert recs[0].rows == 3 and recs[0].batch == 4
+    assert "copilot_engine_step_seconds_bucket" in \
+        eng.telemetry.metrics.render_prometheus()
+
+
+@pytest.mark.slow
+def test_generate_failure_dumps_flight_record(tmp_path,
+                                              tiny_engine_parts):
+    cfg, params = tiny_engine_parts
+    eng = _engine(cfg, params)
+    eng.telemetry.dump_dir = str(tmp_path)
+    # sabotage the decode dispatch so the error path fires mid-generate
+    def boom(*a, **k):
+        raise RuntimeError("dispatch exploded")
+
+    eng._decode_fn = boom
+    with pytest.raises(RuntimeError, match="dispatch exploded"):
+        eng.generate([[5, 6, 7]], max_new_tokens=8)
+    dumps = list(tmp_path.glob("error-*.json"))
+    assert dumps, "engine error did not auto-dump the flight recorder"
+    data = json.loads(dumps[0].read_text())
+    assert data["error"]["message"] == "dispatch exploded"
+    assert data["in_flight"], "dump must name the requests in flight"

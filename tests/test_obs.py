@@ -50,6 +50,66 @@ def test_prometheus_exposition_format():
     assert 'le="+Inf"' in text
 
 
+def test_prometheus_histogram_exposition_exact():
+    """Lock the histogram wire format: cumulative buckets, a +Inf
+    bucket equal to _count, then _sum and _count — exactly the series
+    histogram_quantile() and the alert pack consume."""
+    m = InMemoryMetrics(namespace="copilot")
+    m.buckets = (0.1, 1.0)
+    m.observe("ttft_seconds", 0.05, labels={"engine": "generation"})
+    m.observe("ttft_seconds", 0.5, labels={"engine": "generation"})
+    m.observe("ttft_seconds", 99.0, labels={"engine": "generation"})
+    text = m.render_prometheus()
+    expected = (
+        "# TYPE copilot_ttft_seconds histogram\n"
+        'copilot_ttft_seconds_bucket{engine="generation",le="0.1"} 1\n'
+        'copilot_ttft_seconds_bucket{engine="generation",le="1.0"} 2\n'
+        'copilot_ttft_seconds_bucket{engine="generation",le="+Inf"} 3\n'
+        'copilot_ttft_seconds_sum{engine="generation"} 99.55\n'
+        'copilot_ttft_seconds_count{engine="generation"} 3\n'
+    )
+    assert expected in text
+    assert text.endswith("\n")
+
+
+def test_prometheus_label_value_escaping():
+    """Backslash, quote and newline in label values must escape per the
+    text format (backslash first — or its own escapes double up)."""
+    m = InMemoryMetrics(namespace="copilot")
+    m.increment("events", labels={"path": 'a\\b"c\nd'})
+    text = m.render_prometheus()
+    assert 'path="a\\\\b\\"c\\nd"' in text
+
+
+def test_prometheus_nonfinite_values_render_as_prometheus_floats():
+    """str(float('inf')) is 'inf', which a Prometheus scraper rejects,
+    dropping the WHOLE exposition — non-finite samples must render as
+    +Inf/-Inf/NaN."""
+    m = InMemoryMetrics(namespace="copilot")
+    m.gauge("ratio", float("inf"))
+    m.gauge("neg", float("-inf"))
+    m.gauge("nan", float("nan"))
+    text = m.render_prometheus()
+    assert "copilot_ratio +Inf" in text
+    assert "copilot_neg -Inf" in text
+    assert "copilot_nan NaN" in text
+    assert "\ncopilot_ratio inf" not in text
+
+
+def test_extract_correlation_ids_normalization():
+    from copilot_for_consensus_tpu.obs.errors import (
+        extract_correlation_ids,
+    )
+
+    assert extract_correlation_ids(None) == []
+    assert extract_correlation_ids({"correlation_id": "a"}) == ["a"]
+    assert extract_correlation_ids(
+        {"correlation_ids": ["a", "b", "", "a"]}) == ["a", "b"]
+    assert extract_correlation_ids(
+        {"correlation_id": "a",
+         "correlation_ids": ("b", "a")}) == ["a", "b"]
+
+
 def test_collecting_error_reporter():
     r = CollectingErrorReporter()
     r.report(ValueError("x"), {"stage": "parse"})
@@ -99,7 +159,8 @@ def test_http_error_reporter_sentry_role():
             try:
                 boom()
             except RuntimeError as exc:
-                rep.report(exc, {"service": "parsing", "doc": "d1"})
+                rep.report(exc, {"service": "parsing", "doc": "d1",
+                                 "correlation_ids": ["c-1", "c-2"]})
         deadline = time.monotonic() + 10
         while not received and time.monotonic() < deadline:
             time.sleep(0.05)
@@ -108,6 +169,10 @@ def test_http_error_reporter_sentry_role():
         assert ev["error_type"] == "RuntimeError"
         assert ev["release"] == "r3" and ev["environment"] == "test"
         assert ev["tags"]["service"] == "parsing"
+        # correlation ids ride FIRST-CLASS on the event, not only as a
+        # stringified tag — an engine failure names its in-flight
+        # requests in a joinable field
+        assert ev["correlation_ids"] == ["c-1", "c-2"]
         assert "boom" in ev["stacktrace"]
         assert rep.suppressed == 2
     finally:

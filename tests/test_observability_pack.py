@@ -52,7 +52,19 @@ KNOWN_SERIES = {
     "copilot_disk_free_bytes", "copilot_disk_total_bytes",
     "up", "push_time_seconds", "time", "vector", "absent",
 }
-_SERIES_RE = re.compile(r"\b(copilot_[a-z_]+|up|push_time_seconds)\b")
+
+# Engine flight-recorder series come from the telemetry REGISTRY
+# (engine/telemetry.py:METRICS), not a hand-copied list — the registry
+# is what the telemetry layer actually emits, so dashboard/alert
+# references can only reference what exists.
+from copilot_for_consensus_tpu.engine.telemetry import (  # noqa: E402
+    METRICS as ENGINE_METRICS,
+    prometheus_series as _engine_series,
+)
+
+KNOWN_SERIES |= set(_engine_series())
+# [a-z0-9_]: engine series contain digits (engine_e2e_seconds)
+_SERIES_RE = re.compile(r"\b(copilot_[a-z0-9_]+|up|push_time_seconds)\b")
 
 
 def _alert_files():
@@ -103,6 +115,122 @@ def test_dashboards_parse_and_reference_real_series():
                     base = re.sub(r"_(bucket|sum|count)$", "", name)
                     assert base in KNOWN_SERIES, (f.name, panel["title"],
                                                   name)
+
+
+# -- engine flight-recorder metric-name contract -------------------------
+#
+# The PR-1 bug class: an alert wrote deriv() where the series needed
+# rate() (or referenced a series nobody emits) and rotted silently —
+# the expression evaluates to empty/garbage and the alert can never
+# fire. These tests catch both statically: every copilot_engine_*
+# reference must exist in the telemetry registry, carry the right
+# suffix for its type, and sit under a PromQL function legal for that
+# type. A separate test drives a full EngineTelemetry lifecycle and
+# asserts the registry matches what is ACTUALLY emitted, both ways.
+
+
+def _serving_pack_exprs():
+    exprs = []
+    doc = json.loads((DASHBOARDS / "serving-engines.json").read_text())
+    for panel in doc["panels"]:
+        for target in panel.get("targets", []):
+            exprs.append((f"dashboard:{panel['title']}", target["expr"]))
+    doc = yaml.safe_load((ALERTS / "serving.yml").read_text())
+    for group in doc["groups"]:
+        for rule in group["rules"]:
+            exprs.append((f"alert:{rule['alert']}", rule["expr"]))
+    return exprs
+
+
+_ENGINE_REF_RE = re.compile(r"\bcopilot_engine_[a-z0-9_]+\b")
+
+
+def test_engine_series_references_are_emitted_by_registry():
+    emitted = _engine_series()            # full name -> type
+    refs = {}
+    for where, expr in _serving_pack_exprs():
+        for name in _ENGINE_REF_RE.findall(expr):
+            refs.setdefault(name, where)
+    assert refs, "serving pack references no engine telemetry series"
+    for name, where in refs.items():
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert base in emitted, (
+            f"{where} references {name}, which the telemetry registry "
+            f"(engine/telemetry.py:METRICS) does not emit")
+        if name != base:
+            assert emitted[base] == "histogram", (
+                f"{where}: {name} uses a histogram suffix but "
+                f"{base} is a {emitted[base]}")
+
+
+def test_engine_promql_functions_match_series_types():
+    """rate()/increase() need counters (or histogram components);
+    deriv()/ *_over_time need gauges — applied to the wrong type the
+    expression silently evaluates to nonsense."""
+    emitted = _engine_series()
+    rate_re = re.compile(r"\b(?:rate|irate|increase)\(\s*"
+                         r"(copilot_engine_[a-z0-9_]+)")
+    gauge_fn_re = re.compile(
+        r"\b(?:deriv|avg_over_time|min_over_time|max_over_time|"
+        r"quantile_over_time|delta)\(\s*(copilot_engine_[a-z0-9_]+)")
+    for where, expr in _serving_pack_exprs():
+        for name in rate_re.findall(expr):
+            base = re.sub(r"_(bucket|sum|count)$", "", name)
+            typ = emitted.get(base)
+            assert typ in ("counter", "histogram"), (
+                f"{where}: rate() over {name} ({typ}) — gauges need "
+                f"deriv()/…_over_time")
+            if typ == "histogram":
+                assert name != base, (
+                    f"{where}: rate() over bare histogram {name}; use "
+                    f"_bucket/_sum/_count")
+        for name in gauge_fn_re.findall(expr):
+            base = re.sub(r"_(bucket|sum|count)$", "", name)
+            assert emitted.get(base) == "gauge", (
+                f"{where}: gauge function over {name} "
+                f"({emitted.get(base)}) — counters/histograms need "
+                f"rate()")
+
+
+def test_telemetry_registry_matches_actual_emission():
+    """Drive one full lifecycle through EngineTelemetry and assert the
+    set of series it lands in its collector EQUALS the registry — a
+    metric added to the code but not the registry (or vice versa) fails
+    here, keeping the contract tests above honest."""
+    from copilot_for_consensus_tpu.engine.telemetry import (
+        EngineTelemetry,
+    )
+    from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+
+    m = InMemoryMetrics(namespace="copilot")
+    tele = EngineTelemetry(engine="generation", num_slots=4, metrics=m)
+    tele.on_submit(1, prompt_len=16, correlation_id="c-1")
+    tele.on_admit(1, wave_start=0.0, admit_kind="seeded",
+                  prefix_hit_tokens=8)
+    tele.record_step("prefill_seeded", 0.01, rows=1, batch=2,
+                     tokens=8, padded_tokens=32)
+    tele.record_step("decode", 0.002, rows=1, batch=4, tokens=4,
+                     padded_tokens=32)
+    tele.record_step("verify", 0.002, rows=1, batch=4, tokens=3,
+                     padded_tokens=16, draft_tokens=4,
+                     accepted_tokens=2)
+    tele.gauge_queue(3, active=1)
+    tele.on_retire(1, new_tokens=8, finish_reason="eos")
+    tele.update_ledgers(
+        prefix_stats={"enabled": True, "hit_rate": 0.5},
+        spec_stats={"enabled": True, "acceptance_rate": 0.5,
+                    "draft_hit_rate": 0.25,
+                    "tokens_per_weight_pass": 2.0})
+    tele.record_error(RuntimeError("boom"))
+    emitted = (set(m.counters) | set(m.gauges) | set(m.histograms))
+    assert emitted == set(ENGINE_METRICS), (
+        f"registry drift: only-in-code {emitted - set(ENGINE_METRICS)}, "
+        f"only-in-registry {set(ENGINE_METRICS) - emitted}")
+    # and the TYPE of each emitted series matches its declaration
+    for name, (typ, _labels, _help) in ENGINE_METRICS.items():
+        store = {"counter": m.counters, "gauge": m.gauges,
+                 "histogram": m.histograms}[typ]
+        assert name in store, (name, typ)
 
 
 def test_gateway_metrics_exposes_bus_gauges():

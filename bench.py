@@ -110,6 +110,31 @@ PRESETS = {
                      "BENCH_NEW_TOKENS": "384",
                      "BENCH_DECODE_WINDOW": "32",
                      "BENCH_WINDOWS_PER_DISPATCH": "1"},
+    # SLO-aware scheduler (engine/scheduler.py): adversarial mixed
+    # traffic — long batch-lane prompts (the ITL killers), short
+    # interactive chats from a second tenant, and embed bursts riding
+    # the same host loop. The artifact runs the SAME mix twice —
+    # scheduler ON (chunked prefill + DRR + shedding) and OFF (FIFO) —
+    # and records TTFT p99 / ITL p95 against the declared SLO bounds
+    # both ways, plus shed_rate and fairness_jain_index; greedy
+    # per-request outputs must be bit-identical between the arms —
+    # which requires kv_dtype == compute dtype: a chunk continuation
+    # re-reads earlier chunks' KV FROM the cache, so an fp8 cache
+    # would perturb the long prompts' logits vs the monolithic wave
+    # (same argument as prefix-cache seeding; docs/SCHEDULER.md).
+    "mixed_traffic": {"BENCH_MAX_LEN": "1024", "BENCH_SLOTS": "32",
+                      "BENCH_KV_DTYPE": "bfloat16",
+                      "BENCH_NEW_TOKENS": "64",
+                      "BENCH_DECODE_WINDOW": "8",
+                      "BENCH_WINDOWS_PER_DISPATCH": "1",
+                      "BENCH_MIX_CHAT": "48",
+                      "BENCH_MIX_CHAT_LEN": "96",
+                      "BENCH_MIX_LONG": "12",
+                      "BENCH_MIX_LONG_LEN": "832",
+                      "BENCH_MIX_EMBED_TEXTS": "192",
+                      "BENCH_CHUNK_TOKENS": "128",
+                      "BENCH_TTFT_SLO": "2.0",
+                      "BENCH_ITL_SLO": "0.25"},
 }
 
 
@@ -125,6 +150,11 @@ PRESET_CONTRACT_MODULES = {
     # (donation alias, kv-layout group, draft-length bucket coverage)
     "spec_decode": ["copilot_for_consensus_tpu.engine.generation"],
     "decode_heavy": ["copilot_for_consensus_tpu.engine.generation"],
+    # the scheduler contract traces the chunked-prefill continuation
+    # dispatch (donation alias, engine.generation-kv layout group,
+    # chunk-width bucket coverage)
+    "mixed_traffic": ["copilot_for_consensus_tpu.engine.generation",
+                      "copilot_for_consensus_tpu.engine.scheduler"],
 }
 
 
@@ -167,6 +197,19 @@ def spec_columns(ss0: dict, ss1: dict) -> dict:
     }
 
 
+def sched_columns(summary: dict, sched_stats: dict) -> dict:
+    """mixed_traffic columns: the SLO latencies from the engine's own
+    telemetry summary plus the scheduler's shed/fairness ledger —
+    exactly the four numbers ISSUE 6 gates on."""
+    return {
+        "ttft_p99_s": summary.get("ttft_p99_s", 0.0),
+        "itl_p95_s": summary.get("itl_p95_s", 0.0),
+        "shed_rate": round(sched_stats.get("shed_rate", 0.0), 4),
+        "fairness_jain_index": sched_stats.get("fairness_jain_index",
+                                               1.0),
+    }
+
+
 def telemetry_columns(eng, last_n: int | None = None) -> dict:
     """Flight-recorder latency columns (engine/telemetry.py), sourced
     from the engine's OWN request spans and step records instead of
@@ -184,6 +227,7 @@ def telemetry_columns(eng, last_n: int | None = None) -> dict:
         "ttft_p95_s": s["ttft_p95_s"],
         "ttft_p99_s": s["ttft_p99_s"],
         "itl_mean_s": s["itl_mean_s"],
+        "itl_p95_s": s["itl_p95_s"],
         "mean_occupancy": s["mean_occupancy"],
     }
 
@@ -411,10 +455,213 @@ def extra_rows() -> list[dict]:
     return out
 
 
+# -- mixed-traffic SLO gate (engine/scheduler.py) -----------------------
+
+def mixed_traffic_headline() -> dict:
+    """Adversarial mixed-traffic gate for the SLO-aware scheduler.
+
+    The mix: every long batch-lane prompt arrives BEFORE the first
+    chat (FIFO's worst case — the monolithic prefill waves stall every
+    decode window), short interactive chats from a second tenant
+    trickle in over the first steps, and an embed burst contends for
+    the host loop mid-run. The same scripted arrivals run twice —
+    scheduler ON (chunked prefill + weighted DRR + shedding) and OFF
+    (FIFO) — and the artifact records TTFT p99 / ITL p95 against the
+    declared SLO bounds for BOTH arms, plus shed_rate and
+    fairness_jain_index for the scheduler arm. Greedy per-request
+    outputs must be bit-identical between arms for every request that
+    completed in both (ordering may change; token streams may not)."""
+    import jax  # noqa: F401  (device availability probe ran already)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from copilot_for_consensus_tpu.engine.embedding import EmbeddingEngine
+    from copilot_for_consensus_tpu.engine.generation import GenerationEngine
+    from copilot_for_consensus_tpu.engine.scheduler import (
+        EngineOverloaded,
+        SchedulerConfig,
+    )
+    from copilot_for_consensus_tpu.models import decoder_config
+    from copilot_for_consensus_tpu.models.configs import encoder_config
+
+    preset_vals = PRESETS["mixed_traffic"]
+
+    def knob(name: str, default: str) -> str:
+        return os.environ.get(name, preset_vals.get(name, default))
+
+    model = knob("BENCH_MODEL", "mistral-7b")
+    slots = int(knob("BENCH_SLOTS", "32"))
+    max_len = int(knob("BENCH_MAX_LEN", "1024"))
+    new_tokens = int(knob("BENCH_NEW_TOKENS", "64"))
+    window = int(knob("BENCH_DECODE_WINDOW", "8"))
+    n_chat = int(knob("BENCH_MIX_CHAT", "48"))
+    chat_len = int(knob("BENCH_MIX_CHAT_LEN", "96"))
+    n_long = int(knob("BENCH_MIX_LONG", "12"))
+    long_len = int(knob("BENCH_MIX_LONG_LEN", "832"))
+    n_embed = int(knob("BENCH_MIX_EMBED_TEXTS", "192"))
+    chunk_tokens = int(knob("BENCH_CHUNK_TOKENS", "128"))
+    ttft_slo = float(knob("BENCH_TTFT_SLO", "2.0"))
+    itl_slo = float(knob("BENCH_ITL_SLO", "0.25"))
+    kv_name = knob("BENCH_KV_DTYPE", "float8_e4m3fn")
+    wq = knob("BENCH_WEIGHT_DTYPE", "int8")
+    quantize = (False if knob("BENCH_QUANTIZE", "1") != "1" else wq)
+
+    cfg = decoder_config(model)
+    rng = np.random.default_rng(0)
+    # Scripted arrivals: (step, script_idx, tenant, priority, prompt).
+    # All long prompts land at step 0 — ahead of every chat.
+    script = []
+    for i in range(n_long):
+        script.append((0, i, "analytics", "batch", rng.integers(
+            3, cfg.vocab_size, size=long_len).tolist()))
+    for i in range(n_chat):
+        script.append((1 + i // 8, n_long + i, "chat", "interactive",
+                       rng.integers(3, cfg.vocab_size,
+                                    size=chat_len).tolist()))
+    embed_texts = [f"mixed traffic embed text {i} corpus chunk " * 4
+                   for i in range(n_embed)]
+
+    def run_arm(sched_on: bool) -> dict:
+        sched = None
+        if sched_on:
+            sched = SchedulerConfig(
+                chunk_tokens=chunk_tokens,
+                prefill_wave_tokens=4 * chunk_tokens,
+                quantum_tokens=chunk_tokens,
+                tenant_weights={"chat": 2.0, "analytics": 1.0},
+                max_queue_depth=48, batch_shed_depth=32,
+                ttft_p99_slo_s=4 * ttft_slo,
+                queue_wait_p95_slo_s=2 * ttft_slo)
+        buckets = tuple(sorted({chat_len, chunk_tokens, long_len}))
+        eng = GenerationEngine(
+            cfg, num_slots=slots, max_len=max_len,
+            prefill_buckets=buckets, dtype=jnp.bfloat16,
+            kv_dtype=kv_name, seed=0, quantize=quantize,
+            decode_window=window, windows_per_dispatch=1,
+            scheduler=sched, telemetry=True)
+        emb_model = knob("BENCH_EMBED_MODEL",
+                         "tiny" if model == "tiny" else "minilm-l6")
+        emb = EmbeddingEngine(encoder_config(emb_model), batch_size=32,
+                              scheduler=eng._sched if sched_on
+                              else None)
+        # Warmup: compile the steady-state programs (admission buckets,
+        # chunk widths, decode kv extents, embed tiles) OUTSIDE the
+        # measured window — the timed TTFT/ITL percentiles must measure
+        # scheduling, not XLA compiles.
+        warm_ids = set()
+        for plen, tenant, prio in ((long_len, "analytics", "batch"),
+                                   (chat_len, "chat", "interactive")):
+            warm_ids.add(eng.submit(
+                rng.integers(3, cfg.vocab_size, size=plen).tolist(),
+                new_tokens, tenant=tenant, priority=prio))
+        drained = set()
+        while drained < warm_ids:
+            drained |= {c.request_id for c in eng.step()}
+        emb.embed_batch(embed_texts[:4], tenant="ingest")
+        fair0 = dict(eng._sched.fairness_snapshot()) if sched_on else {}
+        outputs: dict[int, list[int]] = {}
+        done = shed = 0
+        rid_to_idx: dict[int, int] = {}
+        pending = sorted(script)
+        step_idx = 0
+        embed_done = False
+        t0 = time.monotonic()
+        while done + shed < len(script) and step_idx < 100000:
+            while pending and pending[0][0] <= step_idx:
+                _, sidx, tenant, prio, prompt = pending.pop(0)
+                try:
+                    rid = eng.submit(prompt, new_tokens, tenant=tenant,
+                                     priority=prio)
+                    rid_to_idx[rid] = sidx
+                except EngineOverloaded:
+                    shed += 1
+            if not embed_done and step_idx == 4:
+                try:
+                    emb.embed_batch(embed_texts, tenant="ingest")
+                except EngineOverloaded:
+                    pass
+                embed_done = True
+            for c in eng.step():
+                outputs[rid_to_idx[c.request_id]] = c.tokens
+                done += 1
+            step_idx += 1
+        elapsed = max(1e-6, time.monotonic() - t0)
+        total_new = sum(len(t) for t in outputs.values())
+        # Fairness over the TIMED window only (warmup ran under the
+        # anonymous tenant mix), shed rate over the scripted arrivals.
+        sched_stats = dict(eng.sched_stats())
+        if sched_on:
+            from copilot_for_consensus_tpu.engine.scheduler import (
+                jain_index,
+            )
+            fair1 = eng._sched.fairness_snapshot()
+            deltas = [v - fair0.get(t, 0.0) for t, v in fair1.items()
+                      if v - fair0.get(t, 0.0) > 0]
+            sched_stats["fairness_jain_index"] = round(
+                jain_index(deltas), 4)
+            sched_stats["shed_rate"] = round(
+                shed / max(1, done + shed), 4)
+        return {
+            "tok_s": total_new / elapsed,
+            "completed": done,
+            "outputs": outputs,
+            "summary": eng.telemetry.latency_summary(last_n=done),
+            "sched": sched_stats,
+        }
+
+    log("mixed_traffic: scheduler ON arm")
+    on = run_arm(True)
+    log("mixed_traffic: scheduler OFF arm (FIFO)")
+    off = run_arm(False)
+    common = set(on["outputs"]) & set(off["outputs"])
+    bit_identical = all(on["outputs"][k] == off["outputs"][k]
+                        for k in common)
+
+    def slo_ok(summary: dict) -> bool:
+        return (summary["ttft_p99_s"] <= ttft_slo
+                and summary["itl_p95_s"] <= itl_slo)
+
+    cols = sched_columns(on["summary"], on["sched"])
+    log(f"mixed_traffic: ON  ttft_p99 {on['summary']['ttft_p99_s']}s "
+        f"itl_p95 {on['summary']['itl_p95_s']}s "
+        f"shed_rate {cols['shed_rate']} "
+        f"jain {cols['fairness_jain_index']}")
+    log(f"mixed_traffic: OFF ttft_p99 {off['summary']['ttft_p99_s']}s "
+        f"itl_p95 {off['summary']['itl_p95_s']}s; "
+        f"bit-identical over {len(common)} common requests: "
+        f"{bit_identical}")
+    return {
+        "metric": f"{model} mixed-traffic serving under SLO "
+                  f"(scheduler on, {slots} slots, {n_long} long + "
+                  f"{n_chat} chat + {n_embed}-text embed burst)",
+        "value": round(on["tok_s"], 2),
+        "unit": "tok/s",
+        "vs_baseline": round(on["tok_s"] / BASELINE_TOK_S, 3),
+        **cols,
+        "slo": {"ttft_p99_s": ttft_slo, "itl_p95_s": itl_slo},
+        "slo_ok_sched_on": slo_ok(on["summary"]),
+        "slo_ok_sched_off": slo_ok(off["summary"]),
+        "sched_off": {
+            "ttft_p99_s": off["summary"]["ttft_p99_s"],
+            "itl_p95_s": off["summary"]["itl_p95_s"],
+            "tok_s": round(off["tok_s"], 2),
+        },
+        "bit_identical_greedy": bit_identical,
+        "completed_on": on["completed"],
+        "completed_off": off["completed"],
+        "chunk_dispatches": on["sched"].get("chunk_dispatches", 0),
+    }
+
+
 # -- headline -----------------------------------------------------------
 
 def headline() -> dict:
     import jax
+
+    if os.environ.get("BENCH_PRESET", "") == "mixed_traffic":
+        # The scheduler gate is a two-arm scripted-arrival run, not a
+        # generate()-to-completion throughput shape.
+        return mixed_traffic_headline()
 
     # Preset values fill in behind explicit env vars WITHOUT mutating
     # os.environ — extra_rows() children inherit this process's env, so

@@ -68,6 +68,7 @@ CONTRACT_MODULES = (
     "copilot_for_consensus_tpu.parallel.pipeline",
     "copilot_for_consensus_tpu.engine.generation",
     "copilot_for_consensus_tpu.engine.prefix_cache",
+    "copilot_for_consensus_tpu.engine.scheduler",
     "copilot_for_consensus_tpu.engine.longctx",
     "copilot_for_consensus_tpu.vectorstore.tpu",
 )

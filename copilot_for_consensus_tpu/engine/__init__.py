@@ -6,6 +6,12 @@ engine in the role of Ollama / llama.cpp, and a cross-text-batching
 embedding engine in the role of sentence-transformers.
 """
 
+from copilot_for_consensus_tpu.engine.scheduler import (
+    EngineOverloaded,
+    Scheduler,
+    SchedulerConfig,
+    jain_index,
+)
 from copilot_for_consensus_tpu.engine.telemetry import (
     EngineTelemetry,
     FlightRecorder,
@@ -28,4 +34,8 @@ __all__ = [
     "FlightRecorder",
     "RequestTrace",
     "StepRecord",
+    "EngineOverloaded",
+    "Scheduler",
+    "SchedulerConfig",
+    "jain_index",
 ]

@@ -149,22 +149,46 @@ class AsyncEngineRunner:
     def submit(self, prompt: list[int],
                max_new_tokens: int = 256, *,
                cache_eligible_tokens: int | None = None,
-               correlation_id: str = "") -> Handle:
+               correlation_id: str = "", tenant: str = "",
+               priority: str = "") -> Handle:
         """Thread-safe enqueue; returns a waitable handle.
         ``cache_eligible_tokens`` plumbs through to
         ``GenerationEngine.submit`` (prefix-cache publish cap);
-        ``correlation_id`` tags the request's telemetry span."""
+        ``correlation_id`` tags the request's telemetry span;
+        ``tenant``/``priority`` feed the engine's scheduler when one is
+        configured.
+
+        Load shedding happens HERE, synchronously: an overloaded
+        scheduler raises ``EngineOverloaded`` on the caller's thread
+        (so the service can answer 429 + Retry-After immediately)
+        instead of handing back a handle doomed to fail a dispatch
+        cycle later. The engine's own submit re-checks on the
+        dispatcher thread — this pre-check reads only the scheduler's
+        shed state, which is GIL-safe counter reads."""
         if self._thread is None:
             raise RuntimeError("runner not started")
+        sched = getattr(self.engine, "_sched", None)
+        if sched is not None:
+            sched.check_admission(
+                tenant=tenant, priority=priority or "interactive",
+                prompt_tokens=len(prompt),
+                correlation_id=correlation_id)
         h = Handle()
+        kw: dict = {}
+        if cache_eligible_tokens is not None:
+            kw["cache_eligible_tokens"] = cache_eligible_tokens
+        if correlation_id:
+            kw["correlation_id"] = correlation_id
+        if tenant:
+            kw["tenant"] = tenant
+        if priority:
+            kw["priority"] = priority
         with self._work:
             if self._stop:
                 # a submit racing stop() must not enqueue a handle the
                 # (exiting) dispatcher will never resolve
                 raise RuntimeError("runner stopped")
-            self._pending.append((prompt, max_new_tokens,
-                                  cache_eligible_tokens,
-                                  correlation_id, h))
+            self._pending.append((prompt, max_new_tokens, kw, h))
             self._work.notify()
         return h
 
@@ -175,20 +199,34 @@ class AsyncEngineRunner:
 
     # -- dispatcher side ------------------------------------------------
 
+    @staticmethod
+    def _engine_idle(eng) -> bool:
+        """No work anywhere in the engine: active slots, engine queue,
+        piggyback feed, AND (scheduler engines) the scheduler's tenant
+        queues / chunked-prefill streams — a request parked in a tenant
+        queue still needs step() calls to ever be released."""
+        if eng._active or eng._queue or getattr(eng, "_prefilling",
+                                                None):
+            return False
+        if getattr(eng, "_chunking", None) \
+                or getattr(eng, "_chunk_pending", None):
+            return False
+        sched = getattr(eng, "_sched", None)
+        return sched is None or sched.queued == 0
+
     def _loop(self) -> None:
         eng = self.engine
         while True:
             with self._work:
                 while (not self._stop and not self._pending
-                       and not eng._active and not eng._queue
-                       and not getattr(eng, "_prefilling", None)):
+                       and self._engine_idle(eng)):
                     self._work.wait(timeout=0.1)
                 if self._stop:
                     # Fail every outstanding handle promptly — a caller
                     # blocked in result() must not sit out its full
                     # timeout just because the runner was stopped.
                     exc = RuntimeError("runner stopped")
-                    for _, _, _, _, h in self._pending:
+                    for *_rest, h in self._pending:
                         h._fail(exc)
                     for h in self._handles.values():
                         h._fail(exc)
@@ -202,15 +240,14 @@ class AsyncEngineRunner:
             # A bad request (e.g. empty prompt) fails ITS handle, not
             # the loop — an unhandled exception here would kill the
             # dispatcher and hang every outstanding and future handle.
-            for prompt, mnt, ce, corr, h in fresh:
+            # A scheduler shed (EngineOverloaded) fails the handle the
+            # same contained way: it is an ADMISSION outcome, so it
+            # must not trip the engine-failure path below (no flight-
+            # recorder dump, no error_reporter post-mortem).
+            for prompt, mnt, kw, h in fresh:
                 try:
                     # kwargs only when set: duck-typed engine stands-in
                     # (tests, shims) keep their 2-arg submit signature
-                    kw = {}
-                    if ce is not None:
-                        kw["cache_eligible_tokens"] = ce
-                    if corr:
-                        kw["correlation_id"] = corr
                     rid = eng.submit(prompt, mnt, **kw)
                 except Exception as exc:
                     h._fail(exc)

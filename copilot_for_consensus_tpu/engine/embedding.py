@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from copilot_for_consensus_tpu.engine.scheduler import resolve_scheduler
 from copilot_for_consensus_tpu.engine.telemetry import resolve_telemetry
 from copilot_for_consensus_tpu.engine.tokenizer import (
     HashWordTokenizer,
@@ -45,6 +46,7 @@ class EmbeddingEngine:
         dtype=jnp.bfloat16,
         attn_impl: str = "auto",
         telemetry: Any = True,
+        scheduler: Any = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -54,6 +56,16 @@ class EmbeddingEngine:
         # lifecycle, so spans stay on the generation side.
         self.telemetry = resolve_telemetry(telemetry, engine="embedding",
                                            num_slots=batch_size)
+        # SLO-aware scheduler (engine/scheduler.py): the embedding
+        # engine has no request queue, so the scheduler's role here is
+        # batch SIZING and burst shedding — oversized embed bursts get
+        # an honest EngineOverloaded (→ 429 / bus retry) and, under
+        # overload, encode tiles shrink so a burst yields the host
+        # loop between dispatches. Pass the GENERATION engine's
+        # Scheduler instance to close the loop across engines: embed
+        # bursts then back off exactly when chat traffic is hurting.
+        self.scheduler = resolve_scheduler(scheduler,
+                                           telemetry=self.telemetry)
         self.batch_size = batch_size
         self.buckets = tuple(sorted(set(
             min(b, cfg.max_positions) for b in buckets)))
@@ -122,10 +134,22 @@ class EmbeddingEngine:
         (``copilot_embedding/base.py:12-25``)."""
         return self.embed_batch([text])[0].tolist()
 
-    def embed_batch(self, texts: Sequence[str]) -> np.ndarray:
-        """[N] texts → [N, dim] fp32, L2-normalized. Order preserved."""
+    def embed_batch(self, texts: Sequence[str], *, tenant: str = "",
+                    correlation_id: str = "") -> np.ndarray:
+        """[N] texts → [N, dim] fp32, L2-normalized. Order preserved.
+
+        With a scheduler configured, the call is admission-checked
+        (oversized bursts shed with ``EngineOverloaded``) and the
+        per-dispatch tile rows come from ``Scheduler.embed_admit`` —
+        smaller under overload, so one burst cannot monopolize the
+        device while latency-sensitive traffic is suffering."""
         if not texts:
             return np.zeros((0, self.cfg.d_model), dtype=np.float32)
+        rows_cap = self.batch_size
+        if self.scheduler is not None:
+            rows_cap = self.scheduler.embed_admit(
+                len(texts), tenant=tenant, batch_size=self.batch_size,
+                correlation_id=correlation_id)
         max_bucket = self.buckets[-1]
         encoded: list[list[int]] = []
         for t in texts:
@@ -140,11 +164,21 @@ class EmbeddingEngine:
             by_bucket.setdefault(b, []).append(i)
 
         for bucket, idxs in by_bucket.items():
-            for start in range(0, len(idxs), self.batch_size):
-                group = idxs[start:start + self.batch_size]
+            for start in range(0, len(idxs), rows_cap):
+                group = idxs[start:start + rows_cap]
                 n = len(group)
-                tokens = np.zeros((self.batch_size, bucket), dtype=np.int32)
-                lengths = np.ones(self.batch_size, dtype=np.int32)
+                # Row count pads to the next power of two (bounds the
+                # compile-shape count at log2(batch_size) per bucket —
+                # the same discipline as the generation engine's
+                # admission wave), so a scheduler-shrunk tile really
+                # is a smaller program, not a full-width tile with
+                # more padding.
+                rows = 1
+                while rows < n:
+                    rows *= 2
+                rows = min(rows, self.batch_size)
+                tokens = np.zeros((rows, bucket), dtype=np.int32)
+                lengths = np.ones(rows, dtype=np.int32)
                 for row, i in enumerate(group):
                     ids = encoded[i]
                     tokens[row, :len(ids)] = ids
@@ -160,7 +194,7 @@ class EmbeddingEngine:
                 if self.telemetry is not None:
                     self.telemetry.record_step(
                         "embed", time.monotonic() - t0, seq=seq,
-                        rows=n, batch=self.batch_size,
+                        rows=n, batch=rows,
                         tokens=int(lengths[:n].sum()),
-                        padded_tokens=self.batch_size * bucket)
+                        padded_tokens=rows * bucket)
         return out

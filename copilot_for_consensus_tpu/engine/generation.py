@@ -57,6 +57,10 @@ from copilot_for_consensus_tpu.engine.sampling import (
     sample,
     verify_draft,
 )
+from copilot_for_consensus_tpu.engine.scheduler import (
+    jain_index,
+    resolve_scheduler,
+)
 from copilot_for_consensus_tpu.engine.telemetry import resolve_telemetry
 from copilot_for_consensus_tpu.engine.tokenizer import (
     NgramDraftIndex,
@@ -96,6 +100,11 @@ class Request:
     #: request's telemetry span and into flight-recorder dumps / error
     #: reports (engine/telemetry.py)
     correlation_id: str = ""
+    #: multi-tenant scheduling (engine/scheduler.py): the fairness key
+    #: ("" = the anonymous/default tenant) and the priority lane
+    #: (interactive > batch; batch sheds first under SLO pressure)
+    tenant: str = ""
+    priority: str = "interactive"
 
 
 @dataclass
@@ -191,6 +200,7 @@ class GenerationEngine:
         profile_dir: str | None = None,
         int4_pallas_max_extent: int | None = 1536,
         telemetry: Any = True,
+        scheduler: Any = None,
     ):
         self.profile_dir = profile_dir
         # Flight recorder + request-lifecycle spans + Prometheus export
@@ -695,6 +705,69 @@ class GenerationEngine:
         self._verify_fn = jax.jit(_verify, donate_argnums=(4,),
                                   static_argnames=("kv_len",))
 
+        # ---- SLO-aware scheduler (engine/scheduler.py) -----------------
+        # Admission policy owner: per-tenant weighted-DRR fairness with
+        # priority lanes, closed-loop load shedding over the telemetry
+        # signals, and CHUNKED PREFILL — prompts longer than the
+        # configured chunk size split across continuation dispatches
+        # co-scheduled with decode windows, so one long prompt costs
+        # many small ITL bumps instead of a monolithic admission stall.
+        # The continuation program below is the seeded-prefill path
+        # (PR 1) generalized: ``decoder.verify_seeded`` reads the
+        # slot's own partially-filled cache as the seeded prefix, the
+        # chunk's fresh KV scatters in at the per-row fill offset, and
+        # the FINAL chunk samples the first token from the last prompt
+        # position — bit-identical (greedy) to the monolithic wave when
+        # the cache dtype matches the compute dtype, same argument as
+        # the prefix cache. Design: docs/SCHEDULER.md.
+        self._sched = resolve_scheduler(scheduler,
+                                        telemetry=self.telemetry)
+        # Chunking rides prefill_attention_seeded, which (like spec
+        # decode) does not implement absolute-timeline window masking.
+        self._chunk_ok = (cfg.sliding_window == 0
+                          or cfg.sliding_window >= self.max_len)
+        ct = self.prompt_limit
+        if self._sched is not None:
+            ct = max(1, min(self._sched.cfg.chunk_tokens,
+                            self.prompt_limit))
+        #: static chunk-width bucket set — the retrace bound for the
+        #: continuation program, exactly like the verify dispatch's
+        #: draft-length buckets (shardcheck: scheduler-chunked-prefill)
+        self._chunk_buckets = tuple(sorted(
+            {min(b, ct) for b in self.buckets} | {ct}))
+        #: released long prompts waiting for a slot to start chunking
+        self._chunk_pending: list[Request] = []
+        #: slot → [request, tokens filled so far, chunk-start time]
+        self._chunking: dict[int, list] = {}
+        #: chunked-prefill accounting (sched_stats())
+        self.chunk_dispatches = 0
+        self.chunk_prefill_tokens = 0
+        self.chunk_s = 0.0
+
+        def _prefill_chunk(params, tokens, qlens, positions, cache, key,
+                           *, kv_len):
+            """One chunked-prefill continuation dispatch: every
+            chunking slot's next prompt chunk attends (its own cache
+            prefix ++ fresh causal chunk) in ONE weight pass, fresh KV
+            merges at the per-row fill offset, and each row samples a
+            candidate first token from its last fed position (the host
+            keeps it only for rows whose prompt completed this chunk).
+            Non-chunking rows park at position max_len: their fresh KV
+            drops in the merge and their logits are discarded — the
+            same park-OOB discipline as the verify dispatch."""
+            logits, k_new, v_new = decoder.verify_seeded(
+                params, tokens, qlens, positions, cfg, cache,
+                kv_len=kv_len)
+            cache = decoder.merge_window(cache, k_new, v_new, positions,
+                                         steps=tokens.shape[1])
+            last = jnp.take_along_axis(
+                logits, (qlens - 1)[:, None, None], axis=1)[:, 0]
+            first = sample(last, key, self.sampling)
+            return first, cache
+
+        self._chunk_fn = jax.jit(_prefill_chunk, donate_argnums=(4,),
+                                 static_argnames=("kv_len",))
+
         # ---- host-side slot state --------------------------------------
         self._free = list(range(num_slots))
         self._active: dict[int, Request] = {}          # slot → request
@@ -781,7 +854,8 @@ class GenerationEngine:
 
     def submit(self, prompt: list[int], max_new_tokens: int = 256, *,
                cache_eligible_tokens: int | None = None,
-               correlation_id: str = "") -> int:
+               correlation_id: str = "", tenant: str = "",
+               priority: str = "interactive") -> int:
         """Enqueue a tokenized prompt; returns a request id.
 
         ``cache_eligible_tokens`` caps how many leading prompt tokens
@@ -790,7 +864,12 @@ class GenerationEngine:
         publishes the whole block-aligned prompt prefix.
         ``correlation_id`` tags the request's telemetry span (and any
         flight-recorder dump / error report naming it) with the
-        pipeline event id that caused it."""
+        pipeline event id that caused it. ``tenant``/``priority`` feed
+        the scheduler's fairness/shedding policy when one is configured
+        — an overloaded scheduler raises :class:`EngineOverloaded`
+        HERE, at the door, instead of queueing work it cannot serve
+        within SLO (the service layer maps it to HTTP 429 +
+        Retry-After)."""
         if not prompt:
             raise ValueError("empty prompt")
         limit = self.prompt_limit
@@ -802,20 +881,41 @@ class GenerationEngine:
             # head no longer matches any cacheable span
             cache_eligible_tokens = 0 if cache_eligible_tokens \
                 is not None else None
+        if self._sched is not None:
+            self._sched.check_admission(
+                tenant=tenant, priority=priority,
+                prompt_tokens=len(prompt),
+                correlation_id=correlation_id)
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(Request(
+        req = Request(
             rid, list(prompt), max_new_tokens,
             cache_eligible_tokens=cache_eligible_tokens,
-            correlation_id=correlation_id))
+            correlation_id=correlation_id, tenant=tenant,
+            priority=priority)
+        if self._sched is not None:
+            self._sched.enqueue(req)
+        else:
+            self._queue.append(req)
         if self.telemetry is not None:
             self.telemetry.on_submit(rid, len(prompt), correlation_id)
         return rid
 
     def step(self) -> list[Completion]:
         """Admit queued requests into free slots, run one decode step for
-        all active slots, retire finished ones. Returns completions."""
+        all active slots, retire finished ones. Returns completions.
+
+        With a scheduler configured, admission is gated by it: the
+        closed loop observes this step's signals, at most one wave's
+        token budget is released (DRR order, interactive lane first),
+        long prompts advance by ONE chunk dispatch, and only then does
+        the decode window run — so the per-step prefill work, and with
+        it ITL, stays bounded regardless of prompt mix."""
+        if self._sched is not None:
+            self._sched_pump()
         self._admit()
+        if self._chunk_pending or self._chunking:
+            self._chunk_step()
         if self._active or self._prefilling:
             self._decode_once()
         if self.telemetry is not None:
@@ -906,9 +1006,41 @@ class GenerationEngine:
         }
         return out
 
+    def sched_stats(self) -> dict:
+        """Scheduler counters for benches/metrics (mirrors
+        ``prefix_stats``/``spec_stats``). ``shed_rate`` is over all
+        admission attempts; ``fairness_jain_index`` is Jain's index
+        over per-tenant admitted tokens normalized by DRR weight (1.0
+        = perfectly weighted-fair)."""
+        out = {
+            "enabled": self._sched is not None,
+            "chunk_dispatches": self.chunk_dispatches,
+            "chunk_prefill_tokens": self.chunk_prefill_tokens,
+        }
+        if self._sched is None:
+            return out
+        s = self._sched
+        attempts = s.shed_total + s.submitted_total
+        fairness = s.fairness_snapshot()
+        out.update({
+            "submitted": s.submitted_total,
+            "shed": s.shed_total,
+            "shed_rate": s.shed_total / attempts if attempts else 0.0,
+            "overload_level": s.overload_level,
+            "fairness": {t: round(v, 1) for t, v in fairness.items()},
+            "fairness_jain_index": round(
+                jain_index(fairness.values()), 4),
+            "signals": dict(s.last_signals),
+        })
+        return out
+
     @property
     def queue_depth(self) -> int:
-        return len(self._queue) + len(self._prefilling)
+        n = (len(self._queue) + len(self._prefilling)
+             + len(self._chunk_pending) + len(self._chunking))
+        if self._sched is not None:
+            n += self._sched.queued
+        return n
 
     @property
     def active_count(self) -> int:
@@ -1130,6 +1262,11 @@ class GenerationEngine:
         # pack into one dispatch), so only active decode positions
         # constrain the extent
         hi = max([int(self._positions[s]) for s in self._active] + [0])
+        return self._kv_extent(hi)
+
+    def _kv_extent(self, hi: int) -> int:
+        """Bucket an occupied-prefix extent to the 128-aligned static
+        set (shared by the decode and chunked-prefill dispatches)."""
         if hi == 0:
             return min(128, self.max_len)
         bucket = min(-(-(hi + 1) // 128) * 128, self.max_len)
@@ -1141,6 +1278,143 @@ class GenerationEngine:
         if bucket * 8 >= self.max_len * 7:
             return self.max_len
         return bucket
+
+    # ------------------------------------------------------------------
+    # SLO-aware scheduling (engine/scheduler.py)
+    # ------------------------------------------------------------------
+
+    def _sched_cost(self, req: Request) -> int:
+        """What this request will actually prefill: its prompt minus
+        the prefix-cache match — the DRR charge AND the chunk-vs-wave
+        routing size, so cached prompts cost their suffix."""
+        if self._prefix is None:
+            return len(req.prompt)
+        return max(1, len(req.prompt) - self._prefix.match_tokens(
+            req.prompt, digests=self._req_digests(req)))
+
+    def _placement_key(self, req: Request):
+        """Prefix-cache-aware placement key: the first radix block
+        digest. Requests sharing it open with the same block-aligned
+        span, so co-scheduling them into one wave makes the whole wave
+        ride the seeded path (or publish one shared prefix)."""
+        if self._prefix is None:
+            return None
+        digs = self._req_digests(req)
+        return digs[0] if digs else None
+
+    def _sched_pump(self) -> None:
+        """One scheduler turn: feed the closed loop, release at most
+        one wave's token budget of requests (DRR order), route
+        long-prompt cache misses to the chunked-prefill path."""
+        sched = self._sched
+        sched.observe(queued=self.queue_depth,
+                      active=len(self._active),
+                      num_slots=self.num_slots,
+                      telemetry=self.telemetry)
+        staged = (len(self._queue) + len(self._prefilling)
+                  + len(self._chunk_pending))
+        room = len(self._free) - staged
+        if room <= 0:
+            return
+        reqs = sched.select(max_requests=room,
+                            token_budget=sched.cfg.prefill_wave_tokens,
+                            cost_fn=self._sched_cost,
+                            placement_key=self._placement_key)
+        ct = sched.cfg.chunk_tokens
+        for req in reqs:
+            # Prefix-cache hits keep the seeded wave (the pool gather
+            # and the chunk continuation cannot share one program);
+            # long cache-miss prompts chunk. A hit shows as suffix
+            # cost < prompt length — no extra radix walk (the digests
+            # are memoized on the Request, but the walk isn't free).
+            cost = self._sched_cost(req)
+            if self._chunk_ok and cost >= len(req.prompt) \
+                    and len(req.prompt) > ct:
+                self._chunk_pending.append(req)
+            else:
+                self._queue.append(req)
+
+    def _chunk_step(self) -> None:
+        """One chunked-prefill continuation dispatch: every chunking
+        slot advances by at most one chunk-bucket of prompt tokens;
+        rows whose prompt completes activate into decode with their
+        first token (sampled in-program from the last prompt
+        position). Free/active rows park OOB and drop."""
+        while self._chunk_pending and self._free:
+            req = self._chunk_pending.pop(0)
+            slot = self._free.pop(0)
+            self._chunking[slot] = [req, 0, time.monotonic()]
+        if not self._chunking:
+            return
+        t0 = time.monotonic()
+        ct = self._chunk_buckets[-1]
+        rem_max = max(len(req.prompt) - filled
+                      for req, filled, _ in self._chunking.values())
+        width = _next_bucket(min(rem_max, ct), self._chunk_buckets)
+        tokens = np.zeros((self.num_slots, width), dtype=np.int32)
+        qlens = np.ones((self.num_slots,), dtype=np.int32)
+        positions = np.full((self.num_slots,), self.max_len,
+                            dtype=np.int32)
+        fed: dict[int, int] = {}
+        hi = 0
+        for slot, (req, filled, _started) in self._chunking.items():
+            n = min(len(req.prompt) - filled, width)
+            tokens[slot, :n] = req.prompt[filled:filled + n]
+            qlens[slot] = n
+            positions[slot] = filled
+            fed[slot] = n
+            hi = max(hi, filled)
+        self._key, sub = jax.random.split(self._key)
+        seq = self.telemetry.next_step() if self.telemetry is not None \
+            else None
+        with step_annotation("prefill_chunk", seq):
+            with quant.pallas_qmatmul_override(
+                    self._decode_pallas_override):
+                first_dev, self._cache = self._chunk_fn(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray(qlens),
+                    jnp.asarray(positions),
+                    self._cache,
+                    sub,
+                    kv_len=self._kv_extent(hi),
+                )
+            first = _host_fetch(first_dev)
+        step_s = time.monotonic() - t0
+        self.chunk_s += step_s
+        self.chunk_dispatches += 1
+        now = time.monotonic()
+        rows = len(fed)
+        for slot in list(self._chunking):
+            entry = self._chunking[slot]
+            req, _filled, started = entry
+            entry[1] += fed[slot]
+            self.prefill_tokens += fed[slot]
+            self.chunk_prefill_tokens += fed[slot]
+            if entry[1] < len(req.prompt):
+                continue
+            del self._chunking[slot]
+            tok = int(first[slot])
+            if self.telemetry is not None:
+                self.telemetry.on_admit(req.request_id,
+                                        wave_start=started,
+                                        admit_kind="chunked")
+            self._active[slot] = req
+            self._generated[slot] = [tok]
+            self._spec_track(slot, req, tok)
+            self._positions[slot] = len(req.prompt)
+            self._next_tok[slot] = tok
+            self._t_prefill[slot] = now - started
+            req.decode_started_at = now
+            if tok in self._eos_set or req.max_new_tokens <= 1:
+                self._retire(slot,
+                             "eos" if tok in self._eos_set else "length")
+        if self.telemetry is not None:
+            self.telemetry.record_step(
+                "prefill_chunk", step_s, seq=seq, rows=rows,
+                batch=self.num_slots, tokens=sum(fed.values()),
+                padded_tokens=self.num_slots * width)
+            self.telemetry.on_prefill_chunks(rows)
 
     def _decode_once(self) -> None:
         window = self._dispatch_steps

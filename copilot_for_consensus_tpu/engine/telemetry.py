@@ -123,11 +123,27 @@ METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "gauge", ("engine",),
         "Per-stream decode ledger across plain and verify paths; 1.0 "
         "is the vanilla decode wall."),
+    # ---- SLO-aware scheduler (engine/scheduler.py) ----
+    "engine_sched_tenant_queue_depth": (
+        "gauge", ("engine", "tenant"),
+        "Requests queued in the scheduler, per tenant."),
+    "engine_sched_deficit": (
+        "gauge", ("engine", "tenant"),
+        "Weighted-DRR deficit (prompt tokens the tenant may release), "
+        "per tenant."),
+    "engine_sched_shed_total": (
+        "counter", ("engine", "tenant", "priority"),
+        "Requests shed with a structured EngineOverloaded rejection "
+        "(surfaced as HTTP 429 + Retry-After at the edge)."),
+    "engine_sched_prefill_chunks_total": (
+        "counter", ("engine",),
+        "Chunked-prefill continuation rows dispatched (long prompts "
+        "split across decode steps to bound ITL)."),
 }
 
 #: step-record kinds the engines emit (doc + test anchor)
-STEP_KINDS = ("prefill", "prefill_seeded", "decode", "verify",
-              "piggyback", "embed")
+STEP_KINDS = ("prefill", "prefill_seeded", "prefill_chunk", "decode",
+              "verify", "piggyback", "embed")
 
 
 def prometheus_series(namespace: str = "copilot") -> dict[str, str]:
@@ -407,6 +423,31 @@ class EngineTelemetry:
             m.gauge("engine_slot_occupancy",
                     active / self.num_slots, lb)
 
+    # -- scheduler (engine/scheduler.py) --------------------------------
+
+    def sched_gauges(self, tenant_depths: dict[str, int],
+                     deficits: dict[str, float] | None = None) -> None:
+        """Per-tenant scheduler state → gauges. Tenant label defaults
+        to "default" for the anonymous tenant so the series is always
+        well-formed."""
+        m, lb = self.metrics, self._labels
+        for tenant, depth in tenant_depths.items():
+            m.gauge("engine_sched_tenant_queue_depth", float(depth),
+                    {**lb, "tenant": tenant or "default"})
+        for tenant, d in (deficits or {}).items():
+            m.gauge("engine_sched_deficit", float(d),
+                    {**lb, "tenant": tenant or "default"})
+
+    def on_shed(self, tenant: str, priority: str) -> None:
+        self.metrics.increment(
+            "engine_sched_shed_total", 1.0,
+            {**self._labels, "tenant": tenant or "default",
+             "priority": priority or "batch"})
+
+    def on_prefill_chunks(self, rows: int = 1) -> None:
+        self.metrics.increment("engine_sched_prefill_chunks_total",
+                               float(rows), self._labels)
+
     def update_ledgers(self, prefix_stats: dict | None = None,
                        spec_stats: dict | None = None) -> None:
         """Export the engine's existing ledgers (prefix_stats /
@@ -443,7 +484,7 @@ class EngineTelemetry:
         if last_n is not None:
             traces = traces[-last_n:]
         ttfts = sorted(t.ttft_s for t in traces)
-        itls = [t.itl_s for t in traces if t.new_tokens > 1]
+        itls = sorted(t.itl_s for t in traces if t.new_tokens > 1)
 
         def pct(sorted_vals: list[float], q: float) -> float:
             if not sorted_vals:
@@ -471,6 +512,7 @@ class EngineTelemetry:
             "ttft_p99_s": round(pct(ttfts, 0.99), 6),
             "itl_mean_s": round(sum(itls) / len(itls), 6) if itls
             else 0.0,
+            "itl_p95_s": round(pct(itls, 0.95), 6),
             "mean_occupancy": round(occ, 4),
         }
 

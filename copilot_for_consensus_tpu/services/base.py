@@ -26,6 +26,24 @@ from copilot_for_consensus_tpu.obs.metrics import (
 from copilot_for_consensus_tpu.storage.base import DocumentStore
 
 
+def accepts_kwargs(fn: Callable, names: tuple[str, ...]) -> set[str]:
+    """Which of ``names`` can be passed to ``fn`` as keyword arguments
+    (explicitly or via ``**kwargs``). The services probe their
+    summarizer/provider capabilities ONCE with this at construction —
+    duck-typed stand-ins keep their short signatures and simply lose
+    the optional tags (correlation_id, tenant, ...)."""
+    import inspect
+
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):
+        return set()
+    var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                 for p in params)
+    have = {p.name for p in params}
+    return {n for n in names if var_kw or n in have}
+
+
 class BaseService:
     """Owns adapters; routes envelopes to ``on_<EventType>`` methods."""
 

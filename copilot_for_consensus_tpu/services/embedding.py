@@ -15,8 +15,12 @@ import time
 from datetime import datetime, timezone
 
 from copilot_for_consensus_tpu.core import events as ev
-from copilot_for_consensus_tpu.core.retry import DocumentNotFoundError
+from copilot_for_consensus_tpu.core.retry import (
+    DocumentNotFoundError,
+    RetryableError,
+)
 from copilot_for_consensus_tpu.embedding.base import EmbeddingProvider
+from copilot_for_consensus_tpu.engine.scheduler import EngineOverloaded
 from copilot_for_consensus_tpu.services.base import BaseService
 from copilot_for_consensus_tpu.vectorstore.base import VectorStore
 
@@ -26,11 +30,25 @@ class EmbeddingService(BaseService):
     consumes = ("ChunksPrepared", "SourceDeletionRequested")
 
     def __init__(self, publisher, store, provider: EmbeddingProvider,
-                 vector_store: VectorStore, batch_size: int = 64, **kw):
+                 vector_store: VectorStore, batch_size: int = 64,
+                 tenant: str = "", **kw):
         super().__init__(publisher, store, **kw)
         self.provider = provider
         self.vector_store = vector_store
         self.batch_size = batch_size
+        # Multi-tenant scheduling (engine/scheduler.py): embed bursts
+        # carry this tenant key into the TPU provider's scheduler so
+        # they are sized/shed against latency-sensitive traffic.
+        # Capability probed once (services/base.py:accepts_kwargs) —
+        # duck-typed providers keep their 1-arg embed_batch and simply
+        # lose the tag.
+        from copilot_for_consensus_tpu.services.base import (
+            accepts_kwargs,
+        )
+
+        self.tenant = tenant
+        self._embed_takes_tenant = "tenant" in accepts_kwargs(
+            provider.embed_batch, ("tenant",))
         # Engine flight-recorder wiring: a TPU provider's embed-step
         # telemetry (engine/telemetry.py) exports into THIS service's
         # collector so it reaches the gateway /metrics scrape.
@@ -61,8 +79,19 @@ class EmbeddingService(BaseService):
         thread_ids: set[str] = set()
         for start in range(0, len(docs), self.batch_size):
             batch = docs[start:start + self.batch_size]
-            vectors = self.provider.embed_batch(
-                [d.get("text", "") for d in batch])
+            kw = {"tenant": self.tenant} \
+                if self._embed_takes_tenant and self.tenant else {}
+            try:
+                vectors = self.provider.embed_batch(
+                    [d.get("text", "") for d in batch], **kw)
+            except EngineOverloaded as exc:
+                # Scheduler shed the burst: transient backpressure, not
+                # a failure — the bus retry policy backs off and the
+                # already-embedded chunks in earlier batches stay
+                # flagged (idempotent replay skips them).
+                raise RetryableError(
+                    f"embedding engine overloaded ({exc.reason}), "
+                    f"retry after {exc.retry_after_s:.1f}s") from exc
             self.vector_store.add_embeddings(
                 (d["chunk_id"], vec, {
                     "thread_id": d.get("thread_id", ""),

@@ -10,12 +10,15 @@ HTTP server covers that surface with zero dependencies.
 from __future__ import annotations
 
 import json
+import math
 import re
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, unquote, urlparse
+
+from copilot_for_consensus_tpu.engine.scheduler import EngineOverloaded
 
 
 class HTTPError(Exception):
@@ -148,6 +151,18 @@ class Router:
                 return Response(out)
             except HTTPError as exc:
                 return Response({"error": exc.message}, status=exc.status)
+            except EngineOverloaded as exc:
+                # The scheduler's honest backpressure (engine/
+                # scheduler.py): a structured 429 with Retry-After —
+                # the drain estimate, not a constant — and the
+                # correlation id so the rejection joins the request's
+                # trace. NOT the 500 backstop: shedding is the system
+                # working as designed, and clients are expected to
+                # retry after the advertised delay.
+                return Response(
+                    exc.as_event_fields(), status=429,
+                    headers={"Retry-After":
+                             str(max(1, math.ceil(exc.retry_after_s)))})
             except Exception as exc:
                 # A handler bug must yield a 500 response, not a dropped
                 # connection (reference services respond through FastAPI's

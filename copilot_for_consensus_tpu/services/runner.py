@@ -324,7 +324,13 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
     chunking = ChunkingService(
         publisher(), store,
         chunker=TokenWindowChunker(**cfg.get("chunking", {})), **common)
+    # Scheduling identity (engine/scheduler.py): deployment config names
+    # the tenant/priority this pipeline's engine traffic runs under, so
+    # a multi-tenant serving deployment can weight/quota it (and shed it
+    # honestly) against interactive traffic.
+    tenancy = dict(cfg.get("tenancy") or {})
     embedding = EmbeddingService(publisher(), store, provider, vector_store,
+                                 tenant=str(tenancy.get("tenant", "")),
                                  **common)
     orch_cfg = cfg.get("orchestrator", {})
     orchestrator = OrchestrationService(
@@ -338,6 +344,8 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
     summarization = SummarizationService(
         publisher(), store, summarizer, consensus_detector=consensus,
         pipelined=bool(dict(cfg.get("llm") or {}).get("pipelined")),
+        tenant=str(tenancy.get("tenant", "")),
+        priority=str(tenancy.get("priority", "")),
         **common)
     reporting = ReportingService(
         publisher(), store,

@@ -22,6 +22,7 @@ from copilot_for_consensus_tpu.core.retry import (
     DocumentNotFoundError,
     RetryableError,
 )
+from copilot_for_consensus_tpu.engine.scheduler import EngineOverloaded
 from copilot_for_consensus_tpu.services.base import BaseService
 from copilot_for_consensus_tpu.summarization.base import (
     RateLimitError,
@@ -37,11 +38,19 @@ class SummarizationService(BaseService):
     def __init__(self, publisher, store, summarizer: Summarizer,
                  consensus_detector: ConsensusDetector | None = None,
                  context_window_tokens: int = 4096,
-                 pipelined: bool = False, **kw):
+                 pipelined: bool = False, tenant: str = "",
+                 priority: str = "", **kw):
         super().__init__(publisher, store, **kw)
         self.summarizer = summarizer
         self.consensus_detector = consensus_detector
         self.context_window_tokens = context_window_tokens
+        # Multi-tenant scheduling (engine/scheduler.py): this service
+        # instance's requests carry these keys into the engine's
+        # fairness/shedding policy. Deployment config decides — e.g.
+        # the pipeline's bulk re-summarization runs as a "batch"-lane
+        # tenant so interactive traffic preempts it.
+        self.tenant = tenant
+        self.priority = priority
         # Pipelined mode: events submit into the engine's continuous
         # batch and return immediately; a harvester thread runs the
         # store/publish tail when each generation lands. This is what
@@ -53,21 +62,18 @@ class SummarizationService(BaseService):
         # pipeline's existing recovery spine) instead of redelivery.
         self.pipelined = pipelined and hasattr(summarizer,
                                                "summarize_async")
-        # Capability probe ONCE, not per event: does summarize_async
-        # accept correlation_id (explicitly or via **kwargs)? Duck-typed
-        # stand-ins keep their 1-arg signature and simply lose the tag.
-        self._async_takes_corr = False
-        if self.pipelined:
-            import inspect
+        # Capability probe ONCE, not per event: which of the optional
+        # kwargs (correlation_id, tenant, priority) does
+        # summarize_async accept? (services/base.py:accepts_kwargs)
+        from copilot_for_consensus_tpu.services.base import (
+            accepts_kwargs,
+        )
 
-            try:
-                self._async_takes_corr = any(
-                    p.name == "correlation_id"
-                    or p.kind is inspect.Parameter.VAR_KEYWORD
-                    for p in inspect.signature(
-                        summarizer.summarize_async).parameters.values())
-            except (TypeError, ValueError):
-                pass
+        self._async_kwargs: set[str] = set()
+        if self.pipelined:
+            self._async_kwargs = accepts_kwargs(
+                summarizer.summarize_async,
+                ("correlation_id", "tenant", "priority"))
         # Engine flight-recorder wiring (engine/telemetry.py): the
         # engines' copilot_engine_* observations must land on THIS
         # service's collector — the one the gateway /metrics serves —
@@ -199,12 +205,27 @@ class SummarizationService(BaseService):
 
         t0 = time.monotonic()
         if self.pipelined:
-            # correlation_id reaches the engine's telemetry span when
-            # the summarizer accepts it (capability probed once at
-            # construction).
-            kw = {"correlation_id": correlation_id} \
-                if self._async_takes_corr else {}
-            wait = self.summarizer.summarize_async(context, **kw)
+            # correlation_id / tenant / priority reach the engine's
+            # telemetry span and scheduler when the summarizer accepts
+            # them (capabilities probed once at construction).
+            kw = {}
+            if "correlation_id" in self._async_kwargs:
+                kw["correlation_id"] = correlation_id
+            if self.tenant and "tenant" in self._async_kwargs:
+                kw["tenant"] = self.tenant
+            if self.priority and "priority" in self._async_kwargs:
+                kw["priority"] = self.priority
+            try:
+                wait = self.summarizer.summarize_async(context, **kw)
+            except EngineOverloaded as exc:
+                # The scheduler shed this request at the door — an
+                # ADMISSION outcome, not an engine failure: no error-
+                # reporter dump, just the bus retry policy backing off
+                # for the advertised drain time (the same contract as
+                # the reference's rate-limit handling below).
+                raise RetryableError(
+                    f"engine overloaded ({exc.reason}), retry after "
+                    f"{exc.retry_after_s:.1f}s") from exc
 
             def finalize(summary, _t0=t0, _tid=thread_id,
                          _sid=summary_id, _chunks=selected_chunks,
@@ -226,6 +247,12 @@ class SummarizationService(BaseService):
             # Let the retry policy back off (reference ``:367-402``).
             raise RetryableError(
                 f"rate limited, retry after {exc.retry_after_s}s") from exc
+        except EngineOverloaded as exc:
+            # Scheduler shed on the synchronous path: same backoff
+            # contract as a rate limit — transient, honest, retryable.
+            raise RetryableError(
+                f"engine overloaded ({exc.reason}), retry after "
+                f"{exc.retry_after_s:.1f}s") from exc
         latency = time.monotonic() - t0
         self._store_and_publish(summary, summary_id, thread_id,
                                 selected_chunks, context_selection,

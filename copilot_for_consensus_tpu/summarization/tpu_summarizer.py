@@ -79,7 +79,8 @@ class TPUSummarizer(Summarizer):
                  long_context: bool = False, kv_dtype: str | None = None,
                  quantize: bool | str = "int8",
                  cache_scope: str = "full",
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 tenant: str = "", priority: str = ""):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
             ByteTokenizer,
@@ -90,6 +91,10 @@ class TPUSummarizer(Summarizer):
         self.max_new_tokens = max_new_tokens
         self.template = template
         self.system = system
+        #: default scheduling identity for this summarizer's requests
+        #: (engine/scheduler.py); per-call kwargs override
+        self.tenant = tenant
+        self.priority = priority
         #: obs/errors.py reporter for engine dispatch failures — set by
         #: the owning service (SummarizationService wires its own); the
         #: lazily-built AsyncEngineRunner picks it up so an engine
@@ -214,12 +219,15 @@ class TPUSummarizer(Summarizer):
                 prompts, self.max_new_tokens,
                 cache_eligible_tokens=self._cache_eligible)
         handles = [runner.submit(p, self.max_new_tokens,
-                                 cache_eligible_tokens=self._cache_eligible)
+                                 cache_eligible_tokens=self._cache_eligible,
+                                 tenant=self.tenant,
+                                 priority=self.priority)
                    for p in prompts]
         return [h.result(timeout=600.0) for h in handles]
 
     def summarize_async(self, thread: ThreadContext, *,
-                        correlation_id: str = ""):
+                        correlation_id: str = "", tenant: str = "",
+                        priority: str = ""):
         """Submit one thread into the continuous batch WITHOUT waiting:
         returns a zero-arg callable that blocks for and returns the
         Summary. Many in-flight submissions share the decode batch —
@@ -230,7 +238,11 @@ class TPUSummarizer(Summarizer):
 
         ``correlation_id`` (the pipeline event id) tags the request's
         engine telemetry span, so a flight-recorder dump or engine
-        error report names the pipeline event, not just a slot."""
+        error report names the pipeline event, not just a slot.
+        ``tenant``/``priority`` (falling back to the summarizer's
+        defaults) feed the engine scheduler's fairness and shedding
+        policy; an overloaded scheduler raises ``EngineOverloaded``
+        HERE, synchronously, so the caller can back off honestly."""
         from copilot_for_consensus_tpu.engine.async_runner import (
             AsyncEngineRunner,
         )
@@ -263,7 +275,9 @@ class TPUSummarizer(Summarizer):
         handle = self._runner.submit(
             prompt, self.max_new_tokens,
             cache_eligible_tokens=self._cache_eligible,
-            correlation_id=correlation_id)
+            correlation_id=correlation_id,
+            tenant=tenant or self.tenant,
+            priority=priority or self.priority)
 
         def wait(timeout: float | None = 600.0) -> Summary:
             comp = handle.result(timeout)

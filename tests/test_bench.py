@@ -108,6 +108,40 @@ def test_preset_artifact_columns_unchanged():
     assert bench.spec_columns(ss0, ss0)["tokens_per_weight_pass"] == 0.0
 
 
+def test_mixed_traffic_preset_registered():
+    """The scheduler gate's preset (ISSUE 6): adversarial mix with at
+    least two tenants, contract-traced through BOTH the generation
+    engine and the scheduler module (the chunked-prefill dispatch)."""
+    assert "mixed_traffic" in bench.PRESETS
+    p = bench.PRESETS["mixed_traffic"]
+    assert int(p["BENCH_MIX_CHAT"]) > 0 and int(p["BENCH_MIX_LONG"]) > 0
+    # adversarial: the long prompts must actually be long enough to
+    # need chunking at the preset's chunk size
+    assert int(p["BENCH_MIX_LONG_LEN"]) > int(p["BENCH_CHUNK_TOKENS"])
+    mods = bench.PRESET_CONTRACT_MODULES["mixed_traffic"]
+    assert "copilot_for_consensus_tpu.engine.generation" in mods
+    assert "copilot_for_consensus_tpu.engine.scheduler" in mods
+
+
+def test_sched_columns_contract():
+    """The mixed_traffic artifact columns are a cross-round contract:
+    ttft_p99_s / itl_p95_s / shed_rate / fairness_jain_index."""
+    summary = {"ttft_p99_s": 1.25, "itl_p95_s": 0.08,
+               "ttft_p50_s": 0.2}
+    stats = {"shed_rate": 0.125, "fairness_jain_index": 0.96,
+             "chunk_dispatches": 7}
+    cols = bench.sched_columns(summary, stats)
+    assert set(cols) == {"ttft_p99_s", "itl_p95_s", "shed_rate",
+                         "fairness_jain_index"}
+    assert cols["ttft_p99_s"] == 1.25
+    assert cols["shed_rate"] == 0.125
+    assert cols["fairness_jain_index"] == 0.96
+    # empty stats degrade to the no-scheduler defaults, not KeyErrors
+    empty = bench.sched_columns({}, {})
+    assert empty["shed_rate"] == 0.0
+    assert empty["fairness_jain_index"] == 1.0
+
+
 def test_telemetry_columns_contract():
     """Flight-recorder columns come from the engine's own telemetry;
     a telemetry-disabled engine (BENCH_TELEMETRY=0 overhead arm)
@@ -130,7 +164,7 @@ def test_telemetry_columns_contract():
         tele.on_retire(rid, new_tokens=4, finish_reason="length")
     cols = bench.telemetry_columns(FakeEngine(), last_n=3)
     assert set(cols) == {"ttft_p50_s", "ttft_p95_s", "ttft_p99_s",
-                         "itl_mean_s", "mean_occupancy"}
+                         "itl_mean_s", "itl_p95_s", "mean_occupancy"}
     assert cols["ttft_p50_s"] > 0
     assert cols["mean_occupancy"] == 0.75
 

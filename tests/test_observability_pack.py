@@ -215,6 +215,14 @@ def test_telemetry_registry_matches_actual_emission():
                      padded_tokens=16, draft_tokens=4,
                      accepted_tokens=2)
     tele.gauge_queue(3, active=1)
+    # scheduler series (engine/scheduler.py): per-tenant gauges, the
+    # shed counter, and the chunked-prefill counter
+    tele.sched_gauges({"tenant-a": 2, "": 1},
+                      {"tenant-a": 128.0, "": 0.0})
+    tele.on_shed("tenant-a", "batch")
+    tele.on_prefill_chunks(3)
+    tele.record_step("prefill_chunk", 0.004, rows=2, batch=4,
+                     tokens=48, padded_tokens=256)
     tele.on_retire(1, new_tokens=8, finish_reason="eos")
     tele.update_ledgers(
         prefix_stats={"enabled": True, "hit_rate": 0.5},

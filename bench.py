@@ -122,6 +122,37 @@ PRESETS = {
     # re-reads earlier chunks' KV FROM the cache, so an fp8 cache
     # would perturb the long prompts' logits vs the monolithic wave
     # (same argument as prefix-cache seeding; docs/SCHEDULER.md).
+    # Fault-injected, self-healing serving (engine/faults.py +
+    # engine/supervisor.py): a mixed_traffic-style workload (short
+    # chats + long prompts, spec decode ON) runs twice — fault-free
+    # baseline, then under a seeded three-phase fault script
+    # (transient exceptions on every dispatch kind, ONE hang past the
+    # watchdog deadline, one persistent verify fault that trips the
+    # spec breaker). The gate: ZERO lost handles (every submit
+    # resolves with a Completion or a structured error carrying a
+    # correlation id), surviving greedy outputs bit-identical to the
+    # baseline, and the recovery counters within budget — the
+    # recovered/replayed/failed/breaker_trips columns + chaos_ok.
+    # COMPUTE dtype is pinned to float32 (kv matches automatically):
+    # a replayed request's first fresh token comes from the
+    # continuation PREFILL's logits where the baseline's came from
+    # DECODE logits at the same position, and those two program
+    # families only agree bit-for-bit when rounding can't flip the
+    # argmax — measured exact at f32, off-by-low-bits at bf16. This
+    # is a correctness gate, not a throughput shape; mixed_traffic's
+    # kv-dtype pin is the same move one level down
+    # (docs/RESILIENCE.md#replay-semantics).
+    "chaos": {"BENCH_MAX_LEN": "512", "BENCH_SLOTS": "16",
+              "BENCH_CHAOS_DTYPE": "float32",
+              "BENCH_NEW_TOKENS": "48",
+              "BENCH_DECODE_WINDOW": "8",
+              "BENCH_WINDOWS_PER_DISPATCH": "1",
+              "BENCH_SPEC_DECODE": "1",
+              "BENCH_CHAOS_CHAT": "24", "BENCH_CHAOS_CHAT_LEN": "96",
+              "BENCH_CHAOS_LONG": "6", "BENCH_CHAOS_LONG_LEN": "320",
+              "BENCH_CHAOS_SEED": "7",
+              "BENCH_CHAOS_HANG_S": "12",
+              "BENCH_CHAOS_DECODE_DEADLINE_S": "6"},
     "mixed_traffic": {"BENCH_MAX_LEN": "1024", "BENCH_SLOTS": "32",
                       "BENCH_KV_DTYPE": "bfloat16",
                       "BENCH_NEW_TOKENS": "64",
@@ -155,6 +186,11 @@ PRESET_CONTRACT_MODULES = {
     # chunk-width bucket coverage)
     "mixed_traffic": ["copilot_for_consensus_tpu.engine.generation",
                       "copilot_for_consensus_tpu.engine.scheduler"],
+    # the chaos arm exercises every generation dispatch kind (the
+    # fault plane wraps them all); the contract set is the generation
+    # module's — faults fire strictly at the host boundary and add no
+    # jitted entrypoints of their own
+    "chaos": ["copilot_for_consensus_tpu.engine.generation"],
 }
 
 
@@ -207,6 +243,20 @@ def sched_columns(summary: dict, sched_stats: dict) -> dict:
         "shed_rate": round(sched_stats.get("shed_rate", 0.0), 4),
         "fairness_jain_index": sched_stats.get("fairness_jain_index",
                                                1.0),
+    }
+
+
+def chaos_columns(recovery: dict) -> dict:
+    """chaos columns: the runner's recovery ledger
+    (``AsyncEngineRunner.recovery_stats``) — how many requests came
+    back via replay, how many replays ran, how many spent their budget
+    (structured EngineFailed), and the watchdog/breaker activity."""
+    return {
+        "recovered": int(recovery.get("recovered", 0)),
+        "replayed": int(recovery.get("replayed", 0)),
+        "failed": int(recovery.get("failed", 0)),
+        "breaker_trips": int(recovery.get("breaker_trips", 0)),
+        "watchdog_trips": int(recovery.get("watchdog_trips", 0)),
     }
 
 
@@ -653,6 +703,246 @@ def mixed_traffic_headline() -> dict:
     }
 
 
+# -- chaos gate (engine/faults.py + engine/supervisor.py) ---------------
+
+def chaos_headline() -> dict:
+    """Fault-injected self-healing gate: the same scripted cohorts run
+    fault-free (baseline outputs) and then through a seeded three-
+    phase fault script against ONE engine+runner — (1) a transient
+    exception on every dispatch kind (request replay must recover,
+    bit-identically), (2) a hang past the watchdog deadline (handles
+    must fail structured, the dispatcher must stay live), (3) a
+    persistent verify fault (the spec breaker must flip to plain
+    decode, then restore via the half-open probe once cleared). Every
+    handle must resolve — Completion or structured error carrying a
+    correlation id — and every chaos-arm COMPLETION must be
+    bit-identical to the baseline (replayed requests included: the
+    continuation resubmit is greedy bit-identical by the chunked-
+    prefill identity argument, docs/RESILIENCE.md)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu.engine.async_runner import (
+        AsyncEngineRunner,
+    )
+    from copilot_for_consensus_tpu.engine.faults import (
+        PERSISTENT,
+        FaultInjector,
+        FaultPlan,
+        FaultSpec,
+    )
+    from copilot_for_consensus_tpu.engine.generation import (
+        GenerationEngine,
+    )
+    from copilot_for_consensus_tpu.engine.supervisor import (
+        SupervisorConfig,
+    )
+    from copilot_for_consensus_tpu.models import decoder_config
+
+    preset_vals = PRESETS["chaos"]
+
+    def knob(name: str, default: str) -> str:
+        return os.environ.get(name, preset_vals.get(name, default))
+
+    model = knob("BENCH_MODEL", "mistral-7b")
+    slots = int(knob("BENCH_SLOTS", "16"))
+    max_len = int(knob("BENCH_MAX_LEN", "512"))
+    new_tokens = int(knob("BENCH_NEW_TOKENS", "48"))
+    window = int(knob("BENCH_DECODE_WINDOW", "8"))
+    n_chat = int(knob("BENCH_CHAOS_CHAT", "24"))
+    chat_len = int(knob("BENCH_CHAOS_CHAT_LEN", "96"))
+    n_long = int(knob("BENCH_CHAOS_LONG", "6"))
+    long_len = int(knob("BENCH_CHAOS_LONG_LEN", "320"))
+    seed = int(knob("BENCH_CHAOS_SEED", "7"))
+    hang_s = float(knob("BENCH_CHAOS_HANG_S", "12"))
+    deadline = float(knob("BENCH_CHAOS_DECODE_DEADLINE_S", "6"))
+    # compute dtype pinned f32 for exact replay bit-identity (see the
+    # preset comment); kv cache matches the compute dtype
+    dtype = {"float32": jnp.float32,
+             "bfloat16": jnp.bfloat16}[knob("BENCH_CHAOS_DTYPE",
+                                            "float32")]
+    wq = knob("BENCH_WEIGHT_DTYPE", "int8")
+    quantize = (False if knob("BENCH_QUANTIZE", "1") != "1" else wq)
+
+    cfg = decoder_config(model)
+    rng = np.random.default_rng(seed)
+
+    # Copy-heavy prompts (the spec_decode preset's shape) so the
+    # persistent verify fault actually has verify dispatches to hit.
+    def copy_heavy(plen: int) -> list[int]:
+        half = plen // 2
+        head = rng.integers(3, cfg.vocab_size, size=half).tolist()
+        tail: list[int] = []
+        while len(tail) < plen - half:
+            s0 = int(rng.integers(0, max(1, half - 16)))
+            tail.extend(head[s0:s0 + 16])
+        return head + tail[:plen - half]
+
+    prompts = [copy_heavy(chat_len) for _ in range(n_chat)] \
+        + [copy_heavy(long_len) for _ in range(n_long)]
+    buckets = tuple(sorted({chat_len, long_len}))
+    # cohorts: phase 1 (replay) / phase 2 (hang) / phase 3 (breaker)
+    thirds = max(1, len(prompts) // 3)
+    cohorts = [list(range(0, thirds)),
+               list(range(thirds, 2 * thirds)),
+               list(range(2 * thirds, len(prompts)))]
+
+    def build_engine():
+        return GenerationEngine(
+            cfg, num_slots=slots, max_len=max_len,
+            prefill_buckets=buckets, dtype=dtype,
+            kv_dtype=dtype, seed=0, quantize=quantize,
+            decode_window=window, windows_per_dispatch=1,
+            spec_decode=True, telemetry=True)
+
+    def drain(runner, idxs):
+        outputs: dict[int, list] = {}
+        errors: dict[int, BaseException] = {}
+        handles = [(i, runner.submit(list(prompts[i]), new_tokens,
+                                     correlation_id=f"chaos-{i}"))
+                   for i in idxs]
+        for i, h in handles:
+            try:
+                outputs[i] = h.result(timeout=900.0).tokens
+            except Exception as exc:   # noqa: BLE001 — classified below
+                errors[i] = exc
+        return outputs, errors
+
+    log("chaos: fault-free baseline arm")
+    base_eng = build_engine()
+    base_runner = AsyncEngineRunner(base_eng).start()
+    base_out: dict[int, list] = {}
+    for cohort in cohorts:
+        out, errs = drain(base_runner, cohort)
+        assert not errs, errs
+        base_out.update(out)
+    base_runner.stop()
+
+    log("chaos: fault-injected arm (supervisor on)")
+    eng = build_engine()
+    sup_cfg = SupervisorConfig(
+        deadlines_s={k: deadline for k in
+                     ("prefill", "prefill_seeded", "decode", "verify")},
+        step_deadline_s=20 * deadline,
+        watchdog_poll_s=0.05, replay_budget=6,
+        verify_breaker_threshold=2, breaker_probe_after_s=1.0)
+    runner = AsyncEngineRunner(eng, supervisor=sup_cfg).start()
+    # warm every program OUTSIDE the fault window with one full fault-
+    # free pass (every bucket + the admission batch shapes): a first-
+    # call XLA compile inside a tight-deadline dispatch frame would
+    # read as a hang (production deadlines are minutes; the chaos
+    # knobs shrink them so the gate runs in bench time)
+    warm, warm_errs = drain(runner, list(range(len(prompts))))
+    assert warm and not warm_errs, ("warmup failed", warm_errs)
+
+    plans = {
+        # phase 1: one transient exception on the 2nd occurrence of
+        # EVERY dispatch kind — replay must recover all of it
+        "transient": FaultPlan(seed=seed, specs=[
+            FaultSpec(kind="*", at=2, count=1)]),
+        # phase 2: the first dispatch hangs past the watchdog deadline
+        "hang": FaultPlan(seed=seed, specs=[
+            FaultSpec(kind="*", at=1, count=1, mode="hang",
+                      hang_s=hang_s)]),
+        # phase 3: persistent verify faults — the spec breaker must
+        # flip the engine to plain decode and traffic keep completing
+        "verify-breaker": FaultPlan(seed=seed, specs=[
+            FaultSpec(kind="verify", at=1, count=PERSISTENT)]),
+    }
+    outputs: dict[int, list] = {}
+    errors: dict[int, BaseException] = {}
+    fired = []
+    settle_ok = True
+    t0 = time.monotonic()
+    for cohort, (phase, plan) in zip(cohorts, plans.items()):
+        log(f"chaos: phase {phase}")
+        inj = FaultInjector(plan)
+        eng.faults = inj
+        out, errs = drain(runner, cohort)
+        inj.release_hangs()
+        eng.faults = None
+        # settle barrier: the hang phase's drain returns at the
+        # watchdog trip, while the dispatcher is still stuck inside
+        # the hung dispatch — one fault-free probe request (pending
+        # submits survive a suspect event) resolves only after the
+        # dispatcher has recovered and purged the zombie work, so the
+        # next phase starts against a clean engine instead of racing
+        # the recovery.
+        probe_idx = cohort[0]
+        settle, settle_errs = drain(runner, [probe_idx])
+        settle_ok = settle_ok and not settle_errs and \
+            settle.get(probe_idx) == base_out[probe_idx]
+        outputs.update(out)
+        errors.update(errs)
+        fired.extend({"phase": phase, **f}
+                     for f in inj.stats()["log"])
+    # post-storm: once the faults are gone and the breaker cooldown
+    # has elapsed, the half-open probe must restore speculation and
+    # the engine must still serve bit-identically
+    verify_hit = any(f["kind"] == "verify" for f in fired)
+    spec0 = eng.spec_dispatches
+    if verify_hit:
+        # let the open breaker reach its probe window so the post
+        # drain can actually exercise the restore path
+        time.sleep(sup_cfg.breaker_probe_after_s + 0.2)
+    post, post_errs = drain(runner, [cohorts[0][0]])
+    elapsed = max(1e-6, time.monotonic() - t0)
+    rec = runner.recovery_stats()
+    breaker_state = rec["breakers"]["spec_verify"]["state"]
+    spec_restored = (not verify_hit
+                     or (breaker_state == "closed"
+                         and eng.spec_dispatches > spec0))
+    runner.stop()
+
+    submitted = sum(len(c) for c in cohorts)
+    zero_lost = (len(outputs) + len(errors) == submitted
+                 and not post_errs
+                 and not any(isinstance(e, TimeoutError)
+                             for e in errors.values()))
+    structured = all(
+        hasattr(e, "correlation_id") for e in errors.values())
+    bit_identical = (
+        settle_ok
+        and all(outputs[i] == base_out[i] for i in outputs)
+        and post.get(cohorts[0][0]) == base_out[cohorts[0][0]])
+    cols = chaos_columns(rec)
+    # within budget: replays recovered phase 1, no budget spent, the
+    # watchdog caught the phase-2 hang, and (when verify dispatches
+    # ran at all) the spec breaker tripped AND the half-open probe
+    # restored speculation after the faults cleared
+    budget_ok = (cols["replayed"] >= 1 and cols["failed"] == 0
+                 and cols["watchdog_trips"] >= 1
+                 and (cols["breaker_trips"] >= 1 or not verify_hit)
+                 and spec_restored)
+    chaos_ok = bool(zero_lost and structured and bit_identical
+                    and budget_ok)
+    total_new = sum(len(t) for t in outputs.values())
+    tok_s = total_new / elapsed
+    log(f"chaos: {len(outputs)} completed / {len(errors)} "
+        f"structured-failed of {submitted}; bit-identical "
+        f"{bit_identical}, recovery {cols}, "
+        f"breaker {breaker_state}, chaos_ok {chaos_ok}")
+    return {
+        "metric": f"{model} fault-injected serving "
+                  f"(supervisor on, {slots} slots, {n_chat} chat + "
+                  f"{n_long} long, 3-phase seeded fault script)",
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+        **cols,
+        "completed": len(outputs),
+        "failed_structured": len(errors),
+        "zero_lost_handles": zero_lost,
+        "bit_identical_greedy": bit_identical,
+        "verify_breaker_state": breaker_state,
+        "spec_restored": spec_restored,
+        "chaos_ok": chaos_ok,
+        "faults_fired": fired,
+        "fault_plan": {k: p.to_dict() for k, p in plans.items()},
+    }
+
+
 # -- headline -----------------------------------------------------------
 
 def headline() -> dict:
@@ -662,6 +952,9 @@ def headline() -> dict:
         # The scheduler gate is a two-arm scripted-arrival run, not a
         # generate()-to-completion throughput shape.
         return mixed_traffic_headline()
+    if os.environ.get("BENCH_PRESET", "") == "chaos":
+        # The resilience gate is a two-arm fault-injection run.
+        return chaos_headline()
 
     # Preset values fill in behind explicit env vars WITHOUT mutating
     # os.environ — extra_rows() children inherit this process's env, so

@@ -6,11 +6,24 @@ engine in the role of Ollama / llama.cpp, and a cross-text-batching
 embedding engine in the role of sentence-transformers.
 """
 
+from copilot_for_consensus_tpu.engine.faults import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+)
 from copilot_for_consensus_tpu.engine.scheduler import (
     EngineOverloaded,
     Scheduler,
     SchedulerConfig,
     jain_index,
+)
+from copilot_for_consensus_tpu.engine.supervisor import (
+    CircuitBreaker,
+    EngineFailed,
+    EngineSupervisor,
+    EngineSuspect,
+    SupervisorConfig,
 )
 from copilot_for_consensus_tpu.engine.telemetry import (
     EngineTelemetry,
@@ -38,4 +51,13 @@ __all__ = [
     "Scheduler",
     "SchedulerConfig",
     "jain_index",
+    "FaultSpec",
+    "FaultPlan",
+    "FaultInjector",
+    "InjectedFault",
+    "CircuitBreaker",
+    "EngineFailed",
+    "EngineSupervisor",
+    "EngineSuspect",
+    "SupervisorConfig",
 ]

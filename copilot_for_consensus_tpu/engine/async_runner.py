@@ -24,9 +24,25 @@ the steady-state duty cycle is decode_time / (decode_time +
 admission_time) — what ``scripts/bench_poisson.py`` measures against
 the batch bench.
 
+Resilience (``supervisor=``, engine/supervisor.py;
+docs/RESILIENCE.md): with a supervisor attached, an engine failure no
+longer loses every in-flight request. The watchdog converts a HUNG
+dispatch into a contained engine-suspect event (in-engine handles fail
+with a structured :class:`~.supervisor.EngineSuspect`; pending submits
+survive and serve after recovery), and a FAILED dispatch triggers
+containment + request replay: each evacuated request's accepted tokens
+already live host-side, so survivors resubmit as
+prompt+generated-so-far continuations (greedy bit-identical) under a
+per-request retry budget, with a structured
+:class:`~.supervisor.EngineFailed` (correlation id + flight-record
+path) only when the budget is spent.
+
 Reference comparison: the reference's summarization service holds ONE
 blocking HTTP connection per summary (``local_llm_summarizer.py:106``);
-this is the first-party continuous-batching replacement's front door.
+this is the first-party continuous-batching replacement's front door —
+and the supervisor is its stand-in for the crash isolation the
+reference gets from RabbitMQ redelivery when an inference container
+dies (SURVEY §0).
 """
 
 from __future__ import annotations
@@ -39,6 +55,11 @@ from copilot_for_consensus_tpu.engine.generation import (
     Completion,
     GenerationEngine,
 )
+from copilot_for_consensus_tpu.engine.supervisor import (
+    EngineFailed,
+    EngineSuspect,
+    resolve_supervisor,
+)
 
 
 @dataclass
@@ -46,6 +67,8 @@ class Handle:
     """Caller-side future for one request."""
 
     request_id: int = -1
+    correlation_id: str = ""
+    created_at: float = field(default_factory=time.monotonic)
     _event: threading.Event = field(default_factory=threading.Event)
     _completion: Completion | None = None
     _error: BaseException | None = None
@@ -57,7 +80,15 @@ class Handle:
 
     def result(self, timeout: float | None = None) -> Completion:
         if not self._event.wait(timeout):
-            raise TimeoutError("generation not finished")
+            # Enriched timeout: name the request so the caller can
+            # join the flight-recorder dump / engine telemetry span
+            # without guessing which of its handles this was.
+            elapsed = time.monotonic() - self.created_at
+            raise TimeoutError(
+                f"generation not finished after {elapsed:.1f}s "
+                f"(request_id={self.request_id}, "
+                f"correlation_id={self.correlation_id or '<none>'}, "
+                f"timeout={timeout}s)")
         if self._error is not None:
             raise self._error
         assert self._completion is not None
@@ -104,6 +135,19 @@ class Handle:
                 pass    # a broken observer must not kill the dispatcher
 
 
+@dataclass
+class _ReplayState:
+    """Per-handle replay bookkeeping (keyed by the CURRENT engine
+    request id): the original request's identity so a stitched
+    completion reports the caller's prompt length and full token
+    stream, not the continuation's."""
+
+    prompt_len: int
+    max_new_tokens: int
+    tokens: list[int]          # accepted across all prior attempts
+    attempts: int = 0
+
+
 class AsyncEngineRunner:
     """Dispatcher thread owning a ``GenerationEngine``'s device calls.
 
@@ -111,22 +155,41 @@ class AsyncEngineRunner:
     with the flight-recorder context: the correlation ids of the
     requests that were in flight and the dump path when the engine's
     telemetry wrote one — an engine error report that cannot name its
-    victims is a post-mortem with the body missing."""
+    victims is a post-mortem with the body missing.
+
+    ``supervisor`` (``engine/supervisor.py``): None/False disables
+    (legacy fail-all containment), True builds one with defaults, a
+    ``SupervisorConfig``/``EngineSupervisor`` wires watchdog deadlines,
+    invariant audits, request replay and the degraded-mode breakers.
+    Its watchdog thread starts/stops with the runner."""
 
     def __init__(self, engine: GenerationEngine, *,
-                 error_reporter=None):
+                 error_reporter=None, supervisor=None):
         self.engine = engine
         self.error_reporter = error_reporter
+        self.supervisor = resolve_supervisor(supervisor, engine)
+        if self.supervisor is not None:
+            self.supervisor.set_suspect_callback(self._on_suspect)
         self._pending: list[
             tuple[list[int], int, int | None, str, Handle]] = []
         self._handles: dict[int, Handle] = {}
+        self._replays: dict[int, _ReplayState] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stop = False
         self._thread: threading.Thread | None = None
+        #: monotonic start of the in-progress eng.step(), None when idle
+        #: — what stop() names when the dispatcher fails to join
+        self._step_t0: float | None = None
         #: dispatcher-loop stats for benches/metrics
         self.completed = 0
         self.decode_busy_s = 0.0
+        #: resilience counters (recovery_stats())
+        self.replayed = 0          # continuation resubmissions
+        self.recovered = 0         # completions that needed >=1 replay
+        self.replay_failed = 0     # EngineFailed (budget spent)
+        self.suspect_failures = 0  # handles failed by the watchdog
+        self._last_dump_path = ""
 
     # -- caller side ----------------------------------------------------
 
@@ -136,27 +199,85 @@ class AsyncEngineRunner:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="engine-dispatch")
         self._thread.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
         return self
 
-    def stop(self, timeout: float = 30.0) -> None:
+    def stop(self, timeout: float = 30.0) -> bool:
+        """Stop the dispatcher. Returns True when the thread joined
+        cleanly; False when it did NOT (a hung dispatch) — in that
+        case every outstanding handle is failed with a structured
+        :class:`EngineSuspect` naming the stuck dispatch state, the
+        condition is logged, and the daemon thread is abandoned rather
+        than silently leaving callers to sit out their full
+        ``result()`` timeouts."""
+        fi = getattr(self.engine, "faults", None)
+        if fi is not None:
+            # shutdown must never wait out a scripted chaos hang
+            fi.release_hangs()
         with self._work:
             self._stop = True
             self._work.notify()
+        joined = True
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                joined = False
+                state = self._dispatch_state()
+                exc = EngineSuspect(
+                    f"runner stopped but the dispatcher thread failed "
+                    f"to join within {timeout:.1f}s; stuck in {state} — "
+                    f"outstanding handles failed, thread abandoned "
+                    f"(daemon)", kind="stop",
+                    elapsed_s=self._step_elapsed(),
+                    deadline_s=timeout)
+                self._fail_outstanding(exc)
+                try:
+                    from copilot_for_consensus_tpu.obs.logging import (
+                        get_logger,
+                    )
+                    get_logger().error("engine dispatcher failed to "
+                                       "join on stop", state=state,
+                                       timeout_s=timeout)
+                except Exception:
+                    pass   # logging must not mask the condition
             self._thread = None
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        return joined
+
+    def _step_elapsed(self) -> float:
+        t0 = self._step_t0
+        return time.monotonic() - t0 if t0 is not None else 0.0
+
+    def _dispatch_state(self) -> str:
+        """Human-readable description of what the dispatcher is stuck
+        in — the supervisor's innermost dispatch frame when one is
+        active, else the coarse step timing."""
+        if self.supervisor is not None:
+            cur = self.supervisor.current_dispatch()
+            if cur is not None:
+                kind, t0 = cur
+                return (f"dispatch:{kind} "
+                        f"({time.monotonic() - t0:.1f}s)")
+        if self._step_t0 is not None:
+            return f"engine.step() ({self._step_elapsed():.1f}s)"
+        return "idle (not inside a dispatch)"
 
     def submit(self, prompt: list[int],
                max_new_tokens: int = 256, *,
                cache_eligible_tokens: int | None = None,
                correlation_id: str = "", tenant: str = "",
-               priority: str = "") -> Handle:
+               priority: str = "",
+               deadline_s: float | None = None) -> Handle:
         """Thread-safe enqueue; returns a waitable handle.
         ``cache_eligible_tokens`` plumbs through to
         ``GenerationEngine.submit`` (prefix-cache publish cap);
         ``correlation_id`` tags the request's telemetry span;
         ``tenant``/``priority`` feed the engine's scheduler when one is
-        configured.
+        configured; ``deadline_s`` is the per-request wall-clock budget
+        (expired work is dropped, not computed — the handle resolves
+        with ``finish_reason="deadline"``).
 
         Load shedding happens HERE, synchronously: an overloaded
         scheduler raises ``EngineOverloaded`` on the caller's thread
@@ -173,7 +294,7 @@ class AsyncEngineRunner:
                 tenant=tenant, priority=priority or "interactive",
                 prompt_tokens=len(prompt),
                 correlation_id=correlation_id)
-        h = Handle()
+        h = Handle(correlation_id=correlation_id)
         kw: dict = {}
         if cache_eligible_tokens is not None:
             kw["cache_eligible_tokens"] = cache_eligible_tokens
@@ -183,6 +304,8 @@ class AsyncEngineRunner:
             kw["tenant"] = tenant
         if priority:
             kw["priority"] = priority
+        if deadline_s is not None:
+            kw["deadline_s"] = deadline_s
         with self._work:
             if self._stop:
                 # a submit racing stop() must not enqueue a handle the
@@ -196,6 +319,27 @@ class AsyncEngineRunner:
         """Prefix-cache counters passthrough (counter reads are atomic
         enough for metrics; no engine lock is taken)."""
         return self.engine.prefix_stats()
+
+    def recovery_stats(self) -> dict:
+        """Resilience ledger for benches/metrics (mirrors
+        ``prefix_stats``): replay/recovery counters plus the
+        supervisor's watchdog/breaker/audit state when one is wired."""
+        out = {
+            "replayed": self.replayed,
+            "recovered": self.recovered,
+            "failed": self.replay_failed,
+            "suspect_failures": self.suspect_failures,
+        }
+        if self.supervisor is not None:
+            s = self.supervisor.stats()
+            out["watchdog_trips"] = s["watchdog_trips"]
+            out["containments"] = s["containments"]
+            out["released_pins"] = s["released_pins"]
+            out["quarantined_slots"] = s["quarantined_slots"]
+            out["breaker_trips"] = sum(
+                b["trips"] for b in s["breakers"].values())
+            out["breakers"] = s["breakers"]
+        return out
 
     # -- dispatcher side ------------------------------------------------
 
@@ -216,25 +360,27 @@ class AsyncEngineRunner:
 
     def _loop(self) -> None:
         eng = self.engine
+        sup = self.supervisor
         while True:
             with self._work:
                 while (not self._stop and not self._pending
                        and self._engine_idle(eng)):
                     self._work.wait(timeout=0.1)
                 if self._stop:
-                    # Fail every outstanding handle promptly — a caller
-                    # blocked in result() must not sit out its full
-                    # timeout just because the runner was stopped.
-                    exc = RuntimeError("runner stopped")
-                    for *_rest, h in self._pending:
-                        h._fail(exc)
-                    for h in self._handles.values():
-                        h._fail(exc)
-                    self._pending.clear()
-                    self._handles.clear()
-                    return
-                fresh = self._pending
-                self._pending = []
+                    stopping = True
+                else:
+                    stopping = False
+                    fresh = self._pending
+                    self._pending = []
+            if stopping:
+                # Fail every outstanding handle promptly — a caller
+                # blocked in result() must not sit out its full
+                # timeout just because the runner was stopped. (The
+                # sweep re-takes the lock internally and fires the
+                # failures outside it — done-callbacks may re-enter
+                # submit.)
+                self._fail_outstanding(RuntimeError("runner stopped"))
+                return
             # Enqueue arrivals into the engine on the dispatcher thread
             # (the engine is single-owner; only this thread touches it).
             # A bad request (e.g. empty prompt) fails ITS handle, not
@@ -253,29 +399,250 @@ class AsyncEngineRunner:
                     h._fail(exc)
                     continue
                 h.request_id = rid
-                self._handles[rid] = h
+                # _handles/_replays are shared with the watchdog
+                # thread's _on_suspect — every mutation holds the lock
+                with self._work:
+                    self._handles[rid] = h
             t0 = time.monotonic()
+            self._step_t0 = t0
+            if sup is not None:
+                # coarse watchdog frame over the whole step; the
+                # engine's _dispatch_boundary nests the precise kind
+                sup.begin_dispatch("step")
             try:
                 comps = eng.step()  # admit wave + one decode dispatch
             except Exception as exc:
-                # Device/engine failure: every in-flight request is
-                # lost — surface the error on each handle and keep the
-                # dispatcher alive for new work. The flight recorder
-                # dumps FIRST (it names the requests in flight by
-                # correlation id), then the error reporter gets the
-                # dump context.
+                # Device/engine failure. Flight recorder dumps FIRST
+                # (it names the requests in flight by correlation id),
+                # then the error reporter gets the dump context. With a
+                # supervisor: containment + request replay — surviving
+                # requests continue from their host-side accepted
+                # tokens instead of being lost. Without: the legacy
+                # fail-all containment. Either way the dispatcher
+                # stays alive for new work.
                 self._report_engine_error(exc)
-                for h in self._handles.values():
-                    h._fail(exc)
-                self._handles.clear()
+                if sup is not None:
+                    self._recover(exc)
+                else:
+                    for h in self._handles.values():
+                        h._fail(exc)
+                    self._handles.clear()
                 continue
             finally:
+                if sup is not None:
+                    sup.end_dispatch("step")
+                self._step_t0 = None
                 self.decode_busy_s += time.monotonic() - t0
+            if sup is not None:
+                sup.on_step_ok()
             for c in comps:
                 self.completed += 1
-                h = self._handles.pop(c.request_id, None)
+                # pop under the lock (shared with the watchdog's
+                # _on_suspect); resolve OUTSIDE it — done-callbacks may
+                # re-enter submit(), which takes the same lock
+                with self._work:
+                    h = self._handles.pop(c.request_id, None)
+                    meta = self._replays.pop(c.request_id, None)
+                if h is None:
+                    continue   # watchdog failed this handle mid-hang
+                if meta is not None:
+                    # Stitch the continuation onto the original
+                    # identity: the caller sees ONE completion with its
+                    # own prompt length and the full token stream.
+                    c = Completion(
+                        request_id=c.request_id,
+                        prompt_len=meta.prompt_len,
+                        tokens=meta.tokens + c.tokens,
+                        finish_reason=c.finish_reason,
+                        prefill_s=c.prefill_s, decode_s=c.decode_s)
+                    self.recovered += 1
+                h._resolve(c)
+            if sup is not None and sup.take_suspect():
+                # The watchdog tripped during a step that then returned
+                # on its own: the in-engine waiters were failed by the
+                # callback, so the engine's surviving work — active
+                # slots AND queued requests — is zombie compute.
+                # Evacuate and purge it rather than burning dispatches
+                # on requests nobody is waiting for; any handle the
+                # callback RACED past (submitted between the trip and
+                # this cleanup) is failed here with the same structured
+                # error, never left to strand until its timeout.
+                exc = sup.last_suspect or EngineSuspect(
+                    "engine suspect (watchdog)")
+                dropped = [req for req, _gen in sup.evacuate()]
+                dropped += sup.purge_queued()
+                for req in dropped:
+                    rid = getattr(req, "request_id", None)
+                    with self._work:
+                        h = self._handles.pop(rid, None)
+                        self._replays.pop(rid, None)
+                    if h is not None:
+                        h._fail(exc)
+                sup.audit(repair=True)
+
+    # -- failure handling ------------------------------------------------
+
+    def _on_suspect(self, exc: EngineSuspect) -> None:
+        """Watchdog callback (WATCHDOG THREAD): a dispatch overran its
+        deadline and the dispatcher is stuck inside it. Fail the
+        in-engine handles structured so their callers unwedge NOW;
+        pending submits never touched the suspect engine, so they stay
+        queued and serve after the dispatcher recovers — which is what
+        keeps the front door live through a bounded hang. Handles are
+        popped under the lock but failed OUTSIDE it: done-callbacks
+        may re-enter submit(), which takes the same lock."""
+        with self._work:
+            victims = list(self._handles.values())
+            self._handles.clear()
+            self._replays.clear()
+        for h in victims:
+            h._fail(exc)
+        self.suspect_failures += len(victims)
+
+    def _recover(self, exc: BaseException) -> None:
+        """Containment + replay after a failed step (DISPATCHER
+        THREAD). The supervisor evacuates every active/chunking slot
+        and repairs the engine's invariants; each evacuated request
+        either resubmits as a prompt+generated continuation (budget
+        permitting) or fails with a structured EngineFailed naming the
+        correlation id and the flight-record dump."""
+        sup = self.supervisor
+        tele = getattr(self.engine, "telemetry", None)
+        plan = sup.contain(exc)
+        if plan.suspect:
+            # The watchdog already failed EVERY in-engine handle
+            # (including queued requests') while this step hung — the
+            # engine's queued work is waiterless now; drop it instead
+            # of computing it for nobody (failing any handle the trip
+            # callback raced past).
+            exc_s = sup.last_suspect or EngineSuspect(
+                "engine suspect (watchdog)")
+            for req in sup.purge_queued():
+                rid = getattr(req, "request_id", None)
+                with self._work:
+                    h = self._handles.pop(rid, None)
+                    self._replays.pop(rid, None)
                 if h is not None:
-                    h._resolve(c)
+                    h._fail(exc_s)
+        budget = sup.cfg.replay_budget
+        for req, gen in plan.evacuated:
+            with self._work:
+                h = self._handles.pop(req.request_id, None)
+                meta = self._replays.pop(req.request_id, None)
+            if h is None:
+                continue   # watchdog already failed this handle
+            if meta is None:
+                meta = _ReplayState(prompt_len=len(req.prompt),
+                                    max_new_tokens=req.max_new_tokens,
+                                    tokens=[])
+            tokens = meta.tokens + list(gen)
+            attempts = meta.attempts + 1
+            remaining = meta.max_new_tokens - len(tokens)
+            if remaining <= 0:
+                # The failed step had already harvested this request's
+                # FULL output (multi-window dispatches land all their
+                # tokens before the failing window raises): everything
+                # the caller asked for exists host-side — resolve it,
+                # don't burn a replay or fail it.
+                if meta.attempts:
+                    self.recovered += 1
+                h._resolve(Completion(
+                    request_id=req.request_id,
+                    prompt_len=meta.prompt_len,
+                    tokens=tokens[:meta.max_new_tokens],
+                    finish_reason="length"))
+                continue
+            limit = getattr(self.engine, "prompt_limit", None)
+            if attempts > budget or (
+                    limit is not None
+                    and len(req.prompt) + len(gen) > limit):
+                # Budget spent — or the continuation no longer FITS
+                # (prompt+generated past prompt_limit): submit would
+                # silently head-truncate it and the replay would
+                # diverge from the fault-free stream, which is worse
+                # than an honest structured failure.
+                reason = ("replay-budget" if attempts > budget
+                          else "continuation-too-long")
+                self.replay_failed += 1
+                if tele is not None:
+                    tele.on_replay_failed()
+                h._fail(EngineFailed(
+                    f"request {req.request_id} lost to engine failure "
+                    f"after {attempts - 1} replay(s) "
+                    f"({reason}, budget {budget}): "
+                    f"{type(exc).__name__}: {exc}",
+                    request_id=req.request_id,
+                    correlation_id=req.correlation_id,
+                    attempts=attempts - 1, reason=reason,
+                    flight_record=self._last_dump_path))
+                continue
+            kw: dict = {}
+            if req.cache_eligible_tokens is not None:
+                kw["cache_eligible_tokens"] = req.cache_eligible_tokens
+            if req.correlation_id:
+                kw["correlation_id"] = req.correlation_id
+            if req.tenant:
+                kw["tenant"] = req.tenant
+            if req.priority:
+                kw["priority"] = req.priority
+            if req.deadline_at != float("inf"):
+                kw["deadline_s"] = max(
+                    0.0, req.deadline_at - time.monotonic())
+            try:
+                # The continuation: everything accepted so far becomes
+                # prompt (seeded prefill re-derives the KV the failed
+                # cache held; greedy decode continues bit-identically —
+                # the chunked-prefill identity argument,
+                # docs/RESILIENCE.md).
+                new_rid = self.engine.submit(
+                    list(req.prompt) + list(gen), remaining, **kw)
+            except Exception as sub_exc:
+                # e.g. EngineOverloaded while shedding under the
+                # lowered cap — structured, honest, final for this
+                # handle
+                h._fail(sub_exc)
+                continue
+            h.request_id = new_rid
+            with self._work:
+                self._handles[new_rid] = h
+                self._replays[new_rid] = _ReplayState(
+                    prompt_len=meta.prompt_len,
+                    max_new_tokens=meta.max_new_tokens,
+                    tokens=tokens, attempts=attempts)
+            self.replayed += 1
+            if tele is not None:
+                tele.on_replay()
+        if sup.unhealthy:
+            # Persistent failure mode: queued work that admit-wave
+            # unwinds keep requeuing never touches the replay budget,
+            # so without this gate a permanently failing dispatch
+            # would raise/requeue forever while callers hang to their
+            # own timeouts. Declare the engine unhealthy: fail every
+            # outstanding handle structured and purge the queues —
+            # the dispatcher stays alive for traffic submitted after
+            # the fault clears (a success resets the counter).
+            term = EngineFailed(
+                f"engine unhealthy: {sup.consecutive_failures} "
+                f"consecutive failed steps (last: "
+                f"{type(exc).__name__}: {exc})",
+                reason="engine-unhealthy",
+                flight_record=self._last_dump_path)
+            self.suspect_failures += self._fail_outstanding(term)
+            sup.purge_queued()
+
+    def _fail_outstanding(self, exc: BaseException) -> int:
+        """Fail every pending and in-engine handle with ``exc``
+        (lock-held sweep shared by the watchdog callback and the
+        unhealthy terminal gate). Returns how many were failed."""
+        with self._work:
+            victims = ([h for *_r, h in self._pending]
+                       + list(self._handles.values()))
+            self._pending.clear()
+            self._handles.clear()
+            self._replays.clear()
+        for h in victims:
+            h._fail(exc)
+        return len(victims)
 
     def _report_engine_error(self, exc: BaseException) -> None:
         """Flight-recorder dump + error report for a failed dispatch.
@@ -288,6 +655,8 @@ class AsyncEngineRunner:
                 dump = tele.record_error(exc)
             except Exception:
                 pass
+        self._last_dump_path = (dump or {}).get("dump_path", "") \
+            if isinstance(dump, dict) else ""
         if self.error_reporter is None:
             return
         context: dict = {"component": "engine-dispatch"}

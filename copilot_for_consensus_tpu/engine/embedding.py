@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from copilot_for_consensus_tpu.engine.faults import resolve_faults
 from copilot_for_consensus_tpu.engine.scheduler import resolve_scheduler
 from copilot_for_consensus_tpu.engine.telemetry import resolve_telemetry
 from copilot_for_consensus_tpu.engine.tokenizer import (
@@ -47,9 +48,15 @@ class EmbeddingEngine:
         attn_impl: str = "auto",
         telemetry: Any = True,
         scheduler: Any = None,
+        faults: Any = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
+        # Fault-injection plane (engine/faults.py): the chaos harness
+        # scripts kind="embed" faults against the encode dispatch
+        # boundary. Share the generation engine's injector to chaos
+        # both engines under one seeded plan.
+        self.faults = resolve_faults(faults)
         # Step telemetry (engine/telemetry.py): one StepRecord per
         # encode dispatch (kind="embed") with tile occupancy and
         # bucket-padding waste — the embedding engine has no request
@@ -183,6 +190,9 @@ class EmbeddingEngine:
                     ids = encoded[i]
                     tokens[row, :len(ids)] = ids
                     lengths[row] = len(ids)
+                if self.faults is not None:
+                    # host dispatch boundary — never inside jitted code
+                    self.faults.check("embed")
                 seq = self.telemetry.next_step() \
                     if self.telemetry is not None else None
                 t0 = time.monotonic()

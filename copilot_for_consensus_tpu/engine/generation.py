@@ -40,6 +40,7 @@ its single LLM connection.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -51,6 +52,10 @@ import numpy as np
 from copilot_for_consensus_tpu.analysis.contracts import (
     ContractCase,
     checkable,
+)
+from copilot_for_consensus_tpu.engine.faults import (
+    InjectedFault,
+    resolve_faults,
 )
 from copilot_for_consensus_tpu.engine.sampling import (
     SamplingConfig,
@@ -105,6 +110,11 @@ class Request:
     #: (interactive > batch; batch sheds first under SLO pressure)
     tenant: str = ""
     priority: str = "interactive"
+    #: absolute monotonic deadline (engine/supervisor.py policy):
+    #: expired work is DROPPED (finish_reason="deadline"), never
+    #: computed — queued requests at step start, active slots at
+    #: harvest. inf = no deadline.
+    deadline_at: float = float("inf")
 
 
 @dataclass
@@ -112,7 +122,7 @@ class Completion:
     request_id: int
     prompt_len: int
     tokens: list[int]
-    finish_reason: str            # "eos" | "length"
+    finish_reason: str            # "eos" | "length" | "deadline"
     prefill_s: float = 0.0
     decode_s: float = 0.0
 
@@ -201,8 +211,30 @@ class GenerationEngine:
         int4_pallas_max_extent: int | None = 1536,
         telemetry: Any = True,
         scheduler: Any = None,
+        faults: Any = None,
     ):
         self.profile_dir = profile_dir
+        # Resilience plane (engine/faults.py + engine/supervisor.py;
+        # docs/RESILIENCE.md): ``faults`` installs a deterministic
+        # seeded fault injector at every host dispatch boundary
+        # (``_dispatch_boundary`` — never inside jitted code); a
+        # supervisor (attached by EngineSupervisor/AsyncEngineRunner)
+        # gets watchdog begin/end + success/failure callbacks from the
+        # same boundary, and may lower ``_slot_cap`` (resource breaker)
+        # or veto the verify dispatch (spec breaker).
+        self.faults = resolve_faults(faults)
+        self.supervisor: Any = None
+        self._last_failed_kind = ""
+        self._slot_cap = num_slots
+        #: requests dropped un-computed because deadline_at passed
+        self.deadline_expired = 0
+        #: set the first time a deadline_s submit arrives — the
+        #: per-step expiry sweep walks every queue, so engines that
+        #: never see a deadline skip it entirely (hot-path economy)
+        self._deadlines_in_use = False
+        #: contained prefix-publish failures (completion still
+        #: delivered; only the cache contribution was lost)
+        self.prefix_publish_failures = 0
         # Flight recorder + request-lifecycle spans + Prometheus export
         # (engine/telemetry.py). Default ON: pure host-side bookkeeping
         # around dispatches the engine already syncs on (<1% measured —
@@ -855,7 +887,8 @@ class GenerationEngine:
     def submit(self, prompt: list[int], max_new_tokens: int = 256, *,
                cache_eligible_tokens: int | None = None,
                correlation_id: str = "", tenant: str = "",
-               priority: str = "interactive") -> int:
+               priority: str = "interactive",
+               deadline_s: float | None = None) -> int:
         """Enqueue a tokenized prompt; returns a request id.
 
         ``cache_eligible_tokens`` caps how many leading prompt tokens
@@ -869,7 +902,11 @@ class GenerationEngine:
         — an overloaded scheduler raises :class:`EngineOverloaded`
         HERE, at the door, instead of queueing work it cannot serve
         within SLO (the service layer maps it to HTTP 429 +
-        Retry-After)."""
+        Retry-After). ``deadline_s`` is the per-request wall-clock
+        budget: once it expires the request is dropped (queued) or
+        retired with its partial output (active), both with
+        ``finish_reason="deadline"`` — expired work is never
+        computed."""
         if not prompt:
             raise ValueError("empty prompt")
         limit = self.prompt_limit
@@ -888,11 +925,15 @@ class GenerationEngine:
                 correlation_id=correlation_id)
         rid = self._next_id
         self._next_id += 1
+        if deadline_s is not None:
+            self._deadlines_in_use = True
         req = Request(
             rid, list(prompt), max_new_tokens,
             cache_eligible_tokens=cache_eligible_tokens,
             correlation_id=correlation_id, tenant=tenant,
-            priority=priority)
+            priority=priority,
+            deadline_at=(time.monotonic() + max(0.0, deadline_s)
+                         if deadline_s is not None else float("inf")))
         if self._sched is not None:
             self._sched.enqueue(req)
         else:
@@ -911,6 +952,7 @@ class GenerationEngine:
         long prompts advance by ONE chunk dispatch, and only then does
         the decode window run — so the per-step prefill work, and with
         it ITL, stays bounded regardless of prompt mix."""
+        self._expire_deadlines()
         if self._sched is not None:
             self._sched_pump()
         self._admit()
@@ -952,6 +994,11 @@ class GenerationEngine:
 
     def generate_text(self, prompts: list[str], tokenizer: Tokenizer,
                       max_new_tokens: int = 256) -> list[str]:
+        if self.faults is not None:
+            # tokenization is a host boundary of the serving path too —
+            # the chaos harness scripts faults against it like any
+            # dispatch kind (the summarizer's encode does the same)
+            self.faults.check("tokenize")
         comps = self.generate(
             [tokenizer.encode(p, add_bos=True) for p in prompts],
             max_new_tokens)
@@ -965,6 +1012,7 @@ class GenerationEngine:
             "enabled": self._prefix is not None,
             "prefill_tokens": self.prefill_tokens,
             "prefill_tokens_saved": self.prefill_tokens_saved,
+            "publish_failures": self.prefix_publish_failures,
         }
         if self._prefix is not None:
             s = self._prefix.stats
@@ -1050,6 +1098,104 @@ class GenerationEngine:
     # internals
     # ------------------------------------------------------------------
 
+    @contextlib.contextmanager
+    def _dispatch_boundary(self, kind: str):
+        """The host-side dispatch boundary every device program runs
+        under: the fault plane's injection point (engine/faults.py —
+        strictly BEFORE the jitted call, never inside traced code) and
+        the supervisor's watchdog/outcome surface
+        (engine/supervisor.py). On failure the kind is recorded so
+        containment can classify without parsing tracebacks."""
+        sup = self.supervisor
+        if sup is not None:
+            sup.begin_dispatch(kind)
+        try:
+            if self.faults is not None:
+                self.faults.check(kind)
+            yield
+            if sup is not None:
+                sup.on_dispatch_ok(kind)
+        except Exception as exc:
+            self._last_failed_kind = kind
+            if isinstance(exc, InjectedFault) \
+                    and self.telemetry is not None:
+                self.telemetry.on_fault_injected(kind, exc.mode)
+            if sup is not None:
+                sup.on_dispatch_error(kind, exc)
+            raise
+        finally:
+            if sup is not None:
+                sup.end_dispatch(kind)
+
+    def set_slot_cap(self, cap: int) -> None:
+        """Occupancy cap (≤ num_slots): admission paths stop filling
+        slots beyond it. The supervisor's resource breaker lowers it
+        after repeated device resource exhaustion and restores it via
+        half-open probes; already-active slots above a lowered cap
+        drain naturally."""
+        self._slot_cap = max(1, min(self.num_slots, int(cap)))
+
+    @property
+    def _occupied(self) -> int:
+        return len(self._active) + len(self._chunking)
+
+    def _expire_deadlines(self) -> None:
+        """Drop every request whose ``deadline_at`` has passed —
+        queued work un-computed (empty completion), active work with
+        its partial output — all with ``finish_reason="deadline"``.
+        Runs at step start so a deep queue cannot burn dispatches on
+        work nobody is waiting for anymore."""
+        if not self._deadlines_in_use:
+            return    # no deadline ever submitted: skip the queue walk
+        now = time.monotonic()
+        expired: list[Request] = []
+        if self._queue:
+            live = [r for r in self._queue if r.deadline_at > now]
+            if len(live) != len(self._queue):
+                expired += [r for r in self._queue
+                            if r.deadline_at <= now]
+                self._queue = live
+        if self._chunk_pending:
+            live = [r for r in self._chunk_pending
+                    if r.deadline_at > now]
+            if len(live) != len(self._chunk_pending):
+                expired += [r for r in self._chunk_pending
+                            if r.deadline_at <= now]
+                self._chunk_pending = live
+        if self._prefilling:
+            live = [(r, t) for r, t in self._prefilling
+                    if r.deadline_at > now]
+            if len(live) != len(self._prefilling):
+                expired += [r for r, _t in self._prefilling
+                            if r.deadline_at <= now]
+                self._prefilling = live
+        for slot in list(self._chunking):
+            req = self._chunking[slot][0]
+            if req.deadline_at <= now:
+                del self._chunking[slot]
+                self._positions[slot] = self.max_len
+                self._free.append(slot)
+                expired.append(req)
+        if self._sched is not None:
+            expired += self._sched.drop_expired(now)
+        for req in expired:
+            self.deadline_expired += 1
+            self._done[req.request_id] = Completion(
+                request_id=req.request_id, prompt_len=len(req.prompt),
+                tokens=[], finish_reason="deadline")
+            if self.telemetry is not None:
+                self.telemetry.on_deadline_expired()
+                self.telemetry.on_retire(req.request_id, new_tokens=0,
+                                         finish_reason="deadline")
+        # active slots: retire with whatever was accepted so far (the
+        # partial output is real work — only FUTURE compute is dropped)
+        for slot, req in list(self._active.items()):
+            if req.deadline_at <= now:
+                self.deadline_expired += 1
+                if self.telemetry is not None:
+                    self.telemetry.on_deadline_expired()
+                self._retire(slot, "deadline")
+
     def _admit(self) -> None:
         """Admit every queued request a free slot can take, as ONE
         batched prefill. The r1 per-request path cost a full weight pass
@@ -1131,7 +1277,8 @@ class GenerationEngine:
         # cached span never enters the prefill transient, which is
         # exactly why a shared-prefix wave packs more rows per dispatch.
         longest = 0
-        while self._queue and self._free and len(batch) < 128:
+        while (self._queue and self._free and len(batch) < 128
+               and self._occupied + len(batch) < self._slot_cap):
             head = self._queue[0]
             suffix = len(head.prompt)
             digs = None
@@ -1154,6 +1301,8 @@ class GenerationEngine:
                     m = None
             batch.append((self._free.pop(0), self._queue.pop(0)))
             matches.append(m)
+        if not batch:
+            return     # occupancy cap (supervisor resource breaker)
         plens = [len(req.prompt) for _, req in batch]
         suffix_lens = [plens[i] - (matches[i].tokens if matches[i]
                                    else 0) for i in range(len(batch))]
@@ -1172,45 +1321,61 @@ class GenerationEngine:
         wave_kind = "prefill_seeded" if seeded else "prefill"
         seq = self.telemetry.next_step() if self.telemetry is not None \
             else None
-        with step_annotation(wave_kind, seq):
-            if seeded:
-                # Seeded wave: rows prefill only their suffix; the
-                # matched blocks gather from the pool inside the same
-                # program. NB pads to a power of two (same
-                # compile-count bounding as N).
-                nb = 1
-                while nb < max(len(m.block_ids) for m in matches
-                               if m is not None):
-                    nb *= 2
-                bids = np.full((n, nb), self._prefix.num_blocks,
-                               dtype=np.int32)               # OOB pad
-                pref_lens = np.zeros((n,), dtype=np.int32)
-                for i, (slot, req) in enumerate(batch):
-                    suf = req.prompt[plens[i] - suffix_lens[i]:]
-                    tokens[i, :len(suf)] = suf
-                    lengths[i] = len(suf)
-                    slots[i] = slot
-                    if matches[i] is not None:
-                        bids[i, :len(matches[i].block_ids)] = \
-                            matches[i].block_ids
-                        pref_lens[i] = matches[i].tokens
-                first_dev, self._cache = self._admit_seeded_fn(
-                    self.params, jnp.asarray(tokens),
-                    jnp.asarray(lengths),
-                    self._prefix.pool["k"], self._prefix.pool["v"],
-                    jnp.asarray(bids.reshape(-1)),
-                    jnp.asarray(pref_lens),
-                    self._cache, jnp.asarray(slots), sub)
-            else:
-                for i, (slot, req) in enumerate(batch):
-                    tokens[i, :plens[i]] = req.prompt
-                    lengths[i] = plens[i]
-                    slots[i] = slot
-                first_dev, self._cache = self._admit_fn(
-                    self.params, jnp.asarray(tokens),
-                    jnp.asarray(lengths),
-                    self._cache, jnp.asarray(slots), sub)
-            first = _host_fetch(first_dev)         # the ONE host sync
+        try:
+            with step_annotation(wave_kind, seq), \
+                    self._dispatch_boundary(wave_kind):
+                if seeded:
+                    # Seeded wave: rows prefill only their suffix; the
+                    # matched blocks gather from the pool inside the
+                    # same program. NB pads to a power of two (same
+                    # compile-count bounding as N).
+                    nb = 1
+                    while nb < max(len(m.block_ids) for m in matches
+                                   if m is not None):
+                        nb *= 2
+                    bids = np.full((n, nb), self._prefix.num_blocks,
+                                   dtype=np.int32)           # OOB pad
+                    pref_lens = np.zeros((n,), dtype=np.int32)
+                    for i, (slot, req) in enumerate(batch):
+                        suf = req.prompt[plens[i] - suffix_lens[i]:]
+                        tokens[i, :len(suf)] = suf
+                        lengths[i] = len(suf)
+                        slots[i] = slot
+                        if matches[i] is not None:
+                            bids[i, :len(matches[i].block_ids)] = \
+                                matches[i].block_ids
+                            pref_lens[i] = matches[i].tokens
+                    first_dev, self._cache = self._admit_seeded_fn(
+                        self.params, jnp.asarray(tokens),
+                        jnp.asarray(lengths),
+                        self._prefix.pool["k"], self._prefix.pool["v"],
+                        jnp.asarray(bids.reshape(-1)),
+                        jnp.asarray(pref_lens),
+                        self._cache, jnp.asarray(slots), sub)
+                else:
+                    for i, (slot, req) in enumerate(batch):
+                        tokens[i, :plens[i]] = req.prompt
+                        lengths[i] = plens[i]
+                        slots[i] = slot
+                    first_dev, self._cache = self._admit_fn(
+                        self.params, jnp.asarray(tokens),
+                        jnp.asarray(lengths),
+                        self._cache, jnp.asarray(slots), sub)
+                first = _host_fetch(first_dev)     # the ONE host sync
+        except Exception:
+            # Lossless unwind (crash containment): the wave's requests
+            # were popped from queue+free but never activated — put
+            # them back at the queue head (order preserved) and release
+            # the lookup pins, so an admit failure costs one retried
+            # wave, never a lost request. (Retried lookups re-count in
+            # the prefix stats; the savings ledger only counts
+            # successful waves, so it stays honest.)
+            for i, (slot, req) in enumerate(batch):
+                self._free.append(slot)
+                if matches[i] is not None:
+                    self._prefix.release(matches[i])
+            self._queue[0:0] = [req for _slot, req in batch]
+            raise
         prefill_s = time.monotonic() - t0
         self.admitted_s += prefill_s
         if self.telemetry is not None:
@@ -1340,7 +1505,8 @@ class GenerationEngine:
         rows whose prompt completes activate into decode with their
         first token (sampled in-program from the last prompt
         position). Free/active rows park OOB and drop."""
-        while self._chunk_pending and self._free:
+        while self._chunk_pending and self._free \
+                and self._occupied < self._slot_cap:
             req = self._chunk_pending.pop(0)
             slot = self._free.pop(0)
             self._chunking[slot] = [req, 0, time.monotonic()]
@@ -1367,7 +1533,13 @@ class GenerationEngine:
         self._key, sub = jax.random.split(self._key)
         seq = self.telemetry.next_step() if self.telemetry is not None \
             else None
-        with step_annotation("prefill_chunk", seq):
+        # On failure the _chunking entries are untouched (fill offsets
+        # only advance after a successful host fetch): an injected
+        # fault retries the same chunk next step; a real device failure
+        # is evacuated by the supervisor, which restarts chunking
+        # requests from token zero (their partial fill is not trusted).
+        with step_annotation("prefill_chunk", seq), \
+                self._dispatch_boundary("prefill_chunk"):
             with quant.pallas_qmatmul_override(
                     self._decode_pallas_override):
                 first_dev, self._cache = self._chunk_fn(
@@ -1426,7 +1598,10 @@ class GenerationEngine:
         # steps keep the plain windowed path: a window amortizes the
         # host sync over ``decode_window`` tokens, which beats a
         # 1-token verify dispatch when there is nothing to verify.
-        if (self.spec_decode and self._active
+        # _spec_allowed consults the supervisor's spec_verify circuit
+        # breaker: open → plain decode serves (degraded mode), half-
+        # open → exactly this step may probe with a verify dispatch.
+        if (self.spec_decode and self._active and self._spec_allowed()
                 and not (self._prefilling and self._free)):
             drafts = self._spec_drafts()
             if drafts:
@@ -1443,7 +1618,8 @@ class GenerationEngine:
         seq = self.telemetry.next_step() if self.telemetry is not None \
             else None
         piggy_tok0 = self.piggy_tokens
-        with step_annotation(step_kind, seq):
+        with step_annotation(step_kind, seq), \
+                self._dispatch_boundary(step_kind):
             if piggy:
                 toks = self._dispatch_piggyback(sub)
                 self.piggy_s += time.monotonic() - t0
@@ -1509,6 +1685,13 @@ class GenerationEngine:
                 tokens=harvested_total
                 + (self.piggy_tokens - piggy_tok0),
                 padded_tokens=window * self.num_slots)
+
+    def _spec_allowed(self) -> bool:
+        """Spec-decode degraded-mode gate: the supervisor's
+        ``spec_verify`` circuit breaker (open after repeated verify
+        failures) vetoes the verify dispatch; plain decode serves."""
+        sup = self.supervisor
+        return sup is None or sup.spec_allowed()
 
     def _spec_track(self, slot: int, req: Request, first_tok: int
                     ) -> None:
@@ -1584,7 +1767,8 @@ class GenerationEngine:
         t0 = time.monotonic()
         seq = self.telemetry.next_step() if self.telemetry is not None \
             else None
-        with step_annotation("verify", seq):
+        with step_annotation("verify", seq), \
+                self._dispatch_boundary("verify"):
             with quant.pallas_qmatmul_override(
                     self._decode_pallas_override):
                 out_dev, acc_dev, self._cache = self._verify_fn(
@@ -1676,7 +1860,8 @@ class GenerationEngine:
             plen = len(req.prompt)
             steps = -(-plen // chunk)
             lane = min(range(p), key=lambda i: lane_next[i])
-            if lane_next[lane] + steps > w_sz or not self._free:
+            if (lane_next[lane] + steps > w_sz or not self._free
+                    or self._occupied + len(placed) >= self._slot_cap):
                 deferred.append((req, started))
                 continue                        # wait for next dispatch
             slot = self._free.pop(0)
@@ -1709,6 +1894,28 @@ class GenerationEngine:
         are activated into their slots here."""
         (pre_tok, rope_base, kv_begin, kv_len, sel_rel, sel_w, sel_p,
          sidx, pidx, placed) = self._pack_prefill()
+        try:
+            toks = self._piggy_dispatch(
+                key, pre_tok, rope_base, kv_begin, kv_len, sel_rel,
+                sel_w, sel_p, sidx, pidx, placed)
+        except Exception:
+            # Lossless unwind (crash containment): packed rows took
+            # slots and left _prefilling but never activated — requeue
+            # them (queue head) and free their slots, and back out the
+            # accounting _pack_prefill charged for work that never ran.
+            for slot, req, _started, _i in placed:
+                self._free.append(slot)
+            self._queue[0:0] = [req for _s, req, _t, _i in placed]
+            n_tok = sum(len(req.prompt) for _s, req, _t, _i in placed)
+            self.piggy_rows -= len(placed)
+            self.piggy_tokens -= n_tok
+            self.prefill_tokens -= n_tok
+            raise
+        return toks
+
+    def _piggy_dispatch(self, key, pre_tok, rope_base, kv_begin,
+                        kv_len, sel_rel, sel_w, sel_p, sidx, pidx,
+                        placed) -> np.ndarray:
         with quant.pallas_qmatmul_override(self._decode_pallas_override):
             toks_dev, first_dev, self._cache = self._piggy_fn(
                 self.params,
@@ -1760,10 +1967,17 @@ class GenerationEngine:
             # cache still holds this prompt's KV at [0, plen). Prompt
             # KV is temperature-independent (it never saw a sampled
             # token), so it is safe to share across sampling configs.
+            # A publish failure is CONTAINED here (counted, pin still
+            # released): it loses only this prompt's cache
+            # contribution, and must never take the completion — or
+            # the whole step — down with it.
             try:
-                self._prefix.publish(
-                    req.prompt, self._cache, slot,
-                    eligible_tokens=req.cache_eligible_tokens)
+                with self._dispatch_boundary("prefix_publish"):
+                    self._prefix.publish(
+                        req.prompt, self._cache, slot,
+                        eligible_tokens=req.cache_eligible_tokens)
+            except Exception:
+                self.prefix_publish_failures += 1
             finally:
                 m = self._prefix_pins.pop(req.request_id, None)
                 if m is not None:

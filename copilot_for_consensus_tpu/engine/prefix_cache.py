@@ -161,6 +161,28 @@ class PrefixCache:
     def node_count(self) -> int:
         return len(self._nodes)
 
+    @property
+    def pinned_refcount(self) -> int:
+        """Total outstanding pins across all nodes — the supervisor's
+        invariant audit compares this against the engine's live pin
+        table to spot leaks."""
+        return sum(n.refcount for n in self._nodes)
+
+    def flush(self) -> int:
+        """Drop EVERY cached block (trie + pool accounting) and return
+        how many were freed. The engine supervisor calls this after a
+        failure that may have corrupted device state: pool blocks of
+        unknown integrity must never seed a future admission wave. Any
+        still-held ``PrefixMatch`` is force-orphaned (its nodes leave
+        the trie; ``release`` on it stays safe because it only
+        decrements node refcounts we are discarding anyway)."""
+        n = len(self._nodes)
+        self._nodes.clear()
+        self._root.children.clear()
+        self._free = list(range(self.num_blocks))
+        self.stats.blocks_evicted += n
+        return n
+
     # -- hashing / matching ---------------------------------------------
 
     def _block_digests(self, tokens, n_blocks: int):

@@ -189,6 +189,13 @@ class Scheduler:
         self._rotation: list[str] = []     # DRR visit order
         #: closed-loop state (observe())
         self.overload_level = 0            # 0 ok | 1 shed batch | 2 all
+        #: external pressure floor on the overload level, set by the
+        #: engine supervisor's resource breaker (engine/supervisor.py):
+        #: repeated device resource exhaustion lowers the engine's
+        #: occupancy cap AND raises this, so the shed loop starts
+        #: rejecting batch work at the edge instead of re-OOMing.
+        #: Cleared by the supervisor when capacity is restored.
+        self.pressure = 0
         self.retry_after_s = self.cfg.min_retry_after_s
         self.last_signals: dict[str, float] = {}
         #: requests staged inside the engine (queue/prefilling/chunking)
@@ -347,6 +354,7 @@ class Scheduler:
             level = 1
         if queued >= self.cfg.max_queue_depth:
             level = 2
+        level = max(level, min(2, self.pressure))
         self.overload_level = level
         # Honest Retry-After: time to drain the current backlog at the
         # recently observed completion rate, clamped. No observed rate
@@ -370,6 +378,75 @@ class Scheduler:
         }
         self._export_gauges()
         return self.last_signals
+
+    # -- per-request deadlines (engine/supervisor.py policy) ------------
+
+    def drop_expired(self, now: float | None = None) -> list:
+        """Remove queued requests whose ``deadline_at`` has passed and
+        return them (the engine retires each with
+        ``finish_reason="deadline"`` — expired work is DROPPED, never
+        computed). The per-tenant queued-token ledgers are repaid so
+        quota accounting stays honest."""
+        now = time.monotonic() if now is None else now
+        out: list = []
+        for (tenant, _lane), q in self._queues.items():
+            if not q:
+                continue
+            keep = [r for r in q
+                    if getattr(r, "deadline_at", float("inf")) > now]
+            if len(keep) == len(q):
+                continue
+            st = self._tenants[tenant]
+            for r in q:
+                if getattr(r, "deadline_at", float("inf")) <= now:
+                    out.append(r)
+                    st.queued_tokens = max(
+                        0, st.queued_tokens - len(r.prompt))
+            q.clear()
+            q.extend(keep)
+        if out:
+            self._export_gauges()
+        return out
+
+    def purge(self) -> list:
+        """Drain EVERY tenant queue, repaying the queued-token ledgers
+        and re-exporting the gauges; returns the dropped requests. The
+        engine supervisor uses this after a suspect event (the dropped
+        requests' handles were already failed — computing them would
+        serve nobody)."""
+        out: list = []
+        for (tenant, _lane), q in self._queues.items():
+            if not q:
+                continue
+            st = self._tenants[tenant]
+            for r in q:
+                out.append(r)
+                st.queued_tokens = max(
+                    0, st.queued_tokens - len(r.prompt))
+            q.clear()
+        if out:
+            self._export_gauges()
+        return out
+
+    def recount_queued_tokens(self) -> dict[str, tuple[int, int]]:
+        """Recompute every tenant's queued-token ledger from the
+        actual queues; returns ``{tenant: (recorded, actual)}`` for
+        the ones that drifted (already repaired). The supervisor's
+        post-failure invariant audit calls this — ledger drift would
+        silently skew quota enforcement forever."""
+        actual: dict[str, int] = {}
+        for (tenant, _lane), q in self._queues.items():
+            actual[tenant] = actual.get(tenant, 0) + sum(
+                len(r.prompt) for r in q)
+        drift: dict[str, tuple[int, int]] = {}
+        for tenant, st in self._tenants.items():
+            want = actual.get(tenant, 0)
+            if st.queued_tokens != want:
+                drift[tenant] = (st.queued_tokens, want)
+                st.queued_tokens = want
+        if drift:
+            self._export_gauges()
+        return drift
 
     # -- wave composition (DRR + prefix placement) ----------------------
 

@@ -139,6 +139,42 @@ METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "counter", ("engine",),
         "Chunked-prefill continuation rows dispatched (long prompts "
         "split across decode steps to bound ITL)."),
+    # ---- resilience: fault plane + supervisor (engine/faults.py,
+    # engine/supervisor.py; docs/RESILIENCE.md) ----
+    "engine_fault_injected_total": (
+        "counter", ("engine", "kind", "mode"),
+        "Faults fired by the injection plane (chaos harness; any "
+        "nonzero value in production means a fault plan leaked in)."),
+    "engine_fault_watchdog_trips_total": (
+        "counter", ("engine", "kind"),
+        "Dispatches that overran their per-kind watchdog deadline — "
+        "the engine was marked suspect and in-flight handles failed "
+        "structured instead of wedging their callers."),
+    "engine_fault_breaker_state": (
+        "gauge", ("engine", "breaker"),
+        "Circuit-breaker state per degraded mode (0 closed, 0.5 "
+        "half-open probe, 1 open): spec_verify open = spec decode "
+        "disabled; resource open = occupancy cap lowered."),
+    "engine_recovery_replays_total": (
+        "counter", ("engine",),
+        "In-flight requests resubmitted as prompt+generated "
+        "continuations after an engine failure (request replay)."),
+    "engine_recovery_failed_total": (
+        "counter", ("engine",),
+        "Requests terminally failed with structured EngineFailed "
+        "after their replay budget was spent."),
+    "engine_recovery_quarantined_slots": (
+        "gauge", ("engine",),
+        "Slots quarantined by the post-failure invariant audit "
+        "(irreconcilable state; capacity reduced until restart)."),
+    "engine_recovery_released_pins_total": (
+        "counter", ("engine",),
+        "Leaked prefix-cache pins released by the post-failure audit "
+        "(a leaked pin would hold its pool blocks forever)."),
+    "engine_recovery_deadline_expired_total": (
+        "counter", ("engine",),
+        "Requests dropped (not computed) because their per-request "
+        "deadline_s expired before completion."),
 }
 
 #: step-record kinds the engines emit (doc + test anchor)
@@ -447,6 +483,43 @@ class EngineTelemetry:
     def on_prefill_chunks(self, rows: int = 1) -> None:
         self.metrics.increment("engine_sched_prefill_chunks_total",
                                float(rows), self._labels)
+
+    # -- resilience (engine/faults.py, engine/supervisor.py) ------------
+
+    def on_fault_injected(self, kind: str, mode: str) -> None:
+        self.metrics.increment(
+            "engine_fault_injected_total", 1.0,
+            {**self._labels, "kind": kind, "mode": mode})
+
+    def on_watchdog_trip(self, kind: str) -> None:
+        self.metrics.increment("engine_fault_watchdog_trips_total", 1.0,
+                               {**self._labels, "kind": kind})
+
+    def breaker_gauge(self, breaker: str, state: float) -> None:
+        """0 closed | 0.5 half-open | 1 open (CircuitBreaker.GAUGE)."""
+        self.metrics.gauge("engine_fault_breaker_state", float(state),
+                           {**self._labels, "breaker": breaker})
+
+    def on_replay(self, n: int = 1) -> None:
+        self.metrics.increment("engine_recovery_replays_total",
+                               float(n), self._labels)
+
+    def on_replay_failed(self, n: int = 1) -> None:
+        self.metrics.increment("engine_recovery_failed_total",
+                               float(n), self._labels)
+
+    def gauge_quarantined(self, n: int) -> None:
+        self.metrics.gauge("engine_recovery_quarantined_slots",
+                           float(n), self._labels)
+
+    def on_released_pins(self, n: int = 1) -> None:
+        self.metrics.increment("engine_recovery_released_pins_total",
+                               float(n), self._labels)
+
+    def on_deadline_expired(self, n: int = 1) -> None:
+        self.metrics.increment(
+            "engine_recovery_deadline_expired_total", float(n),
+            self._labels)
 
     def update_ledgers(self, prefix_stats: dict | None = None,
                        spec_stats: dict | None = None) -> None:

@@ -19,6 +19,10 @@ from typing import Any, Callable
 from urllib.parse import parse_qs, unquote, urlparse
 
 from copilot_for_consensus_tpu.engine.scheduler import EngineOverloaded
+from copilot_for_consensus_tpu.engine.supervisor import (
+    EngineFailed,
+    EngineSuspect,
+)
 
 
 class HTTPError(Exception):
@@ -163,6 +167,16 @@ class Router:
                     exc.as_event_fields(), status=429,
                     headers={"Retry-After":
                              str(max(1, math.ceil(exc.retry_after_s)))})
+            except (EngineFailed, EngineSuspect) as exc:
+                # The supervisor's structured terminal failures
+                # (engine/supervisor.py): the replay budget was spent
+                # or the watchdog declared the engine suspect. 503 (the
+                # backend is degraded, the request may succeed on
+                # retry once recovery completes) with the correlation
+                # id / flight-record path in the body so the client
+                # report joins the post-mortem — NOT an anonymous 500.
+                return Response(exc.as_event_fields(), status=503,
+                                headers={"Retry-After": "5"})
             except Exception as exc:
                 # A handler bug must yield a 500 response, not a dropped
                 # connection (reference services respond through FastAPI's

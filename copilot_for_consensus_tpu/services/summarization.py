@@ -23,6 +23,10 @@ from copilot_for_consensus_tpu.core.retry import (
     RetryableError,
 )
 from copilot_for_consensus_tpu.engine.scheduler import EngineOverloaded
+from copilot_for_consensus_tpu.engine.supervisor import (
+    EngineFailed,
+    EngineSuspect,
+)
 from copilot_for_consensus_tpu.services.base import BaseService
 from copilot_for_consensus_tpu.summarization.base import (
     RateLimitError,
@@ -253,6 +257,16 @@ class SummarizationService(BaseService):
             raise RetryableError(
                 f"engine overloaded ({exc.reason}), retry after "
                 f"{exc.retry_after_s:.1f}s") from exc
+        except (EngineFailed, EngineSuspect) as exc:
+            # Supervisor-structured engine failure (replay budget
+            # spent / watchdog suspect): the bus retry policy is the
+            # outer recovery layer — exactly the broker-redelivery
+            # story the reference gets from RabbitMQ when its
+            # inference container dies (SURVEY §0). The engine will
+            # have recovered (or been replaced) by redelivery time.
+            raise RetryableError(
+                f"engine failure ({type(exc).__name__}): {exc}"
+            ) from exc
         latency = time.monotonic() - t0
         self._store_and_publish(summary, summary_id, thread_id,
                                 selected_chunks, context_selection,

@@ -43,6 +43,11 @@ def create_summarizer(config: Any = None, **kwargs: Any) -> Summarizer:
             quantize=_cfg_get(config, "quantize", "int8"),
             long_context=bool(_cfg_get(config, "long_context", False)),
             profile_dir=_cfg_get(config, "profile_dir"),
+            # resilience (engine/supervisor.py): supervisor=true wires
+            # watchdog + request replay + degraded-mode breakers into
+            # the engine's dispatcher; deadline_s drops expired work
+            supervisor=_cfg_get(config, "supervisor", None),
+            deadline_s=_cfg_get(config, "deadline_s", None),
             **kwargs,
         )
     if driver in ("openai", "azure_openai"):

@@ -80,7 +80,8 @@ class TPUSummarizer(Summarizer):
                  quantize: bool | str = "int8",
                  cache_scope: str = "full",
                  profile_dir: str | None = None,
-                 tenant: str = "", priority: str = ""):
+                 tenant: str = "", priority: str = "",
+                 supervisor=None, deadline_s: float | None = None):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
             ByteTokenizer,
@@ -95,6 +96,13 @@ class TPUSummarizer(Summarizer):
         #: (engine/scheduler.py); per-call kwargs override
         self.tenant = tenant
         self.priority = priority
+        #: resilience (engine/supervisor.py): True/SupervisorConfig
+        #: wires watchdog + containment + request replay + degraded-
+        #: mode breakers into the lazily-built AsyncEngineRunner;
+        #: deadline_s is the default per-request wall-clock budget
+        #: (expired work is dropped, not computed)
+        self.supervisor = supervisor
+        self.deadline_s = deadline_s
         #: obs/errors.py reporter for engine dispatch failures — set by
         #: the owning service (SummarizationService wires its own); the
         #: lazily-built AsyncEngineRunner picks it up so an engine
@@ -221,9 +229,28 @@ class TPUSummarizer(Summarizer):
         handles = [runner.submit(p, self.max_new_tokens,
                                  cache_eligible_tokens=self._cache_eligible,
                                  tenant=self.tenant,
-                                 priority=self.priority)
+                                 priority=self.priority,
+                                 deadline_s=self.deadline_s)
                    for p in prompts]
-        return [h.result(timeout=600.0) for h in handles]
+        return [self._checked(h.result(timeout=600.0))
+                for h in handles]
+
+    @staticmethod
+    def _checked(comp):
+        """A deadline-expired completion (dropped un-computed, empty
+        tokens) must surface as a structured FAILURE, not decode into
+        an empty 'successful' summary the service would store and
+        publish — the bus retry policy is the recovery layer here,
+        same as every other engine failure mode."""
+        if comp.finish_reason == "deadline" and not comp.tokens:
+            from copilot_for_consensus_tpu.engine.supervisor import (
+                EngineFailed,
+            )
+            raise EngineFailed(
+                f"request {comp.request_id} dropped at its deadline "
+                f"before any tokens were generated",
+                request_id=comp.request_id, reason="deadline-expired")
+        return comp
 
     def summarize_async(self, thread: ThreadContext, *,
                         correlation_id: str = "", tenant: str = "",
@@ -247,6 +274,13 @@ class TPUSummarizer(Summarizer):
             AsyncEngineRunner,
         )
 
+        fi = getattr(self.engine, "faults", None)
+        if fi is not None:
+            # tokenization is a host boundary of the serving path too —
+            # the chaos harness scripts kind="tokenize" faults here; an
+            # injected fault raises synchronously and the service's
+            # failure handling contains it like any bad request
+            fi.check("tokenize")
         prompt = self.tokenizer.encode(
             build_prompt(thread, self.template, self.system),
             add_bos=True)
@@ -271,16 +305,18 @@ class TPUSummarizer(Summarizer):
         if getattr(self, "_runner", None) is None:
             self._runner = AsyncEngineRunner(
                 self.engine,
-                error_reporter=self.error_reporter).start()
+                error_reporter=self.error_reporter,
+                supervisor=self.supervisor).start()
         handle = self._runner.submit(
             prompt, self.max_new_tokens,
             cache_eligible_tokens=self._cache_eligible,
             correlation_id=correlation_id,
             tenant=tenant or self.tenant,
-            priority=priority or self.priority)
+            priority=priority or self.priority,
+            deadline_s=self.deadline_s)
 
         def wait(timeout: float | None = 600.0) -> Summary:
-            comp = handle.result(timeout)
+            comp = self._checked(handle.result(timeout))
             return Summary(
                 thread_id=thread.thread_id,
                 summary_text=self.tokenizer.decode(comp.tokens).strip(),

@@ -142,6 +142,42 @@ def test_sched_columns_contract():
     assert empty["fairness_jain_index"] == 1.0
 
 
+def test_chaos_preset_registered():
+    """The resilience gate's preset (ISSUE 7): spec decode ON (the
+    persistent verify fault needs a verify dispatch to hit), compute
+    dtype pinned to float32 (the replay bit-identity requirement:
+    prefill and decode logits only agree exactly at f32), a hang
+    longer than the watchdog deadline, contract-traced through the
+    generation engine."""
+    assert "chaos" in bench.PRESETS
+    p = bench.PRESETS["chaos"]
+    assert p["BENCH_SPEC_DECODE"] == "1"
+    assert p["BENCH_CHAOS_DTYPE"] == "float32"
+    assert float(p["BENCH_CHAOS_HANG_S"]) > \
+        float(p["BENCH_CHAOS_DECODE_DEADLINE_S"])
+    assert int(p["BENCH_CHAOS_CHAT"]) > 0 and \
+        int(p["BENCH_CHAOS_LONG"]) > 0
+    assert "copilot_for_consensus_tpu.engine.generation" in \
+        bench.PRESET_CONTRACT_MODULES["chaos"]
+
+
+def test_chaos_columns_contract():
+    """The chaos artifact columns are a cross-round contract:
+    recovered / replayed / failed / breaker_trips / watchdog_trips
+    (plus the chaos_ok verdict assembled in chaos_headline)."""
+    rec = {"recovered": 5, "replayed": 7, "failed": 1,
+           "breaker_trips": 2, "watchdog_trips": 1,
+           "containments": 9, "suspect_failures": 3}
+    cols = bench.chaos_columns(rec)
+    assert set(cols) == {"recovered", "replayed", "failed",
+                         "breaker_trips", "watchdog_trips"}
+    assert cols["recovered"] == 5 and cols["failed"] == 1
+    # empty stats degrade to zeros, not KeyErrors
+    empty = bench.chaos_columns({})
+    assert empty == {"recovered": 0, "replayed": 0, "failed": 0,
+                     "breaker_trips": 0, "watchdog_trips": 0}
+
+
 def test_telemetry_columns_contract():
     """Flight-recorder columns come from the engine's own telemetry;
     a telemetry-disabled engine (BENCH_TELEMETRY=0 overhead arm)

@@ -223,6 +223,17 @@ def test_telemetry_registry_matches_actual_emission():
     tele.on_prefill_chunks(3)
     tele.record_step("prefill_chunk", 0.004, rows=2, batch=4,
                      tokens=48, padded_tokens=256)
+    # resilience series (engine/faults.py + engine/supervisor.py):
+    # fault plane, watchdog, breakers, replay, audit, deadlines
+    tele.on_fault_injected("decode", "error")
+    tele.on_watchdog_trip("decode")
+    tele.breaker_gauge("spec_verify", 1.0)
+    tele.breaker_gauge("resource", 0.5)
+    tele.on_replay()
+    tele.on_replay_failed()
+    tele.gauge_quarantined(1)
+    tele.on_released_pins(2)
+    tele.on_deadline_expired()
     tele.on_retire(1, new_tokens=8, finish_reason="eos")
     tele.update_ledgers(
         prefix_stats={"enabled": True, "hit_rate": 0.5},

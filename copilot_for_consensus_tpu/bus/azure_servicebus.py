@@ -55,6 +55,7 @@ from copilot_for_consensus_tpu.bus.base import (
     EventCallback,
     EventPublisher,
     EventSubscriber,
+    PoisonEnvelope,
     PublishError,
 )
 
@@ -469,6 +470,20 @@ class AzureServiceBusSubscriber(EventSubscriber):
                              name="sb-lock-renewer").start()
         try:
             cb(envelope)
+        except PoisonEnvelope as exc:
+            # Deterministic failure: the *Failed event (published by
+            # BaseService before raising) is the operator record.
+            # Settle the message — abandoning would re-run the handler
+            # through the whole redelivery budget and mint a duplicate
+            # failure event per delivery. This transport's REST surface
+            # has no dead-letter settle op, so completing is the
+            # degrade path bus/base.py names for drivers without
+            # quarantine support.
+            _LOG.warning("poison envelope settled on %r: %s",
+                         rk, exc.reason)
+            stop_renew.set()
+            self._complete(msg)
+            return
         except Exception:
             stop_renew.set()
             self._abandon(msg)   # redelivery; broker DLQs past max

@@ -15,6 +15,41 @@ class PublishError(Exception):
     pass
 
 
+class BusSaturated(PublishError):
+    """Structured backpressure signal: the bus cannot absorb more work.
+
+    Raised by publishers when the durable publish outbox overflows
+    (``reason="outbox-full"``) — the caller must slow down or shed;
+    also carried (not raised) by ``saturation()`` when a routing key's
+    broker-side depth crossed the high watermark
+    (``reason="queue-depth"``). Analogue of the engine's
+    ``EngineOverloaded``: honest backpressure instead of silent loss.
+    """
+
+    def __init__(self, message: str, *, routing_key: str = "",
+                 depth: int = 0, limit: int = 0,
+                 reason: str = "queue-depth"):
+        super().__init__(message)
+        self.routing_key = routing_key
+        self.depth = depth
+        self.limit = limit
+        self.reason = reason
+
+
+class PoisonEnvelope(Exception):
+    """Classification signal: this envelope can never be processed, no
+    matter how often it redelivers — schema-invalid at the bus edge
+    (``bus/validating.py``) or a deterministic (non-``RetryableError``)
+    handler failure. Subscriber drivers that support quarantine skip
+    the redelivery budget and park it in the dead-letter table with
+    ``reason``; drivers without poison support degrade to the normal
+    redelivery budget."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
 class EventPublisher(abc.ABC):
     """Publishes event envelopes to a topic exchange by routing key."""
 
@@ -23,6 +58,20 @@ class EventPublisher(abc.ABC):
 
     def close(self) -> None:
         pass
+
+    def saturation(self) -> dict[str, int]:
+        """Routing keys whose last-known broker-side depth is at/above
+        this publisher's high watermark (empty when unconfigured or
+        healthy) — the signal services throttle consumption on.
+        Drivers without depth feedback return {}."""
+        return {}
+
+    def pending_depths(self) -> dict[str, int]:
+        """Best-effort snapshot of broker-side pending depth per
+        routing key (the ingestion pacing surface). Drivers without an
+        introspection channel — or with an unreachable broker —
+        return {}."""
+        return {}
 
     @abc.abstractmethod
     def publish_envelope(self, envelope: Mapping[str, Any],

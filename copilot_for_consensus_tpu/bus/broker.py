@@ -33,9 +33,11 @@ import time
 from typing import Any
 
 from copilot_for_consensus_tpu.bus.base import (
+    BusSaturated,
     EventCallback,
     EventPublisher,
     EventSubscriber,
+    PoisonEnvelope,
     PublishError,
 )
 
@@ -81,12 +83,18 @@ class _QueueStore:
                     state TEXT NOT NULL DEFAULT 'pending',
                     attempts INTEGER NOT NULL DEFAULT 0,
                     lease_expires REAL,
-                    enqueued_at REAL NOT NULL
+                    enqueued_at REAL NOT NULL,
+                    reason TEXT
                 )""")
             try:  # pre-group db files: add the column in place
                 self._db.execute(
                     "ALTER TABLE messages ADD COLUMN grp TEXT "
                     "NOT NULL DEFAULT ''")
+            except sqlite3.OperationalError:
+                pass
+            try:  # pre-quarantine db files: dead-letter reason column
+                self._db.execute(
+                    "ALTER TABLE messages ADD COLUMN reason TEXT")
             except sqlite3.OperationalError:
                 pass
             self._db.execute("""
@@ -114,7 +122,11 @@ class _QueueStore:
                     "UPDATE messages SET grp=? "
                     "WHERE rk=? AND grp='' AND state='pending'", (grp, rk))
 
-    def enqueue(self, rk: str, envelope: str) -> int:
+    def enqueue(self, rk: str, envelope: str) -> tuple[int, int]:
+        """Insert one row per bound group; returns (last row id, depth)
+        where depth is the key's worst per-group pending count AFTER the
+        insert — piggybacked on the publisher confirm so producers get
+        backpressure feedback for free with every publish."""
         now = time.time()
         with self._lock, self._db:
             groups = [g for (g,) in self._db.execute(
@@ -125,7 +137,26 @@ class _QueueStore:
                     "INSERT INTO messages (rk, grp, envelope, enqueued_at) "
                     "VALUES (?, ?, ?, ?)", (rk, grp, envelope, now))
                 last = cur.lastrowid
-            return last
+            return last, self._depth_locked(rk)
+
+    def _depth_locked(self, rk: str) -> int:
+        # Parked rows (grp='', published before any consumer bound —
+        # possibly never: report.published and *.failed have no
+        # subscriber by design) are retention, not backlog: counting
+        # them would make watermark pacing stall a stage forever
+        # against a queue nothing drains. Depth = work a LIVE consumer
+        # group is behind on.
+        row = self._db.execute(
+            "SELECT MAX(n) FROM (SELECT COUNT(*) AS n FROM messages "
+            "WHERE rk=? AND state='pending' AND grp != '' GROUP BY grp)",
+            (rk,)).fetchone()
+        return int(row[0] or 0)
+
+    def depth(self, rk: str) -> int:
+        """Worst per-group pending count for one key — the watermark
+        poll the pacing publisher uses between confirms."""
+        with self._lock:
+            return self._depth_locked(rk)
 
     def fetch(self, rks: list[str], grp: str, limit: int, lease_s: float
               ) -> list[tuple[int, str, str, int]]:
@@ -157,17 +188,32 @@ class _QueueStore:
                 f"({','.join('?' for _ in ids)}) AND state='inflight'",
                 ids)
 
-    def nack(self, ids: list[int], max_redeliveries: int) -> None:
+    def nack(self, ids: list[int], max_redeliveries: int,
+             poison: bool = False, reason: str | None = None) -> None:
         if not ids:
             return
         qmarks = ",".join("?" for _ in ids)
         with self._lock, self._db:
+            if poison:
+                # Quarantine: a deterministically-unprocessable message
+                # (schema-invalid, non-retryable handler error) skips
+                # the redelivery budget entirely — straight to the
+                # dead-letter state with a structured reason, attempts
+                # untouched so the operator sees it never cycled.
+                self._db.execute(
+                    f"UPDATE messages SET state='dead', "
+                    f"lease_expires=NULL, reason=? "
+                    f"WHERE id IN ({qmarks}) AND state='inflight'",
+                    (reason or "poison", *ids))
+                return
             self._db.execute(
                 f"UPDATE messages SET attempts=attempts+1, "
                 f"lease_expires=NULL, state=CASE WHEN attempts+1 >= ? "
-                f"THEN 'dead' ELSE 'pending' END "
+                f"THEN 'dead' ELSE 'pending' END, "
+                f"reason=CASE WHEN attempts+1 >= ? THEN ? ELSE reason END "
                 f"WHERE id IN ({qmarks}) AND state='inflight'",
-                (max_redeliveries, *ids))
+                (max_redeliveries, max_redeliveries,
+                 reason or "redelivery budget exhausted", *ids))
 
     def expire_leases(self, parked_ttl_s: float = 300.0) -> int:
         with self._lock, self._db:
@@ -186,19 +232,34 @@ class _QueueStore:
             return cur.rowcount
 
     def counts(self) -> dict[str, dict[str, int]]:
+        """Per-key state split. Pre-bind retention rows surface as
+        ``parked`` (not ``pending``): no live consumer group owes that
+        work, so backpressure (watermark pacing, the ingestion pacer)
+        and the queue-depth gauges/alerts must not count it as
+        backlog. ``pending`` is the WORST single consumer group's
+        backlog (same semantics as :meth:`depth` and the
+        ``copilot_bus_pending`` gauge the 1000-message SLO alerts on) —
+        summing across groups would inflate a 4-consumer key 4x past
+        the depth any one consumer actually owes. Other states sum
+        across groups."""
         with self._lock:
             rows = self._db.execute(
-                "SELECT rk, state, COUNT(*) FROM messages "
-                "GROUP BY rk, state").fetchall()
+                "SELECT rk, CASE WHEN grp='' AND state='pending' "
+                "THEN 'parked' ELSE state END AS st, grp, COUNT(*) "
+                "FROM messages GROUP BY rk, st, grp").fetchall()
         out: dict[str, dict[str, int]] = {}
-        for rk, state, n in rows:
-            out.setdefault(rk, {})[state] = n
+        for rk, state, _grp, n in rows:
+            states = out.setdefault(rk, {})
+            if state == "pending":
+                states[state] = max(states.get(state, 0), n)
+            else:
+                states[state] = states.get(state, 0) + n
         return out
 
     def dead_letters(self, rk: str | None = None
-                     ) -> list[tuple[int, str, str, int]]:
-        q = ("SELECT id, rk, envelope, attempts FROM messages "
-             "WHERE state='dead'")
+                     ) -> list[tuple[int, str, str, int, str]]:
+        q = ("SELECT id, rk, envelope, attempts, "
+             "COALESCE(reason, '') FROM messages WHERE state='dead'")
         args: tuple = ()
         if rk:
             q += " AND rk=?"
@@ -207,8 +268,8 @@ class _QueueStore:
             return self._db.execute(q + " ORDER BY id", args).fetchall()
 
     def requeue_dead(self, rk: str | None = None) -> int:
-        q = "UPDATE messages SET state='pending', attempts=0 " \
-            "WHERE state='dead'"
+        q = "UPDATE messages SET state='pending', attempts=0, " \
+            "reason=NULL WHERE state='dead'"
         args: tuple = ()
         if rk:
             q += " AND rk=?"
@@ -253,8 +314,13 @@ class Broker:
     def _handle(self, req: dict) -> dict:
         op = req.get("op")
         if op == "pub":
-            mid = self.store.enqueue(req["rk"], json.dumps(req["envelope"]))
-            return {"ok": True, "id": mid}            # publisher confirm
+            mid, depth = self.store.enqueue(req["rk"],
+                                            json.dumps(req["envelope"]))
+            # publisher confirm + the key's pending depth, so every
+            # producer gets backpressure feedback with its confirm
+            return {"ok": True, "id": mid, "depth": depth}
+        if op == "depth":
+            return {"ok": True, "depth": self.store.depth(req["rk"])}
         if op == "bind":
             self.store.bind(list(req.get("rks", [])),
                             req.get("group", DEFAULT_GROUP))
@@ -271,15 +337,17 @@ class Broker:
             self.store.ack(list(req.get("ids", [])))
             return {"ok": True}
         if op == "nack":
-            self.store.nack(list(req.get("ids", [])), self.max_redeliveries)
+            self.store.nack(list(req.get("ids", [])), self.max_redeliveries,
+                            poison=bool(req.get("poison")),
+                            reason=req.get("reason"))
             return {"ok": True}
         if op == "counts":
             return {"ok": True, "counts": self.store.counts()}
         if op == "dead":
             return {"ok": True, "msgs": [
                 {"id": i, "rk": rk, "envelope": json.loads(env),
-                 "attempts": at}
-                for i, rk, env, at in self.store.dead_letters(
+                 "attempts": at, "reason": reason}
+                for i, rk, env, at, reason in self.store.dead_letters(
                     req.get("rk"))]}
         if op == "requeue_dead":
             return {"ok": True, "n": self.store.requeue_dead(req.get("rk"))}
@@ -381,8 +449,8 @@ class _Client:
             if self._sock is None:
                 self._connect()
             payload = json.dumps(req).encode()
-            last = "timeout"
-            for _ in range(self.retries):
+            last = "no attempt made"
+            for attempt in range(1, max(1, self.retries) + 1):
                 self._sock.send_multipart([b"", payload])
                 poller = zmq.Poller()
                 poller.register(self._sock, zmq.POLLIN)
@@ -392,6 +460,8 @@ class _Client:
                     if not reply.get("ok"):
                         raise PublishError(reply.get("error", "broker nak"))
                     return reply
+                last = (f"timeout after {self.timeout_ms}ms on attempt "
+                        f"{attempt}/{self.retries}")
                 self._connect()      # stale socket: drop + reconnect
             raise PublishError(f"broker unreachable at {self.address} "
                                f"({last})")
@@ -403,17 +473,142 @@ class _Client:
                 self._sock = None
 
 
+class _Outbox:
+    """Bounded durable publish outbox: envelopes the broker could not
+    confirm park here (sqlite WAL, same file discipline as
+    ``_QueueStore``; ``:memory:`` for embedded publishers — set
+    ``outbox_path`` when parked work must survive a publisher-process
+    restart too). Strictly FIFO: rows leave only after the broker
+    confirmed them, so replay order == publish order."""
+
+    def __init__(self, path: str = ":memory:", cap: int = 10000):
+        self.cap = cap
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._lock = threading.Lock()
+        with self._lock, self._db:
+            self._db.execute("""
+                CREATE TABLE IF NOT EXISTS outbox (
+                    id INTEGER PRIMARY KEY AUTOINCREMENT,
+                    rk TEXT NOT NULL,
+                    envelope TEXT NOT NULL,
+                    parked_at REAL NOT NULL
+                )""")
+            # cached row count (seeded from durable files): depth() is
+            # on the publish hot path, where it almost always answers
+            # "empty" — that must not cost a sqlite query per publish
+            self._n = int(self._db.execute(
+                "SELECT COUNT(*) FROM outbox").fetchone()[0])
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._n
+
+    def append(self, rk: str, envelope_json: str) -> int:
+        with self._lock, self._db:
+            cur = self._db.execute(
+                "INSERT INTO outbox (rk, envelope, parked_at) "
+                "VALUES (?, ?, ?)", (rk, envelope_json, time.time()))
+            self._n += 1
+            return cur.lastrowid
+
+    def oldest(self, limit: int) -> list[tuple[int, str, str]]:
+        with self._lock:
+            return self._db.execute(
+                "SELECT id, rk, envelope FROM outbox ORDER BY id "
+                "LIMIT ?", (limit,)).fetchall()
+
+    def remove(self, ids: list[int]) -> None:
+        if not ids:
+            return
+        with self._lock, self._db:
+            cur = self._db.execute(
+                f"DELETE FROM outbox WHERE id IN "
+                f"({','.join('?' for _ in ids)})", ids)
+            self._n -= cur.rowcount
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
 class BrokerPublisher(EventPublisher):
     """Publishes with broker confirms (the role of RabbitMQ publisher
-    confirms, ``rabbitmq_publisher.py:146-149``)."""
+    confirms, ``rabbitmq_publisher.py:146-149``) — and, new in the
+    pipeline fault plane round, SURVIVES the broker being away:
 
-    def __init__(self, config: Any = None):
+    * **Outage ride-through.** A publish the broker cannot confirm
+      parks in a bounded durable outbox instead of raising into the
+      handler (where it used to become nack → redelivery → dead
+      letter); a stop-aware backoff thread replays parked envelopes in
+      publish order once the broker is back, so a broker restart costs
+      latency, not work. Outbox overflow raises the structured
+      :class:`BusSaturated` (``reason="outbox-full"``) — honest
+      backpressure, never a silent drop.
+    * **Depth-watermark backpressure.** Every confirm carries the
+      routing key's broker-side pending depth. With
+      ``high_watermark`` configured, a publish that lands at/above it
+      blocks (stop-aware, bounded by ``saturation_max_wait_s``) until
+      the key drains below ``low_watermark`` — pacing the producer at
+      the source — and ``saturation()`` exposes the hot keys so
+      services can throttle their own consumption too.
+    * **Fault plane.** ``faults`` (a ``bus/faults.py`` boundary or
+      plan) fires the ``publish`` boundary: injected faults take the
+      exact outage path above, which is how the chaos harness proves
+      the ride-through deterministically.
+
+    Config keys: ``timeout_ms``, ``retries``, ``outbox_path``,
+    ``outbox_cap``, ``high_watermark`` (0 = off), ``low_watermark``
+    (default half of high), ``saturation_poll_s``,
+    ``saturation_max_wait_s``."""
+
+    def __init__(self, config: Any = None, client=None, faults=None):
+        from copilot_for_consensus_tpu.bus.faults import resolve_boundary
+
         cfg = dict(config or {})
-        address = cfg.get("address") or (
+        self._address = cfg.get("address") or (
             f"tcp://{cfg.get('host', '127.0.0.1')}:"
             f"{cfg.get('port', DEFAULT_PORT)}")
-        self._client = _Client(address,
-                               timeout_ms=int(cfg.get("timeout_ms", 5000)))
+        self._client = client if client is not None else _Client(
+            self._address, timeout_ms=int(cfg.get("timeout_ms", 5000)),
+            retries=int(cfg.get("retries", 3)))
+        self._depth_client = None  # lazy single-try client (pacing polls)
+        self.high_watermark = int(cfg.get("high_watermark", 0) or 0)
+        self.low_watermark = int(
+            cfg.get("low_watermark", max(1, self.high_watermark // 2)))
+        self.saturation_poll_s = float(cfg.get("saturation_poll_s", 0.05))
+        # Pace bound: must stay WELL below the broker lease
+        # (DEFAULT_LEASE_S, 30s) — a pace can run inside a consumer
+        # handler that is itself holding a lease, and blocking past it
+        # turns backpressure into lease-expiry redeliveries (duplicate
+        # work) exactly when the bus is already saturated.
+        self.saturation_max_wait_s = float(
+            cfg.get("saturation_max_wait_s", 10.0))
+        # How stale a last-confirm depth snapshot may be before
+        # saturation() re-polls the broker for that key: without a
+        # refresh, a key hot at its last publish would read saturated
+        # forever once the producer goes quiet, throttling every
+        # service until process restart.
+        self.saturation_refresh_s = float(
+            cfg.get("saturation_refresh_s", 1.0))
+        self.outbox = _Outbox(cfg.get("outbox_path", ":memory:"),
+                              cap=int(cfg.get("outbox_cap", 10000)))
+        self.faults = resolve_boundary(faults)
+        #: rk -> (last known pending depth, monotonic stamp)
+        self._depths: dict[str, tuple[int, float]] = {}
+        self._stop = threading.Event()
+        self._replay_lock = threading.Lock()
+        self._replayer: threading.Thread | None = None
+        self._stats_lock = threading.Lock()
+        self._stats = {"confirmed": 0, "parked": 0, "replayed": 0,
+                       "overflow": 0, "throttle_waits": 0}
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[key] += n
+
+    # ---- publish path ------------------------------------------------
 
     def publish_envelope(self, envelope, routing_key=None):
         if routing_key is None:
@@ -421,30 +616,238 @@ class BrokerPublisher(EventPublisher):
 
             cls = EVENT_TYPES.get(envelope.get("event_type", ""))
             routing_key = cls.routing_key if cls else "unrouted"
-        self._client.request(
-            {"op": "pub", "rk": routing_key, "envelope": dict(envelope)})
+        env = dict(envelope)
+        outage: BaseException | None = None
+        if self.faults is not None:
+            try:
+                self.faults.check("publish")
+            except Exception as exc:  # injected fault == broker outage
+                outage = exc
+        # Ordering: while anything is parked, new publishes park BEHIND
+        # it — rows leave the outbox only after their confirm, so the
+        # per-publisher order survives the outage.
+        if outage is None and self.outbox.depth() == 0:
+            try:
+                reply = self._client.request(
+                    {"op": "pub", "rk": routing_key, "envelope": env})
+            except PublishError as exc:
+                outage = exc
+            else:
+                self._bump("confirmed")
+                self._pace(routing_key, int(reply.get("depth", 0)))
+                return
+        self._park(routing_key, env, outage)
+
+    def _park(self, routing_key: str, env: dict,
+              cause: BaseException | None) -> None:
+        with self._replay_lock:
+            depth = self.outbox.depth()
+            if depth >= self.outbox.cap:
+                self._bump("overflow")
+                raise BusSaturated(
+                    f"publish outbox full ({depth} envelopes parked, "
+                    f"cap {self.outbox.cap}) while the broker is "
+                    f"unreachable" + (f": {cause}" if cause else ""),
+                    routing_key=routing_key, depth=depth,
+                    limit=self.outbox.cap, reason="outbox-full")
+            self.outbox.append(routing_key, json.dumps(env))
+            self._bump("parked")
+            self._ensure_replayer()
+
+    def _ensure_replayer(self) -> None:
+        # caller holds _replay_lock
+        if self._replayer is not None and self._replayer.is_alive():
+            return
+        self._replayer = threading.Thread(
+            target=self._replay_loop, name="bus-publish-replay",
+            daemon=True)
+        self._replayer.start()
+
+    def _replay_loop(self) -> None:
+        """Drain the outbox oldest-first once the broker confirms again.
+        Stop-aware exponential backoff between failed rounds (never a
+        bare sleep — the jaxlint ``blocking-call`` contract); exits
+        when the outbox is empty (re-spawned by the next park)."""
+        backoff = 0.1
+        while not self._stop.is_set():
+            try:
+                batch = self.outbox.oldest(16)
+                if not batch:
+                    with self._replay_lock:
+                        if self.outbox.depth() == 0:
+                            self._replayer = None
+                            return
+                    continue
+                sent: list[int] = []
+                try:
+                    for oid, rk, env_json in batch:
+                        if self.faults is not None:
+                            self.faults.check("publish")
+                        reply = self._client.request(
+                            {"op": "pub", "rk": rk,
+                             "envelope": json.loads(env_json)})
+                        sent.append(oid)
+                        self._note_depth(rk, int(reply.get("depth", 0)))
+                except Exception:  # broker still away (or injected fault)
+                    pass
+                finally:
+                    if sent:
+                        self.outbox.remove(sent)
+                        self._bump("replayed", len(sent))
+            except Exception:
+                # close() raced us past its join timeout and shut the
+                # outbox db (sqlite ProgrammingError) — or some other
+                # infra failure. Durable rows confirmed but not removed
+                # replay again next start: at-least-once, absorbed by
+                # the idempotent-ids contract.
+                if self._stop.is_set():
+                    return
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 2.0)
+                continue
+            if sent:
+                backoff = 0.1           # broker is back: full speed
+            elif self._stop.wait(backoff):
+                return
+            else:
+                backoff = min(backoff * 2, 2.0)
+
+    # ---- backpressure ------------------------------------------------
+
+    def _note_depth(self, rk: str, depth: int) -> None:
+        self._depths[rk] = (int(depth), time.monotonic())
+
+    def _poll_depth(self, rk: str) -> int | None:
+        """One best-effort broker depth query (single try, short
+        timeout); None when the broker is unreachable."""
+        if self._depth_client is None:
+            self._depth_client = _Client(self._address,
+                                         timeout_ms=1500, retries=1)
+        try:
+            depth = int(self._depth_client.request(
+                {"op": "depth", "rk": rk})["depth"])
+        except PublishError:
+            return None
+        self._note_depth(rk, depth)
+        return depth
+
+    def _pace(self, rk: str, depth: int) -> None:
+        self._note_depth(rk, depth)
+        if not self.high_watermark or depth < self.high_watermark:
+            return
+        # Saturated: hold THIS producer (stop-aware, bounded) until the
+        # key drains below the low watermark — backpressure lands where
+        # the flood originates instead of 4x past the SLO downstream.
+        self._bump("throttle_waits")
+        deadline = time.monotonic() + self.saturation_max_wait_s
+        while time.monotonic() < deadline:
+            if self._stop.wait(self.saturation_poll_s):
+                break
+            cur = self._poll_depth(rk)
+            if cur is None:
+                break       # outage mid-pace: the outbox takes over
+            if cur < self.low_watermark:
+                break
+
+    def saturation(self) -> dict[str, int]:
+        if not self.high_watermark:
+            return {}
+        hot: dict[str, int] = {}
+        now = time.monotonic()
+        for rk, (depth, at) in list(self._depths.items()):
+            if depth < self.high_watermark:
+                continue
+            if now - at >= self.saturation_refresh_s:
+                # Stale snapshot: the key was hot at its last confirm
+                # but the producer has gone quiet since — re-poll so a
+                # drained queue stops throttling consumers. Broker
+                # unreachable reads as not-hot: the outbox ride-through
+                # governs outages, not the consumption throttle.
+                refreshed = self._poll_depth(rk)
+                if refreshed is None:
+                    continue
+                depth = refreshed
+            if depth >= self.high_watermark:
+                hot[rk] = depth
+        return hot
+
+    def pending_depths(self) -> dict[str, int]:
+        if self._depth_client is None:
+            self._depth_client = _Client(self._address,
+                                         timeout_ms=1500, retries=1)
+        try:
+            counts = self._depth_client.request({"op": "counts"})["counts"]
+        except PublishError:
+            return {}
+        return {rk: states.get("pending", 0)
+                for rk, states in counts.items()}
+
+    def outbox_stats(self) -> dict[str, int]:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["outbox_depth"] = self.outbox.depth()
+        return out
 
     def close(self):
+        self._stop.set()
+        replayer = self._replayer
+        if replayer is not None:
+            # A replayer mid-request against an unreachable broker can
+            # block for the client's full retry budget before it sees
+            # the stop flag — wait at least that long so the outbox db
+            # closes after the thread is really done (the loop also
+            # survives a lost race, exiting on the first closed-db
+            # error once stop is set).
+            budget = max(5.0,
+                         getattr(self._client, "timeout_ms", 5000)
+                         / 1000.0
+                         * max(1, getattr(self._client, "retries", 3))
+                         + 1.0)
+            replayer.join(timeout=budget)
         self._client.close()
+        if self._depth_client is not None:
+            self._depth_client.close()
+        self.outbox.close()
 
 
 class BrokerSubscriber(EventSubscriber):
     """Pull-based consumer: fetch → dispatch → ack/nack per message.
     ``group`` names this consumer's queue group: subscribers sharing a
     group compete (replicas), distinct groups each see every message
-    (distinct services) — same contract as ``InProcSubscriber``."""
+    (distinct services) — same contract as ``InProcSubscriber``.
 
-    def __init__(self, config: Any = None, group: str | None = None):
+    Failure classification (the poison-quarantine contract,
+    docs/RESILIENCE.md): a handler raising ``RetryableError`` (or any
+    bus-level ``PublishError``) nacks onto the normal lease/redelivery
+    path; ``PoisonEnvelope`` or any other exception — a deterministic
+    failure redelivery cannot fix — quarantines straight to the
+    dead-letter table with a structured reason, skipping the
+    redelivery budget. Every failure is logged with routing key +
+    event id and counted in ``copilot_bus_dispatch_failures_total``."""
+
+    def __init__(self, config: Any = None, group: str | None = None,
+                 client=None, faults=None):
+        from copilot_for_consensus_tpu.bus.faults import resolve_boundary
+        from copilot_for_consensus_tpu.obs.logging import get_logger
+        from copilot_for_consensus_tpu.obs.metrics import NoopMetrics
+
         cfg = dict(config or {})
         address = cfg.get("address") or (
             f"tcp://{cfg.get('host', '127.0.0.1')}:"
             f"{cfg.get('port', DEFAULT_PORT)}")
         self._address = address
-        self._client = _Client(address,
-                               timeout_ms=int(cfg.get("timeout_ms", 5000)))
+        self._timeout_ms = int(cfg.get("timeout_ms", 5000))
+        self._retries = int(cfg.get("retries", 3))
+        self._client = client if client is not None else _Client(
+            address, timeout_ms=self._timeout_ms, retries=self._retries)
         self.poll_interval_s = float(cfg.get("poll_interval_s", 0.05))
         self.batch = int(cfg.get("batch", 16))
         self.group = group or cfg.get("group") or DEFAULT_GROUP
+        self.faults = resolve_boundary(faults)
+        #: shared with the owning pipeline's collector by the runner
+        self.metrics = NoopMetrics()
+        self.logger = get_logger()
         self._routes: dict[str, EventCallback] = {}
         self._counts_client: _Client | None = None
         self._stop = threading.Event()
@@ -469,17 +872,52 @@ class BrokerSubscriber(EventSubscriber):
                                           timeout_ms=timeout_ms, retries=1)
         return self._counts_client.request({"op": "counts"})["counts"]
 
+    def _classify_failure(self, msg: dict, exc: BaseException) -> dict:
+        """Map a handler exception to the broker verdict, logging and
+        counting it (``bus/broker.py:476`` used to swallow these into a
+        bare ``ok = False`` — a redelivery storm with no diagnosis)."""
+        from copilot_for_consensus_tpu.core.retry import RetryableError
+
+        envelope = msg.get("envelope") or {}
+        transient = isinstance(exc, (RetryableError, PublishError)) \
+            and not isinstance(exc, PoisonEnvelope)
+        kind = "transient" if transient else "poison"
+        self.logger.error(
+            "bus dispatch failed",
+            routing_key=msg["rk"], group=self.group, kind=kind,
+            event_id=envelope.get("event_id", ""),
+            event_type=envelope.get("event_type", ""),
+            attempts=msg.get("attempts", 0),
+            error=str(exc), error_type=type(exc).__name__)
+        self.metrics.increment("bus_dispatch_failures_total",
+                               labels={"queue": msg["rk"], "kind": kind})
+        if transient:
+            return {"op": "nack", "ids": [msg["id"]]}
+        reason = (exc.reason if isinstance(exc, PoisonEnvelope)
+                  else f"{type(exc).__name__}: {exc}")
+        self.metrics.increment("bus_poison_total",
+                               labels={"queue": msg["rk"]})
+        return {"op": "nack", "ids": [msg["id"]], "poison": True,
+                "reason": reason[:500]}
+
     def _dispatch(self, msg: dict) -> None:
         cb = self._routes.get(msg["rk"])
-        ok = True
+        verdict = {"op": "ack", "ids": [msg["id"]]}
         if cb is not None:
             try:
                 cb(msg["envelope"])
+            except Exception as exc:
+                verdict = self._classify_failure(msg, exc)
+        if self.faults is not None:
+            try:
+                self.faults.check("ack")
             except Exception:
-                ok = False
+                # Injected ack fault == consumer died before acking:
+                # the lease expires and the message redelivers — the
+                # at-least-once path the idempotent handlers absorb.
+                return
         try:
-            self._client.request(
-                {"op": "ack" if ok else "nack", "ids": [msg["id"]]})
+            self._client.request(verdict)
         except PublishError:
             # Broker unreachable: the lease will expire and the message
             # redelivers — at-least-once holds without us crashing.
@@ -489,6 +927,14 @@ class BrokerSubscriber(EventSubscriber):
         """Process what's queued now; returns the number handled."""
         n = 0
         while max_messages is None or n < max_messages:
+            if self.faults is not None:
+                try:
+                    self.faults.check("fetch")
+                except Exception as exc:
+                    # surfaces exactly like a broker outage so
+                    # start_consuming backs off and reconnects
+                    raise PublishError(
+                        f"injected fetch fault: {exc}") from exc
             want = self.batch if max_messages is None else min(
                 self.batch, max_messages - n)
             reply = self._client.request(

@@ -18,7 +18,12 @@ from copilot_for_consensus_tpu.bus.validating import (
 from copilot_for_consensus_tpu.core.factory import register_driver
 
 
-def create_publisher(config: Any = None, validate: bool = True):
+def create_publisher(config: Any = None, validate: bool = True,
+                     faults=None):
+    """``faults`` (a ``bus/faults.py`` plan or FaultBoundary) is wired
+    into drivers with a fault plane (the broker tier); drivers without
+    one ignore it — the chaos harness targets the deployment topology
+    it actually storms."""
     cfg = dict(config or {})
     driver = cfg.get("driver", "inproc")
     if driver == "inproc":
@@ -26,7 +31,7 @@ def create_publisher(config: Any = None, validate: bool = True):
     elif driver in ("broker", "zmq"):   # zmq kept as a config alias
         from copilot_for_consensus_tpu.bus.broker import BrokerPublisher
 
-        pub = BrokerPublisher(cfg)
+        pub = BrokerPublisher(cfg, faults=faults)
     elif driver == "azure_servicebus":
         from copilot_for_consensus_tpu.bus.azure_servicebus import (
             AzureServiceBusPublisher,
@@ -41,7 +46,7 @@ def create_publisher(config: Any = None, validate: bool = True):
 
 
 def create_subscriber(config: Any = None, validate: bool = True,
-                      on_invalid=None):
+                      on_invalid=None, faults=None):
     cfg = dict(config or {})
     driver = cfg.get("driver", "inproc")
     if driver == "inproc":
@@ -49,7 +54,7 @@ def create_subscriber(config: Any = None, validate: bool = True,
     elif driver in ("broker", "zmq"):
         from copilot_for_consensus_tpu.bus.broker import BrokerSubscriber
 
-        sub = BrokerSubscriber(cfg)
+        sub = BrokerSubscriber(cfg, faults=faults)
     elif driver == "azure_servicebus":
         from copilot_for_consensus_tpu.bus.azure_servicebus import (
             AzureServiceBusSubscriber,

@@ -25,6 +25,7 @@ from copilot_for_consensus_tpu.bus.base import (
     EventCallback,
     EventPublisher,
     EventSubscriber,
+    PoisonEnvelope,
 )
 
 DEFAULT_EXCHANGE = "copilot.events"
@@ -129,6 +130,19 @@ class InProcBroker:
             keys = {rk for rk, _ in self._queues} | set(self._pending)
             return {rk: self.queue_depth(rk) for rk in sorted(keys)}
 
+    def consumer_depths(self) -> dict[str, int]:
+        """Work a LIVE consumer group is behind on: worst bound-queue
+        depth per routing key, parked pre-bind retention EXCLUDED —
+        parity with the durable broker's backpressure depth
+        (``_QueueStore._depth_locked``). Counting parked rows here
+        would make watermark pacing stall forever against keys nothing
+        consumes by design (``report.published``, ``*.failed``)."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for (rk, _g), q in self._queues.items():
+                out[rk] = max(out.get(rk, 0), len(q.items))
+            return out
+
     def _pop_ready(self) -> tuple[_Queue, Mapping[str, Any], int, EventCallback] | None:
         with self._lock:
             for q in self._queues.values():
@@ -147,6 +161,14 @@ class InProcBroker:
         q, envelope, redeliveries, cb = ready
         try:
             cb(envelope)  # normal return = ack
+        except PoisonEnvelope:
+            # Deterministic failure (schema-invalid / non-retryable
+            # handler error): redelivery cannot fix it — skip the
+            # budget and dead-letter immediately (poison quarantine,
+            # same contract as the durable broker's poison nack).
+            with self._work:
+                self.dead_lettered.append((q.name, envelope))
+                self.publish(envelope, q.name + DLQ_SUFFIX)
         except Exception:
             if redeliveries + 1 >= self.max_redeliveries:
                 with self._work:
@@ -198,6 +220,11 @@ class InProcPublisher(EventPublisher):
     def __init__(self, config: Any = None, broker: InProcBroker | None = None):
         cfg = dict(config or {})
         self.broker = broker or get_broker(cfg.get("exchange", DEFAULT_EXCHANGE))
+        # Depth-watermark saturation surface (driver parity with
+        # BrokerPublisher): in-proc consumption shares the publisher's
+        # thread, so there is no pacing WAIT here — just the signal the
+        # services' throttle hook and the ingestion pacer read.
+        self.high_watermark = int(cfg.get("high_watermark", 0) or 0)
 
     def publish_envelope(self, envelope, routing_key=None):
         if routing_key is None:
@@ -206,6 +233,18 @@ class InProcPublisher(EventPublisher):
             cls = EVENT_TYPES.get(envelope.get("event_type", ""))
             routing_key = cls.routing_key if cls else "unrouted"
         self.broker.publish(envelope, routing_key)
+
+    def saturation(self) -> dict[str, int]:
+        if not self.high_watermark:
+            return {}
+        return {rk: d for rk, d in self.broker.consumer_depths().items()
+                if d >= self.high_watermark}
+
+    def pending_depths(self) -> dict[str, int]:
+        # consumer_depths, not routing_key_depths: the pacing surface
+        # must not count parked pre-bind retention (unconsumed terminal
+        # keys would read saturated forever and stall ingestion).
+        return self.broker.consumer_depths()
 
 
 class InProcSubscriber(EventSubscriber):

@@ -14,6 +14,7 @@ from copilot_for_consensus_tpu.bus.base import (
     EventCallback,
     EventPublisher,
     EventSubscriber,
+    PoisonEnvelope,
     PublishError,
 )
 from copilot_for_consensus_tpu.core.validation import (
@@ -42,6 +43,31 @@ class ValidatingPublisher(EventPublisher):
             raise PublishError(f"refusing to publish invalid event: {exc}") from exc
         self.inner.publish_envelope(envelope, routing_key)
 
+    def close(self) -> None:
+        # Explicit: the base class's concrete no-op close() would
+        # otherwise shadow delegation and leak the inner driver's
+        # resources (the broker publisher's outbox + replay thread).
+        self.inner.close()
+
+    def saturation(self) -> dict[str, int]:
+        # Explicit for the same reason as close(): EventPublisher
+        # defines a concrete {} default, so __getattr__ alone would
+        # never fire and the wrapper would hide the inner driver's
+        # depth feedback — silently disabling the services' consumption
+        # throttle and the ingestion pacer in the assembled pipeline
+        # (every service publisher is validating-wrapped).
+        return self.inner.saturation()
+
+    def pending_depths(self) -> dict[str, int]:
+        return self.inner.pending_depths()
+
+    def __getattr__(self, name):
+        # Driver capability passthrough (outbox_stats()/faults/...) —
+        # same delegation contract as ValidatingSubscriber below. Only
+        # covers names the base class does NOT define; anything with a
+        # concrete default needs explicit delegation above.
+        return getattr(self.inner, name)
+
 
 class ValidatingSubscriber(EventSubscriber):
     def __init__(self, inner: EventSubscriber,
@@ -66,7 +92,13 @@ class ValidatingSubscriber(EventSubscriber):
                 self.invalid_count += 1
                 if self.on_invalid is not None:
                     self.on_invalid(envelope, exc)
-                return  # ack: an invalid event can never become valid by retry
+                # An invalid event can never become valid by retry:
+                # poison-quarantine it (drivers with a dead-letter
+                # table park it there with the reason, skipping the
+                # redelivery budget) instead of silently acking it out
+                # of existence.
+                raise PoisonEnvelope(
+                    f"schema validation failed: {exc}") from exc
             callback(envelope)
 
         self.inner.subscribe(routing_keys, guarded)

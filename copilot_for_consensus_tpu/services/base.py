@@ -8,10 +8,15 @@ every handled event bumps counters and a latency histogram.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Mapping
 
-from copilot_for_consensus_tpu.bus.base import EventPublisher
+from copilot_for_consensus_tpu.bus.base import (
+    EventPublisher,
+    PoisonEnvelope,
+    PublishError,
+)
 from copilot_for_consensus_tpu.core.events import Event
 from copilot_for_consensus_tpu.core.retry import (
     RetryExhaustedError,
@@ -60,6 +65,7 @@ class BaseService:
         metrics: MetricsCollector | None = None,
         error_reporter: ErrorReporter | None = None,
         retry: RetryPolicy | None = None,
+        throttle_pause_s: float = 0.05,
     ):
         self.publisher = publisher
         self.store = store
@@ -67,6 +73,14 @@ class BaseService:
         self.metrics = metrics or NoopMetrics()
         self.error_reporter = error_reporter
         self.retry = retry or RetryPolicy()
+        # Bus backpressure (bus/base.py:BusSaturated): when the
+        # publisher reports saturated downstream keys, the handler
+        # pauses briefly BEFORE consuming the next event, so this
+        # stage's intake slows until the queue it feeds drains below
+        # the watermark. Stop-aware (the release event), off unless
+        # the bus config sets a high_watermark.
+        self.throttle_pause_s = throttle_pause_s
+        self._throttle_release = threading.Event()
 
     # -- bus wiring ------------------------------------------------------
 
@@ -76,11 +90,16 @@ class BaseService:
 
     def handle_envelope(self, envelope: Mapping[str, Any]) -> None:
         """Bus callback. Raises to trigger nack/requeue on transient
-        errors; terminal errors publish the failure event and swallow."""
+        errors; terminal errors publish the failure event and then
+        raise :class:`PoisonEnvelope` so bus drivers with a dead-letter
+        table quarantine the envelope (skipping the redelivery budget —
+        a deterministic failure cannot be retried into success) while
+        the ``*Failed`` event remains the requeue-able operator record."""
         etype = envelope.get("event_type", "")
         handler: Callable | None = getattr(self, f"on_{etype}", None)
         if handler is None:
             return
+        self._bus_throttle()
         t0 = time.monotonic()
         try:
             self.retry.run(lambda: handler(Event.from_envelope(envelope)),
@@ -88,6 +107,9 @@ class BaseService:
             self.metrics.increment(f"{self.name}_events_total",
                                    labels={"event": etype, "ok": "true"})
         except RetryExhaustedError as exc:
+            # Transient, already retried with backoff in-process: the
+            # failure event is the record; redelivering would repeat
+            # the whole retry budget for the same outcome.
             self.metrics.increment(f"{self.name}_events_total",
                                    labels={"event": etype, "ok": "false"})
             self.logger.error("retries exhausted", event=etype,
@@ -96,6 +118,15 @@ class BaseService:
                 self.error_reporter.report(exc, {"event": etype})
             self._publish_failure(envelope, exc.last_error,
                                   attempts=exc.attempts)
+        except PublishError:
+            # Bus-level trouble mid-handler (broker outage past the
+            # outbox, BusSaturated overflow): transient by definition —
+            # propagate so the driver nacks onto the lease/redelivery
+            # path instead of minting a failure event the same broker
+            # couldn't carry.
+            self.metrics.increment(f"{self.name}_events_total",
+                                   labels={"event": etype, "ok": "false"})
+            raise
         except Exception as exc:  # unexpected → terminal failure event
             self.metrics.increment(f"{self.name}_events_total",
                                    labels={"event": etype, "ok": "false"})
@@ -104,10 +135,35 @@ class BaseService:
             if self.error_reporter is not None:
                 self.error_reporter.report(exc, {"event": etype})
             self._publish_failure(envelope, exc, attempts=1)
+            raise PoisonEnvelope(
+                f"{type(exc).__name__}: {exc}") from exc
         finally:
             self.metrics.observe(f"{self.name}_handle_seconds",
                                  time.monotonic() - t0,
                                  labels={"event": etype})
+
+    def _bus_throttle(self) -> None:
+        """One bounded, stop-aware pause per event while the publisher
+        reports saturated downstream keys (depth-watermark
+        backpressure). A no-op for publishers without depth feedback
+        or with no watermark configured."""
+        sat = getattr(self.publisher, "saturation", None)
+        if not callable(sat):
+            return
+        try:
+            hot = sat()
+        except Exception:
+            return
+        if not hot:
+            return
+        self.metrics.increment("bus_throttle_total",
+                               labels={"service": self.name})
+        self._throttle_release.wait(self.throttle_pause_s)
+
+    def stop_throttling(self) -> None:
+        """Release any in-progress (and all future) throttle pauses —
+        shutdown must never wait out a backpressure pause."""
+        self._throttle_release.set()
 
     def _publish_failure(self, envelope: Mapping[str, Any],
                          error: BaseException | None,

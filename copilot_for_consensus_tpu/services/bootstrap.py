@@ -79,10 +79,61 @@ def build_service_router(service, *, metrics=None, extra: Router | None
     return router
 
 
+#: Bus metric-name registry: name → (type, labels, help). The contract
+#: tests (tests/test_observability_pack.py, PR-5 pattern) hold alert/
+#: dashboard references AND the actual exposition to exactly this set,
+#: so a renamed series breaks a test instead of silently dead alerts.
+#: Counter families are declared-at-zero on every scrape (increment 0)
+#: so ``rate()`` consumers never see an absent metric.
+BUS_METRICS = {
+    "copilot_bus_queue_depth": (
+        "gauge", ("queue",),
+        "pending+inflight messages per routing key (dead as <rk>.dlq)"),
+    "copilot_bus_dead_letters": (
+        "gauge", ("queue",),
+        "dead-lettered messages per routing key (legacy .dlq view)"),
+    "copilot_bus_pending": (
+        "gauge", ("queue",),
+        "broker-side pending depth per routing key (worst group)"),
+    "copilot_bus_inflight": (
+        "gauge", ("queue",),
+        "leased in-flight messages per routing key"),
+    "copilot_bus_dead": (
+        "gauge", ("queue",),
+        "dead-letter table depth per routing key"),
+    "copilot_bus_parked": (
+        "gauge", ("queue",),
+        "pre-bind retention rows per routing key (no consumer group "
+        "bound; excluded from backpressure depth, TTL-pruned)"),
+    "copilot_bus_outbox_depth": (
+        "gauge", (),
+        "unconfirmed publishes parked in the durable publish outbox"),
+    "copilot_bus_publish_parked_total": (
+        "counter", (),
+        "publishes parked in the outbox because the broker was away"),
+    "copilot_bus_publish_replayed_total": (
+        "counter", (),
+        "parked publishes replayed (in order) after reconnect"),
+    "copilot_bus_publish_overflow_total": (
+        "counter", (),
+        "publishes refused with BusSaturated: outbox at capacity"),
+    "copilot_bus_dispatch_failures_total": (
+        "counter", ("queue", "kind"),
+        "handler failures per routing key, kind=transient|poison"),
+    "copilot_bus_poison_total": (
+        "counter", ("queue",),
+        "envelopes quarantined straight to the dead-letter table"),
+    "copilot_bus_throttle_total": (
+        "counter", ("service",),
+        "consumption pauses taken under depth-watermark backpressure"),
+}
+
+
 class _BusGaugeMetrics:
-    """Proxy that refreshes bus queue-depth / dead-letter gauges right
-    before Prometheus exposition — the series the alert pack
-    (infra/prometheus/alerts/queues.yml) fires on."""
+    """Proxy that refreshes bus queue-depth / dead-letter / outbox
+    gauges right before Prometheus exposition — the series the alert
+    pack (infra/prometheus/alerts/queues.yml) fires on. Emits exactly
+    the :data:`BUS_METRICS` registry."""
 
     def __init__(self, inner, pipeline):
         self._inner = inner
@@ -100,6 +151,46 @@ class _BusGaugeMetrics:
             name = ("bus_dead_letters" if rk.endswith(".dlq")
                     else "bus_queue_depth")
             self._inner.gauge(name, depth, labels={"queue": rk})
+        # pending/inflight/dead split (broker counts()) — the depth the
+        # watermark backpressure paces against and the chaos gate's
+        # final-depth SLO assertion reads.
+        try:
+            counts = self._pipeline.bus_counts()
+        except Exception:
+            counts = {}
+        for rk, states in counts.items():
+            self._inner.gauge("bus_pending", states.get("pending", 0),
+                              labels={"queue": rk})
+            self._inner.gauge("bus_inflight", states.get("inflight", 0),
+                              labels={"queue": rk})
+            self._inner.gauge("bus_dead", states.get("dead", 0),
+                              labels={"queue": rk})
+            self._inner.gauge("bus_parked", states.get("parked", 0),
+                              labels={"queue": rk})
+        # publish-outbox ride-through ledger, aggregated across the
+        # pipeline's publishers (BrokerPublisher.outbox_stats).
+        try:
+            pstats = self._pipeline.publisher_stats()
+        except Exception:
+            pstats = {}
+        self._inner.gauge("bus_outbox_depth",
+                          pstats.get("outbox_depth", 0))
+        # absolute totals from an external monotonic source → counter
+        # TYPE via set_counter (obs/metrics.py; falls back to gauge on
+        # collectors without it)
+        set_counter = getattr(self._inner, "set_counter",
+                              self._inner.gauge)
+        for stat, metric in (("parked", "bus_publish_parked_total"),
+                             ("replayed", "bus_publish_replayed_total"),
+                             ("overflow", "bus_publish_overflow_total")):
+            set_counter(metric, pstats.get(stat, 0))
+        # Declare the event-driven counter families at zero so every
+        # scrape carries them (rate()/deriv() alerts break on absent
+        # series); real increments land on labeled children.
+        for name, (typ, labels, _help) in BUS_METRICS.items():
+            short = name.removeprefix("copilot_")
+            if typ == "counter" and labels:
+                self._inner.increment(short, 0.0)
         # process/host resource series for the resource_limits alerts
         from copilot_for_consensus_tpu.obs.resources import resource_gauges
 

@@ -41,10 +41,22 @@ class IngestionService(BaseService):
     consumes = ("SourceDeletionRequested",)
 
     def __init__(self, publisher, store, archive_store: ArchiveStore,
-                 fetchers: Mapping[str, ArchiveFetcher], **kw):
+                 fetchers: Mapping[str, ArchiveFetcher],
+                 bus_watermark: int = 0, bus_poll_s: float = 0.5,
+                 bus_pause_max_s: float = 300.0, **kw):
         super().__init__(publisher, store, **kw)
         self.archive_store = archive_store
         self.fetchers = dict(fetchers)
+        # Ingest-side backpressure (the SCALE_BROKER lesson: triggering
+        # every archive at once floods json.parsed 4x past the warn
+        # SLO): with a watermark configured, trigger_source pauses
+        # between archives until every pipeline queue drains below it.
+        # scripts/scale_bench.py used to do this externally; it is now
+        # first-class, fed by the broker's depth introspection
+        # (publisher.pending_depths()).
+        self.bus_watermark = int(bus_watermark or 0)
+        self.bus_poll_s = bus_poll_s
+        self.bus_pause_max_s = bus_pause_max_s
 
     # ---- sources CRUD (REST surface of the reference, ``app/api.py``) --
 
@@ -98,6 +110,7 @@ class IngestionService(BaseService):
         correlation_id = uuid.uuid4().hex
         ingested = []
         for fetched in fetcher.fetch(source):
+            self._await_bus_capacity()
             aid = self.ingest_archive(
                 source_id=doc["source_id"], content=fetched.content,
                 archive_uri=fetched.uri, filename=fetched.filename,
@@ -107,6 +120,32 @@ class IngestionService(BaseService):
         self.store.update_document("sources", doc["source_id"], {
             "last_fetch_at": _now_iso(), "last_fetch_status": "ok"})
         return ingested
+
+    def _await_bus_capacity(self) -> float:
+        """Hold the next archive until every non-failure queue is below
+        the watermark (stop-aware via the base throttle release event,
+        bounded by ``bus_pause_max_s``). Returns seconds waited."""
+        if not self.bus_watermark:
+            return 0.0
+        depths_fn = getattr(self.publisher, "pending_depths", None)
+        if not callable(depths_fn):
+            return 0.0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < self.bus_pause_max_s:
+            try:
+                depths = depths_fn()
+            except Exception:
+                break
+            worst = max(
+                (d for rk, d in depths.items()
+                 if not rk.endswith((".failed", ".dlq"))), default=0)
+            if worst < self.bus_watermark:
+                break
+            self.metrics.increment("bus_throttle_total",
+                                   labels={"service": self.name})
+            if self._throttle_release.wait(self.bus_poll_s):
+                break
+        return time.monotonic() - t0
 
     def ingest_archive(self, source_id: str, content: bytes,
                        archive_uri: str = "", filename: str = "",

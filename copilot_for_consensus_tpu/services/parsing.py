@@ -88,16 +88,7 @@ class ParsingService(BaseService):
             draft_mentions = sorted({
                 d for m in members
                 for d in detect_draft_mentions(m.body_raw)})
-            # upsert REPLACES the document: carry over the recovery
-            # spine's fields so an archive redelivery can't wipe a
-            # thread's summary link or reset its retry budget
-            prev = self.store.get_document("threads", tid) or {}
-            carried = {k: prev[k] for k in
-                       ("summary_id", "attempt_count", "last_attempt_at")
-                       if k in prev}
-            self.store.upsert_document("threads", {
-                **carried,
-                "parsed_at": prev.get("parsed_at") or _now_iso(),
+            fields = {
                 "thread_id": tid,
                 "archive_ids": [archive_id],
                 "source_id": source_id,
@@ -115,7 +106,20 @@ class ParsingService(BaseService):
                 "first_message_date": th.first_date,
                 "last_message_date": th.last_date,
                 "draft_mentions": draft_mentions,
-            })
+            }
+            # Archive redeliveries re-run this loop (at-least-once), so
+            # the write must not clobber fields other writers own. A
+            # read-carry-replace (get → copy summary_id → upsert) loses
+            # the update when a summary lands between the read and the
+            # replace — a ZOMBIE parse (lease expired mid-parse, the
+            # redelivery already finished elsewhere) can wipe a
+            # thread's summary link minutes later. update_document
+            # merges just our fields under the store's lock, so the
+            # recovery spine's fields (summary_id, attempt_count,
+            # last_attempt_at) survive without being read at all.
+            if not self.store.update_document("threads", tid, fields):
+                self.store.upsert_document("threads", {
+                    **fields, "parsed_at": _now_iso()})
 
         published = 0
         for idx, msg in enumerate(parsed):

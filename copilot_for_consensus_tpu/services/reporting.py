@@ -47,6 +47,17 @@ class ReportingService(BaseService):
         if summary is None:
             raise DocumentNotFoundError(
                 f"summary {summary_id} not in store")
+        thread_id = summary.get("thread_id", "")
+        thread = (self.store.get_document("threads", thread_id)
+                  if thread_id else None)
+        if (thread is not None and thread.get("summary_id")
+                and thread.get("summary_id") != summary_id):
+            # Superseded while this SummaryComplete was in flight: the
+            # thread re-summarized over more context and the live
+            # report belongs to its CURRENT summary — publishing this
+            # one would mint a duplicate terminal artifact.
+            self.metrics.increment("reporting_superseded_total")
+            return ""
         report_id = generate_report_id(summary_id)
         self.store.upsert_document("reports", {
             "report_id": report_id,
@@ -61,6 +72,14 @@ class ReportingService(BaseService):
         })
         self.store.update_document("summaries", summary_id,
                                    {"report_id": report_id})
+        if thread_id:
+            # Convergent cleanup (the other half of the supersede
+            # contract in summarization._store_and_publish): whichever
+            # writer lands last deletes any report row a raced,
+            # now-superseded summary left for this thread.
+            self.store.delete_documents(
+                "reports", {"thread_id": thread_id,
+                            "summary_id": {"$ne": summary_id}})
         if self.webhook_url:
             try:
                 self.webhook_sender(self.webhook_url, {

@@ -68,7 +68,13 @@ class Pipeline:
     # None = all. Other services still exist for their REST/read surface
     # — their events flow to whichever process owns the role.
     roles: frozenset | None = None
+    # One shared FaultBoundary (bus/faults.py) when cfg["faults"] scripts
+    # a pipeline fault plan: the same plan fires across bus publish/
+    # fetch/ack and the store wrappers, so a chaos phase faults every
+    # boundary coherently. None in production.
+    fault_boundary: Any = None
     _seen_gauge_keys: set = field(default_factory=set)
+    _seen_count_keys: set = field(default_factory=set)
 
     @property
     def services(self):
@@ -150,6 +156,59 @@ class Pipeline:
         self._seen_gauge_keys.update(out)
         return out
 
+    def bus_counts(self) -> dict[str, dict[str, int]]:
+        """Per-key ``{"pending", "inflight", "dead", "parked"}`` — the
+        broker's ``counts()`` split, the source for the
+        ``copilot_bus_pending``/``inflight``/``dead``/``parked`` gauges
+        and the chaos gate's final-depth assertion (which reads
+        pending+inflight only: parked rows are pre-bind retention, not
+        consumer backlog). Keys previously reported but since drained
+        re-emit as zeros (same stickiness rule as
+        ``routing_key_depths``). Best-effort: an unreachable broker
+        returns {}."""
+        def entry() -> dict[str, int]:
+            return {"pending": 0, "inflight": 0, "dead": 0, "parked": 0}
+
+        out = {rk: entry() for rk in self._seen_count_keys}
+        if self.ext_subscribers:
+            try:
+                counts = self.ext_subscribers[0].counts(timeout_ms=1500)
+            except Exception:
+                return {}
+            for rk, states in counts.items():
+                out[rk] = {k: int(states.get(k, 0))
+                           for k in ("pending", "inflight", "dead",
+                                     "parked")}
+        else:
+            for rk, d in self.broker.routing_key_depths().items():
+                out.setdefault(rk, entry())["pending"] = d
+            for rk, _env in self.broker.dead_lettered:
+                out.setdefault(rk, entry())["dead"] += 1
+        self._seen_count_keys.update(out)
+        return out
+
+    def publisher_stats(self) -> dict[str, int]:
+        """Aggregate publish-outbox ledger across every service's
+        publisher (``BrokerPublisher.outbox_stats``; drivers without an
+        outbox contribute nothing) — the ride-through evidence the
+        gauges and the chaos artifact report."""
+        total = {"confirmed": 0, "parked": 0, "replayed": 0,
+                 "overflow": 0, "throttle_waits": 0, "outbox_depth": 0}
+        for svc in self.services:
+            fn = getattr(svc.publisher, "outbox_stats", None)
+            if not callable(fn):
+                continue
+            for k, v in fn().items():
+                total[k] = total.get(k, 0) + int(v)
+        return total
+
+    def stop_throttling(self) -> None:
+        """Release every service's backpressure pause (and any
+        in-progress ingestion pacing wait): shutdown must never wait
+        out a watermark."""
+        for svc in self.services:
+            svc.stop_throttling()
+
     def run_forever(self, stop) -> None:
         """Blocking pump for server mode: in-proc dispatch, or (external
         bus) one consume loop per service — each already survives broker
@@ -166,6 +225,7 @@ class Pipeline:
         try:
             stop.wait()
         finally:
+            self.stop_throttling()
             for sub in self.ext_subscribers:
                 sub.stop()
 
@@ -250,12 +310,36 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
                         f"empty state (set unsafe_private_stores to "
                         f"override in tests)")
     broker = InProcBroker()
+    # Scripted pipeline fault plane (bus/faults.py): cfg["faults"] is a
+    # FaultPlan dict (optionally {"plan": ..., "terminal_kinds": [...]})
+    # shared across bus and storage boundaries — the chaos harness's
+    # config surface, absent in production.
+    fault_boundary = None
+    if cfg.get("faults"):
+        from copilot_for_consensus_tpu.bus.faults import (
+            FaultPlan,
+            resolve_boundary,
+        )
+
+        fcfg = dict(cfg["faults"])
+        plan = fcfg.get("plan", fcfg)
+        fault_boundary = resolve_boundary(
+            FaultPlan.from_dict(dict(plan)),
+            terminal_kinds=tuple(fcfg.get("terminal_kinds", ())))
     store = create_document_store(cfg.get("document_store",
                                           {"driver": "memory"}))
     store.connect()
     vector_store = create_vector_store(cfg.get("vector_store",
                                                {"driver": "memory"}))
     vector_store.connect()
+    if fault_boundary is not None:
+        from copilot_for_consensus_tpu.bus.faults import (
+            FaultingDocumentStore,
+            FaultingVectorStore,
+        )
+
+        store = FaultingDocumentStore(store, fault_boundary)
+        vector_store = FaultingVectorStore(vector_store, fault_boundary)
     provider = create_embedding_provider(cfg.get("embedding",
                                                  {"driver": "mock"}))
     summarizer = create_summarizer(cfg.get("llm", {"driver": "mock"}))
@@ -294,6 +378,13 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
                                              document_store=store)
     else:
         archive_store = InMemoryArchiveStore()
+    if fault_boundary is not None:
+        from copilot_for_consensus_tpu.bus.faults import (
+            FaultingArchiveStore,
+        )
+
+        archive_store = FaultingArchiveStore(archive_store,
+                                             fault_boundary)
     retry = RetryPolicy(RetryConfig(max_attempts=3, base_delay=0.01,
                                     max_delay=0.05))
 
@@ -311,14 +402,21 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
                 create_publisher,
             )
 
-            return create_publisher(bus_cfg)
-        return ValidatingPublisher(InProcPublisher(broker=broker))
+            return create_publisher(bus_cfg, faults=fault_boundary)
+        # the watermark saturation surface works on either tier
+        return ValidatingPublisher(InProcPublisher(
+            config={"high_watermark": bus_cfg.get("high_watermark", 0)},
+            broker=broker))
 
     common = dict(logger=logger, metrics=metrics, retry=retry)
     ingestion = IngestionService(
         publisher(), store, archive_store,
         fetchers={"local": LocalFetcher(),
                   "mock": cfg.get("mock_fetcher") or MockFetcher()},
+        # Ingest pacing rides the same watermark as the publishers'
+        # depth backpressure: one knob (bus.high_watermark) bounds the
+        # whole pipeline's queue depths.
+        bus_watermark=int(bus_cfg.get("high_watermark", 0) or 0),
         **common)
     parsing = ParsingService(publisher(), store, archive_store, **common)
     chunking = ChunkingService(
@@ -358,7 +456,8 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
         ingestion=ingestion, parsing=parsing, chunking=chunking,
         embedding=embedding, orchestrator=orchestrator,
         summarization=summarization, reporting=reporting, metrics=metrics,
-        roles=frozenset(roles) if roles is not None else None)
+        roles=frozenset(roles) if roles is not None else None,
+        fault_boundary=fault_boundary)
 
     for svc in pipeline.owned_services:
         # One queue group per service: fan-out across services (every
@@ -371,12 +470,18 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
                 create_subscriber,
             )
 
-            sub = create_subscriber({**bus_cfg, "group": svc.name})
-            if hasattr(sub, "metrics"):
-                # drivers with consumer-side counters (e.g. the
-                # servicebus bus_misroute_dropped guard) share the
-                # pipeline's collector
-                sub.metrics = pipeline.metrics
+            sub = create_subscriber({**bus_cfg, "group": svc.name},
+                                    faults=fault_boundary)
+            # Drivers with consumer-side counters/logs (broker dispatch
+            # failures, the servicebus bus_misroute_dropped guard)
+            # share the pipeline's collector — set on the INNER driver:
+            # assigning through the validating wrapper would only
+            # shadow the attribute on the wrapper itself.
+            inner = getattr(sub, "inner", sub)
+            if hasattr(inner, "metrics"):
+                inner.metrics = pipeline.metrics
+            if hasattr(inner, "logger") and svc.logger is not None:
+                inner.logger = svc.logger
             sub.subscribe(svc.routing_keys(), svc.handle_envelope)
             pipeline.ext_subscribers.append(sub)
         else:

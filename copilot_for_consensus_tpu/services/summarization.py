@@ -185,6 +185,18 @@ class SummarizationService(BaseService):
         thread = self.store.get_document("threads", thread_id)
         if thread is None:
             raise DocumentNotFoundError(f"thread {thread_id} not in store")
+        current_id = thread.get("summary_id", "")
+        if current_id and current_id != summary_id:
+            cur = self.store.get_document("summaries", current_id)
+            if cur and set(selected_chunks) <= set(
+                    cur.get("chunk_ids", [])):
+                # Stale request: at-least-once redelivery can reorder a
+                # SummarizationRequested behind a newer one that already
+                # summarized a superset of these chunks. The pointer
+                # never moves backward — summarizing again would mint a
+                # duplicate terminal artifact for less context.
+                self.metrics.increment("summarization_stale_total")
+                return None
         chunk_docs = self.store.query_documents(
             "chunks", {"chunk_id": {"$in": selected_chunks}})
         if not chunk_docs and selected_chunks:
@@ -304,9 +316,21 @@ class SummarizationService(BaseService):
                 "agree_count": signal.agree_count,
                 "disagree_count": signal.disagree_count,
             }
+        prev_id = (self.store.get_document("threads", thread_id)
+                   or {}).get("summary_id", "")
         self.store.upsert_document("summaries", doc)
         self.store.update_document("threads", thread_id,
                                    {"summary_id": summary_id})
+        if prev_id and prev_id != summary_id:
+            # Supersede: when a thread re-summarizes over a larger
+            # context (late-arriving messages, the stuck-document
+            # sweep), exactly ONE live summary/report per thread
+            # survives — the predecessor and its report are deleted,
+            # not orphaned as duplicates.
+            self.store.delete_document("summaries", prev_id)
+            self.store.delete_documents("reports",
+                                        {"summary_id": prev_id})
+            self.metrics.increment("summarization_superseded_total")
         self.metrics.observe("summarization_latency_seconds", latency)
         self.metrics.increment("summarization_summaries_total")
         # Prefix-cache visibility: when the summarizer serves from the

@@ -12,6 +12,8 @@ import abc
 import re
 from typing import Any, Iterable, Mapping, Sequence
 
+from copilot_for_consensus_tpu.core.retry import RetryableError
+
 
 class StorageError(Exception):
     pass
@@ -21,6 +23,16 @@ class DuplicateKeyError(StorageError):
     """Insert with an already-present primary key (idempotent stages catch
     this and treat it as success — reference behavior at
     ``chunking/app/service.py:343``)."""
+
+
+class StorageContentionError(StorageError, RetryableError):
+    """Transient lock/contention inside the store (sqlite ``database is
+    locked`` under concurrent writers, Cosmos 429s, ...). Being a
+    :class:`RetryableError` it rides the in-process retry + backoff and
+    then the bus lease/redelivery path — infrastructure contention must
+    never be classified as poison and quarantined (diagnosed from a
+    ``pipeline_chaos`` storm where 35 locked writes dead-lettered good
+    work; ``docs/RESILIENCE.md`` poison-vs-transient table)."""
 
 
 def _resolve_path(doc: Mapping[str, Any], path: str):

@@ -21,21 +21,44 @@ compares as ``"a"`` in SQL — no pipeline stage writes NULs into documents.
 
 from __future__ import annotations
 
+import functools
 import json
 import pathlib
 import re
 import sqlite3
 import threading
-from typing import Any, Mapping, Sequence
+from typing import Any, Callable, Mapping, Sequence
 
 from copilot_for_consensus_tpu.storage import registry
 from copilot_for_consensus_tpu.storage.base import (
     DocumentStore,
     DuplicateKeyError,
+    StorageContentionError,
     StorageError,
     matches_filter,
     sort_documents,
 )
+
+
+def _transient_locks(fn: Callable) -> Callable:
+    """Translate sqlite lock contention (``SQLITE_BUSY``/``SQLITE_LOCKED``
+    surfacing as ``OperationalError: database is locked`` past the busy
+    timeout under concurrent writer services) into the retryable
+    :class:`StorageContentionError`, so the service retry policy backs
+    off and the lease/redelivery path applies instead of the envelope
+    being classified as poison."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except sqlite3.OperationalError as exc:
+            msg = str(exc).lower()
+            if "locked" in msg or "busy" in msg:
+                raise StorageContentionError(str(exc)) from exc
+            raise
+
+    return wrapper
 
 _TABLE_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
 _PATH_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*(\.[A-Za-z_][A-Za-z0-9_]*)*$")
@@ -273,6 +296,7 @@ class SQLiteDocumentStore(DocumentStore):
 
     # -- CRUD --------------------------------------------------------------
 
+    @_transient_locks
     def insert_document(self, collection, doc):
         table = self._table(collection)
         doc_id = self._key(collection, doc)
@@ -286,6 +310,7 @@ class SQLiteDocumentStore(DocumentStore):
             raise DuplicateKeyError(f"{collection}/{doc_id} exists") from exc
         return doc_id
 
+    @_transient_locks
     def upsert_document(self, collection, doc):
         table = self._table(collection)
         doc_id = self._key(collection, doc)
@@ -297,6 +322,7 @@ class SQLiteDocumentStore(DocumentStore):
         self._conn().commit()
         return doc_id
 
+    @_transient_locks
     def get_document(self, collection, doc_id):
         table = self._table(collection)
         row = self._conn().execute(
@@ -323,6 +349,7 @@ class SQLiteDocumentStore(DocumentStore):
             docs = docs[:limit]
         return docs
 
+    @_transient_locks
     def query_documents(self, collection, flt=None, *, limit=None, skip=0,
                         sort: Sequence[tuple[str, int]] | None = None):
         table = self._table(collection)
@@ -345,6 +372,7 @@ class SQLiteDocumentStore(DocumentStore):
             return self._python_query(collection, flt, limit=limit,
                                       skip=skip, sort=sort)
 
+    @_transient_locks
     def update_document(self, collection, doc_id, updates):
         table = self._table(collection)
         conn = self._conn()
@@ -363,6 +391,7 @@ class SQLiteDocumentStore(DocumentStore):
             conn.commit()
             return True
 
+    @_transient_locks
     def delete_document(self, collection, doc_id):
         table = self._table(collection)
         cur = self._conn().execute(
@@ -381,6 +410,7 @@ class SQLiteDocumentStore(DocumentStore):
         self._conn().commit()
         return len(ids)
 
+    @_transient_locks
     def delete_documents(self, collection, flt=None):
         table = self._table(collection)
         try:
@@ -397,6 +427,7 @@ class SQLiteDocumentStore(DocumentStore):
         self._conn().commit()
         return cur.rowcount
 
+    @_transient_locks
     def count_documents(self, collection, flt=None):
         table = self._table(collection)
         try:

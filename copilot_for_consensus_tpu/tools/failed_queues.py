@@ -4,6 +4,17 @@ Parity with the reference's ``scripts/manage_failed_queues.py:41-48``.
 Failure events land on ``*.failed`` queues (and bus-level dead letters on
 ``*.dlq``); this tool lets an operator inspect them and push the
 originating work back through the pipeline.
+
+Two tiers, two backends:
+
+* the in-proc broker's failure-event queues (default; the commands
+  above), and
+* the durable broker's DEAD-LETTER TABLE (``--broker tcp://host:port``
+  with ``list-dead`` / ``requeue-dead`` / ``purge-dead``): messages the
+  poison quarantine parked (schema-invalid, deterministic handler
+  failure — each row carries its structured ``reason``) or that
+  exhausted the redelivery budget. ``requeue-dead`` resets them to
+  pending with a fresh budget — the DLQ runbook in docs/RESILIENCE.md.
 """
 
 from __future__ import annotations
@@ -107,6 +118,51 @@ class FailedQueueManager:
         return out
 
 
+class DeadLetterManager:
+    """Durable-broker dead-letter ops over the client protocol
+    (``bus/broker.py`` ops ``dead`` / ``requeue_dead`` / ``purge_dead``)
+    — the operator surface for the poison-quarantine table."""
+
+    def __init__(self, address: str, timeout_ms: int = 5000):
+        from copilot_for_consensus_tpu.bus.broker import _Client
+
+        self._client = _Client(address, timeout_ms=timeout_ms)
+
+    def list_dead(self, routing_key: str | None = None
+                  ) -> list[dict[str, Any]]:
+        """Every dead-lettered message with its structured ``reason``
+        (poison classification or 'redelivery budget exhausted') and
+        attempt count — poison rows show attempts untouched, proof they
+        never burned the redelivery budget."""
+        reply = self._client.request({"op": "dead", "rk": routing_key})
+        return reply["msgs"]
+
+    def summarize_dead(self) -> dict[str, dict[str, int]]:
+        """Per-routing-key dead counts grouped by reason — the triage
+        view (a burst of one reason = one bug, not many)."""
+        out: dict[str, dict[str, int]] = {}
+        for msg in self.list_dead():
+            per_rk = out.setdefault(msg["rk"], {})
+            reason = msg.get("reason") or "redelivery budget exhausted"
+            per_rk[reason] = per_rk.get(reason, 0) + 1
+        return out
+
+    def requeue_dead(self, routing_key: str | None = None) -> int:
+        """Reset dead rows to pending with a fresh redelivery budget
+        (attempts=0, reason cleared). For poison rows, fix the cause
+        first — an unfixed deterministic failure quarantines again on
+        the first redelivery."""
+        return int(self._client.request(
+            {"op": "requeue_dead", "rk": routing_key})["n"])
+
+    def purge_dead(self, routing_key: str | None = None) -> int:
+        return int(self._client.request(
+            {"op": "purge_dead", "rk": routing_key})["n"])
+
+    def close(self) -> None:
+        self._client.close()
+
+
 def main(argv: list[str] | None = None) -> int:
     from copilot_for_consensus_tpu.bus.inproc import (
         InProcPublisher,
@@ -114,6 +170,10 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     parser = argparse.ArgumentParser(description="failed-queue operator CLI")
+    parser.add_argument(
+        "--broker", default="",
+        help="durable broker address (tcp://host:port) for the "
+             "*-dead commands; e.g. tcp://127.0.0.1:5700")
     sub = parser.add_subparsers(dest="cmd", required=True)
     sub.add_parser("list")
     for cmd in ("inspect", "requeue", "purge"):
@@ -121,7 +181,30 @@ def main(argv: list[str] | None = None) -> int:
         p.add_argument("routing_key")
         if cmd != "purge":
             p.add_argument("--limit", type=int, default=10)
+    for cmd in ("list-dead", "requeue-dead", "purge-dead"):
+        p = sub.add_parser(cmd)
+        p.add_argument("routing_key", nargs="?", default=None)
     args = parser.parse_args(argv)
+
+    if args.cmd in ("list-dead", "requeue-dead", "purge-dead"):
+        if not args.broker:
+            parser.error(f"{args.cmd} needs --broker tcp://host:port "
+                         f"(the durable broker's dead-letter table)")
+        dlq = DeadLetterManager(args.broker)
+        try:
+            if args.cmd == "list-dead":
+                print(json.dumps({
+                    "summary": dlq.summarize_dead() if not args.routing_key
+                    else {},
+                    "messages": dlq.list_dead(args.routing_key),
+                }, indent=2))
+            elif args.cmd == "requeue-dead":
+                print(dlq.requeue_dead(args.routing_key))
+            else:
+                print(dlq.purge_dead(args.routing_key))
+        finally:
+            dlq.close()
+        return 0
 
     broker = get_broker()
     mgr = FailedQueueManager(broker, InProcPublisher(broker=broker))

@@ -178,6 +178,51 @@ def test_chaos_columns_contract():
                      "breaker_trips": 0, "watchdog_trips": 0}
 
 
+def test_pipeline_chaos_preset_registered():
+    """The pipeline fault gate's preset (ISSUE 8): a host-only storm —
+    no jitted entrypoints for the shardcheck preflight to trace — with
+    a watermark strictly inside the scaled warn SLO (pacing must hold
+    depth UNDER the SLO with headroom, not ride its edge), poison
+    envelopes to quarantine, and an overload drag so the OFF arm
+    reproduces the SCALE_BROKER flood deterministically."""
+    assert "pipeline_chaos" in bench.PRESETS
+    p = bench.PRESETS["pipeline_chaos"]
+    assert int(p["BENCH_PIPE_MESSAGES"]) > 0
+    assert int(p["BENCH_PIPE_POISON"]) > 0
+    assert float(p["BENCH_PIPE_DRAG_S"]) > 0
+    slo = int(p["BENCH_PIPE_WARN_SLO"])
+    assert 0 < slo // 2 < slo          # the watermark the harness uses
+    # host-only: the preflight must SKIP, not trace the default engine
+    # set a pipeline storm never dispatches to
+    assert bench.PRESET_CONTRACT_MODULES["pipeline_chaos"] == []
+
+
+def test_pipeline_chaos_columns_contract():
+    """The pipeline_chaos artifact columns are a cross-round contract:
+    lost / duplicated / quarantined / replayed_publishes plus the
+    redelivery, sweep-recovery and two-arm depth evidence (the
+    pipeline_chaos_ok verdict is assembled in
+    pipeline_chaos_headline)."""
+    audit = {"lost": 0, "duplicated": 0, "quarantined": 5,
+             "replayed_publishes": 104, "redelivered": 3,
+             "recovered_by_sweep": 2, "max_depth_backpressure_on": 8,
+             "max_depth_backpressure_off": 88, "final_depth_max": 0,
+             "extra_key_ignored": 1}
+    cols = bench.pipeline_chaos_columns(audit)
+    assert set(cols) == {"lost", "duplicated", "quarantined",
+                         "replayed_publishes", "redelivered",
+                         "recovered_by_sweep",
+                         "max_depth_backpressure_on",
+                         "max_depth_backpressure_off",
+                         "final_depth_max"}
+    assert cols["quarantined"] == 5
+    assert cols["replayed_publishes"] == 104
+    assert cols["max_depth_backpressure_off"] == 88
+    # empty audit degrades to zeros, not KeyErrors
+    empty = bench.pipeline_chaos_columns({})
+    assert set(empty.values()) == {0}
+
+
 def test_telemetry_columns_contract():
     """Flight-recorder columns come from the engine's own telemetry;
     a telemetry-disabled engine (BENCH_TELEMETRY=0 overhead arm)

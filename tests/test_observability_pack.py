@@ -37,7 +37,6 @@ KNOWN_SERIES = {
     "copilot_summarization_summaries_total",
     "copilot_summarization_latency_seconds",
     "copilot_reporting_reports_total",
-    "copilot_bus_queue_depth", "copilot_bus_dead_letters",
     # stats exporter gauges (tools/exporters.py)
     "copilot_collection_documents", "copilot_documents_pending",
     "copilot_vectorstore_vectors", "copilot_vectorstore_dimension",
@@ -63,6 +62,16 @@ from copilot_for_consensus_tpu.engine.telemetry import (  # noqa: E402
 )
 
 KNOWN_SERIES |= set(_engine_series())
+
+# Bus series likewise come from the BUS_METRICS registry next to the
+# emitter (services/bootstrap.py:_BusGaugeMetrics) — the PR-5 pattern
+# extended to the pipeline fault plane (PR 8): alerts/dashboards can
+# only reference bus series the gateway exposition actually carries.
+from copilot_for_consensus_tpu.services.bootstrap import (  # noqa: E402
+    BUS_METRICS,
+)
+
+KNOWN_SERIES |= set(BUS_METRICS)
 # [a-z0-9_]: engine series contain digits (engine_e2e_seconds)
 _SERIES_RE = re.compile(r"\b(copilot_[a-z0-9_]+|up|push_time_seconds)\b")
 
@@ -265,8 +274,42 @@ def test_gateway_metrics_exposes_bus_gauges():
             f"http://127.0.0.1:{server.port}/metrics").read().decode()
         assert "copilot_bus_queue_depth" in body
         assert 'queue="report.delivery.failed"' in body
+        # Registry ⇄ exposition honesty (the PR-5 equality pattern):
+        # every BUS_METRICS family must be present on a live scrape —
+        # gauges refreshed per scrape, counters declared at zero — so
+        # the alert pack's rate()/deriv() expressions never evaluate
+        # over an absent series. copilot_bus_dead_letters is the one
+        # exception: its <rk>.dlq gauge only exists once something
+        # dead-letters (covered by test_gauge_depths semantics).
+        emitted = set(re.findall(r"^(copilot_bus_[a-z_]+)\{?",
+                                 body, flags=re.M))
+        expected = set(BUS_METRICS) - {"copilot_bus_dead_letters"}
+        assert expected <= emitted, sorted(expected - emitted)
+        assert emitted <= set(BUS_METRICS), sorted(
+            emitted - set(BUS_METRICS))
     finally:
         server.stop()
+
+
+def test_bus_alert_functions_match_series_types():
+    """rate()/increase() need counters; deriv()/delta() need gauges —
+    the PR-1 dead-alert bug class, applied to the copilot_bus_* pack."""
+    counter_fns = {"rate", "irate", "increase"}
+    gauge_fns = {"deriv", "delta", "idelta"}
+    fn_re = re.compile(r"\b(rate|irate|increase|deriv|delta|idelta)\s*"
+                       r"\(\s*(copilot_bus_[a-z_]+)")
+    for f in _alert_files():
+        doc = yaml.safe_load(f.read_text())
+        for group in doc["groups"]:
+            for rule in group["rules"]:
+                for fn, series in fn_re.findall(rule["expr"]):
+                    typ = BUS_METRICS[series][0]
+                    if fn in counter_fns:
+                        assert typ == "counter", (f.name, rule["alert"],
+                                                  fn, series, typ)
+                    if fn in gauge_fns:
+                        assert typ == "gauge", (f.name, rule["alert"],
+                                                fn, series, typ)
 
 
 def test_profiler_flag_captures_trace(tmp_path):

@@ -238,3 +238,44 @@ def test_sqlite_indexed_query_scales(tmp_path):
     assert {d["chunk_id"] for d in hits2} == {d["chunk_id"] for d in hits}
     assert dt_indexed < dt_scan / 5, (dt_indexed, dt_scan)
     s.close()
+
+
+def test_sqlite_lock_contention_is_retryable(tmp_path, monkeypatch):
+    """``OperationalError: database is locked`` (writer contention past
+    the busy timeout) must surface as the retryable
+    ``StorageContentionError`` — infrastructure contention rides the
+    retry/redelivery spine, it must never classify as poison."""
+    import sqlite3
+
+    from copilot_for_consensus_tpu.core.retry import RetryableError
+    from copilot_for_consensus_tpu.storage.base import (
+        StorageContentionError,
+    )
+
+    s = SQLiteDocumentStore({"path": str(tmp_path / "lock.sqlite3")})
+    s.insert_document("sources", {"source_id": "s1", "name": "s1"})
+
+    class _LockedConn:
+        def execute(self, *a, **kw):
+            raise sqlite3.OperationalError("database is locked")
+
+        def commit(self):
+            raise sqlite3.OperationalError("database is locked")
+
+    monkeypatch.setattr(s, "_conn", lambda: _LockedConn())
+    with pytest.raises(StorageContentionError) as ei:
+        s.upsert_document("sources", {"source_id": "s1", "name": "s2"})
+    assert isinstance(ei.value, RetryableError)
+    with pytest.raises(StorageContentionError):
+        s.get_document("sources", "s1")
+    # non-lock OperationalErrors keep their class (genuinely broken SQL
+    # or schema must not masquerade as transient)
+    class _BrokenConn:
+        def execute(self, *a, **kw):
+            raise sqlite3.OperationalError("no such table: docs_nope")
+
+    monkeypatch.setattr(s, "_conn", lambda: _BrokenConn())
+    with pytest.raises(sqlite3.OperationalError):
+        s.get_document("sources", "s1")
+    monkeypatch.undo()
+    s.close()

@@ -8,6 +8,13 @@ over the repo and exits non-zero on any non-baselined finding:
   engine's jitted hot paths fast and correct.
 * ``concurrency`` group (concurrency.py): blocking-call — handler-thread
   hygiene for the bus and services.
+* ``race`` group (racecheck.py): static concurrency analysis over the
+  serving/pipeline thread plane — lock-order cycles, callbacks invoked
+  under locks, RacerD-style lock-consistency on fields, thread
+  stop/join lifecycle, and ``__getattr__`` wrappers shadowed by
+  concrete base-class defaults. Its wrapper-shadow rule additionally
+  runs a cross-module pass (base classes resolved through the package
+  import graph) that ``--fast`` and explicit-path runs skip.
 * ``policy`` group (policy.py): the original validate_python lane
   (syntax, import smoke, mutable defaults, unused imports, bare except).
 * ``shard`` group (shardcheck.py): the SEMANTIC pass — traces the
@@ -40,6 +47,7 @@ from copilot_for_consensus_tpu.analysis import (
     concurrency,
     jax_rules,
     policy,
+    racecheck,
 )
 from copilot_for_consensus_tpu.analysis.base import (
     DEFAULT_BASELINE,
@@ -57,6 +65,7 @@ from copilot_for_consensus_tpu.analysis.base import (
 GROUPS = {
     "jax": jax_rules.check,
     "concurrency": concurrency.check,
+    "race": racecheck.check,
     "policy": policy.check,
 }
 
@@ -74,6 +83,11 @@ RULES = {
     "collective-axis": "jax",
     "blocking-call": "concurrency",
     "policy-syntax": "policy",
+    "race-lock-order": "race",
+    "race-callback-under-lock": "race",
+    "race-unlocked-field": "race",
+    "race-thread-lifecycle": "race",
+    "race-wrapper-shadow": "race",
     "policy-mutable-default": "policy",
     "policy-bare-except": "policy",
     "policy-unused-import": "policy",
@@ -134,10 +148,14 @@ def analyze_files(paths: list[pathlib.Path],
     """Run the per-file rule groups over explicit files (no import
     smoke, no semantic pass). The API the tests drive fixtures
     through."""
+    return _analyze_modules([Module(p) for p in paths], groups)
+
+
+def _analyze_modules(mods: list[Module],
+                     groups: set[str] | None = None) -> list[Finding]:
     groups = set(GROUPS) if groups is None else groups & set(GROUPS)
     findings: list[Finding] = []
-    for path in paths:
-        mod = Module(path)
+    for mod in mods:
         for g in sorted(groups):
             findings.extend(GROUPS[g](mod))
     return _dedupe(findings)
@@ -166,11 +184,17 @@ def main(argv: list[str] | None = None) -> int:
                          "validate_python set for policy rules; "
                          "explicit paths skip the shard group)")
     ap.add_argument("--fast", action="store_true",
-                    help="skip the import-smoke stage and the semantic "
-                         "(shard) pass")
+                    help="skip the import-smoke stage, the semantic "
+                         "(shard) pass, and race's cross-module pass")
     ap.add_argument("--rules",
                     help="comma list of rule ids or groups "
                          f"({', '.join(sorted(ALL_GROUPS))}) to run")
+    ap.add_argument("--group", action="append", dest="groups",
+                    choices=sorted(ALL_GROUPS), metavar="GROUP",
+                    help="run only this rule family (repeatable; "
+                         f"one of {', '.join(sorted(ALL_GROUPS))}) — "
+                         "the dev-loop filter the CI job matrix also "
+                         "uses; composes with --rules by intersection")
     ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
                     help="baseline file (default: jaxlint_baseline.json "
                          "at the repo root)")
@@ -194,6 +218,19 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     groups, only_rules = _selected_groups(args.rules)
+    if args.groups:
+        groups &= set(args.groups)
+        only_rules = {r for r in only_rules if RULES.get(r) in groups}
+        if not groups:
+            # a contradictory --rules/--group pairing must fail loudly
+            # (rc 2), not sail through as a 0-file "CLEAN" run
+            ap.error(f"--rules {args.rules!r} and --group "
+                     f"{','.join(args.groups)} select no common rule "
+                     "family — nothing would run")
+    #: did race's cross-module (wrapper-shadow over the import graph)
+    #: pass run? When it didn't, its baseline entries are exempt from
+    #: stale judgment — same reasoning as dropping a skipped group.
+    race_cross_ran = False
     findings: list[Finding] = []
     if args.paths:
         analyzed = _expand(args.paths)
@@ -229,7 +266,17 @@ def main(argv: list[str] | None = None) -> int:
         # only; a semantic-only run parses nothing
         pkg = _package_files() if groups & set(GROUPS) else []
         analyzed = list(pkg)
-        findings.extend(analyze_files(pkg, groups))
+        pkg_mods = [Module(p) for p in pkg]
+        findings.extend(_analyze_modules(pkg_mods, groups))
+        if "race" in groups and not args.fast:
+            # cross-module wrapper-shadow: resolves base classes
+            # through the package import graph (a wrapper in bus/
+            # validating.py vs the concrete defaults of its ABC in
+            # bus/base.py). Cheap (pure ast, trees reused from the
+            # per-file pass), but it needs the whole package — hence
+            # full-repo runs only.
+            findings.extend(racecheck.check_cross(pkg, modules=pkg_mods))
+            race_cross_ran = True
         if "policy" in groups:
             extras = [p for p in policy.policy_files()
                       if PACKAGE not in p.resolve().parents]
@@ -280,6 +327,11 @@ def main(argv: list[str] | None = None) -> int:
             analyzed_rel = {rel(p) for p in analyzed}
             for e in stale:
                 if e["path"] not in analyzed_rel:
+                    continue
+                if (e.get("rule") == "race-wrapper-shadow"
+                        and not race_cross_ran):
+                    # cross-module-only findings can't be judged stale
+                    # by a run that skipped the cross-module pass
                     continue
                 msg = (f"stale baseline entry (no longer matches): "
                        f"{e['rule']} in {e['path']} [{e['context']}]")

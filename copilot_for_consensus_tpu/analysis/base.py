@@ -220,6 +220,220 @@ def baseline_entries_for(findings: list[Finding]) -> list[dict]:
 
 
 # ---------------------------------------------------------------------------
+# assignment-provenance lock model (shared by blocking-call and racecheck)
+# ---------------------------------------------------------------------------
+#
+# The old heuristic ("does the with-item's name contain 'lock'?") missed
+# every Condition-typed member (``async_runner._work``) and every alias
+# whose name doesn't say lock. This model tracks *provenance* instead:
+# a name is a lock because it was BOUND from ``threading.Lock()`` /
+# ``RLock()`` / ``Condition()`` / ``Semaphore()`` (directly, via a
+# dataclass ``field(default_factory=threading.Lock)``, or by aliasing —
+# ``Condition(self._lock)`` shares the identity of ``self._lock``).
+# Events and Threads ride the same machinery (racecheck's
+# thread-lifecycle rule needs both).
+
+#: threading factory name -> (role, reentrant). Condition() builds its
+#: own RLock, so bare Condition is reentrant; Condition(lock) aliases
+#: the wrapped lock and inherits ITS reentrancy. Semaphores are marked
+#: reentrant (re-acquiring one is legal when the count allows) so they
+#: never produce self-deadlock findings, only cross-lock cycles.
+THREADING_FACTORIES = {
+    "Lock": ("lock", False),
+    "RLock": ("lock", True),
+    "Condition": ("lock", True),
+    "Semaphore": ("lock", True),
+    "BoundedSemaphore": ("lock", True),
+    "Event": ("event", False),
+    "Thread": ("thread", False),
+}
+
+
+@dataclass
+class LockInfo:
+    """One threading primitive with a stable identity. Aliases (a
+    Condition wrapping a Lock, a field assigned from another lock
+    field) map to the SAME LockInfo object, so identity comparisons
+    answer "is this the same lock?" regardless of spelling."""
+
+    name: str          # canonical spelling, e.g. "Broker._stats_lock"
+    kind: str          # factory of the original binding ("Lock", ...)
+    role: str          # "lock" | "event" | "thread"
+    reentrant: bool
+    line: int
+
+
+def threading_imports(tree: ast.Module) -> set[str]:
+    """Bare names this module imported from ``threading`` (so a bare
+    ``Thread(...)`` / ``Lock()`` is only treated as the primitive when
+    it actually IS one — a domain class named Thread is not)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def _named_factory(head: str, bare_ok: set[str]) -> str | None:
+    tail = head.rsplit(".", 1)[-1]
+    if tail not in THREADING_FACTORIES:
+        return None
+    if head.startswith("threading."):
+        return tail
+    if "." not in head and head in bare_ok:
+        return tail
+    return None
+
+
+def _factory_of(value: ast.AST, bare_ok: set[str]) -> str | None:
+    """Factory name when ``value`` constructs a threading primitive:
+    ``threading.Lock()``, bare ``Lock()`` (when from-imported from
+    threading), or the dataclass idiom
+    ``field(default_factory=threading.Lock)``."""
+    if not isinstance(value, ast.Call):
+        return None
+    hit = _named_factory(dotted_name(value.func), bare_ok)
+    if hit is not None:
+        return hit
+    if dotted_name(value.func).rsplit(".", 1)[-1] == "field":
+        df = kw(value, "default_factory")
+        if df is not None:
+            return _named_factory(dotted_name(df), bare_ok)
+    return None
+
+
+class LockModel:
+    """Where every threading primitive in one module is bound.
+
+    Three scopes: module-level names, per-class instance/class fields
+    (``self._x = threading.Lock()`` in any method, class-body
+    assignments, dataclass ``field(default_factory=...)``), and
+    function locals. ``resolve(expr, node)`` answers "which primitive
+    does this expression denote at this use site?" using the enclosing
+    class/function found through the parent map."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.module_vars: dict[str, LockInfo] = {}
+        self.class_fields: dict[str, dict[str, LockInfo]] = {}
+        self.fn_locals: dict[tuple[str, str], LockInfo] = {}
+        if mod.tree is None:
+            self.bare_names: set[str] = set()
+            return
+        self.bare_names = threading_imports(mod.tree)
+        # Pass 1: direct factory bindings plus aliases whose source is
+        # already known. Unresolvable aliases (``Condition(self._lock)``
+        # textually BEFORE ``self._lock = threading.Lock()``) are
+        # deferred, not bound fresh — a premature fresh binding would
+        # stick (bindings never overwrite) and hide the alias identity.
+        # Pass 2 (final) re-walks: deferred aliases now resolve against
+        # the pass-1 bindings; a Condition whose wrapped lock is still
+        # unknown (e.g. a parameter) binds as its own fresh lock.
+        for final in (False, True):
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign):
+                    self._bind(node.targets, node.value, node, final)
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    self._bind([node.target], node.value, node, final)
+
+    # -- collection ----------------------------------------------------
+
+    def _scope_of(self, node: ast.AST) -> tuple[str | None, str | None]:
+        """(enclosing class name, enclosing function qualname)."""
+        cls = fn = None
+        cur = self.mod.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn is None:
+                fn = self.mod.qualname(cur)
+            elif isinstance(cur, ast.ClassDef) and cls is None:
+                cls = cur.name
+            cur = self.mod.parent(cur)
+        return cls, fn
+
+    def _bind(self, targets: list[ast.expr], value: ast.AST,
+              site: ast.AST, final: bool = True) -> None:
+        factory = _factory_of(value, self.bare_names)
+        info: LockInfo | None = None
+        cls, fn = self._scope_of(site)
+        if factory is not None:
+            role, reentrant = THREADING_FACTORIES[factory]
+            # Condition(existing_lock) aliases the wrapped lock
+            if factory == "Condition" and isinstance(value, ast.Call) \
+                    and value.args:
+                inner = self.resolve(value.args[0], site)
+                if inner is not None and inner.role == "lock":
+                    info = inner
+                elif not final:
+                    return    # wrapped lock not bound yet: defer
+            if info is None:
+                info = LockInfo("", factory, role, reentrant,
+                                getattr(site, "lineno", 1))
+        else:
+            # plain alias: RHS is itself a known primitive
+            if isinstance(value, (ast.Name, ast.Attribute)):
+                info = self.resolve(value, site)
+            if info is None:
+                return
+        for t in targets:
+            if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id == "self" \
+                    and cls is not None:
+                fields = self.class_fields.setdefault(cls, {})
+                if not info.name:
+                    info.name = f"{cls}.{t.attr}"
+                fields.setdefault(t.attr, info)
+            elif isinstance(t, ast.Name):
+                if fn is not None:
+                    if not info.name:
+                        info.name = t.id
+                    self.fn_locals.setdefault((fn, t.id), info)
+                elif cls is not None:
+                    # class-body assignment: a class attribute
+                    if not info.name:
+                        info.name = f"{cls}.{t.id}"
+                    self.class_fields.setdefault(cls, {}).setdefault(
+                        t.id, info)
+                else:
+                    if not info.name:
+                        info.name = t.id
+                    self.module_vars.setdefault(t.id, info)
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve(self, expr: ast.AST,
+                use_site: ast.AST) -> LockInfo | None:
+        """The primitive ``expr`` denotes at ``use_site``, or None.
+        ``self.x`` looks in the enclosing class; a bare name looks in
+        the enclosing function's locals, then the class attributes,
+        then module scope."""
+        cls, fn = self._scope_of(use_site)
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            if cls is not None:
+                return self.class_fields.get(cls, {}).get(expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            if fn is not None:
+                hit = self.fn_locals.get((fn, expr.id))
+                if hit is not None:
+                    return hit
+            if cls is not None:
+                hit = self.class_fields.get(cls, {}).get(expr.id)
+                if hit is not None:
+                    return hit
+            return self.module_vars.get(expr.id)
+        return None
+
+    def locks_of(self, cls: str) -> dict[str, LockInfo]:
+        """Field name -> LockInfo for one class (role 'lock' only)."""
+        return {f: i for f, i in self.class_fields.get(cls, {}).items()
+                if i.role == "lock"}
+
+
+# ---------------------------------------------------------------------------
 # small AST helpers shared by the rule groups
 # ---------------------------------------------------------------------------
 

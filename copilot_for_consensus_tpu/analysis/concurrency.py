@@ -26,6 +26,7 @@ import re
 
 from copilot_for_consensus_tpu.analysis.base import (
     Finding,
+    LockModel,
     Module,
     dotted_name,
 )
@@ -57,10 +58,20 @@ def _is_audited(mod: Module, node: ast.AST) -> bool:
                for suffix, func in AUDITED_RETRY_HELPERS)
 
 
-def _lockish_with(item: ast.withitem) -> bool:
+def lockish_with(item: ast.withitem, locks: LockModel) -> bool:
+    """Is this with-item a lock acquisition? Provenance first (the
+    shared ``LockModel``: anything bound from ``threading.Lock`` /
+    ``RLock`` / ``Condition`` / ``Semaphore``, through aliases — so
+    Condition-typed members like ``async_runner._work`` count); the
+    old name-token heuristic survives only as a fallback for names
+    whose construction the model cannot see (parameters, fields set by
+    another module)."""
     expr = item.context_expr
     if isinstance(expr, ast.Call):
         expr = expr.func
+    info = locks.resolve(expr, item.context_expr)
+    if info is not None:
+        return info.role == "lock"
     name = dotted_name(expr).lower()
     # token match, not substring: `blockchain`/`clock` are not locks
     tokens = set(re.split(r"[^a-z0-9]+", name))
@@ -70,6 +81,7 @@ def _lockish_with(item: ast.withitem) -> bool:
 def check(mod: Module) -> list[Finding]:
     if mod.tree is None:
         return []
+    locks = LockModel(mod)
     out: list[Finding] = []
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.Call) and dotted_name(
@@ -84,7 +96,7 @@ def check(mod: Module) -> list[Finding]:
             if f is not None:
                 out.append(f)
         elif isinstance(node, (ast.With, ast.AsyncWith)):
-            if not any(_lockish_with(i) for i in node.items):
+            if not any(lockish_with(i, locks) for i in node.items):
                 continue
             # stop at nested function boundaries: a callback DEFINED
             # under the lock does not publish under the lock

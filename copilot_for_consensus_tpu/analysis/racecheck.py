@@ -1,0 +1,1216 @@
+"""Rule group ``race``: static concurrency analysis for the thread plane.
+
+PRs 7 and 8 grew a real multi-threaded runtime — dispatcher thread,
+watchdog, outbox replayer, backpressure pacer, shipping-logger pump —
+and review kept catching the SAME bug classes by hand: callbacks fired
+while holding the lock the watchdog shares, ledgers mutated with and
+without their lock, wrapper delegation silently defeated by a concrete
+base-class default. These are exactly what syntactic lock-consistency
+analyzers (Infer's RacerD, the kernel's lockdep) catch cheaply, so this
+group turns them into a machine-checked gate.
+
+Five rules over one per-module model (``_ModuleScan``) built on the
+shared assignment-provenance :class:`~.base.LockModel`:
+
+* ``race-lock-order`` — per-module lock-acquisition graph ("lock A held
+  while acquiring lock B", with call-graph propagation in the style of
+  jaxlint's host-sync context propagation). A cycle is a potential
+  deadlock; acquiring a held NON-reentrant lock is a guaranteed one.
+* ``race-callback-under-lock`` — a user-supplied callable (anything
+  bound from a constructor/registration parameter: done-callbacks,
+  ``error_reporter``, subscriber handlers) invoked while a lock is
+  held. Done-callbacks may re-enter ``submit()`` — the exact PR-7
+  re-entrancy class. Propagates through the call graph, including
+  calls to other classes' callback-firing methods in the same module
+  (``handle._resolve(...)`` under the dispatcher lock).
+* ``race-unlocked-field`` — RacerD-style lock consistency: a ``self._x``
+  written under a lock in one method and read/written bare in another
+  method of the same class. The bare access is the finding.
+* ``race-thread-lifecycle`` — every ``threading.Thread(target=...)``
+  needs a reachable stop path: either the target (transitively) polls a
+  stop ``Event`` (``.wait()``/``.is_set()``) or the thread object is
+  ``join()``ed somewhere in its owner. Daemon-and-forget loops are
+  findings.
+* ``race-wrapper-shadow`` — a class relying on ``__getattr__``
+  delegation whose concrete base class defines the same method as a
+  trivial default (``pass`` / ``return {}``): the delegation never
+  fires, so the wrapper silently serves the default instead of the
+  wrapped driver's implementation — the PR-8 ``ValidatingPublisher.
+  saturation()`` bug as a lint rule. The per-file pass resolves
+  same-module bases; :func:`check_cross` resolves bases across the
+  package via the import graph (skipped under ``--fast`` and for
+  explicit-path runs).
+
+Held-lock reasoning: a method's body holds what its ``with`` blocks
+hold lexically, PLUS what every internal call site holds when the
+method is private, never referenced as a value (callbacks/thread
+targets escape), and only ever called with that lock held — the
+``# caller holds _replay_lock`` idiom, inferred instead of trusted.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from copilot_for_consensus_tpu.analysis.base import (
+    Finding,
+    LockInfo,
+    LockModel,
+    Module,
+    dotted_name,
+    kw,
+)
+
+RULES = (
+    "race-lock-order",
+    "race-callback-under-lock",
+    "race-unlocked-field",
+    "race-thread-lifecycle",
+    "race-wrapper-shadow",
+)
+
+#: container-method names that mutate their receiver: a call
+#: ``self._x.append(...)`` is a WRITE of ``_x`` for lock-consistency
+MUTATORS = {"append", "appendleft", "extend", "insert", "add", "update",
+            "pop", "popleft", "popitem", "remove", "discard", "clear",
+            "setdefault"}
+
+#: methods excluded from unlocked-field: construction happens-before
+#: every cross-thread access, so bare writes there are fine
+CONSTRUCTORS = {"__init__", "__post_init__"}
+
+#: constructors that mark a field as a plain shared CONTAINER — only
+#: these get their element mutations (``self._x[k] = v``,
+#: ``self._x.append(...)``) counted as writes OF THE FIELD. An object
+#: field (``self.outbox.append(...)``) synchronizes itself; calling
+#: its methods is not a data race on the reference.
+CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                   "OrderedDict", "Counter"}
+
+
+# ---------------------------------------------------------------------------
+# per-module scan: units, accesses, acquisitions, call edges
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Access:
+    fld: str
+    write: bool
+    held: frozenset
+    node: ast.AST
+
+
+@dataclass
+class _Acq:
+    lock: LockInfo
+    held: frozenset          # locks held at the acquisition site
+    node: ast.AST
+
+
+@dataclass
+class _Call:
+    name: str                # method/callback name
+    kind: str                # "cb" | "self" | "attr"
+    held: frozenset
+    node: ast.AST
+
+
+@dataclass
+class _ThreadCtor:
+    node: ast.Call
+    target: ast.expr | None
+    assigned: LockInfo | None   # thread provenance when visible
+
+
+@dataclass(eq=False)       # identity semantics: units live in sets
+class _Unit:
+    """One scan unit: a method, module function, or nested function
+    (nested defs can run on other threads, so they scan as their own
+    unit with an empty initial held set)."""
+
+    node: ast.AST
+    qualname: str
+    cls: str | None          # enclosing class name, None at module level
+    name: str                # bare function name
+    accesses: list[_Access] = field(default_factory=list)
+    acquisitions: list[_Acq] = field(default_factory=list)
+    calls: list[_Call] = field(default_factory=list)
+    threads: list[_ThreadCtor] = field(default_factory=list)
+    joins: set[int] = field(default_factory=set)   # id(thread LockInfo)
+    #: a join whose receiver has NO provenance (`for t in threads:
+    #: t.join()`) — it may join anything, so it excuses untracked
+    #: threads; a join of a KNOWN other thread excuses nothing
+    untracked_join: bool = False
+    polls_stop: bool = False
+    # summaries (fixpoint over the call graph)
+    acquires: set[int] = field(default_factory=set)
+    invokes_cb: bool = False
+    inherited_held: frozenset = frozenset()
+
+
+def _is_self(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+class _ModuleScan:
+    """Builds every unit plus per-class method/callback-field tables."""
+
+    def __init__(self, mod: Module, locks: LockModel):
+        self.mod = mod
+        self.locks = locks
+        self.units: list[_Unit] = []
+        #: class -> {method name -> unit}
+        self.methods: dict[str, dict[str, _Unit]] = {}
+        #: class -> field names bound from a parameter (user-supplied
+        #: callables when invoked) — direct or container-element
+        self.cb_fields: dict[str, set[str]] = {}
+        #: class -> method names referenced as values (escape: may run
+        #: on any thread, so they inherit no held locks)
+        self.escapes: dict[str, set[str]] = {}
+        #: class -> fields holding plain shared containers (element
+        #: mutations count as writes of the field)
+        self.container_fields: dict[str, set[str]] = {}
+        assert mod.tree is not None
+        self._collect_cb_fields()
+        self._collect_container_fields()
+        self._collect_units(mod.tree, cls=None)
+        for u in self.units:
+            if u.cls is not None:
+                self.methods.setdefault(u.cls, {}).setdefault(u.name, u)
+        for u in self.units:
+            _UnitWalk(self, u).run()
+        self._fixpoint()
+
+    # -- discovery -----------------------------------------------------
+
+    def _enclosing_class(self, node: ast.AST) -> str | None:
+        cur = self.mod.parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a method of a class nested in a function still
+                # belongs to that class; a plain nested def does not
+                pass
+            cur = self.mod.parent(cur)
+        return None
+
+    def _collect_units(self, tree: ast.AST, cls: str | None) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.units.append(_Unit(
+                    node, self.mod.qualname(node),
+                    self._enclosing_class(node), node.name))
+
+    def _collect_cb_fields(self) -> None:
+        """Fields assigned from a parameter anywhere in their class:
+        ``self.F = param``, ``self.F.append(param)``,
+        ``self.F[k] = param`` — the provenance that makes a later
+        ``self.F(...)`` (or element call) a user-callback invocation."""
+        assert self.mod.tree is not None
+        for fn in ast.walk(self.mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls = self._enclosing_class(fn)
+            if cls is None:
+                continue
+            a = fn.args
+            params = {p.arg for p in
+                      a.posonlyargs + a.args + a.kwonlyargs} - {"self"}
+            if not params:
+                continue
+            bucket = self.cb_fields.setdefault(cls, set())
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Name) \
+                        and node.value.id in params:
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and _is_self(t.value):
+                            bucket.add(t.attr)
+                        elif isinstance(t, ast.Subscript) and isinstance(
+                                t.value, ast.Attribute) \
+                                and _is_self(t.value.value):
+                            bucket.add(t.value.attr)
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr in ("append", "add", "insert",
+                                               "setdefault") \
+                        and isinstance(node.func.value, ast.Attribute) \
+                        and _is_self(node.func.value.value) \
+                        and any(isinstance(arg, ast.Name)
+                                and arg.id in params
+                                for arg in node.args):
+                    bucket.add(node.func.value.attr)
+        # a field that is a lock/event/thread is never a callback
+        for cls, fields in self.cb_fields.items():
+            fields.difference_update(self.locks.class_fields.get(cls, {}))
+
+    def _is_container_ctor(self, value: ast.AST) -> bool:
+        if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            tail = dotted_name(value.func).rsplit(".", 1)[-1]
+            if tail in CONTAINER_CTORS:
+                return True
+            if tail == "field":
+                df = kw(value, "default_factory")
+                if df is not None and dotted_name(df).rsplit(
+                        ".", 1)[-1] in CONTAINER_CTORS:
+                    return True
+        return False
+
+    def _collect_container_fields(self) -> None:
+        assert self.mod.tree is not None
+        for node in ast.walk(self.mod.tree):
+            targets: list[ast.expr] = []
+            value: ast.AST | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None or not self._is_container_ctor(value):
+                continue
+            cls = self._enclosing_class(node)
+            if cls is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Attribute) and _is_self(t.value):
+                    self.container_fields.setdefault(cls, set()).add(
+                        t.attr)
+                elif isinstance(t, ast.Name) and isinstance(
+                        self.mod.parent(node), ast.ClassDef):
+                    # class-body (dataclass) field declaration
+                    self.container_fields.setdefault(cls, set()).add(
+                        t.id)
+
+    # -- summaries -----------------------------------------------------
+
+    def attr_callees(self, name: str) -> list[_Unit]:
+        """Units a ``<obj>.name(...)`` call may reach — name-based
+        cross-class resolution, trusted only when the name is defined
+        by exactly ONE class in the module (``handle._resolve(...)``
+        resolves; ubiquitous names like ``close`` stay opaque rather
+        than smearing every class's summary onto every receiver)."""
+        cands = [u for u in self.units
+                 if u.name == name and u.cls is not None]
+        classes = {u.cls for u in cands}
+        return cands if len(classes) == 1 else []
+
+    def _fixpoint(self) -> None:
+        by_cls = self.methods
+        for u in self.units:
+            u.acquires = {id(a.lock) for a in u.acquisitions}
+            u.invokes_cb = any(c.kind == "cb" for c in u.calls)
+        for _ in range(12):
+            changed = False
+            for u in self.units:
+                for c in u.calls:
+                    callees: list[_Unit] = []
+                    if c.kind == "self" and u.cls is not None:
+                        callee = by_cls.get(u.cls, {}).get(c.name)
+                        if callee is not None:
+                            callees = [callee]
+                    elif c.kind == "attr":
+                        callees = self.attr_callees(c.name)
+                    for callee in callees:
+                        if callee.acquires - u.acquires:
+                            u.acquires |= callee.acquires
+                            changed = True
+                        if callee.invokes_cb and not u.invokes_cb:
+                            u.invokes_cb = True
+                            changed = True
+            if not changed:
+                break
+        # inherited held locks: private, non-escaping, internally
+        # called methods inherit the INTERSECTION of their call sites'
+        # held sets (the "# caller holds the lock" idiom, verified)
+        sites: dict[int, list[frozenset]] = {}
+        for _ in range(4):
+            sites.clear()
+            for u in self.units:
+                for c in u.calls:
+                    # EVERY resolvable call site counts — a lock-free
+                    # cross-class call (`h._cancel()`) must shrink the
+                    # intersection, or a racy bare access inside the
+                    # callee hides behind its self-call sites' locks
+                    callees: list[_Unit] = []
+                    if c.kind == "self" and u.cls is not None:
+                        callee = by_cls.get(u.cls, {}).get(c.name)
+                        if callee is not None:
+                            callees = [callee]
+                    elif c.kind == "attr":
+                        callees = self.attr_callees(c.name)
+                    for callee in callees:
+                        if callee.cls is None:
+                            continue
+                        sites.setdefault(id(callee), []).append(
+                            c.held | u.inherited_held)
+            changed = False
+            for u in self.units:
+                if (u.cls is None or not u.name.startswith("_")
+                        or u.name.startswith("__")
+                        or u.name in self.escapes.get(u.cls, ())):
+                    continue
+                held_sets = sites.get(id(u))
+                if not held_sets:
+                    continue
+                inherited = frozenset.intersection(*held_sets)
+                if inherited != u.inherited_held:
+                    u.inherited_held = inherited
+                    changed = True
+            if not changed:
+                break
+
+
+class _UnitWalk:
+    """One unit's body: tracks the lexically-held lock set, records
+    field accesses, lock acquisitions, calls, thread constructions.
+    Stops at nested function boundaries (each nested def is its own
+    unit — it may run on another thread with nothing held)."""
+
+    def __init__(self, scan: _ModuleScan, unit: _Unit):
+        self.scan = scan
+        self.unit = unit
+        self.mod = scan.mod
+        self.locks = scan.locks
+        self.cb_fields = scan.cb_fields.get(unit.cls or "", set())
+        self.containers = scan.container_fields.get(unit.cls or "",
+                                                    set())
+        self.methods = set(scan.methods.get(unit.cls or "", ()))
+        #: local names holding user-callback values (from cb fields,
+        #: through tuple unpacking / iteration / container reads)
+        self.cb_locals: set[str] = set()
+
+    def run(self) -> None:
+        body = getattr(self.unit.node, "body", [])
+        self._stmts(body, frozenset())
+
+    # -- statements ----------------------------------------------------
+
+    def _stmts(self, stmts: list[ast.stmt], held: frozenset) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: frozenset) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                         # separate units walk alone
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                self._expr(item.context_expr, held)
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                info = self.locks.resolve(expr, item.context_expr)
+                if info is not None and info.role == "lock":
+                    self.unit.acquisitions.append(
+                        _Acq(info, new_held, item.context_expr))
+                    new_held = new_held | {id(info)}
+            self._stmts(stmt.body, new_held)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.For):
+            self._expr(stmt.iter, held)
+            if isinstance(stmt.target, ast.Name) \
+                    and self._is_cb_value(stmt.iter):
+                self.cb_locals.add(stmt.target.id)
+            self._target(stmt.target, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, held)
+            for h in stmt.handlers:
+                self._stmts(h.body, held)
+            self._stmts(stmt.orelse, held)
+            self._stmts(stmt.finalbody, held)
+        elif isinstance(stmt, ast.Assign):
+            self._expr(stmt.value, held)
+            self._taint(stmt.targets, stmt.value)
+            for t in stmt.targets:
+                self._target(t, held)
+        elif isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value, held)
+            self._field_of_target(stmt.target, held, read_too=True)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+                self._taint([stmt.target], stmt.value)
+            self._target(stmt.target, held)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._field_of_target(t, held)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._expr(child, held)
+
+    # -- callback-value taint ------------------------------------------
+
+    def _is_cb_value(self, expr: ast.AST) -> bool:
+        """Does this expression yield a user callback (or a container
+        of them)? ``self.F`` for a cb field, a tainted local, an
+        element read of either (``x[k]`` / ``x.get(k)``)."""
+        if isinstance(expr, ast.Attribute) and _is_self(expr.value):
+            return expr.attr in self.cb_fields
+        if isinstance(expr, ast.Name):
+            return expr.id in self.cb_locals
+        if isinstance(expr, ast.Subscript):
+            return self._is_cb_value(expr.value)
+        if isinstance(expr, ast.Call) and isinstance(
+                expr.func, ast.Attribute) \
+                and expr.func.attr in ("get", "pop", "popleft"):
+            return self._is_cb_value(expr.func.value)
+        return False
+
+    def _taint(self, targets: list[ast.expr], value: ast.AST) -> None:
+        if len(targets) == 1 and isinstance(targets[0], ast.Tuple) \
+                and isinstance(value, ast.Tuple) \
+                and len(targets[0].elts) == len(value.elts):
+            for t, v in zip(targets[0].elts, value.elts):
+                if isinstance(t, ast.Name):
+                    if self._is_cb_value(v):
+                        self.cb_locals.add(t.id)
+                    else:
+                        self.cb_locals.discard(t.id)
+            return
+        tainted = self._is_cb_value(value)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if tainted:
+                    self.cb_locals.add(t.id)
+                else:
+                    self.cb_locals.discard(t.id)
+
+    # -- targets / field accesses --------------------------------------
+
+    def _record_access(self, fld: str, write: bool, held: frozenset,
+                       node: ast.AST) -> None:
+        prov = self.locks.class_fields.get(self.unit.cls or "", {})
+        info = prov.get(fld)
+        if info is not None and info.role in ("lock", "event"):
+            return            # the primitives themselves are not data
+        if fld in self.methods:
+            return
+        self.unit.accesses.append(_Access(fld, write, held, node))
+
+    def _field_of_target(self, t: ast.expr, held: frozenset,
+                         read_too: bool = False) -> None:
+        """A store target: ``self.X = ...`` and ``self.X[k] = ...``
+        are writes of X (the container mutation included)."""
+        if isinstance(t, ast.Attribute) and _is_self(t.value):
+            if read_too:
+                self._record_access(t.attr, False, held, t)
+            self._record_access(t.attr, True, held, t)
+        elif isinstance(t, ast.Subscript):
+            self._expr(t.slice, held)
+            inner = t.value
+            if isinstance(inner, ast.Attribute) and _is_self(inner.value):
+                # element store: a write of the FIELD only for plain
+                # shared containers; other objects own their state
+                write = inner.attr in self.containers
+                if read_too or not write:
+                    self._record_access(inner.attr, False, held, inner)
+                if write:
+                    self._record_access(inner.attr, True, held, inner)
+            else:
+                self._expr(inner, held)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._field_of_target(el, held, read_too)
+
+    def _target(self, t: ast.expr, held: frozenset) -> None:
+        self._field_of_target(t, held)
+
+    # -- expressions ---------------------------------------------------
+
+    def _expr(self, root: ast.AST, held: frozenset) -> None:
+        stack: list[ast.AST] = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue                   # separate unit / opaque
+            if isinstance(node, ast.Call):
+                self._call(node, held)
+                stack.extend(node.args)
+                stack.extend(k.value for k in node.keywords)
+                continue
+            if isinstance(node, ast.Attribute):
+                if _is_self(node.value):
+                    if node.attr in self.methods:
+                        # a method referenced as a VALUE escapes: it
+                        # may run on any thread (Thread target,
+                        # registered callback) — no held inheritance
+                        self.scan.escapes.setdefault(
+                            self.unit.cls or "", set()).add(node.attr)
+                    else:
+                        self._record_access(node.attr, False, held, node)
+                    continue
+                stack.append(node.value)
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _call(self, node: ast.Call, held: frozenset) -> None:
+        head = dotted_name(node.func)
+        if head == "threading.Thread" or (
+                head == "Thread"
+                and "Thread" in self.locks.bare_names):
+            self._thread_ctor(node)
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            attr = node.func.attr
+            if _is_self(recv):
+                if attr in self.methods:
+                    self.unit.calls.append(_Call(attr, "self", held,
+                                                 node))
+                elif attr in self.cb_fields:
+                    self.unit.calls.append(_Call(attr, "cb", held, node))
+                else:
+                    self._record_access(attr, False, held, node.func)
+                return
+            # mutator call on self.X through one attribute level — a
+            # write of X only when X is a plain shared container
+            if isinstance(recv, ast.Attribute) and _is_self(recv.value):
+                if attr in MUTATORS and recv.attr in self.containers:
+                    self._record_access(recv.attr, True, held, recv)
+                else:
+                    self._record_access(recv.attr, False, held, recv)
+            else:
+                self._expr(recv, held)
+            if attr == "join":
+                info = self.locks.resolve(recv, node)
+                if info is not None and info.role == "thread":
+                    self.unit.joins.add(id(info))
+                else:
+                    self.unit.untracked_join = True
+            elif attr in ("wait", "is_set"):
+                info = self.locks.resolve(recv, node)
+                name = dotted_name(recv).rsplit(".", 1)[-1].lower()
+                if (info is not None and info.role == "event") \
+                        or "stop" in name.replace("-", "_").split("_"):
+                    self.unit.polls_stop = True
+            if self._is_cb_value(node.func.value) \
+                    and attr not in ("get", "pop", "popleft"):
+                # a method call ON a callback value is not an
+                # invocation, and must stay OPAQUE: recording it as an
+                # attr call would let name-based resolution smear an
+                # unrelated class's lock/callback summary onto the
+                # callback receiver
+                return
+            self.unit.calls.append(_Call(attr, "attr", held, node))
+            return
+        if isinstance(node.func, ast.Name):
+            if node.func.id in self.cb_locals:
+                self.unit.calls.append(_Call(node.func.id, "cb", held,
+                                             node))
+            return
+        if isinstance(node.func, ast.Subscript) \
+                and self._is_cb_value(node.func):
+            # direct element invocation: ``self._handlers[key](env)``
+            name = dotted_name(node.func.value).rsplit(".", 1)[-1] \
+                or "<callback>"
+            self.unit.calls.append(_Call(f"{name}[...]", "cb", held,
+                                         node))
+            self._expr(node.func.slice, held)
+            inner = node.func.value
+            if isinstance(inner, ast.Attribute) and _is_self(inner.value):
+                self._record_access(inner.attr, False, held, inner)
+            return
+        self._expr(node.func, held)
+
+    def _thread_ctor(self, node: ast.Call) -> None:
+        target = None
+        for k in node.keywords:
+            if k.arg == "target":
+                target = k.value
+        assigned = None
+        # climb through the enclosing assignment (if any) to find the
+        # thread's binding — provenance gives it a stable identity the
+        # join scan can match
+        cur: ast.AST | None = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.mod.parent(cur)
+        if isinstance(cur, ast.Assign) and len(cur.targets) == 1:
+            assigned = self.locks.resolve(cur.targets[0], node)
+            if assigned is not None and assigned.role != "thread":
+                assigned = None
+        self.unit.threads.append(_ThreadCtor(node, target, assigned))
+
+
+# ---------------------------------------------------------------------------
+# rule 1: race-lock-order
+# ---------------------------------------------------------------------------
+
+
+def _check_lock_order(mod: Module, scan: _ModuleScan) -> list[Finding]:
+    out: list[Finding] = []
+    info_by_id: dict[int, LockInfo] = {}
+    for u in scan.units:
+        for a in u.acquisitions:
+            info_by_id[id(a.lock)] = a.lock
+    #: (A, B) -> (unit qualname, node) of a representative site
+    edges: dict[tuple[int, int], tuple[str, ast.AST]] = {}
+
+    def note_edge(a: int, b: int, unit: _Unit, node: ast.AST) -> None:
+        edges.setdefault((a, b), (unit.qualname, node))
+
+    for u in scan.units:
+        ih = u.inherited_held
+        for a in u.acquisitions:
+            held = a.held | ih
+            for lid in held:
+                if lid == id(a.lock):
+                    if not a.lock.reentrant:
+                        f = mod.finding(
+                            "race-lock-order", a.node,
+                            f"non-reentrant lock '{a.lock.name}' "
+                            "acquired while already held on this path "
+                            "— a guaranteed self-deadlock (use an "
+                            "RLock or release first)",
+                            context=u.qualname)
+                        if f is not None:
+                            out.append(f)
+                else:
+                    note_edge(lid, id(a.lock), u, a.node)
+        for c in u.calls:
+            held = c.held | ih
+            if not held:
+                continue
+            callees: list[_Unit] = []
+            if c.kind == "self" and u.cls is not None:
+                callee = scan.methods.get(u.cls, {}).get(c.name)
+                if callee is not None:
+                    callees = [callee]
+            elif c.kind == "attr":
+                callees = scan.attr_callees(c.name)
+            acq: set[int] = set()
+            for callee in callees:
+                acq |= callee.acquires
+            for b in acq:
+                binfo = info_by_id.get(b)
+                if b in held:
+                    if binfo is not None and not binfo.reentrant:
+                        f = mod.finding(
+                            "race-lock-order", c.node,
+                            f"call to '{c.name}()' re-acquires "
+                            f"non-reentrant lock '{binfo.name}' that "
+                            "is already held at this call site — a "
+                            "guaranteed self-deadlock",
+                            context=u.qualname)
+                        if f is not None:
+                            out.append(f)
+                else:
+                    for a in held:
+                        note_edge(a, b, u, c.node)
+
+    # cycles in the order graph (lockdep's invariant: the "held while
+    # acquiring" relation must stay acyclic)
+    adj: dict[int, set[int]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reachable(src: int, dst: int) -> bool:
+        seen = {src}
+        stack = [src]
+        while stack:
+            n = stack.pop()
+            for m in adj.get(n, ()):
+                if m == dst:
+                    return True
+                if m not in seen:
+                    seen.add(m)
+                    stack.append(m)
+        return False
+
+    reported: set[frozenset] = set()
+    for (a, b), (qual, node) in sorted(
+            edges.items(),
+            key=lambda kv: (getattr(kv[1][1], "lineno", 0), kv[0])):
+        if not reachable(b, a):
+            continue
+        key = frozenset((a, b))
+        if key in reported:
+            continue
+        reported.add(key)
+        na = info_by_id.get(a)
+        nb = info_by_id.get(b)
+        an = na.name if na else "?"
+        bn = nb.name if nb else "?"
+        f = mod.finding(
+            "race-lock-order", node,
+            f"lock-order cycle: '{an}' is held while acquiring "
+            f"'{bn}' here, but another path acquires '{an}' while "
+            f"holding '{bn}' — a potential deadlock; pick one order "
+            "and document it",
+            context=qual)
+        if f is not None:
+            out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 2: race-callback-under-lock
+# ---------------------------------------------------------------------------
+
+
+def _check_callback_under_lock(mod: Module,
+                               scan: _ModuleScan) -> list[Finding]:
+    out: list[Finding] = []
+    for u in scan.units:
+        ih = u.inherited_held
+        for c in u.calls:
+            held = c.held | ih
+            if not held:
+                continue
+            if c.kind == "cb":
+                f = mod.finding(
+                    "race-callback-under-lock", c.node,
+                    f"user-supplied callback '{c.name}' invoked while "
+                    "holding a lock — a callback may re-enter the "
+                    "lock's owner (e.g. a done-callback calling "
+                    "submit()) and deadlock, or run arbitrary code in "
+                    "the critical section; collect under the lock, "
+                    "fire outside it",
+                    context=u.qualname)
+                if f is not None:
+                    out.append(f)
+                continue
+            callees: list[_Unit] = []
+            if c.kind == "self" and u.cls is not None:
+                callee = scan.methods.get(u.cls, {}).get(c.name)
+                if callee is not None:
+                    callees = [callee]
+            elif c.kind == "attr":
+                callees = scan.attr_callees(c.name)
+            if any(cal.invokes_cb for cal in callees):
+                f = mod.finding(
+                    "race-callback-under-lock", c.node,
+                    f"call to '{c.name}()', which fires user-supplied "
+                    "callbacks, made while holding a lock — the "
+                    "callback runs inside the critical section and "
+                    "may re-enter it; resolve/fail handles outside "
+                    "the lock",
+                    context=u.qualname)
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 3: race-unlocked-field
+# ---------------------------------------------------------------------------
+
+
+def _check_unlocked_field(mod: Module, scan: _ModuleScan
+                          ) -> list[Finding]:
+    out: list[Finding] = []
+    #: class -> field -> list[(unit, access, effective held)]
+    table: dict[str, dict[str, list]] = {}
+    for u in scan.units:
+        if u.cls is None or u.name in CONSTRUCTORS:
+            continue
+        for a in u.accesses:
+            held = a.held | u.inherited_held
+            table.setdefault(u.cls, {}).setdefault(a.fld, []).append(
+                (u, a, held))
+    info_names: dict[int, str] = {}
+    for u in scan.units:
+        for a in u.acquisitions:
+            info_names[id(a.lock)] = a.lock.name
+    for u in scan.units:
+        for f, i in scan.locks.class_fields.get(u.cls or "", {}).items():
+            info_names.setdefault(id(i), i.name)
+    for cls, fields in table.items():
+        if not scan.locks.locks_of(cls):
+            continue               # no locks in this class: nothing to
+        for fld, accs in fields.items():       # be inconsistent WITH
+            locked_writes = [x for x in accs if x[1].write and x[2]]
+            locked_any = [x for x in accs if x[2]]
+            if not locked_any:
+                continue
+            guards = sorted({info_names.get(lid, "?")
+                             for _, _, held in locked_any
+                             for lid in held})
+            guard_s = "/".join(f"'{g}'" for g in guards)
+            # RacerD's actual invariant is a COMMON lock: accesses
+            # under two different locks race just like a bare one
+            # does. When the lockset intersection over all guarded
+            # accesses (at least one a write) is empty, flag once.
+            common = frozenset.intersection(
+                *(held for _, _, held in locked_any))
+            if not common and locked_writes and len(locked_any) > 1:
+                unit, acc, held = locked_any[-1]
+                f = mod.finding(
+                    "race-unlocked-field", acc.node,
+                    f"accesses of field '{fld}' share NO common lock "
+                    f"(guards seen: {guard_s}) — holding different "
+                    "locks does not synchronize; pick one guard for "
+                    "every cross-thread access",
+                    context=unit.qualname)
+                if f is not None:
+                    out.append(f)
+            seen_lines: set[int] = set()
+            for unit, acc, held in accs:
+                if held:
+                    continue
+                others = ({x[0] for x in locked_writes}
+                          if not acc.write
+                          else {x[0] for x in locked_any})
+                if not (others - {unit}):
+                    continue
+                line = getattr(acc.node, "lineno", 1)
+                if line in seen_lines:
+                    continue
+                seen_lines.add(line)
+                verb = "written" if acc.write else "read"
+                f = mod.finding(
+                    "race-unlocked-field", acc.node,
+                    f"field '{fld}' is guarded by {guard_s} elsewhere "
+                    f"in this class but {verb} here without it — "
+                    "lock-consistency violation (either every "
+                    "cross-thread access holds the guard, or none "
+                    "needs to)",
+                    context=unit.qualname)
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 4: race-thread-lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _check_thread_lifecycle(mod: Module, scan: _ModuleScan
+                            ) -> list[Finding]:
+    out: list[Finding] = []
+    by_name: dict[str, list[_Unit]] = {}
+    for u in scan.units:
+        by_name.setdefault(u.name, []).append(u)
+
+    def polls(unit: _Unit, seen: set[int]) -> bool:
+        if id(unit) in seen:
+            return False
+        seen.add(id(unit))
+        if unit.polls_stop:
+            return True
+        for c in unit.calls:
+            callees: list[_Unit] = []
+            if c.kind == "self" and unit.cls is not None:
+                callee = scan.methods.get(unit.cls, {}).get(c.name)
+                if callee is not None:
+                    callees = [callee]
+            elif c.kind == "attr":
+                callees = scan.attr_callees(c.name)
+            if any(polls(cal, seen) for cal in callees):
+                return True
+        # nested units (a `def loop():` thread body defines helpers)
+        for v in scan.units:
+            if v is not unit and v.qualname.startswith(
+                    unit.qualname + "."):
+                if v.polls_stop:
+                    return True
+        return False
+
+    def resolve_target(t: ast.expr | None,
+                       owner: _Unit) -> _Unit | None:
+        if t is None:
+            return None
+        if isinstance(t, ast.Attribute) and _is_self(t.value) \
+                and owner.cls is not None:
+            return scan.methods.get(owner.cls, {}).get(t.attr)
+        if isinstance(t, ast.Name):
+            # local def first (qualname nesting), then module-level
+            for u in scan.units:
+                if u.name == t.id and u.qualname.startswith(
+                        owner.qualname + "."):
+                    return u
+            for u in by_name.get(t.id, []):
+                if u.cls is None and "." not in u.qualname.replace(
+                        u.name, "", 1).strip("."):
+                    return u
+            cands = by_name.get(t.id, [])
+            return cands[0] if cands else None
+        return None
+
+    for u in scan.units:
+        # the owning scope: the whole class for methods, every
+        # module-level function for module-level owners (a thread
+        # created in start() and joined in stop() shares the module
+        # global that carries it)
+        cls_units = [v for v in scan.units if v.cls == u.cls]
+        for tc in u.threads:
+            target_unit = resolve_target(tc.target, u)
+            joined = False
+            if tc.assigned is not None:
+                joined = any(id(tc.assigned) in v.joins
+                             for v in cls_units)
+            if not joined:
+                # fallback: a provenance-free join in the owning scope
+                # (the `for t in threads: t.join()` idiom) may join
+                # anything, including this thread. Joins of KNOWN
+                # other threads don't count — a class that joins _a
+                # but forgets _b must still flag _b.
+                joined = any(v.untracked_join for v in cls_units)
+            stoppable = (target_unit is not None
+                         and polls(target_unit, set()))
+            if joined or stoppable:
+                continue
+            tname = (dotted_name(tc.target)
+                     if tc.target is not None else "<unknown>")
+            f = mod.finding(
+                "race-thread-lifecycle", tc.node,
+                f"thread target '{tname}' has no reachable stop path: "
+                "the target never polls a stop Event "
+                "(`.wait(timeout)`/`.is_set()`) and the thread is "
+                "never join()ed by its owner — a daemon-and-forget "
+                "loop that outlives shutdown and races teardown",
+                context=u.qualname)
+            if f is not None:
+                out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 5: race-wrapper-shadow
+# ---------------------------------------------------------------------------
+
+
+def _is_trivial_default(fn: ast.AST) -> bool:
+    """A concrete do-nothing default: body (docstring aside) is
+    ``pass`` / ``...`` / ``return`` of a constant or empty container.
+    These exist to be overridden — and they are exactly what defeats
+    ``__getattr__`` delegation silently."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for deco in fn.decorator_list:
+        name = dotted_name(deco).rsplit(".", 1)[-1]
+        if name in ("abstractmethod", "abstractproperty", "property"):
+            return False
+    body = list(fn.body)
+    if body and isinstance(body[0], ast.Expr) and isinstance(
+            body[0].value, ast.Constant) and isinstance(
+            body[0].value.value, str):
+        body = body[1:]
+    if len(body) != 1:
+        return False
+    stmt = body[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                 ast.Constant):
+        return True
+    if isinstance(stmt, ast.Return):
+        v = stmt.value
+        if v is None or isinstance(v, ast.Constant):
+            return True
+        if isinstance(v, (ast.Dict, ast.List, ast.Tuple, ast.Set)) \
+                and not getattr(v, "elts", None) \
+                and not getattr(v, "keys", None):
+            return True
+    return False
+
+
+def _delegating_getattr(cls: ast.ClassDef) -> ast.FunctionDef | None:
+    """The class's ``__getattr__`` when it forwards to a wrapped
+    object (``getattr(self.<field>, ...)`` anywhere in the body)."""
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "__getattr__":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Name) \
+                        and sub.func.id == "getattr" and sub.args \
+                        and isinstance(sub.args[0], ast.Attribute) \
+                        and _is_self(sub.args[0].value):
+                    return node
+    return None
+
+
+class _ClassIndex:
+    """Class lookup across one or many modules, import-graph aware."""
+
+    def __init__(self, modules: list[Module]):
+        self.classes: dict[tuple[str, str], ast.ClassDef] = {}
+        #: importer relpath -> {local name -> (source module path,
+        #: ORIGINAL name)} — `from x import Y as Z` stores Z -> Y so
+        #: lookup in the defining module uses the name it defines
+        self.imports: dict[str, dict[str, tuple[str, str]]] = {}
+        self.mods: dict[str, Module] = {}
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            self.mods[mod.relpath] = mod
+            imap = self.imports.setdefault(mod.relpath, {})
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes[(mod.relpath, node.name)] = node
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    if node.level:
+                        # relative import: resolve against the
+                        # importing module's own path so `from .base
+                        # import X` in bus/ means bus/base.py, never
+                        # some other base.py in the tree
+                        parts = mod.relpath.split("/")[:-1]
+                        if node.level > 1:
+                            parts = parts[:-(node.level - 1)]
+                        src = "/".join(
+                            parts + node.module.split(".")) + ".py"
+                    else:
+                        src = node.module.replace(".", "/") + ".py"
+                    for alias in node.names:
+                        imap[alias.asname or alias.name] = (src,
+                                                            alias.name)
+
+    def _module_for(self, suffix: str) -> str | None:
+        # component-boundary suffix match so "copilot_for_consensus_
+        # tpu/bus/base.py" resolves whether relpaths are repo-relative
+        # or absolute — and "base.py" never matches "database.py"
+        for rel in self.mods:
+            if rel == suffix or rel.endswith("/" + suffix):
+                return rel
+        return None
+
+    def resolve_base(self, mod: Module,
+                     base: ast.expr) -> ast.ClassDef | None:
+        name = dotted_name(base).rsplit(".", 1)[-1]
+        if not name:
+            return None
+        hit = self.classes.get((mod.relpath, name))
+        if hit is not None:
+            return hit
+        entry = self.imports.get(mod.relpath, {}).get(name)
+        if entry is not None:
+            src, original = entry
+            target = self._module_for(src)
+            if target is not None:
+                return self.classes.get((target, original))
+        return None
+
+    def owner_of(self, cls: ast.ClassDef) -> Module | None:
+        for (rel, name), node in self.classes.items():
+            if node is cls:
+                return self.mods.get(rel)
+        return None
+
+
+def _ancestor_chain(cls: ast.ClassDef, mod: Module,
+                    index: _ClassIndex
+                    ) -> list[tuple[ast.ClassDef, Module]]:
+    """Resolvable ancestors, breadth-first — Python's MRO,
+    approximately: the first definition of a name wins."""
+    chain: list[tuple[ast.ClassDef, Module]] = []
+    queue: list[tuple[ast.ClassDef, Module]] = []
+    for b in cls.bases:
+        owner = index.resolve_base(mod, b)
+        if owner is not None:
+            queue.append((owner, index.owner_of(owner) or mod))
+    seen: set[int] = set()
+    while queue:
+        base, base_mod = queue.pop(0)
+        if id(base) in seen:
+            continue
+        seen.add(id(base))
+        chain.append((base, base_mod))
+        for b in base.bases:
+            owner = index.resolve_base(base_mod, b)
+            if owner is not None:
+                queue.append((owner, index.owner_of(owner) or base_mod))
+    return chain
+
+
+def _check_wrapper_shadow(mod: Module,
+                          index: _ClassIndex) -> list[Finding]:
+    out: list[Finding] = []
+    assert mod.tree is not None
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        chain = _ancestor_chain(cls, mod, index)
+        # the delegation may itself be inherited (a `_Wrapper` base
+        # providing __getattr__): the subclass still shadows it with
+        # any OTHER ancestor's concrete trivial default
+        ga: ast.AST | None = _delegating_getattr(cls)
+        if ga is None:
+            for base, _ in chain:
+                if _delegating_getattr(base) is not None:
+                    ga = cls           # anchor at the class statement
+                    break
+        if ga is None:
+            continue
+        defined = {n.name for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        for base, _ in chain:
+            for m in base.body:
+                if not isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                if m.name in defined or m.name.startswith("__"):
+                    continue
+                if _is_trivial_default(m):
+                    f = mod.finding(
+                        "race-wrapper-shadow", ga,
+                        f"'{cls.name}' delegates through __getattr__, "
+                        f"but concrete base-class default "
+                        f"'{base.name}.{m.name}()' shadows it — "
+                        f"__getattr__ only fires for MISSING "
+                        f"attributes, so '{m.name}' silently serves "
+                        "the base default instead of the wrapped "
+                        "object's implementation; add an explicit "
+                        f"override that forwards '{m.name}'",
+                        context=cls.name)
+                    if f is not None:
+                        out.append(f)
+                defined.add(m.name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def check(mod: Module) -> list[Finding]:
+    """All five race rules for one module (wrapper-shadow resolves
+    same-module bases only here; :func:`check_cross` adds the
+    package-wide base resolution)."""
+    if mod.tree is None:
+        return []
+    locks = LockModel(mod)
+    scan = _ModuleScan(mod, locks)
+    out: list[Finding] = []
+    out.extend(_check_lock_order(mod, scan))
+    out.extend(_check_callback_under_lock(mod, scan))
+    out.extend(_check_unlocked_field(mod, scan))
+    out.extend(_check_thread_lifecycle(mod, scan))
+    out.extend(_check_wrapper_shadow(mod, _ClassIndex([mod])))
+    return out
+
+
+def check_cross(paths, modules: list[Module] | None = None
+                ) -> list[Finding]:
+    """The cross-module wrapper-shadow pass: resolves base classes
+    through the package import graph, so a wrapper in ``bus/
+    validating.py`` is checked against the concrete defaults its ABC
+    in ``bus/base.py`` defines. Skipped under ``--fast`` and for
+    explicit-path runs (it needs the whole package to resolve
+    imports). Pass ``modules`` to reuse already-parsed trees (the CLI
+    does — the per-file groups parsed the same files moments ago)."""
+    if modules is None:
+        modules = [Module(p) for p in paths]
+    index = _ClassIndex(modules)
+    out: list[Finding] = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        out.extend(_check_wrapper_shadow(mod, index))
+    return out

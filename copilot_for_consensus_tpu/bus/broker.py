@@ -791,7 +791,8 @@ class BrokerPublisher(EventPublisher):
 
     def close(self):
         self._stop.set()
-        replayer = self._replayer
+        with self._replay_lock:
+            replayer = self._replayer
         if replayer is not None:
             # A replayer mid-request against an unreachable broker can
             # block for the client's full retry budget before it sees

@@ -166,14 +166,15 @@ class InProcBroker:
             # handler error): redelivery cannot fix it — skip the
             # budget and dead-letter immediately (poison quarantine,
             # same contract as the durable broker's poison nack).
-            with self._work:
-                self.dead_lettered.append((q.name, envelope))
-                self.publish(envelope, q.name + DLQ_SUFFIX)
+            # publish() takes the broker lock itself and the
+            # dead-letter list append is GIL-atomic, so neither runs
+            # inside the critical section.
+            self.dead_lettered.append((q.name, envelope))
+            self.publish(envelope, q.name + DLQ_SUFFIX)
         except Exception:
             if redeliveries + 1 >= self.max_redeliveries:
-                with self._work:
-                    self.dead_lettered.append((q.name, envelope))
-                    self.publish(envelope, q.name + DLQ_SUFFIX)
+                self.dead_lettered.append((q.name, envelope))
+                self.publish(envelope, q.name + DLQ_SUFFIX)
             else:
                 with self._work:
                     q.items.append((envelope, redeliveries + 1))

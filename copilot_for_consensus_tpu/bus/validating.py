@@ -33,9 +33,6 @@ class ValidatingPublisher(EventPublisher):
     def connect(self):
         self.inner.connect()
 
-    def close(self):
-        self.inner.close()
-
     def publish_envelope(self, envelope, routing_key=None):
         try:
             validate_envelope(envelope, self.provider)

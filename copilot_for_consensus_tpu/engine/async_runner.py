@@ -424,9 +424,15 @@ class AsyncEngineRunner:
                 if sup is not None:
                     self._recover(exc)
                 else:
-                    for h in self._handles.values():
+                    # legacy fail-all containment: sweep under the
+                    # lock (shared with the watchdog-less stop path),
+                    # fail OUTSIDE it — done-callbacks may re-enter
+                    # submit()
+                    with self._work:
+                        victims = list(self._handles.values())
+                        self._handles.clear()
+                    for h in victims:
                         h._fail(exc)
-                    self._handles.clear()
                 continue
             finally:
                 if sup is not None:

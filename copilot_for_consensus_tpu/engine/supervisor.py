@@ -318,6 +318,10 @@ class EngineSupervisor:
     def begin_dispatch(self, kind: str) -> None:
         with self._frame_lock:
             self._next_frame += 1
+            # _clock is a pure time source (time.monotonic or a test
+            # stub), never user re-entrant code; reading it inside the
+            # frame lock keeps the (kind, t0, id) tuple consistent.
+            # jaxlint: disable=race-callback-under-lock
             self._frames.append((kind, self._clock(), self._next_frame))
 
     def end_dispatch(self, kind: str) -> None:
@@ -347,6 +351,8 @@ class EngineSupervisor:
                 kind, t0, fid = self._frames[-1]
                 if fid in self._tripped_frames:
                     continue
+                # pure time source, same as begin_dispatch
+                # jaxlint: disable=race-callback-under-lock
                 elapsed = self._clock() - t0
                 deadline = self.cfg.deadline_for(kind)
                 if elapsed <= deadline:

@@ -208,6 +208,10 @@ class AzureMonitorMetrics(InMemoryMetrics):
     # ``azure_monitor_metrics.py:307,328``); the latter is the
     # inherited accessor under the reference's name
     def get_errors_count(self) -> int:
+        # GIL-atomic int read; taking _flush_lock here would block the
+        # accessor behind an in-progress flush's network POST for a
+        # stale-read-tolerant parity counter.
+        # jaxlint: disable=race-unlocked-field
         return self.errors_count
 
     get_gauge_value = InMemoryMetrics.gauge_value

@@ -92,16 +92,19 @@ class InMemoryMetrics(MetricsCollector):
     # -- accessors ---------------------------------------------------------
 
     def counter_value(self, name: str, labels: dict[str, str] | None = None) -> float:
-        return self.counters.get(name, {}).get(_label_key(labels), 0.0)
+        with self._lock:
+            return self.counters.get(name, {}).get(_label_key(labels), 0.0)
 
     def gauge_value(self, name: str, labels: dict[str, str] | None = None) -> float:
-        return self.gauges.get(name, {}).get(_label_key(labels), 0.0)
+        with self._lock:
+            return self.gauges.get(name, {}).get(_label_key(labels), 0.0)
 
     def histogram_stats(self, name: str, labels: dict[str, str] | None = None):
-        entry = self.histograms.get(name, {}).get(_label_key(labels))
-        if entry is None:
-            return None
-        return {"sum": entry[0], "count": entry[1]}
+        with self._lock:
+            entry = self.histograms.get(name, {}).get(_label_key(labels))
+            if entry is None:
+                return None
+            return {"sum": entry[0], "count": entry[1]}
 
     # -- Prometheus text exposition ---------------------------------------
 

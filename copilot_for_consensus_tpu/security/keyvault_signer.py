@@ -124,6 +124,10 @@ class AzureKeyVaultSigner(JWTSigner):
         """Lazy: JWTManager reads this for the JWT header before the
         first sign, so the vault key must be fetched here too."""
         self._load_public()
+        # write-once under _load_lock; _load_public() acquires that
+        # lock first, so this read happens-after the load on every
+        # thread (guarded-lazy-init publication, not a race)
+        # jaxlint: disable=race-unlocked-field
         return self._kid
 
     # -- AAD bearer (same flow as security/secrets.py Key Vault) -------
@@ -245,6 +249,9 @@ class AzureKeyVaultSigner(JWTSigner):
         from cryptography.hazmat.primitives.asymmetric import padding
 
         try:
+            # write-once under _load_lock, published by _load_public()
+            # above (same happens-before argument as `kid`)
+            # jaxlint: disable=race-unlocked-field
             self._pub.verify(signature, signing_input,
                              padding.PKCS1v15(), hashes.SHA256())
             return True
@@ -253,4 +260,6 @@ class AzureKeyVaultSigner(JWTSigner):
 
     def public_jwk(self) -> dict[str, Any]:
         self._load_public()
+        # write-once under _load_lock, published by _load_public()
+        # jaxlint: disable=race-unlocked-field
         return dict(self._jwk)

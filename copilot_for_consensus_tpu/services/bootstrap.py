@@ -58,6 +58,12 @@ class ServiceRuntime:
         if not self._started:
             return
         self.subscriber.stop()
+        if self._consumer is not None:
+            # join the consumer so teardown never races a handler
+            # mid-dispatch (bounded: the consume loop polls its stop
+            # flag every poll interval)
+            self._consumer.join(timeout=5.0)
+            self._consumer = None
         if self.http is not None:
             self.http.stop()
         self._started = False
@@ -226,6 +232,11 @@ class PipelineServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._pump is not None:
+            # run_forever returns once _stop is set (it waits on it);
+            # join so teardown never races the pump's consume loops
+            self._pump.join(timeout=5.0)
+            self._pump = None
         self.http.stop()
 
 

@@ -228,6 +228,11 @@ class Pipeline:
             self.stop_throttling()
             for sub in self.ext_subscribers:
                 sub.stop()
+            for t in threads:
+                # consume loops poll their stop flag each interval;
+                # join so the pump's caller can tear the bus down
+                # without racing an in-flight dispatch
+                t.join(timeout=5.0)
 
     def ingest_and_run(self, source_id: str) -> dict[str, int]:
         """Trigger a source, run the pipeline to quiescence, return

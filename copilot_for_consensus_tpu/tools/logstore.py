@@ -222,7 +222,10 @@ class LogStoreServer:
 
     def stop(self) -> None:
         self._stop.set()
-        self._tcp.shutdown()
+        self._tcp.shutdown()          # unblocks serve_forever
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads.clear()
         self._tcp.server_close()
         self._http.stop()
 

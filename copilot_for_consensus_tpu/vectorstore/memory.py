@@ -40,7 +40,8 @@ class InMemoryVectorStore(InvertedIndexMixin, VectorStore):
 
     @property
     def dimension(self) -> int | None:
-        return self._dim
+        with self._lock:
+            return self._dim
 
     @staticmethod
     def _normalize(vector: Sequence[float]) -> np.ndarray:
@@ -50,7 +51,8 @@ class InMemoryVectorStore(InvertedIndexMixin, VectorStore):
 
     @property
     def _n(self) -> int:
-        return len(self._ids)
+        with self._lock:
+            return len(self._ids)
 
     def _grow_to(self, rows: int) -> None:
         cap = self._vectors.shape[0]

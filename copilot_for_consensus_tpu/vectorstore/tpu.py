@@ -64,7 +64,8 @@ class TPUVectorStore(InvertedIndexMixin, VectorStore):
 
     @property
     def dimension(self) -> int | None:
-        return self._dim
+        with self._lock:
+            return self._dim
 
     def count(self) -> int:
         with self._lock:

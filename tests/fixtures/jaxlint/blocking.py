@@ -21,6 +21,36 @@ class BadConsumer:
         time.sleep(0.01)
 
 
+class BadConditionConsumer:
+    """``_work`` has no 'lock' in its name: only assignment provenance
+    (bound from ``threading.Condition``, aliasing ``self._lock``)
+    identifies it as a lock — the async_runner dispatcher shape the
+    old name-token heuristic missed."""
+
+    def __init__(self, bus):
+        self.bus = bus
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stop = threading.Event()
+
+    def run(self):
+        with self._work:
+            self.bus.publish_envelope({})      # broker RTT under lock
+
+
+class GoodConditionConsumer:
+    def __init__(self, bus):
+        self.bus = bus
+        self._work = threading.Condition()
+        self._stop = threading.Event()
+
+    def run(self):
+        with self._work:
+            batch = list(self.bus.queue)
+        for env in batch:                      # publish OUTSIDE the lock
+            self.bus.publish_envelope(env)
+
+
 class GoodConsumer:
     def __init__(self, bus):
         self.bus = bus

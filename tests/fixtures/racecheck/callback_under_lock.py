@@ -1,0 +1,79 @@
+# racecheck fixture: race-callback-under-lock — user-supplied
+# callables must fire OUTSIDE the critical section (a done-callback
+# may re-enter submit() and deadlock; the PR-7 dispatcher class).
+import threading
+
+
+class BadNotifier:
+    def __init__(self, on_done):
+        self._lock = threading.Lock()
+        self._on_done = on_done          # constructor-supplied callable
+        self._pending = []
+
+    def submit(self, item):
+        with self._lock:
+            self._pending.append(item)
+
+    def complete(self, result):
+        with self._lock:
+            self._pending.pop()
+            self._on_done(result)        # fires INSIDE the lock
+
+
+class BadIndirect:
+    """The invocation is one call away: ``_finish`` fires the
+    registered callbacks, and ``complete`` calls it under the lock —
+    call-graph propagation must still flag the call site."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._callbacks = []
+        self._done = False
+
+    def add_done_callback(self, fn):
+        with self._lock:
+            self._callbacks.append(fn)
+
+    def _finish(self, result):
+        for fn in self._callbacks:
+            fn(result)
+
+    def complete(self, result):
+        with self._lock:
+            self._done = True
+            self._finish(result)         # fires callbacks under lock
+
+
+class BadSubscriptDispatch:
+    """The handler is invoked straight out of its container —
+    ``self._handlers[key](env)`` — while the registry lock is held."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handlers = {}
+
+    def register(self, key, fn):
+        with self._lock:
+            self._handlers[key] = fn
+
+    def dispatch(self, key, env):
+        with self._lock:
+            self._handlers[key](env)     # element call under the lock
+
+
+class GoodNotifier:
+    """Mutate ledgers under the lock, fire the callback after."""
+
+    def __init__(self, on_done):
+        self._lock = threading.Lock()
+        self._on_done = on_done
+        self._pending = []
+
+    def submit(self, item):
+        with self._lock:
+            self._pending.append(item)
+
+    def complete(self, result):
+        with self._lock:
+            self._pending.pop()
+        self._on_done(result)            # outside the critical section

@@ -1,0 +1,91 @@
+# racecheck fixture: race-lock-order — the "held while acquiring"
+# relation must stay acyclic (lockdep's invariant).
+import threading
+
+
+class BadOrder:
+    """``admit`` holds _alpha while taking _beta; ``drain`` holds _beta
+    while taking _alpha — the classic ABBA deadlock pair."""
+
+    def __init__(self):
+        self._alpha = threading.Lock()
+        self._beta = threading.Lock()
+        self._items = []
+
+    def admit(self, item):
+        with self._alpha:
+            with self._beta:
+                self._items.append(item)
+
+    def drain(self):
+        with self._beta:
+            with self._alpha:
+                return list(self._items)
+
+
+class BadSelfDeadlock:
+    """A non-reentrant lock re-acquired through an internal call while
+    already held — guaranteed, not just potential."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def bump_twice(self):
+        with self._lock:
+            self.bump()
+
+
+class BadAliasBeforeSource:
+    """The Condition is declared BEFORE the lock it wraps: provenance
+    must still see one identity (deferred alias binding), so holding
+    the condition while taking the 'other' lock is a self-deadlock."""
+
+    def __init__(self):
+        self._work = threading.Condition(self._lock)
+        self._lock = threading.Lock()
+        self._jobs = []
+
+    def drain(self):
+        with self._work:
+            with self._lock:
+                return list(self._jobs)
+
+
+class GoodOrder:
+    """Same two locks, ONE documented order everywhere: no cycle."""
+
+    def __init__(self):
+        self._alpha = threading.Lock()
+        self._beta = threading.Lock()
+        self._items = []
+
+    def admit(self, item):
+        with self._alpha:
+            with self._beta:
+                self._items.append(item)
+
+    def drain(self):
+        with self._alpha:
+            with self._beta:
+                return list(self._items)
+
+
+class GoodReentrant:
+    """An RLock may be re-acquired on the same thread by design."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def bump_twice(self):
+        with self._lock:
+            self.bump()

@@ -1,0 +1,90 @@
+# racecheck fixture: race-thread-lifecycle — every Thread needs a
+# reachable stop path (a stop-Event-polling target, or a join in its
+# owner); daemon-and-forget loops race teardown.
+import threading
+import time
+
+
+class BadPump:
+    """Daemon-and-forget: the loop never polls a stop Event and the
+    thread is never joined."""
+
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            time.sleep(0.1)  # jaxlint: disable=blocking-call
+
+
+class BadSecondThread:
+    """Joining thread ``_a`` must not excuse forgetting thread ``_b``
+    — only a provenance-free join (a list loop) may excuse anything."""
+
+    def __init__(self):
+        self._a = threading.Thread(target=self._drain)
+        self._b = threading.Thread(target=self._pump, daemon=True)
+        self._a.start()
+        self._b.start()
+
+    def _drain(self):
+        return None
+
+    def _pump(self):
+        while True:
+            time.sleep(0.1)  # jaxlint: disable=blocking-call
+
+    def stop(self):
+        self._a.join(timeout=5.0)      # _b is never joined or stopped
+
+
+class GoodPump:
+    """Stop-aware loop plus a bounded join in ``stop()``."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.1)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+_PUMP = None
+
+
+def good_module_start():
+    """A module-level thread joined by a SIBLING module function: the
+    owning scope is the module, not just the creating function."""
+    global _PUMP
+    _PUMP = threading.Thread(target=_module_loop, daemon=True)
+    _PUMP.start()
+
+
+def _module_loop():
+    while True:
+        time.sleep(0.1)  # jaxlint: disable=blocking-call
+
+
+def good_module_stop():
+    _PUMP.join(timeout=5.0)
+
+
+class GoodJoinOnly:
+    """No stop Event, but the owner joins the (bounded) worker — the
+    scatter/gather fan-out idiom."""
+
+    def run(self, jobs):
+        threads = []
+        for job in jobs:
+            t = threading.Thread(target=job)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()

@@ -1,0 +1,138 @@
+# racecheck fixture: race-unlocked-field — RacerD-style lock
+# consistency: a field written under its lock in one method must not
+# be accessed bare in another.
+import threading
+
+
+class BadLedger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def record(self, n):
+        with self._lock:
+            self._total += n
+
+    def snapshot(self):
+        return self._total               # bare read of a guarded field
+
+
+class BadContainer:
+    """Element mutations of a plain shared dict are writes OF the
+    field: the bare ``_stats[key] += 1`` races the locked reader."""
+
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._stats = {"confirmed": 0}
+
+    def bump(self, key):
+        self._stats[key] += 1            # bare element write
+
+    def counts(self):
+        with self._stats_lock:
+            return dict(self._stats)
+
+
+class GoodLedger:
+    """Every cross-thread access holds the guard."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def record(self, n):
+        with self._lock:
+            self._total += n
+
+    def snapshot(self):
+        with self._lock:
+            return self._total
+
+
+class BadTwoGuards:
+    """Writes under one lock, reads under ANOTHER: holding different
+    locks does not synchronize — the lockset intersection is empty."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._count = 0
+
+    def record(self, n):
+        with self._a:
+            self._count += n
+
+    def snapshot(self):
+        with self._b:
+            return self._count
+
+
+class _CrossHandle:
+    """``_mark_done`` is called under the lock from its own class —
+    but also LOCK-FREE from another class below, so the 'caller holds
+    the lock' inference must not apply and the bare write must flag."""
+
+    def __init__(self):
+        self._lk = threading.Lock()
+        self._state = 0
+
+    def finish(self):
+        with self._lk:
+            self._mark_done()
+
+    def _mark_done(self):
+        self._state = 1
+
+    def snapshot(self):
+        with self._lk:
+            return self._state
+
+
+class BadCrossClassCaller:
+    def drop(self, handle):
+        handle._mark_done()          # no lock held at this call site
+
+
+class GoodInjectedLock:
+    """A lock field that is ALSO assignable from a parameter (test
+    injection): it must stay a lock, never become a 'callback', and
+    the scan must not crash on the dual provenance."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def use_lock(self, lock):
+        self._lock = lock
+
+    def record(self, n):
+        with self._lock:
+            self._total += n
+
+    def snapshot(self):
+        with self._lock:
+            return self._total
+
+
+class GoodPrivateHelper:
+    """``_bump_locked`` is only ever called with the lock held — the
+    inferred '# caller holds the lock' idiom must NOT flag it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def record(self, n):
+        with self._lock:
+            self._bump_locked(n)
+
+    def also_record(self, n):
+        with self._lock:
+            self._bump_locked(n)
+
+    def _bump_locked(self, n):
+        self._total += n
+
+    def snapshot(self):
+        with self._lock:
+            return self._total

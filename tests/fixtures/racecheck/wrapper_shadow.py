@@ -1,0 +1,63 @@
+# racecheck fixture: race-wrapper-shadow — __getattr__ only fires for
+# MISSING attributes, so a concrete trivial base-class default
+# silently defeats delegation (the shipped ValidatingPublisher.
+# saturation() bug, as a lint rule). Same-module base resolution here;
+# the cross-module pass covers the real bus/ wrapper against its ABC.
+
+
+class DriverBase:
+    """Concrete do-nothing defaults that exist to be overridden."""
+
+    def connect(self):
+        pass
+
+    def saturation(self):
+        return {}
+
+    def publish(self, envelope):
+        raise NotImplementedError
+
+
+class BadWrapper(DriverBase):
+    """Relies on __getattr__ for everything it doesn't define: the
+    base's concrete ``connect``/``saturation`` defaults shadow the
+    delegation, so the wrapped driver's implementations never run."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def publish(self, envelope):
+        return self.inner.publish(envelope)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class GoodWrapper(DriverBase):
+    """Explicit forwarders for every concrete base default;
+    __getattr__ only covers names the base does NOT define."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def connect(self):
+        return self.inner.connect()
+
+    def saturation(self):
+        return self.inner.saturation()
+
+    def publish(self, envelope):
+        return self.inner.publish(envelope)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class GoodPlainWrapper:
+    """No concrete-default base at all: delegation is sound."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

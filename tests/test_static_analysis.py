@@ -252,6 +252,328 @@ def test_repo_baseline_entries_all_justified():
         "committed baseline entries must not carry the TODO placeholder")
 
 
+# ---------------------------------------------------------------------------
+# racecheck (the `race` group): each rule proven against its fixture —
+# one true positive AND one clean negative — plus tripwires that
+# re-introduce the REAL shipped bugs (PR-7 callback-under-lock, PR-8
+# wrapper-shadow, broker stats lock-consistency) and assert the lane
+# turns red.
+# ---------------------------------------------------------------------------
+
+RACE_FIXTURES = ROOT / "tests" / "fixtures" / "racecheck"
+
+from copilot_for_consensus_tpu.analysis import racecheck  # noqa: E402
+
+
+def _race_findings(fixture: str, rule: str):
+    out = analyze_files([RACE_FIXTURES / fixture])
+    return [f for f in out if f.rule == rule]
+
+
+@pytest.mark.parametrize("fixture,rule,bad_marker,good_marker", [
+    ("lock_order.py", "race-lock-order", "BadOrder", "GoodOrder"),
+    ("callback_under_lock.py", "race-callback-under-lock",
+     "BadNotifier", "GoodNotifier"),
+    ("unlocked_field.py", "race-unlocked-field", "BadLedger",
+     "GoodLedger"),
+    ("thread_lifecycle.py", "race-thread-lifecycle", "BadPump",
+     "GoodPump"),
+    ("wrapper_shadow.py", "race-wrapper-shadow", "BadWrapper",
+     "GoodWrapper"),
+])
+def test_race_rule_true_positive_and_clean_negative(fixture, rule,
+                                                    bad_marker,
+                                                    good_marker):
+    found = _race_findings(fixture, rule)
+    assert any(bad_marker in f.context or bad_marker in f.message
+               for f in found), (rule, found)
+    assert not any(good_marker in f.context or good_marker in f.message
+                   for f in found), (rule, found)
+
+
+def test_lock_order_cycle_names_both_locks():
+    """The ABBA report must name both locks so the reader can pick an
+    order; the single-lock self-deadlock is reported as guaranteed."""
+    found = _race_findings("lock_order.py", "race-lock-order")
+    cycle = [f for f in found if "cycle" in f.message]
+    assert cycle and "_alpha" in cycle[0].message \
+        and "_beta" in cycle[0].message, found
+    assert any("self-deadlock" in f.message
+               and "BadSelfDeadlock" in f.context for f in found), found
+    assert not any("GoodReentrant" in f.context for f in found), found
+
+
+def test_callback_under_lock_propagates_through_call_graph():
+    """``complete()`` never touches a callback directly — it calls
+    ``_finish()``, which does. The call site must still flag."""
+    found = _race_findings("callback_under_lock.py",
+                           "race-callback-under-lock")
+    assert any(f.context == "BadIndirect.complete"
+               and "_finish" in f.message for f in found), found
+
+
+def test_unlocked_field_requires_a_common_lock():
+    """Accesses under two DIFFERENT locks race just like a bare one:
+    the lockset intersection must be non-empty (RacerD's invariant)."""
+    found = _race_findings("unlocked_field.py", "race-unlocked-field")
+    assert any("BadTwoGuards" in f.context
+               and "NO common lock" in f.message for f in found), found
+
+
+def test_callback_under_lock_catches_subscript_invocation():
+    """``self._handlers[key](env)`` under the lock — the element call
+    form must flag just like the bound-local form."""
+    found = _race_findings("callback_under_lock.py",
+                           "race-callback-under-lock")
+    assert any(f.context == "BadSubscriptDispatch.dispatch"
+               for f in found), found
+
+
+def test_wrapper_shadow_cross_pass_resolves_relative_imports(tmp_path):
+    """``from .base import Base`` must resolve against the importing
+    module's own directory — never some other base.py in the tree."""
+    pkg = tmp_path / "pkg"
+    decoy = tmp_path / "other"
+    pkg.mkdir()
+    decoy.mkdir()
+    # decoy base.py with NO trivial defaults: wrong resolution = miss
+    (decoy / "base.py").write_text(
+        "class Base:\n    def saturation(self):\n"
+        "        raise NotImplementedError\n")
+    (pkg / "base.py").write_text(
+        "class Base:\n    def saturation(self):\n        return {}\n")
+    (pkg / "wrap.py").write_text(
+        "from .base import Base\n\n\n"
+        "class Wrapper(Base):\n"
+        "    def __init__(self, inner):\n"
+        "        self.inner = inner\n\n"
+        "    def __getattr__(self, name):\n"
+        "        return getattr(self.inner, name)\n")
+    # and an `as`-aliased import: lookup in the defining module must
+    # use the ORIGINAL name, not the local alias
+    (pkg / "wrap2.py").write_text(
+        "from .base import Base as RenamedBase\n\n\n"
+        "class AliasWrapper(RenamedBase):\n"
+        "    def __init__(self, inner):\n"
+        "        self.inner = inner\n\n"
+        "    def __getattr__(self, name):\n"
+        "        return getattr(self.inner, name)\n")
+    found = [f for f in racecheck.check_cross(
+                 [decoy / "base.py", pkg / "base.py", pkg / "wrap.py",
+                  pkg / "wrap2.py"])
+             if f.rule == "race-wrapper-shadow"]
+    assert any("'saturation'" in f.message and f.context == "Wrapper"
+               for f in found), found
+    assert any("'saturation'" in f.message
+               and f.context == "AliasWrapper" for f in found), found
+
+
+def test_cli_contradictory_rules_group_fails_loudly():
+    """--rules blocking-call --group race selects nothing: that must
+    be a usage error (rc 2), not a 0-file CLEAN run."""
+    with pytest.raises(SystemExit) as exc:
+        jaxlint_main(["--rules", "blocking-call", "--group", "race"])
+    assert exc.value.code == 2
+
+
+def test_unlocked_field_counts_container_element_writes():
+    """``self._stats[key] += 1`` is a write OF ``_stats`` (the broker
+    ledger shape) — bare element mutation must flag."""
+    found = _race_findings("unlocked_field.py", "race-unlocked-field")
+    assert any(f.context == "BadContainer.bump"
+               and "'_stats'" in f.message for f in found), found
+    # the verified "# caller holds the lock" idiom must NOT flag
+    assert not any("GoodPrivateHelper" in f.context for f in found), found
+
+
+def test_inferred_held_defeated_by_cross_class_call_site():
+    """'caller holds the lock' inference must count EVERY resolvable
+    call site: a lock-free cross-class call into ``_mark_done`` makes
+    its bare write a real race, not an inherited-lock access."""
+    found = _race_findings("unlocked_field.py", "race-unlocked-field")
+    assert any(f.context == "_CrossHandle._mark_done"
+               and "'_state'" in f.message for f in found), found
+
+
+def test_module_level_thread_joined_in_sibling_function_is_clean():
+    found = _race_findings("thread_lifecycle.py",
+                           "race-thread-lifecycle")
+    assert not any("_module_loop" in f.message
+                   or "good_module" in f.context for f in found), found
+
+
+def test_thread_lifecycle_join_only_owner_is_clean():
+    found = _race_findings("thread_lifecycle.py",
+                           "race-thread-lifecycle")
+    assert not any("GoodJoinOnly" in f.context for f in found), found
+
+
+def test_thread_lifecycle_tracked_join_excuses_nothing_else():
+    """Joining thread _a must not excuse the forgotten _b; only a
+    provenance-free join (the list-loop idiom) excuses untracked
+    threads."""
+    found = _race_findings("thread_lifecycle.py",
+                           "race-thread-lifecycle")
+    assert any(f.context == "BadSecondThread.__init__"
+               and "_pump" in f.message for f in found), found
+
+
+def test_lock_model_alias_declared_before_source():
+    """``Condition(self._lock)`` textually before ``self._lock =
+    threading.Lock()`` still aliases to ONE identity — holding the
+    condition while taking the lock is a guaranteed self-deadlock."""
+    found = _race_findings("lock_order.py", "race-lock-order")
+    assert any("BadAliasBeforeSource" in f.context
+               and "self-deadlock" in f.message for f in found), found
+
+
+def test_lock_field_reassignable_from_parameter_stays_a_lock():
+    """A lock field also assignable from a parameter (test injection)
+    must neither crash the scan nor be misread as a callback field."""
+    found = analyze_files([RACE_FIXTURES / "unlocked_field.py"])
+    assert not any("GoodInjectedLock" in f.context
+                   for f in found if f.rule.startswith("race-")), found
+
+
+def test_blocking_call_sees_condition_members():
+    """Satellite: the shared assignment-provenance lock model makes
+    blocking-call recognize Condition-typed members whose names never
+    say 'lock' (``self._work``, the async_runner dispatcher shape)."""
+    found = _findings("blocking.py", "blocking-call")
+    assert any(f.context == "BadConditionConsumer.run"
+               for f in found), found
+    assert not any("GoodConditionConsumer" in f.context
+                   for f in found), found
+
+
+# -- tripwires on the REAL runtime files: re-introduce each shipped bug
+
+_RUNNER = ROOT / "copilot_for_consensus_tpu" / "engine" / "async_runner.py"
+_VALIDATING = ROOT / "copilot_for_consensus_tpu" / "bus" / "validating.py"
+_BUS_BASE = ROOT / "copilot_for_consensus_tpu" / "bus" / "base.py"
+_BROKER = ROOT / "copilot_for_consensus_tpu" / "bus" / "broker.py"
+
+
+def test_done_callback_under_runner_lock_fails_the_lane(tmp_path):
+    """PR-7 regression: resolving a Handle inside the dispatcher's
+    ``_work`` lock (shared with the watchdog; done-callbacks may
+    re-enter submit()) must flag race-callback-under-lock."""
+    src = _RUNNER.read_text()
+    needle = ("                with self._work:\n"
+              "                    h = self._handles.pop(c.request_id, None)\n"
+              "                    meta = self._replays.pop(c.request_id, None)\n")
+    assert needle in src, "dispatcher harvest block moved; update the test"
+    mutated = tmp_path / "async_runner_mutated.py"
+    mutated.write_text(src.replace(
+        needle,
+        needle + "                    if h is not None:\n"
+                 "                        h._resolve(c)\n", 1))
+    found = [f for f in analyze_files([mutated])
+             if f.rule == "race-callback-under-lock"]
+    assert any("_resolve" in f.message for f in found), found
+    # the unmutated file is part of the clean e2e run (no findings)
+
+
+def test_wrapper_shadow_catches_inert_saturation(tmp_path):
+    """PR-8 regression: drop ValidatingPublisher's explicit
+    ``saturation()`` delegation and the cross-module pass must flag the
+    base class's concrete ``{}`` default shadowing ``__getattr__`` —
+    the bug that silently disabled the throttle/pacer in the assembled
+    pipeline."""
+    src = _VALIDATING.read_text()
+    start = src.index("    def saturation(self)")
+    end = src.index("    def pending_depths(self)")
+    assert 0 < start < end, "ValidatingPublisher moved; update the test"
+    pkg = tmp_path / "copilot_for_consensus_tpu" / "bus"
+    pkg.mkdir(parents=True)
+    (pkg / "base.py").write_text(_BUS_BASE.read_text())
+    (pkg / "validating.py").write_text(src[:start] + src[end:])
+    found = [f for f in racecheck.check_cross(
+                 [pkg / "base.py", pkg / "validating.py"])
+             if f.rule == "race-wrapper-shadow"]
+    assert any("'saturation'" in f.message
+               and f.context == "ValidatingPublisher"
+               for f in found), found
+    # the unmutated pair is clean (the explicit delegation overrides)
+    clean = [f for f in racecheck.check_cross([_BUS_BASE, _VALIDATING])
+             if f.rule == "race-wrapper-shadow"]
+    assert clean == [], clean
+
+
+def test_unlocked_broker_stats_fails_the_lane(tmp_path):
+    """Dropping ``_stats_lock`` from the publisher's stats mutation
+    must flag race-unlocked-field (the ledger is read under the lock
+    elsewhere)."""
+    src = _BROKER.read_text()
+    needle = ("        with self._stats_lock:\n"
+              "            self._stats[key] += n\n")
+    assert needle in src, "_bump moved; update the test"
+    mutated = tmp_path / "broker_mutated.py"
+    mutated.write_text(src.replace(
+        needle, "        self._stats[key] += n\n", 1))
+    found = [f for f in analyze_files([mutated])
+             if f.rule == "race-unlocked-field"]
+    assert any("'_stats'" in f.message and "_bump" in f.context
+               for f in found), found
+
+
+# -- baseline round trip + CLI group filter for the race family
+
+
+def test_race_baseline_round_trip(tmp_path, capsys):
+    """racecheck findings ride the existing baseline machinery: a
+    justified entry silences the finding; a TODO placeholder warns on a
+    normal run and fails under --strict (the PR-4 rejection rule)."""
+    fixture = RACE_FIXTURES / "unlocked_field.py"
+    found = [f for f in analyze_files([fixture])
+             if f.rule == "race-unlocked-field"]
+    assert found
+    entries = [{"rule": f.rule, "path": f.path, "context": f.context,
+                "message": f.message,
+                "justification": "fixture: deliberate bare access kept "
+                                 "to prove the baseline round trip"}
+               for f in found]
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(entries))
+    args = ["--group", "race", "--baseline", str(bl), str(fixture)]
+    assert jaxlint_main(args) == 0, capsys.readouterr().out
+    for e in entries:
+        e["justification"] = "TODO: explain why this is deliberate"
+    bl.write_text(json.dumps(entries))
+    assert jaxlint_main(args) == 0          # non-strict: warn only
+    assert "baseline-unjustified" in capsys.readouterr().err
+    rc = jaxlint_main(["--strict"] + args)
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "baseline-unjustified" in out.out
+
+
+def test_cli_group_filter(capsys):
+    """--group runs one rule family: the race fixture fails under
+    --group race and passes under --group jax (whose rules don't fire
+    on it) — the dev-loop filter the CI matrix uses."""
+    fixture = str(RACE_FIXTURES / "callback_under_lock.py")
+    rc = jaxlint_main(["--group", "race", "--no-baseline", fixture])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "race-callback-under-lock" in out.out
+    rc = jaxlint_main(["--group", "jax", "--no-baseline", fixture])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_repo_race_group_clean_with_cross_pass():
+    """The full-repo race run (including the cross-module
+    wrapper-shadow pass that --fast skips) is clean — the acceptance
+    bar for dogfooding the analyzer over its own thread plane."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "copilot_for_consensus_tpu.analysis",
+         "--group", "race", "--strict"], cwd=ROOT,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[race]" in proc.stderr, proc.stderr
+
+
 def test_repo_is_clean_end_to_end():
     """The whole tree passes every jaxlint group (modulo the committed,
     justified baseline). --fast skips import smoke, which the suite

@@ -315,6 +315,14 @@ def pipeline_chaos_columns(audit: dict) -> dict:
         "max_depth_backpressure_off": int(
             audit.get("max_depth_backpressure_off", 0)),
         "final_depth_max": int(audit.get("final_depth_max", 0)),
+        # distributed-tracing columns (obs/trace.py + tools/tracepath):
+        # per-stage p95 service time and queue wait from the overload
+        # arm's stage spans, the named bottleneck stage, and the storm
+        # arm's orphan-span audit (zero is the gate)
+        "stage_p95_s": dict(audit.get("stage_p95_s", {})),
+        "queue_wait_p95_s": dict(audit.get("queue_wait_p95_s", {})),
+        "bottleneck_stage": str(audit.get("bottleneck_stage", "")),
+        "orphan_spans": int(audit.get("orphan_spans", 0)),
     }
 
 
@@ -1106,6 +1114,14 @@ def pipeline_chaos_headline() -> dict:
         if faults:
             cfg["faults"] = {"plan": faults}
         p = build_pipeline(cfg)
+        # Pipeline tracing (obs/trace.py): size the global ring to the
+        # arm's span volume (≈ a few tens of spans per message across
+        # publish/stage/store-write spans) and clear the previous arm's
+        # spans, so the per-arm orphan audit never chases evictions.
+        from copilot_for_consensus_tpu.obs import trace as trace_mod
+
+        trace_collector = trace_mod.configure(
+            capacity=min(200_000, messages * 60 + 20_000))
 
         if drag:
             orig = p.chunking.on_JSONParsed
@@ -1295,6 +1311,13 @@ def pipeline_chaos_headline() -> dict:
         lost = (missing + max(0, messages - stored)
                 + max(0, expected_threads - threads_n))
 
+        # Per-stage latency attribution + orphan audit over the arm's
+        # pipeline trace (tools/tracepath.py): names the bottleneck
+        # stage and proves the span DAG stayed connected under faults.
+        from copilot_for_consensus_tpu.tools import tracepath
+
+        trace_report = tracepath.analyze(trace_collector.spans())
+
         p.stop_throttling()
         for sub in p.ext_subscribers:
             sub.stop()
@@ -1330,6 +1353,7 @@ def pipeline_chaos_headline() -> dict:
             "faults_fired": len(fired),
             "threads": threads_n,
             "threads_missing_summary": missing,
+            "trace": trace_report,
         }
 
     tmp_root = pathlib.Path(tempfile.mkdtemp(prefix="pipe-chaos-"))
@@ -1368,11 +1392,15 @@ def pipeline_chaos_headline() -> dict:
 
     backpressure_ok = (on["worst_depth"] < scaled_slo
                        and off["worst_depth"] >= 2 * scaled_slo)
+    # zero orphan spans under faults: redelivery, outbox replay and the
+    # broker restart must yield annotated retries, never disconnected
+    # trace fragments (obs/trace.py orphan audit over the storm arm)
     storm_ok = (storm["lost"] == 0 and storm["duplicated"] == 0
                 and storm["quarantined"] == n_poison
                 and storm["replayed_publishes"] >= 1
                 and storm["redelivered"] >= 1
-                and storm["final_depth_max"] < scaled_slo)
+                and storm["final_depth_max"] < scaled_slo
+                and storm["trace"]["orphan_spans"] == 0)
     pipeline_chaos_ok = bool(backpressure_ok and storm_ok)
     msg_s = storm["messages"] / max(storm["run_s"], 1e-6)
     audit = {
@@ -1381,12 +1409,22 @@ def pipeline_chaos_headline() -> dict:
             "redelivered", "recovered_by_sweep", "final_depth_max")},
         "max_depth_backpressure_on": on["worst_depth"],
         "max_depth_backpressure_off": off["worst_depth"],
+        # stage attribution from the sustained-overload arm (the
+        # SCALE_BROKER failure shape): with chunking dragged below
+        # supply, tracepath must name it — the measurement ROADMAP
+        # item 5's parallelization work is judged against
+        "stage_p95_s": on["trace"]["stage_p95_s"],
+        "queue_wait_p95_s": on["trace"]["queue_wait_p95_s"],
+        "bottleneck_stage": on["trace"]["bottleneck_stage"],
+        "orphan_spans": storm["trace"]["orphan_spans"],
     }
     log(f"pipeline_chaos: lost {storm['lost']}, dup "
         f"{storm['duplicated']}, quarantined {storm['quarantined']}, "
         f"replayed {storm['replayed_publishes']}, redelivered "
         f"{storm['redelivered']}, depth on/off {on['worst_depth']}/"
-        f"{off['worst_depth']}, ok {pipeline_chaos_ok}")
+        f"{off['worst_depth']}, bottleneck "
+        f"{on['trace']['bottleneck_stage'] or '<none>'}, orphan spans "
+        f"{storm['trace']['orphan_spans']}, ok {pipeline_chaos_ok}")
     return {
         "metric": f"host pipeline under seeded storm (broker restart "
                   f"+ store faults + consumer crash + poison + "

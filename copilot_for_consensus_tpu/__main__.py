@@ -164,6 +164,9 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("exporters", help="store/vector stats exporter",
                    add_help=False)
 
+    sub.add_parser("tracepath", help="pipeline trace critical-path "
+                   "analyzer (bottleneck stage)", add_help=False)
+
     for name, hlp in (("export-data", "dump all collections to JSONL"),
                       ("import-data", "load a JSONL dump")):
         mig = sub.add_parser(name, help=hlp)
@@ -198,6 +201,12 @@ def main(argv: list[str] | None = None) -> int:
         from copilot_for_consensus_tpu.tools.exporters import main as ex_main
 
         return ex_main(argv[1:])
+    if argv and argv[0] == "tracepath":
+        from copilot_for_consensus_tpu.tools.tracepath import (
+            main as tp_main,
+        )
+
+        return tp_main(argv[1:])
 
     args = ap.parse_args(argv)
     if args.cmd == "serve":

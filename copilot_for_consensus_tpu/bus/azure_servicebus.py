@@ -269,6 +269,11 @@ class AzureServiceBusPublisher(EventPublisher):
 
             cls = EVENT_TYPES.get(envelope.get("event_type", ""))
             routing_key = cls.routing_key if cls else "unrouted"
+        from copilot_for_consensus_tpu.obs import trace
+
+        # trace-context stamp, same contract as the broker/inproc
+        # drivers: first publish injects, re-publish preserves
+        envelope = trace.inject(envelope, routing_key)
         body = json.dumps(dict(envelope)).encode()
         # Label (subject) + custom property both carry the routing key:
         # rules filter on the property; operators read the subject.
@@ -468,6 +473,14 @@ class AzureServiceBusSubscriber(EventSubscriber):
 
             threading.Thread(target=renewer, daemon=True,
                              name="sb-lock-renewer").start()
+        from copilot_for_consensus_tpu.obs import trace
+
+        try:
+            # DeliveryCount starts at 1; attempt counts REdeliveries
+            delivery = int(msg["props"].get("DeliveryCount", 1) or 1)
+        except (TypeError, ValueError):
+            delivery = 1
+        trace.annotate_delivery(envelope, max(0, delivery - 1))
         try:
             cb(envelope)
         except PoisonEnvelope as exc:

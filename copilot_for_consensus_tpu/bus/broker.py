@@ -616,7 +616,13 @@ class BrokerPublisher(EventPublisher):
 
             cls = EVENT_TYPES.get(envelope.get("event_type", ""))
             routing_key = cls.routing_key if cls else "unrouted"
-        env = dict(envelope)
+        from copilot_for_consensus_tpu.obs import trace
+
+        # Trace-context propagation (obs/trace.py): stamp once at first
+        # publish; an envelope that already carries a trace_id (outbox
+        # replay, DLQ/startup requeue) keeps it, so at-least-once
+        # delivery never orphans a trace.
+        env = dict(trace.inject(envelope, routing_key))
         outage: BaseException | None = None
         if self.faults is not None:
             try:
@@ -883,11 +889,19 @@ class BrokerSubscriber(EventSubscriber):
         transient = isinstance(exc, (RetryableError, PublishError)) \
             and not isinstance(exc, PoisonEnvelope)
         kind = "transient" if transient else "poison"
+        # correlation_id + trace_id ride the failure log line (and the
+        # dead-letter row keeps the whole envelope), so an operator can
+        # pull the trace for a quarantined envelope straight from the
+        # copilot_bus_dispatch_failures_total diagnosis.
+        data = envelope.get("data") or {}
+        tctx = envelope.get("trace") or {}
         self.logger.error(
             "bus dispatch failed",
             routing_key=msg["rk"], group=self.group, kind=kind,
             event_id=envelope.get("event_id", ""),
             event_type=envelope.get("event_type", ""),
+            correlation_id=data.get("correlation_id", ""),
+            trace_id=tctx.get("trace_id", ""),
             attempts=msg.get("attempts", 0),
             error=str(exc), error_type=type(exc).__name__)
         self.metrics.increment("bus_dispatch_failures_total",
@@ -902,9 +916,15 @@ class BrokerSubscriber(EventSubscriber):
                 "reason": reason[:500]}
 
     def _dispatch(self, msg: dict) -> None:
+        from copilot_for_consensus_tpu.obs import trace
+
         cb = self._routes.get(msg["rk"])
         verdict = {"op": "ack", "ids": [msg["id"]]}
         if cb is not None:
+            # broker-side redelivery count → trace attempt annotation,
+            # so a retried delivery's stage span says so
+            trace.annotate_delivery(msg["envelope"],
+                                    int(msg.get("attempts", 0)))
             try:
                 cb(msg["envelope"])
             except Exception as exc:

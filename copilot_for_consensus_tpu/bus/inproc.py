@@ -159,6 +159,12 @@ class InProcBroker:
         if ready is None:
             return False
         q, envelope, redeliveries, cb = ready
+        if redeliveries:
+            from copilot_for_consensus_tpu.obs import trace
+
+            # requeued delivery: annotate the attempt so the stage
+            # span records the retry (same parent, never an orphan)
+            trace.annotate_delivery(envelope, redeliveries)
         try:
             cb(envelope)  # normal return = ack
         except PoisonEnvelope:
@@ -233,7 +239,11 @@ class InProcPublisher(EventPublisher):
 
             cls = EVENT_TYPES.get(envelope.get("event_type", ""))
             routing_key = cls.routing_key if cls else "unrouted"
-        self.broker.publish(envelope, routing_key)
+        from copilot_for_consensus_tpu.obs import trace
+
+        # trace-context stamp (first publish only — requeues keep it)
+        self.broker.publish(trace.inject(envelope, routing_key),
+                            routing_key)
 
     def saturation(self) -> dict[str, int]:
         if not self.high_watermark:

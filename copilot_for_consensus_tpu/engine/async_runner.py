@@ -68,6 +68,9 @@ class Handle:
 
     request_id: int = -1
     correlation_id: str = ""
+    #: (trace_id, span_id) of the submitting stage span, captured at
+    #: submit() so the replay path can annotate the pipeline trace
+    trace_parent: tuple | None = None
     created_at: float = field(default_factory=time.monotonic)
     _event: threading.Event = field(default_factory=threading.Event)
     _completion: Completion | None = None
@@ -294,7 +297,10 @@ class AsyncEngineRunner:
                 tenant=tenant, priority=priority or "interactive",
                 prompt_tokens=len(prompt),
                 correlation_id=correlation_id)
-        h = Handle(correlation_id=correlation_id)
+        from copilot_for_consensus_tpu.obs import trace as _trace
+
+        h = Handle(correlation_id=correlation_id,
+                   trace_parent=_trace.current_ids())
         kw: dict = {}
         if cache_eligible_tokens is not None:
             kw["cache_eligible_tokens"] = cache_eligible_tokens
@@ -616,6 +622,20 @@ class AsyncEngineRunner:
                     max_new_tokens=meta.max_new_tokens,
                     tokens=tokens, attempts=attempts)
             self.replayed += 1
+            if h.trace_parent is not None:
+                # annotate the pipeline trace: the replay is a child of
+                # the stage span that submitted the request, numbered
+                # by attempt — at-least-once recovery shows up as an
+                # annotated retry, never an orphan trace fragment
+                from copilot_for_consensus_tpu.obs import trace
+
+                with trace.span("engine_replay", kind="engine_replay",
+                                service="engine",
+                                correlation_id=req.correlation_id,
+                                attempt=attempts,
+                                parent=h.trace_parent,
+                                request_id=new_rid):
+                    pass
             if tele is not None:
                 tele.on_replay()
         if sup.unhealthy:

@@ -22,6 +22,7 @@ from copilot_for_consensus_tpu.core.retry import (
     RetryExhaustedError,
     RetryPolicy,
 )
+from copilot_for_consensus_tpu.obs import trace
 from copilot_for_consensus_tpu.obs.errors import ErrorReporter
 from copilot_for_consensus_tpu.obs.logging import Logger, get_logger
 from copilot_for_consensus_tpu.obs.metrics import (
@@ -101,46 +102,87 @@ class BaseService:
             return
         self._bus_throttle()
         t0 = time.monotonic()
+        # One stage span per dispatch (obs/trace.py): parented on the
+        # envelope's publish span, queue wait from the publish stamp,
+        # redelivery attempt from the subscriber's annotation. Publishes
+        # the handler makes (follow-up events, failure events) parent
+        # under it via the thread-ambient context, keeping the trace DAG
+        # connected end-to-end. The failure auto-dump runs AFTER the
+        # span context exits (outer finally): the span only records on
+        # exit, and a dump taken mid-span would omit the error span
+        # itself and present its already-recorded failure-event publish
+        # as an orphan.
         try:
-            self.retry.run(lambda: handler(Event.from_envelope(envelope)),
-                           event_type=etype)
-            self.metrics.increment(f"{self.name}_events_total",
-                                   labels={"event": etype, "ok": "true"})
-        except RetryExhaustedError as exc:
-            # Transient, already retried with backoff in-process: the
-            # failure event is the record; redelivering would repeat
-            # the whole retry budget for the same outcome.
-            self.metrics.increment(f"{self.name}_events_total",
-                                   labels={"event": etype, "ok": "false"})
-            self.logger.error("retries exhausted", event=etype,
-                              error=str(exc.last_error))
-            if self.error_reporter is not None:
-                self.error_reporter.report(exc, {"event": etype})
-            self._publish_failure(envelope, exc.last_error,
-                                  attempts=exc.attempts)
-        except PublishError:
-            # Bus-level trouble mid-handler (broker outage past the
-            # outbox, BusSaturated overflow): transient by definition —
-            # propagate so the driver nacks onto the lease/redelivery
-            # path instead of minting a failure event the same broker
-            # couldn't carry.
-            self.metrics.increment(f"{self.name}_events_total",
-                                   labels={"event": etype, "ok": "false"})
+            dump_exc = self._handle_in_span(envelope, etype, handler, t0)
+        except PoisonEnvelope as exc:
+            trace.dump_on_failure(exc.__cause__ or exc)
             raise
-        except Exception as exc:  # unexpected → terminal failure event
-            self.metrics.increment(f"{self.name}_events_total",
-                                   labels={"event": etype, "ok": "false"})
-            self.logger.error("handler failed", event=etype,
-                              error=str(exc), error_type=type(exc).__name__)
-            if self.error_reporter is not None:
-                self.error_reporter.report(exc, {"event": etype})
-            self._publish_failure(envelope, exc, attempts=1)
-            raise PoisonEnvelope(
-                f"{type(exc).__name__}: {exc}") from exc
-        finally:
-            self.metrics.observe(f"{self.name}_handle_seconds",
-                                 time.monotonic() - t0,
-                                 labels={"event": etype})
+        if dump_exc is not None:
+            trace.dump_on_failure(dump_exc)
+
+    def _handle_in_span(self, envelope: Mapping[str, Any], etype: str,
+                        handler: Callable, t0: float
+                        ) -> BaseException | None:
+        """Returns the terminal error to auto-dump for (retry
+        exhaustion), or None; terminal unexpected errors raise
+        PoisonEnvelope and are dumped by the caller."""
+        with trace.stage_span(self.name, envelope) as sp:
+            try:
+                self.retry.run(
+                    lambda: handler(Event.from_envelope(envelope)),
+                    event_type=etype)
+                self.metrics.increment(
+                    f"{self.name}_events_total",
+                    labels={"event": etype, "ok": "true"})
+            except RetryExhaustedError as exc:
+                # Transient, already retried with backoff in-process: the
+                # failure event is the record; redelivering would repeat
+                # the whole retry budget for the same outcome.
+                self.metrics.increment(
+                    f"{self.name}_events_total",
+                    labels={"event": etype, "ok": "false"})
+                self.logger.error("retries exhausted", event=etype,
+                                  error=str(exc.last_error))
+                if self.error_reporter is not None:
+                    self.error_reporter.report(exc, {"event": etype})
+                sp.status = "error"
+                sp.error = (f"RetryExhaustedError: "
+                            f"{exc.last_error}")
+                self._publish_failure(envelope, exc.last_error,
+                                      attempts=exc.attempts)
+                return exc
+            except PublishError:
+                # Bus-level trouble mid-handler (broker outage past the
+                # outbox, BusSaturated overflow): transient by definition
+                # — propagate so the driver nacks onto the lease/
+                # redelivery path instead of minting a failure event the
+                # same broker couldn't carry.
+                self.metrics.increment(
+                    f"{self.name}_events_total",
+                    labels={"event": etype, "ok": "false"})
+                raise
+            except Exception as exc:  # unexpected → terminal failure
+                self.metrics.increment(
+                    f"{self.name}_events_total",
+                    labels={"event": etype, "ok": "false"})
+                self.logger.error("handler failed", event=etype,
+                                  error=str(exc),
+                                  error_type=type(exc).__name__)
+                if self.error_reporter is not None:
+                    self.error_reporter.report(exc, {"event": etype})
+                self._publish_failure(envelope, exc, attempts=1)
+                raise PoisonEnvelope(
+                    f"{type(exc).__name__}: {exc}") from exc
+            finally:
+                dt = time.monotonic() - t0
+                self.metrics.observe(f"{self.name}_handle_seconds", dt,
+                                     labels={"event": etype})
+                # per-stage trace metrics (obs/trace.PIPELINE_METRICS)
+                self.metrics.observe("pipeline_stage_duration_seconds",
+                                     dt, labels={"stage": self.name})
+                self.metrics.observe(
+                    "pipeline_stage_queue_wait_seconds",
+                    sp.queue_wait_s, labels={"stage": self.name})
 
     def _bus_throttle(self) -> None:
         """One bounded, stop-aware pause per event while the publisher

@@ -197,6 +197,14 @@ class _BusGaugeMetrics:
             short = name.removeprefix("copilot_")
             if typ == "counter" and labels:
                 self._inner.increment(short, 0.0)
+        # pipeline-trace span ledger (obs/trace.py:PIPELINE_METRICS):
+        # absolute totals from the global collector → counter TYPE via
+        # set_counter, same move as the publish-outbox totals above
+        from copilot_for_consensus_tpu.obs import trace as _trace
+
+        tstats = _trace.get_collector().stats()
+        set_counter("pipeline_spans_open_total", tstats["opened"])
+        set_counter("pipeline_spans_dropped_total", tstats["dropped"])
         # process/host resource series for the resource_limits alerts
         from copilot_for_consensus_tpu.obs.resources import resource_gauges
 

@@ -21,6 +21,7 @@ from copilot_for_consensus_tpu.core.retry import (
 )
 from copilot_for_consensus_tpu.embedding.base import EmbeddingProvider
 from copilot_for_consensus_tpu.engine.scheduler import EngineOverloaded
+from copilot_for_consensus_tpu.obs import trace
 from copilot_for_consensus_tpu.services.base import BaseService
 from copilot_for_consensus_tpu.vectorstore.base import VectorStore
 
@@ -82,8 +83,15 @@ class EmbeddingService(BaseService):
             kw = {"tenant": self.tenant} \
                 if self._embed_takes_tenant and self.tenant else {}
             try:
-                vectors = self.provider.embed_batch(
-                    [d.get("text", "") for d in batch], **kw)
+                # engine_submit child span under the stage span: a TPU
+                # provider's embed-step telemetry joins the trace via
+                # the shared correlation id
+                with trace.child_span("engine_submit", "embed_batch",
+                                      service=self.name,
+                                      correlation_id=correlation_id,
+                                      rows=len(batch)):
+                    vectors = self.provider.embed_batch(
+                        [d.get("text", "") for d in batch], **kw)
             except EngineOverloaded as exc:
                 # Scheduler shed the burst: transient backpressure, not
                 # a failure — the bus retry policy backs off and the

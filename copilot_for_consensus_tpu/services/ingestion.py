@@ -151,7 +151,22 @@ class IngestionService(BaseService):
                        archive_uri: str = "", filename: str = "",
                        correlation_id: str = "") -> str | None:
         """Content-addressed ingest (reference ``service.py:727,1149``).
-        Returns the archive id, or None when deduped."""
+        Returns the archive id, or None when deduped. Each archive's
+        ingest runs under an ``ingestion`` stage span (obs/trace.py) —
+        the ROOT of the archive's pipeline trace, so the whole
+        archive→parse→chunk→embed→summarize→report DAG hangs off one
+        named stage instead of a bare publish."""
+        from copilot_for_consensus_tpu.obs import trace
+
+        with trace.span(self.name, kind="stage", service=self.name,
+                        correlation_id=correlation_id,
+                        event_type="ArchiveIngested"):
+            return self._ingest_archive(source_id, content, archive_uri,
+                                        filename, correlation_id)
+
+    def _ingest_archive(self, source_id: str, content: bytes,
+                        archive_uri: str, filename: str,
+                        correlation_id: str) -> str | None:
         sha256 = hashlib.sha256(content).hexdigest()
         archive_id = sha256[:ID_HEX_LEN]  # == generate_archive_id_from_bytes
         existing = self.store.get_document("archives", archive_id)

@@ -345,6 +345,18 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
 
         store = FaultingDocumentStore(store, fault_boundary)
         vector_store = FaultingVectorStore(vector_store, fault_boundary)
+    # Distributed-tracing child spans (obs/trace.py): store writes and
+    # vector upserts record under the dispatching stage span. Outside a
+    # trace (no ambient span) the wrappers are pure passthrough, and
+    # they wrap OUTSIDE the fault plane so an injected store fault shows
+    # up as an error-status child span in the trace.
+    from copilot_for_consensus_tpu.obs.trace import (
+        TracingDocumentStore,
+        TracingVectorStore,
+    )
+
+    store = TracingDocumentStore(store)
+    vector_store = TracingVectorStore(vector_store)
     provider = create_embedding_provider(cfg.get("embedding",
                                                  {"driver": "mock"}))
     summarizer = create_summarizer(cfg.get("llm", {"driver": "mock"}))

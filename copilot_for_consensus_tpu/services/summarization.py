@@ -13,6 +13,7 @@ toward.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from datetime import datetime, timezone
 
@@ -27,6 +28,7 @@ from copilot_for_consensus_tpu.engine.supervisor import (
     EngineFailed,
     EngineSuspect,
 )
+from copilot_for_consensus_tpu.obs import trace
 from copilot_for_consensus_tpu.services.base import BaseService
 from copilot_for_consensus_tpu.summarization.base import (
     RateLimitError,
@@ -151,30 +153,40 @@ class SummarizationService(BaseService):
             if item is None:
                 continue
             wait, finalize, ctx = item
-            try:
-                summary = wait()
-                finalize(summary)
-            except Exception as exc:   # noqa: BLE001 — must not die
-                self.logger.error(
-                    "pipelined summarization failed",
-                    thread_id=ctx.get("thread_id", ""),
-                    error=f"{type(exc).__name__}: {exc}")
+            tctx = ctx.get("trace_ctx")
+            resume = (trace.use_context(*tctx, service=self.name)
+                      if tctx else contextlib.nullcontext())
+            with resume:
+                # inside the originating trace context: finalize's
+                # store writes and the SummaryComplete (or
+                # SummarizationFailed) publish stay in its DAG even
+                # though they run on the harvester thread
                 try:
-                    self.publisher.publish(ev.SummarizationFailed(
+                    summary = wait()
+                    finalize(summary)
+                except Exception as exc:   # noqa: BLE001 — must not die
+                    self.logger.error(
+                        "pipelined summarization failed",
                         thread_id=ctx.get("thread_id", ""),
-                        summary_id=ctx.get("summary_id", ""),
-                        error=str(exc), error_type=type(exc).__name__,
-                        attempts=1,
-                        correlation_id=ctx.get("correlation_id", "")))
-                except Exception:
-                    pass
-            finally:
-                with self._flight_lock:
-                    self._in_flight.popleft()
-                    empty = not self._in_flight
-                if empty:
-                    with self._drained:
-                        self._drained.notify_all()
+                        error=f"{type(exc).__name__}: {exc}")
+                    try:
+                        self.publisher.publish(ev.SummarizationFailed(
+                            thread_id=ctx.get("thread_id", ""),
+                            summary_id=ctx.get("summary_id", ""),
+                            error=str(exc),
+                            error_type=type(exc).__name__,
+                            attempts=1,
+                            correlation_id=ctx.get("correlation_id",
+                                                   "")))
+                    except Exception:
+                        pass
+                finally:
+                    with self._flight_lock:
+                        self._in_flight.popleft()
+                        empty = not self._in_flight
+                    if empty:
+                        with self._drained:
+                            self._drained.notify_all()
 
     def process_thread(self, thread_id: str, summary_id: str,
                        selected_chunks: list[str],
@@ -232,7 +244,13 @@ class SummarizationService(BaseService):
             if self.priority and "priority" in self._async_kwargs:
                 kw["priority"] = self.priority
             try:
-                wait = self.summarizer.summarize_async(context, **kw)
+                # engine_submit child span: the engine-side
+                # RequestTrace joins this trace by correlation_id
+                with trace.child_span("engine_submit",
+                                      "summarize_async",
+                                      service=self.name,
+                                      correlation_id=correlation_id):
+                    wait = self.summarizer.summarize_async(context, **kw)
             except EngineOverloaded as exc:
                 # The scheduler shed this request at the door — an
                 # ADMISSION outcome, not an engine failure: no error-
@@ -253,12 +271,19 @@ class SummarizationService(BaseService):
             with self._flight_lock:
                 self._in_flight.append((wait, finalize, {
                     "thread_id": thread_id, "summary_id": summary_id,
-                    "correlation_id": correlation_id}))
+                    "correlation_id": correlation_id,
+                    # the harvester thread re-enters this trace so the
+                    # store/publish tail (and SummaryComplete) stays in
+                    # the originating DAG instead of rooting a new one
+                    "trace_ctx": trace.current_ids()}))
             self._flight_event.set()
             self._ensure_harvester()
             return summary_id
         try:
-            summary = self.summarizer.summarize(context)
+            with trace.child_span("engine_submit", "summarize",
+                                  service=self.name,
+                                  correlation_id=correlation_id):
+                summary = self.summarizer.summarize(context)
         except RateLimitError as exc:
             # Let the retry policy back off (reference ``:367-402``).
             raise RetryableError(

@@ -133,9 +133,19 @@ class DeadLetterManager:
         """Every dead-lettered message with its structured ``reason``
         (poison classification or 'redelivery budget exhausted') and
         attempt count — poison rows show attempts untouched, proof they
-        never burned the redelivery budget."""
+        never burned the redelivery budget. Each row surfaces the
+        envelope's ``correlation_id`` and ``trace_id`` so the operator
+        can pull the message's pipeline trace (obs/trace.py /
+        tools/tracepath.py) straight from the triage listing."""
         reply = self._client.request({"op": "dead", "rk": routing_key})
-        return reply["msgs"]
+        msgs = reply["msgs"]
+        for msg in msgs:
+            env = msg.get("envelope") or {}
+            data = env.get("data") or {}
+            tctx = env.get("trace") or {}
+            msg["correlation_id"] = data.get("correlation_id", "")
+            msg["trace_id"] = tctx.get("trace_id", "")
+        return msgs
 
     def summarize_dead(self) -> dict[str, dict[str, int]]:
         """Per-routing-key dead counts grouped by reason — the triage

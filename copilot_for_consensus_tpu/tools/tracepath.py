@@ -1,0 +1,233 @@
+"""Critical-path analyzer over pipeline trace spans (obs/trace.py).
+
+Reconstructs a thread's archive → parse → chunk → embed → summarize →
+report DAG from collected spans, reports per-stage p50/p95 latency with
+the queue-wait vs service-time breakdown, and names the bottleneck
+stage — the number ROADMAP item 5's ingestion parallelization will be
+judged against (SCALE_BROKER.json shows 59.6 msg/s with queues 4x past
+the warn SLO, but until now nothing could say WHERE the time goes).
+
+Programmatic surface: :func:`analyze` (bench.py's trace columns),
+:func:`trace_path` (one trace's ordered stage chain). CLI:
+
+    python -m copilot_for_consensus_tpu.tools.tracepath dump.json
+    python -m ...tools.tracepath dump.json --json
+    python -m ...tools.tracepath dump.json --trace <trace_id>
+
+where ``dump.json`` is a ``TraceCollector.dump()`` file (the
+``spans`` key) or a bare JSON list of span dicts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+from typing import Any, Iterable, Mapping
+
+from copilot_for_consensus_tpu.obs.trace import Span, orphan_spans
+
+#: canonical forward-path stage order (service names), used to sort the
+#: report; unknown stages sort after, alphabetically
+STAGE_ORDER = ("ingestion", "parsing", "chunking", "embedding",
+               "orchestrator", "summarization", "reporting")
+
+
+def _as_dicts(spans: Iterable[Span | Mapping[str, Any]]
+              ) -> list[dict[str, Any]]:
+    return [s.as_dict() if isinstance(s, Span) else dict(s)
+            for s in spans]
+
+
+def load_spans(path: str | pathlib.Path) -> list[dict[str, Any]]:
+    """Span dicts from a collector dump file (``{"spans": [...]}``) or
+    a bare JSON list."""
+    data = json.loads(pathlib.Path(path).read_text())
+    if isinstance(data, Mapping):
+        data = data.get("spans", [])
+    if not isinstance(data, list):
+        raise ValueError(f"{path}: not a span dump")
+    return [dict(d) for d in data]
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _stage_key(name: str) -> tuple:
+    try:
+        return (0, STAGE_ORDER.index(name))
+    except ValueError:
+        return (1, name)
+
+
+def analyze(spans: Iterable[Span | Mapping[str, Any]]) -> dict[str, Any]:
+    """Per-stage latency attribution over every ``stage`` span.
+
+    Returns::
+
+        {
+          "traces": <distinct trace count>,
+          "spans": <total span count>,
+          "orphan_spans": <spans with a missing recorded parent>,
+          "stages": {stage: {count, p50_s, p95_s, queue_wait_p50_s,
+                             queue_wait_p95_s, total_s,
+                             queue_wait_total_s, errors}},
+          "stage_p95_s": {stage: p95 service time},
+          "queue_wait_p95_s": {stage: p95 queue wait},
+          "bottleneck_stage": <stage maximizing accumulated
+                               residence: queue-wait total +
+                               service total>,
+          "bottleneck_residence_s": <that maximum>,
+        }
+
+    The bottleneck metric is accumulated *residence* — everything
+    events spent waiting in the stage's queue plus its handler service
+    time — which is the stage to parallelize first: per-event p95
+    alone would crown a rare slow stage (one archive-sized parse) over
+    the per-message stage the whole corpus is queueing behind, and
+    residence is exactly the time a wider stage pool removes.
+    """
+    dicts = _as_dicts(spans)
+    stages: dict[str, dict[str, list[float]]] = {}
+    errors: dict[str, int] = {}
+    trace_ids = set()
+    for d in dicts:
+        trace_ids.add(d.get("trace_id", ""))
+        if d.get("kind") != "stage":
+            continue
+        st = stages.setdefault(d["name"], {"dur": [], "wait": []})
+        st["dur"].append(float(d.get("duration_s", 0.0)))
+        st["wait"].append(float(d.get("queue_wait_s", 0.0)))
+        if d.get("status") == "error":
+            errors[d["name"]] = errors.get(d["name"], 0) + 1
+    out_stages: dict[str, dict[str, Any]] = {}
+    bottleneck, worst = "", -1.0
+    for name in sorted(stages, key=_stage_key):
+        dur = sorted(stages[name]["dur"])
+        wait = sorted(stages[name]["wait"])
+        residence = sum(dur) + sum(wait)
+        out_stages[name] = {
+            "count": len(dur),
+            "p50_s": round(_pct(dur, 0.50), 6),
+            "p95_s": round(_pct(dur, 0.95), 6),
+            "queue_wait_p50_s": round(_pct(wait, 0.50), 6),
+            "queue_wait_p95_s": round(_pct(wait, 0.95), 6),
+            "total_s": round(sum(dur), 6),
+            "queue_wait_total_s": round(sum(wait), 6),
+            "residence_s": round(residence, 6),
+            "errors": errors.get(name, 0),
+        }
+        if residence > worst:
+            worst, bottleneck = residence, name
+    return {
+        "traces": len(trace_ids),
+        "spans": len(dicts),
+        "orphan_spans": len(orphan_spans(dicts)),
+        "stages": out_stages,
+        "stage_p95_s": {n: s["p95_s"] for n, s in out_stages.items()},
+        "queue_wait_p95_s": {n: s["queue_wait_p95_s"]
+                             for n, s in out_stages.items()},
+        "bottleneck_stage": bottleneck,
+        "bottleneck_residence_s": round(max(worst, 0.0), 6),
+    }
+
+
+def trace_path(spans: Iterable[Span | Mapping[str, Any]],
+               trace_id: str) -> dict[str, Any]:
+    """One trace's reconstruction: the stage chain in time order with
+    per-hop queue wait and service time, the span DAG edge list, and
+    the end-to-end walk — the "where did THIS thread's time go" view."""
+    dicts = [d for d in _as_dicts(spans) if d.get("trace_id") == trace_id]
+    if not dicts:
+        raise ValueError(f"no spans for trace {trace_id!r}")
+    by_id = {d["span_id"]: d for d in dicts}
+    children: dict[str, list[str]] = {}
+    roots = []
+    for d in dicts:
+        parent = d.get("parent_span_id", "")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(d["span_id"])
+        else:
+            roots.append(d["span_id"])
+    stage_spans = sorted((d for d in dicts if d.get("kind") == "stage"),
+                         key=lambda d: d.get("start_wall", 0.0))
+    hops = [{
+        "stage": d["name"],
+        "event_type": d.get("event_type", ""),
+        "queue_wait_s": round(float(d.get("queue_wait_s", 0.0)), 6),
+        "service_s": round(float(d.get("duration_s", 0.0)), 6),
+        "attempt": int(d.get("attempt", 0)),
+        "status": d.get("status", "ok"),
+        "correlation_id": d.get("correlation_id", ""),
+    } for d in stage_spans]
+    starts = [d.get("start_wall", 0.0) for d in dicts]
+    ends = [d.get("start_wall", 0.0) + d.get("duration_s", 0.0)
+            for d in dicts]
+    return {
+        "trace_id": trace_id,
+        "spans": len(dicts),
+        "roots": roots,
+        "edges": {p: sorted(cs) for p, cs in sorted(children.items())},
+        "path": hops,
+        "queue_wait_total_s": round(
+            sum(h["queue_wait_s"] for h in hops), 6),
+        "service_total_s": round(
+            sum(h["service_s"] for h in hops), 6),
+        "e2e_s": round(max(ends) - min(starts), 6) if dicts else 0.0,
+        "orphan_spans": len(orphan_spans(dicts)),
+    }
+
+
+def render_report(analysis: Mapping[str, Any]) -> str:
+    """Human-readable table for the CLI."""
+    lines = [
+        f"traces {analysis['traces']}  spans {analysis['spans']}  "
+        f"orphans {analysis['orphan_spans']}",
+        f"{'stage':<14} {'n':>6} {'p50':>9} {'p95':>9} "
+        f"{'wait p50':>9} {'wait p95':>9} {'err':>4}",
+    ]
+    for name, s in analysis["stages"].items():
+        lines.append(
+            f"{name:<14} {s['count']:>6} {s['p50_s']:>9.4f} "
+            f"{s['p95_s']:>9.4f} {s['queue_wait_p50_s']:>9.4f} "
+            f"{s['queue_wait_p95_s']:>9.4f} {s['errors']:>4}")
+    lines.append(
+        f"bottleneck: {analysis['bottleneck_stage'] or '<none>'} "
+        f"(accumulated wait+service "
+        f"{analysis['bottleneck_residence_s']:.4f}s)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="pipeline trace critical-path analyzer")
+    ap.add_argument("dumps", nargs="+",
+                    help="TraceCollector dump file(s) (raw format)")
+    ap.add_argument("--trace", default="",
+                    help="reconstruct one trace id instead of the "
+                         "aggregate stage report")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON")
+    args = ap.parse_args(argv)
+    spans: list[dict[str, Any]] = []
+    for p in args.dumps:
+        spans.extend(load_spans(p))
+    if args.trace:
+        out: dict[str, Any] = trace_path(spans, args.trace)
+        print(json.dumps(out, indent=2))
+        return 0
+    analysis = analyze(spans)
+    if args.json:
+        print(json.dumps(analysis, indent=2))
+    else:
+        print(render_report(analysis))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -70,6 +70,20 @@ ENVELOPE = {
         "timestamp": {"type": "string", "minLength": 1},
         "version": {"type": "string", "minLength": 1},
         "data": {"type": "object"},
+        # Distributed-tracing context (obs/trace.py): optional so
+        # foreign/pre-trace envelopes stay valid; preserved verbatim
+        # across redelivery, outbox replay and requeue.
+        "trace": {
+            "type": "object",
+            "properties": {
+                "trace_id": {"type": "string"},
+                "span_id": {"type": "string"},
+                "parent_span_id": {"type": "string"},
+                "published_at": {"type": "number"},
+                "attempt": {"type": "integer"},
+            },
+            "additionalProperties": False,
+        },
     },
     "required": ["event_type", "event_id", "timestamp", "version", "data"],
     "additionalProperties": False,

@@ -441,6 +441,13 @@ def main() -> int:
                       else {"driver": "mock", "dimension": 384}),
         "llm": {"driver": "mock"},
     })
+    # Distributed tracing (obs/trace.py): size the span ring to the
+    # corpus so tools/tracepath can attribute per-stage latency and
+    # name the bottleneck over the whole run.
+    from copilot_for_consensus_tpu.obs import trace as trace_mod
+
+    trace_mod.configure(capacity=min(500_000,
+                                     args.messages * 40 + 20_000))
     metrics = _SamplingMetrics(p.metrics)
     for svc in p.services:
         svc.metrics = metrics
@@ -463,6 +470,20 @@ def main() -> int:
         ok &= good
         print(json.dumps({"stage": stage, "p95_s": round(p95, 4),
                           "slo_s": slo, "ok": good}))
+
+    # Per-stage queue-wait vs service-time attribution + the named
+    # bottleneck, from the pipeline trace (tools/tracepath.py).
+    from copilot_for_consensus_tpu.tools import tracepath
+
+    tp = tracepath.analyze(trace_mod.get_collector().spans())
+    print(json.dumps({
+        "stage": "tracepath",
+        "stage_p95_s": tp["stage_p95_s"],
+        "queue_wait_p95_s": tp["queue_wait_p95_s"],
+        "bottleneck_stage": tp["bottleneck_stage"],
+        "orphan_spans": tp["orphan_spans"],
+        "traces": tp["traces"],
+    }))
 
     # Reporting read path on the full corpus (reference SLO p95 < 0.5s).
     # One warmup query first: the semantic search path jit-compiles the

@@ -49,8 +49,14 @@ def fixtures_dir() -> pathlib.Path:
 _FLIGHT_DIR = os.environ.get("COPILOT_FLIGHT_RECORD_DIR", "")
 if _FLIGHT_DIR:
     from copilot_for_consensus_tpu.engine import telemetry as _telemetry
+    from copilot_for_consensus_tpu.obs import trace as _trace
 
     _telemetry.set_default_dump_dir(_FLIGHT_DIR)
+    # Pipeline trace dumps (obs/trace.py) land in the same artifact
+    # directory, so a red pipeline test ships its span DAG (stage
+    # spans + queue waits + correlation ids, readable by
+    # tools/tracepath) alongside the engine flight records.
+    _trace.set_default_dump_dir(_FLIGHT_DIR)
 
 
 @pytest.hookimpl(hookwrapper=True)
@@ -65,6 +71,8 @@ def pytest_runtest_makereport(item, call):
         from copilot_for_consensus_tpu.engine import (
             telemetry as _telemetry,
         )
+        from copilot_for_consensus_tpu.obs import trace as _trace
 
         tag = re.sub(r"[^A-Za-z0-9._-]+", "_", item.nodeid)[-80:]
         _telemetry.dump_all(_FLIGHT_DIR, tag=tag)
+        _trace.dump_all(_FLIGHT_DIR, tag=f"pipeline-trace-{tag}")

@@ -207,6 +207,9 @@ def test_pipeline_chaos_columns_contract():
              "replayed_publishes": 104, "redelivered": 3,
              "recovered_by_sweep": 2, "max_depth_backpressure_on": 8,
              "max_depth_backpressure_off": 88, "final_depth_max": 0,
+             "stage_p95_s": {"chunking": 0.4},
+             "queue_wait_p95_s": {"chunking": 1.2},
+             "bottleneck_stage": "chunking", "orphan_spans": 0,
              "extra_key_ignored": 1}
     cols = bench.pipeline_chaos_columns(audit)
     assert set(cols) == {"lost", "duplicated", "quarantined",
@@ -214,13 +217,25 @@ def test_pipeline_chaos_columns_contract():
                          "recovered_by_sweep",
                          "max_depth_backpressure_on",
                          "max_depth_backpressure_off",
-                         "final_depth_max"}
+                         "final_depth_max",
+                         # distributed-tracing columns (obs/trace.py +
+                         # tools/tracepath.py, this round's tentpole)
+                         "stage_p95_s", "queue_wait_p95_s",
+                         "bottleneck_stage", "orphan_spans"}
     assert cols["quarantined"] == 5
     assert cols["replayed_publishes"] == 104
     assert cols["max_depth_backpressure_off"] == 88
-    # empty audit degrades to zeros, not KeyErrors
+    assert cols["bottleneck_stage"] == "chunking"
+    assert cols["stage_p95_s"] == {"chunking": 0.4}
+    assert cols["orphan_spans"] == 0
+    # empty audit degrades to zeros/empties, not KeyErrors
     empty = bench.pipeline_chaos_columns({})
-    assert set(empty.values()) == {0}
+    assert empty["bottleneck_stage"] == ""
+    assert empty["stage_p95_s"] == {}
+    assert empty["queue_wait_p95_s"] == {}
+    assert all(v == 0 for k, v in empty.items()
+               if k not in ("bottleneck_stage", "stage_p95_s",
+                            "queue_wait_p95_s"))
 
 
 def test_telemetry_columns_contract():

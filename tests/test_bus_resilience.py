@@ -970,5 +970,12 @@ def test_pipeline_chaos_storm_gate():
     # scaled warn SLO; the unpaced arm flooded well past it
     assert out["max_depth_backpressure_on"] < 16, out
     assert out["max_depth_backpressure_off"] >= 32, out
+    # tracing tentpole: zero orphan spans under the storm (redelivery,
+    # outbox replay and the broker restart all yield annotated retries)
+    # and the dragged chunking handler is the NAMED bottleneck stage
+    assert out["orphan_spans"] == 0, out
+    assert out["bottleneck_stage"] == "chunking", out
+    assert out["stage_p95_s"].get("chunking", 0) > 0, out
+    assert "chunking" in out["queue_wait_p95_s"], out
     assert out["backpressure_ok"] and out["storm_ok"], out
     assert out["pipeline_chaos_ok"] is True, out

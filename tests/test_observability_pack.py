@@ -72,6 +72,17 @@ from copilot_for_consensus_tpu.services.bootstrap import (  # noqa: E402
 )
 
 KNOWN_SERIES |= set(BUS_METRICS)
+
+# Pipeline-trace series come from the tracing registry
+# (obs/trace.py:PIPELINE_METRICS) — stage span histograms emitted by
+# services/base.py per dispatch, span-ledger counters refreshed on the
+# gateway scrape — same contract discipline as the engine registry.
+from copilot_for_consensus_tpu.obs.trace import (  # noqa: E402
+    PIPELINE_METRICS,
+    prometheus_series as _pipeline_series,
+)
+
+KNOWN_SERIES |= set(_pipeline_series())
 # [a-z0-9_]: engine series contain digits (engine_e2e_seconds)
 _SERIES_RE = re.compile(r"\b(copilot_[a-z0-9_]+|up|push_time_seconds)\b")
 
@@ -259,6 +270,89 @@ def test_telemetry_registry_matches_actual_emission():
         store = {"counter": m.counters, "gauge": m.gauges,
                  "histogram": m.histograms}[typ]
         assert name in store, (name, typ)
+
+
+def test_pipeline_trace_registry_matches_actual_emission():
+    """Drive one traced dispatch through a BaseService and assert the
+    set of pipeline_* series it lands EQUALS the registry's histogram
+    families (the span-ledger counters are scrape-time, asserted in
+    test_gateway_metrics_exposes_pipeline_span_counters) — with the
+    declared types."""
+    from copilot_for_consensus_tpu.bus.base import NoopPublisher
+    from copilot_for_consensus_tpu.core.events import JSONParsed
+    from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+    from copilot_for_consensus_tpu.services.base import BaseService
+    from copilot_for_consensus_tpu.storage.memory import (
+        InMemoryDocumentStore,
+    )
+
+    class Svc(BaseService):
+        name = "chunking"
+        consumes = ("JSONParsed",)
+
+        def on_JSONParsed(self, event):
+            pass
+
+    m = InMemoryMetrics(namespace="copilot")
+    svc = Svc(NoopPublisher(), InMemoryDocumentStore(), metrics=m)
+    svc.handle_envelope(JSONParsed(message_doc_id="m1").to_envelope())
+    emitted = {n for n in (set(m.counters) | set(m.gauges)
+                           | set(m.histograms))
+               if n.startswith("pipeline_")}
+    declared_hists = {n for n, (typ, _l, _h) in PIPELINE_METRICS.items()
+                      if typ == "histogram"}
+    assert emitted == declared_hists, (
+        f"registry drift: only-in-code {emitted - declared_hists}, "
+        f"only-in-registry {declared_hists - emitted}")
+    for name in declared_hists:
+        assert name in m.histograms, name
+        assert m.histograms[name], name
+
+
+def test_pipeline_alert_functions_match_series_types():
+    """rate()/increase() need counters or histogram components;
+    deriv()/delta() need gauges — the dead-alert bug class, applied to
+    the copilot_pipeline_* pack."""
+    emitted = _pipeline_series()
+    fn_re = re.compile(r"\b(rate|irate|increase|deriv|delta|idelta)\s*"
+                       r"\(\s*(copilot_pipeline_[a-z0-9_]+)")
+    seen = 0
+    for f in _alert_files():
+        doc = yaml.safe_load(f.read_text())
+        for group in doc["groups"]:
+            for rule in group["rules"]:
+                for fn, name in fn_re.findall(rule["expr"]):
+                    seen += 1
+                    base = re.sub(r"_(bucket|sum|count)$", "", name)
+                    typ = emitted.get(base)
+                    if fn in ("rate", "irate", "increase"):
+                        assert typ in ("counter", "histogram"), (
+                            f.name, rule["alert"], fn, name, typ)
+                        if typ == "histogram":
+                            assert name != base, (
+                                f.name, rule["alert"], name)
+                    else:
+                        assert typ == "gauge", (f.name, rule["alert"],
+                                                fn, name, typ)
+    assert seen, "no alert references the pipeline-trace series"
+
+
+def test_gateway_metrics_exposes_pipeline_span_counters():
+    """The span-ledger counters are refreshed from the global collector
+    on every scrape (services/bootstrap.py), so the
+    PipelineTraceSpansDropped alert never watches an absent series."""
+    from copilot_for_consensus_tpu.services.bootstrap import serve_pipeline
+
+    server = serve_pipeline().start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics").read().decode()
+        assert "copilot_pipeline_spans_open_total" in body
+        assert "copilot_pipeline_spans_dropped_total" in body
+        assert ("# TYPE copilot_pipeline_spans_open_total counter"
+                in body)
+    finally:
+        server.stop()
 
 
 def test_gateway_metrics_exposes_bus_gauges():

@@ -1,0 +1,706 @@
+"""Pipeline-wide distributed tracing (obs/trace.py), the critical-path
+analyzer (tools/tracepath.py), and trace-context propagation across the
+bus drivers: redelivery, outbox replay, engine request replay — the
+acceptance surface of the tracing tentpole.
+
+Fast lane. The chaos-integration orphan gate at storm scale lives in
+tests/test_bus_resilience.py::test_pipeline_chaos_storm_gate (slow)."""
+
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from copilot_for_consensus_tpu.bus import broker as broker_mod
+from copilot_for_consensus_tpu.bus.inproc import (
+    InProcBroker,
+    InProcPublisher,
+    InProcSubscriber,
+)
+from copilot_for_consensus_tpu.core.events import JSONParsed
+from copilot_for_consensus_tpu.obs import trace
+from copilot_for_consensus_tpu.tools import tracepath
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_collector():
+    """Every test gets an empty global ring (and leaves one behind)."""
+    trace.configure(capacity=50_000)
+    yield
+    trace.configure(capacity=8192)
+
+
+# ---------------------------------------------------------------------------
+# context propagation primitives
+# ---------------------------------------------------------------------------
+
+
+def test_inject_stamps_context_and_records_publish_span():
+    env = JSONParsed(message_doc_id="m1",
+                     correlation_id="c-1").to_envelope()
+    out = trace.inject(env, "json.parsed", service="parsing")
+    ctx = trace.extract(out)
+    assert ctx is not None
+    assert ctx["trace_id"] and ctx["span_id"]
+    assert ctx["parent_span_id"] == ""          # no ambient span: root
+    assert ctx["published_at"] > 0
+    spans = trace.get_collector().spans()
+    assert len(spans) == 1
+    pub = spans[0]
+    assert pub.kind == "publish"
+    assert pub.span_id == ctx["span_id"]
+    assert pub.routing_key == "json.parsed"
+    assert pub.correlation_id == "c-1"
+    # the input envelope was not mutated
+    assert trace.extract(env) is None
+
+
+def test_inject_preserves_existing_context():
+    env = trace.inject(JSONParsed().to_envelope(), "json.parsed")
+    before = trace.extract(env)
+    n = len(trace.get_collector().spans())
+    again = trace.inject(env, "json.parsed")
+    assert trace.extract(again) == before
+    # re-publish records no second publish span (outbox replay /
+    # requeue must not fork the DAG)
+    assert len(trace.get_collector().spans()) == n
+
+
+def test_publish_inside_span_parents_under_it():
+    with trace.span("parsing", kind="stage", service="parsing") as sp:
+        env = trace.inject(JSONParsed().to_envelope(), "json.parsed")
+        ctx = trace.extract(env)
+        assert ctx["trace_id"] == sp.trace_id
+        assert ctx["parent_span_id"] == sp.span_id
+    assert trace.orphan_spans(trace.get_collector().spans()) == []
+
+
+def test_stage_span_queue_wait_and_attempt():
+    env = trace.inject(JSONParsed().to_envelope(), "json.parsed")
+    env["trace"]["published_at"] = time.time() - 2.0
+    trace.annotate_delivery(env, 3)
+    with trace.stage_span("chunking", env) as sp:
+        pass
+    assert 1.5 < sp.queue_wait_s < 10.0
+    assert sp.attempt == 3
+    ctx = trace.extract(env)
+    assert sp.trace_id == ctx["trace_id"]
+    assert sp.parent_span_id == ctx["span_id"]
+
+
+def test_stage_span_marks_error_and_propagates():
+    env = trace.inject(JSONParsed().to_envelope(), "json.parsed")
+    with pytest.raises(RuntimeError):
+        with trace.stage_span("chunking", env):
+            raise RuntimeError("boom")
+    s = trace.get_collector().spans()[-1]
+    assert s.status == "error" and "boom" in s.error
+
+
+def test_use_context_resumes_trace_on_another_thread():
+    got = {}
+
+    with trace.span("summarization", kind="stage") as sp:
+        ctx = trace.current_ids()
+
+    def worker():
+        with trace.use_context(*ctx):
+            env = trace.inject(JSONParsed().to_envelope(),
+                               "summary.complete")
+            got["ctx"] = trace.extract(env)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(5)
+    assert got["ctx"]["trace_id"] == sp.trace_id
+    assert got["ctx"]["parent_span_id"] == sp.span_id
+
+
+# ---------------------------------------------------------------------------
+# collector: ring accounting, exports, orphan audit
+# ---------------------------------------------------------------------------
+
+
+def test_collector_ring_counts_drops_exactly():
+    c = trace.TraceCollector(capacity=4)
+    for i in range(10):
+        c.record(trace.Span(trace_id="t", span_id=f"s{i}",
+                            parent_span_id="", name="x", kind="stage"))
+    st = c.stats()
+    assert st == {"opened": 10, "retained": 4, "dropped": 6,
+                  "capacity": 4}
+
+
+def test_exports_are_well_formed(tmp_path):
+    with trace.span("parsing", kind="stage", service="parsing",
+                    correlation_id="c-9"):
+        with trace.child_span("store_write", "upsert_document",
+                              collection="messages"):
+            pass
+    col = trace.get_collector()
+    perfetto = col.export_perfetto()
+    assert perfetto["traceEvents"]
+    ev = perfetto["traceEvents"][0]
+    assert ev["ph"] == "X" and ev["ts"] > 0 and ev["dur"] > 0
+    otlp = col.export_otlp()
+    spans = [s for rs in otlp["resourceSpans"]
+             for ss in rs["scopeSpans"] for s in ss["spans"]]
+    assert spans
+    assert all(s["traceId"] and s["spanId"] for s in spans)
+    # round-trip through files in every format
+    for fmt in ("raw", "perfetto", "otlp"):
+        p = col.dump_to_file(directory=str(tmp_path), tag=fmt, fmt=fmt)
+        assert json.loads(pathlib.Path(p).read_text())
+    # the raw dump is what tracepath loads
+    raw = col.dump_to_file(directory=str(tmp_path))
+    assert tracepath.load_spans(raw)
+
+
+def test_orphan_audit_flags_missing_parents():
+    spans = [
+        {"trace_id": "t", "span_id": "a", "parent_span_id": ""},
+        {"trace_id": "t", "span_id": "b", "parent_span_id": "a"},
+        {"trace_id": "t", "span_id": "c", "parent_span_id": "ZZZ"},
+    ]
+    orphans = trace.orphan_spans(spans)
+    assert [o["span_id"] for o in orphans] == ["c"]
+
+
+def test_dispatch_failure_dump_contains_the_error_span(tmp_path):
+    """The auto-dump for a failing dispatch must be written AFTER the
+    stage span closes: it must contain the error span itself, and its
+    already-recorded failure-event publish span must not read as an
+    orphan (the triage artifact must not misrepresent the failure it
+    exists to diagnose)."""
+    from copilot_for_consensus_tpu.bus.base import (
+        NoopPublisher,
+        PoisonEnvelope,
+    )
+    from copilot_for_consensus_tpu.core import events as ev
+    from copilot_for_consensus_tpu.services.base import BaseService
+    from copilot_for_consensus_tpu.storage.memory import (
+        InMemoryDocumentStore,
+    )
+
+    class Pub(NoopPublisher):
+        def publish_envelope(self, envelope, routing_key=None):
+            trace.inject(envelope, routing_key or "chunking.failed")
+
+    class Svc(BaseService):
+        name = "chunking"
+        consumes = ("JSONParsed",)
+
+        def on_JSONParsed(self, event):
+            raise ValueError("deterministic")
+
+        def failure_event(self, envelope, error, attempts):
+            return ev.ChunkingFailed(error=str(error))
+
+    prev = trace.get_default_dump_dir()
+    trace.set_default_dump_dir(str(tmp_path))
+    try:
+        svc = Svc(Pub(), InMemoryDocumentStore())
+        env = trace.inject(ev.JSONParsed(
+            message_doc_id="m1").to_envelope(), "json.parsed")
+        with pytest.raises(PoisonEnvelope):
+            svc.handle_envelope(env)
+    finally:
+        trace.set_default_dump_dir(prev)
+    dumps = sorted(tmp_path.glob("dispatch-failure-*.json"))
+    assert dumps
+    data = json.loads(dumps[-1].read_text())
+    spans = data["spans"]
+    errs = [s for s in spans
+            if s["kind"] == "stage" and s["status"] == "error"]
+    assert errs, "dump written before the failing stage span recorded"
+    assert "deterministic" in errs[0]["error"]
+    assert trace.orphan_spans(spans) == []
+
+
+def test_dump_on_failure_writes_to_configured_dir(tmp_path):
+    prev = trace.get_default_dump_dir()
+    trace.set_default_dump_dir(str(tmp_path))
+    try:
+        with trace.span("parsing", kind="stage"):
+            pass
+        path = trace.dump_on_failure(RuntimeError("x"))
+        assert path and pathlib.Path(path).exists()
+        data = json.loads(pathlib.Path(path).read_text())
+        assert data["error"]["type"] == "RuntimeError"
+        assert data["spans"]
+    finally:
+        trace.set_default_dump_dir(prev)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one message through the real topology → one connected
+# trace spanning the forward path, joinable with engine telemetry
+# ---------------------------------------------------------------------------
+
+
+def _run_small_pipeline():
+    sys.path.insert(0, str(REPO / "scripts"))
+    from scale_bench import synthetic_mbox
+
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="trace-e2e-"))
+    synthetic_mbox(tmp / "a.mbox", 8, thread_size=4)
+    p = build_pipeline({})
+    p.ingestion.create_source({
+        "source_id": "s1", "name": "s1", "fetcher": "local",
+        "location": str(tmp / "a.mbox")})
+    stats = p.ingest_and_run("s1")
+    return p, stats
+
+
+def test_single_ingest_yields_one_connected_trace_over_5_stages():
+    p, stats = _run_small_pipeline()
+    assert stats["reports"] >= 1
+    spans = trace.get_collector().spans()
+    # zero orphans: every span's parent is recorded
+    assert trace.orphan_spans(spans) == []
+    roots = [s for s in spans if s.kind == "publish"
+             and s.routing_key == "archive.ingested"]
+    assert len(roots) == 1
+    tp = tracepath.trace_path(spans, roots[0].trace_id)
+    stages = {h["stage"] for h in tp["path"]}
+    assert {"ingestion", "parsing", "chunking", "embedding",
+            "orchestrator", "summarization",
+            "reporting"} <= stages                      # ≥ 5 stages
+    assert tp["orphan_spans"] == 0
+    assert tp["e2e_s"] > 0
+    # queue-wait vs service-time breakdown is populated
+    assert tp["service_total_s"] > 0
+    assert all(h["queue_wait_s"] >= 0 for h in tp["path"])
+    # child spans: store writes, vector upserts and the engine submit
+    # all recorded under the stage spans
+    kinds = {s.kind for s in spans
+             if s.trace_id == roots[0].trace_id}
+    assert {"publish", "stage", "store_write", "vector_upsert",
+            "engine_submit"} <= kinds
+
+
+def test_trace_joins_engine_request_trace_by_correlation_id():
+    """The pipeline stage spans and the engine's RequestTrace share the
+    event correlation_id — the join key that stitches host-side stage
+    attribution to the PR-5 flight recorder."""
+    from copilot_for_consensus_tpu.engine.telemetry import EngineTelemetry
+
+    p, _stats = _run_small_pipeline()
+    spans = trace.get_collector().spans()
+    sub = [s for s in spans if s.kind == "engine_submit"]
+    assert sub, "no engine_submit spans recorded"
+    corr = sub[0].correlation_id
+    assert corr
+    # the summarization stage span carries the same correlation id
+    stage_corrs = {s.correlation_id for s in spans
+                   if s.kind == "stage" and s.name == "summarization"}
+    assert corr in stage_corrs
+    # an engine fed that correlation id produces a joinable span
+    tele = EngineTelemetry(engine="generation")
+    tele.on_submit(1, prompt_len=8, correlation_id=corr)
+    assert corr in tele.correlation_ids()
+
+
+def test_stage_metrics_emitted_per_dispatch():
+    from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+
+    p, _stats = _run_small_pipeline()
+    m = p.metrics
+    assert isinstance(m, InMemoryMetrics)
+    stats = m.histogram_stats("pipeline_stage_duration_seconds",
+                              {"stage": "chunking"})
+    assert stats and stats["count"] >= 1
+    waits = m.histogram_stats("pipeline_stage_queue_wait_seconds",
+                              {"stage": "chunking"})
+    assert waits and waits["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# propagation under redelivery (inproc + durable broker)
+# ---------------------------------------------------------------------------
+
+
+def test_inproc_redelivery_annotates_attempts_without_orphans():
+    broker = InProcBroker(max_redeliveries=3)
+    pub = InProcPublisher(broker=broker)
+    sub = InProcSubscriber(broker=broker, group="g")
+    seen = []
+
+    def cb(env):
+        with trace.stage_span("chunking", env) as sp:
+            seen.append(sp.attempt)
+            if len(seen) < 3:
+                raise RuntimeError("transient")
+
+    sub.subscribe(["json.parsed"], cb)
+    pub.publish(JSONParsed(message_doc_id="m1"))
+    broker.drain()
+    assert seen == [0, 1, 2]
+    spans = trace.get_collector().spans()
+    stages = [s for s in spans if s.kind == "stage"]
+    assert len(stages) == 3
+    # every retry is a NEW span with the SAME recorded parent
+    assert len({s.span_id for s in stages}) == 3
+    assert len({s.parent_span_id for s in stages}) == 1
+    assert [s.attempt for s in stages] == [0, 1, 2]
+    assert [s.status for s in stages] == ["error", "error", "ok"]
+    assert trace.orphan_spans(spans) == []
+
+
+def test_fanout_groups_do_not_share_attempt_annotations():
+    """The in-proc broker fan-out shallow-copies envelopes per consumer
+    group; a retry in one group must not stamp its attempt count onto
+    another group's pristine first delivery (annotate_delivery replaces
+    the trace dict, never mutates the shared one)."""
+    broker = InProcBroker(max_redeliveries=3)
+    pub = InProcPublisher(broker=broker)
+    sub_a = InProcSubscriber(broker=broker, group="a")
+    sub_b = InProcSubscriber(broker=broker, group="b")
+    a_attempts, b_attempts = [], []
+
+    def cb_a(env):
+        with trace.stage_span("chunking", env) as sp:
+            a_attempts.append(sp.attempt)
+            if len(a_attempts) < 2:
+                raise RuntimeError("transient")
+
+    def cb_b(env):
+        # group B consumes AFTER group A's retry cycled, so a shared
+        # trace dict would leak A's attempt stamp into B's delivery
+        with trace.stage_span("embedding", env) as sp:
+            b_attempts.append(sp.attempt)
+
+    # BOTH groups bound before the publish: each queue gets a shallow
+    # dict(envelope) copy sharing the nested trace dict. A's retry is
+    # dispatched (and annotated) before B's first delivery, so an
+    # in-place attempt write would bleed into B's copy.
+    sub_a.subscribe(["json.parsed"], cb_a)
+    sub_b.subscribe(["json.parsed"], cb_b)
+    pub.publish(JSONParsed(message_doc_id="m1"))
+    broker.drain()
+    assert a_attempts == [0, 1]
+    # B's only delivery is a FIRST delivery: attempt 0 — before the
+    # fix, the shared trace dict reported A's retry stamp here
+    assert b_attempts == [0]
+
+
+def test_child_and_publish_spans_inherit_owning_service():
+    """A store write under the parsing stage belongs to service
+    "parsing" — not to a fake service named after the store method —
+    and a publish made inside a handler is attributed to the handler's
+    service (the Perfetto pid grouping contract)."""
+    env = trace.inject(JSONParsed().to_envelope(), "json.parsed")
+    with trace.stage_span("parsing", env):
+        with trace.child_span("store_write", "upsert_document") as c:
+            pass
+        out = trace.inject(JSONParsed().to_envelope(), "chunks.prepared")
+        assert trace.extract(out)
+    assert c.service == "parsing"
+    pub = [s for s in trace.get_collector().spans()
+           if s.kind == "publish" and s.routing_key == "chunks.prepared"]
+    assert pub[0].service == "parsing"
+
+
+@pytest.mark.skipif(not broker_mod.HAS_ZMQ, reason="pyzmq missing")
+def test_broker_redelivery_annotates_attempts_without_orphans():
+    from copilot_for_consensus_tpu.core.retry import RetryableError
+
+    broker = broker_mod.Broker(port=0, db_path=":memory:").start()
+    try:
+        pub = broker_mod.BrokerPublisher({"address": broker.address})
+        sub = broker_mod.BrokerSubscriber({"address": broker.address},
+                                          group="g")
+        attempts = []
+
+        def cb(env):
+            with trace.stage_span("chunking", env) as sp:
+                attempts.append(sp.attempt)
+                if len(attempts) < 2:
+                    raise RetryableError("transient")   # nack → requeue
+
+        sub.subscribe(["json.parsed"], cb)
+        pub.publish(JSONParsed(message_doc_id="m1"))
+        deadline = time.monotonic() + 10
+        while len(attempts) < 2 and time.monotonic() < deadline:
+            sub.drain()
+            time.sleep(0.02)
+        assert attempts == [0, 1]
+        spans = trace.get_collector().spans()
+        stages = [s for s in spans if s.kind == "stage"]
+        assert len(stages) == 2
+        assert len({s.parent_span_id for s in stages}) == 1
+        assert trace.orphan_spans(spans) == []
+        pub.close()
+        sub.close()
+    finally:
+        broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# propagation across outbox replay (broker outage ride-through)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not broker_mod.HAS_ZMQ, reason="pyzmq missing")
+def test_outbox_replay_preserves_trace_context():
+    probe = broker_mod.Broker(port=0, db_path=":memory:").start()
+    port = probe.port
+    probe.stop()        # a port that WAS free; broker now down
+    pub = broker_mod.BrokerPublisher({"port": port, "timeout_ms": 150,
+                                      "retries": 1})
+    pub.publish(JSONParsed(message_doc_id="m1", correlation_id="c-7"))
+    assert pub.outbox.depth() == 1
+    # the parked row already carries the injected context
+    (_oid, _rk, env_json), = pub.outbox.oldest(1)
+    parked_ctx = json.loads(env_json)["trace"]
+    assert parked_ctx["trace_id"]
+    n_pub_spans = len([s for s in trace.get_collector().spans()
+                       if s.kind == "publish"])
+    assert n_pub_spans == 1
+    # broker comes back on the same port: the replayer drains in order
+    broker = broker_mod.Broker(port=port, db_path=":memory:").start()
+    try:
+        got = []
+        sub = broker_mod.BrokerSubscriber({"port": port}, group="g")
+        sub.subscribe(["json.parsed"], lambda env: got.append(env))
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            sub.drain()
+            time.sleep(0.05)
+        assert got, "parked publish never replayed"
+        # identical context after the replay — and no second publish
+        # span was recorded for the re-publish
+        assert got[0]["trace"] == parked_ctx
+        assert pub.outbox_stats()["replayed"] == 1
+        assert len([s for s in trace.get_collector().spans()
+                    if s.kind == "publish"]) == n_pub_spans
+        sub.close()
+    finally:
+        pub.close()
+        broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# propagation across engine request replay
+# ---------------------------------------------------------------------------
+
+
+def test_engine_replay_records_annotated_child_span():
+    from test_engine_chaos import StubEngine, _sup_cfg
+
+    from copilot_for_consensus_tpu.engine.async_runner import (
+        AsyncEngineRunner,
+    )
+
+    eng = StubEngine(script=["fail"], fail_gen=2)
+    runner = AsyncEngineRunner(
+        eng, supervisor=_sup_cfg(replay_budget=2)).start()
+    try:
+        with trace.span("summarization", kind="stage",
+                        service="summarization") as sp:
+            h = runner.submit([1, 2, 3], 6, correlation_id="r-1")
+        c = h.result(timeout=10.0)
+        assert len(c.tokens) == 6
+        assert runner.replayed == 1
+        spans = trace.get_collector().spans()
+        replays = [s for s in spans if s.kind == "engine_replay"]
+        assert len(replays) == 1
+        r = replays[0]
+        # annotated retry: attempt number, correlation id, and the
+        # submitting stage span as parent — joined, not orphaned
+        assert r.attempt == 1
+        assert r.correlation_id == "r-1"
+        assert r.trace_id == sp.trace_id
+        assert r.parent_span_id == sp.span_id
+        assert trace.orphan_spans(spans) == []
+    finally:
+        runner.stop()
+
+
+def test_pipelined_summarization_tail_stays_in_trace():
+    """The harvester thread's store/publish tail re-enters the
+    originating trace (summarization stows trace_ctx with each
+    in-flight generation), so SummaryComplete never roots a new
+    trace."""
+    from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+    from copilot_for_consensus_tpu.services.summarization import (
+        SummarizationService,
+    )
+    from copilot_for_consensus_tpu.storage.memory import (
+        InMemoryDocumentStore,
+    )
+    from copilot_for_consensus_tpu.summarization.base import Summary
+
+    class AsyncSummarizer:
+        model_name = "fake"
+
+        def summarize(self, context):
+            raise AssertionError("pipelined path only")
+
+        def summarize_async(self, context, correlation_id=""):
+            def wait():
+                return Summary(thread_id=context.thread_id,
+                               summary_text="s", model=self.model_name)
+            return wait
+
+    broker = InProcBroker()
+    pub = InProcPublisher(broker=broker)
+    store = trace.TracingDocumentStore(InMemoryDocumentStore())
+    store.upsert_document("threads", {
+        "thread_id": "t1", "subject": "x", "participants": [],
+        "message_count": 1})
+    store.upsert_document("chunks", {
+        "chunk_id": "ck1", "thread_id": "t1", "text": "hello"})
+    svc = SummarizationService(pub, store, AsyncSummarizer(),
+                               pipelined=True,
+                               metrics=InMemoryMetrics())
+    from copilot_for_consensus_tpu.core.events import (
+        SummarizationRequested,
+    )
+
+    env = trace.inject(SummarizationRequested(
+        thread_id="t1", summary_id="sum1", selected_chunks=["ck1"],
+        correlation_id="c-5").to_envelope(), "summarization.requested")
+    root_trace = trace.extract(env)["trace_id"]
+    svc.handle_envelope(env)
+    svc.flush(timeout=10)
+    spans = trace.get_collector().spans()
+    done = [s for s in spans if s.kind == "publish"
+            and s.routing_key == "summary.complete"]
+    assert len(done) == 1
+    assert done[0].trace_id == root_trace
+    assert done[0].parent_span_id        # parented, not a new root
+    # the resumed-thread tail attributes to the ORIGINATING service,
+    # not the "publisher"/store-method fallbacks (use_context carries
+    # the service for Perfetto/OTLP grouping)
+    assert done[0].service == "summarization"
+    tail_writes = [s for s in spans if s.kind == "store_write"
+                   and s.trace_id == root_trace]
+    assert tail_writes
+    assert all(s.service == "summarization" for s in tail_writes)
+    assert trace.orphan_spans(spans) == []
+
+
+# ---------------------------------------------------------------------------
+# tracepath: aggregate analysis + bottleneck naming + CLI
+# ---------------------------------------------------------------------------
+
+
+def _stage_dict(stage, dur, wait, trace_id="t1", status="ok"):
+    sid = trace._new_span_id()
+    return {"trace_id": trace_id, "span_id": sid, "parent_span_id": "",
+            "name": stage, "kind": "stage", "service": stage,
+            "start_wall": time.time(), "duration_s": dur,
+            "queue_wait_s": wait, "status": status, "attempt": 0,
+            "correlation_id": "c", "event_type": "", "routing_key": "",
+            "error": "", "attrs": {}}
+
+
+def test_analyze_names_the_dragged_stage_as_bottleneck():
+    spans = []
+    for _ in range(50):
+        spans.append(_stage_dict("parsing", 0.002, 0.001))
+        spans.append(_stage_dict("chunking", 0.02, 0.15))   # dragged
+        spans.append(_stage_dict("embedding", 0.004, 0.002))
+    # one rare slow parse must not outweigh the per-message pileup
+    spans.append(_stage_dict("parsing", 1.0, 0.0))
+    a = tracepath.analyze(spans)
+    assert a["bottleneck_stage"] == "chunking"
+    assert a["stage_p95_s"]["chunking"] >= 0.02
+    assert a["queue_wait_p95_s"]["chunking"] >= 0.15
+    assert set(a["stages"]) == {"parsing", "chunking", "embedding"}
+    st = a["stages"]["chunking"]
+    assert st["count"] == 50
+    assert st["queue_wait_total_s"] > st["total_s"]   # wait-dominated
+    assert a["orphan_spans"] == 0
+
+
+def test_analyze_counts_errors_and_orphans():
+    spans = [_stage_dict("parsing", 0.01, 0.0, status="error"),
+             {**_stage_dict("chunking", 0.01, 0.0),
+              "parent_span_id": "missing-parent"}]
+    a = tracepath.analyze(spans)
+    assert a["stages"]["parsing"]["errors"] == 1
+    assert a["orphan_spans"] == 1
+
+
+def test_tracepath_cli_reports_and_reconstructs(tmp_path, capsys):
+    with trace.span("parsing", kind="stage", service="parsing"):
+        with trace.child_span("store_write", "upsert_document"):
+            pass
+    dump = trace.get_collector().dump_to_file(directory=str(tmp_path))
+    assert tracepath.main([dump]) == 0
+    out = capsys.readouterr().out
+    assert "bottleneck:" in out and "parsing" in out
+    assert tracepath.main([dump, "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["bottleneck_stage"] == "parsing"
+    tid = trace.get_collector().spans()[0].trace_id
+    assert tracepath.main([dump, "--trace", tid]) == 0
+    tp = json.loads(capsys.readouterr().out)
+    assert tp["trace_id"] == tid and tp["spans"] == 2
+
+
+def test_tracepath_module_entrypoint(tmp_path):
+    import subprocess
+
+    with trace.span("parsing", kind="stage"):
+        pass
+    dump = trace.get_collector().dump_to_file(directory=str(tmp_path))
+    res = subprocess.run(
+        [sys.executable, "-m",
+         "copilot_for_consensus_tpu.tools.tracepath", dump],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "bottleneck:" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# DLQ triage carries the trace join keys
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not broker_mod.HAS_ZMQ, reason="pyzmq missing")
+def test_dead_letter_listing_surfaces_correlation_and_trace_ids():
+    from copilot_for_consensus_tpu.bus.base import PoisonEnvelope
+    from copilot_for_consensus_tpu.tools.failed_queues import (
+        DeadLetterManager,
+    )
+
+    broker = broker_mod.Broker(port=0, db_path=":memory:").start()
+    try:
+        pub = broker_mod.BrokerPublisher({"address": broker.address})
+        sub = broker_mod.BrokerSubscriber({"address": broker.address},
+                                          group="g")
+
+        def cb(env):
+            raise PoisonEnvelope("deterministic failure")
+
+        sub.subscribe(["json.parsed"], cb)
+        pub.publish(JSONParsed(message_doc_id="m1",
+                               correlation_id="c-13"))
+        deadline = time.monotonic() + 10
+        while not broker.store.dead_letters() \
+                and time.monotonic() < deadline:
+            sub.drain()
+            time.sleep(0.02)
+        dlq = DeadLetterManager(broker.address)
+        msgs = dlq.list_dead()
+        assert len(msgs) == 1
+        assert msgs[0]["correlation_id"] == "c-13"
+        assert msgs[0]["trace_id"]
+        assert msgs[0]["trace_id"] == \
+            msgs[0]["envelope"]["trace"]["trace_id"]
+        dlq.close()
+        pub.close()
+        sub.close()
+    finally:
+        broker.stop()

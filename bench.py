@@ -186,7 +186,12 @@ PRESETS = {
                        "BENCH_PIPE_DRAG_S": "0.01",
                        "BENCH_PIPE_WARN_SLO": "32",
                        "BENCH_PIPE_POISON": "5",
-                       "BENCH_PIPE_BUDGET_S": "420"},
+                       "BENCH_PIPE_BUDGET_S": "420",
+                       # stage scale-out (ISSUE 11): pools > 1 so the
+                       # delivery contracts (lost 0 / dup 0 / exact
+                       # quarantine) are proven UNDER competing
+                       # consumers + batched waves, not single-threaded
+                       "BENCH_PIPE_WORKERS": "2"},
     "mixed_traffic": {"BENCH_MAX_LEN": "1024", "BENCH_SLOTS": "32",
                       "BENCH_KV_DTYPE": "bfloat16",
                       "BENCH_NEW_TOKENS": "64",
@@ -1067,6 +1072,7 @@ def pipeline_chaos_headline() -> dict:
     # The storm instead pays the honest lease-expiry latency for
     # crash-after-work redeliveries (bounded by the settle budget).
     lease_s = float(knob("BENCH_PIPE_LEASE_S", "30"))
+    workers = int(knob("BENCH_PIPE_WORKERS", "2"))
     hw = max(2, scaled_slo // 2)
 
     if not broker_mod.HAS_ZMQ:
@@ -1110,6 +1116,12 @@ def pipeline_chaos_headline() -> dict:
             "vector_store": {"driver": "memory"},
             "embedding": {"driver": "mock", "dimension": 64},
             "llm": {"driver": "mock"},
+            # stage scale-out: competing consumer pools + batched waves
+            # on the host-bound stages — the chaos contracts must hold
+            # with them enabled (ISSUE 11 acceptance)
+            "services": {name: {"workers": workers}
+                         for name in ("parsing", "chunking",
+                                      "embedding")},
         }
         if faults:
             cfg["faults"] = {"plan": faults}
@@ -1131,6 +1143,15 @@ def pipeline_chaos_headline() -> dict:
                 return _orig(event)
 
             p.chunking.on_JSONParsed = dragged
+            # the batched hot path must drag too (same per-message
+            # cost), or the scripted overload disappears into the wave
+            orig_wave = p.chunking.on_wave_JSONParsed
+
+            def dragged_wave(events, _orig=orig_wave):
+                time.sleep(drag * len(events))
+                return _orig(events)
+
+            p.chunking.on_wave_JSONParsed = dragged_wave
 
         # depth sampler: max PENDING per key (the SCALE_BROKER series
         # the warn SLO is declared over); paused across the restart
@@ -1155,11 +1176,10 @@ def pipeline_chaos_headline() -> dict:
 
         sampler = threading.Thread(target=sample, daemon=True)
         sampler.start()
-        consume_threads = [
-            threading.Thread(target=sub.start_consuming, daemon=True)
-            for sub in p.ext_subscribers]
-        for t in consume_threads:
-            t.start()
+        # stage worker pools (services/pool.py): N stop-aware consume
+        # loops per service, worker labels on the stage spans
+        for pool in p.worker_pools:
+            pool.start()
 
         for a in range(archives):
             p.ingestion.create_source({
@@ -1319,10 +1339,10 @@ def pipeline_chaos_headline() -> dict:
         trace_report = tracepath.analyze(trace_collector.spans())
 
         p.stop_throttling()
-        for sub in p.ext_subscribers:
-            sub.stop()
-        for t in consume_threads:
-            t.join(timeout=5)
+        for pool in p.worker_pools:
+            pool.stop()
+        for pool in p.worker_pools:
+            pool.join(timeout=5)
         for sub in p.ext_subscribers:
             sub.close()
         stop_sampler.set()
@@ -1437,6 +1457,7 @@ def pipeline_chaos_headline() -> dict:
         **pipeline_chaos_columns(audit),
         "warn_slo_scaled": scaled_slo,
         "high_watermark": hw,
+        "workers_per_stage": workers,
         "throttle_waits": storm["throttle_waits"]
         + on["throttle_waits"],
         "threads": storm["threads"],

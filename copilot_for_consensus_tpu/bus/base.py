@@ -10,6 +10,16 @@ from copilot_for_consensus_tpu.core.events import Event
 # Callback receives the envelope dict; raising triggers nack/requeue.
 EventCallback = Callable[[Mapping[str, Any]], None]
 
+# Batch callback (opt-in, `subscribe_batch`): receives a wave of
+# same-routing-key envelopes and returns one outcome per envelope IN
+# ORDER — None acks; an exception instance classifies exactly like the
+# single-dispatch raise (PoisonEnvelope / non-retryable → quarantine,
+# RetryableError / PublishError → nack-redeliver). Returning None means
+# "all acked". Raising from the callback itself signals a wave-level
+# infrastructure failure: drivers fall back to per-envelope dispatch so
+# one bad message can never fail its neighbours.
+BatchEventCallback = Callable[[list], "list[BaseException | None] | None"]
+
 
 class PublishError(Exception):
     pass
@@ -110,6 +120,19 @@ class EventSubscriber(abc.ABC):
 
     @abc.abstractmethod
     def subscribe(self, routing_keys: list[str], callback: EventCallback) -> None: ...
+
+    def subscribe_batch(self, routing_keys: list[str],
+                        callback: BatchEventCallback) -> bool:
+        """Opt-in batch dispatch: register a wave callback for keys the
+        subscriber ALSO has a single-envelope route for (the fallback
+        path). Returns True when the driver supports batch dispatch;
+        this default (drivers without it) is False and registers
+        nothing — callers keep the per-envelope path.
+
+        NOTE for wrappers with ``__getattr__`` delegation: this is a
+        concrete base-class default, so delegating wrappers must
+        forward it explicitly (the race-wrapper-shadow contract)."""
+        return False
 
     @abc.abstractmethod
     def start_consuming(self) -> None:

@@ -26,6 +26,7 @@ The broker runs embedded (``Broker.start()`` thread) or standalone:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import sqlite3
 import threading
@@ -138,6 +139,27 @@ class _QueueStore:
                     "VALUES (?, ?, ?, ?)", (rk, grp, envelope, now))
                 last = cur.lastrowid
             return last, self._depth_locked(rk)
+
+    def enqueue_many(self, items: list[tuple[str, str]]
+                     ) -> dict[str, int]:
+        """Grouped publish: every (rk, envelope) lands in ONE locked
+        transaction — one sqlite commit and one broker round-trip for
+        a whole dispatch wave's follow-up events, instead of one each.
+        Returns the post-insert depth per distinct key (the same
+        backpressure piggyback as :meth:`enqueue`)."""
+        now = time.time()
+        with self._lock, self._db:
+            groups_of: dict[str, list[str]] = {}
+            for rk, envelope in items:
+                if rk not in groups_of:
+                    groups_of[rk] = [g for (g,) in self._db.execute(
+                        "SELECT grp FROM bindings WHERE rk=?", (rk,))]
+                for grp in (groups_of[rk] or [""]):
+                    self._db.execute(
+                        "INSERT INTO messages "
+                        "(rk, grp, envelope, enqueued_at) "
+                        "VALUES (?, ?, ?, ?)", (rk, grp, envelope, now))
+            return {rk: self._depth_locked(rk) for rk in groups_of}
 
     def _depth_locked(self, rk: str) -> int:
         # Parked rows (grp='', published before any consumer bound —
@@ -296,7 +318,8 @@ class Broker:
 
     def __init__(self, port: int = DEFAULT_PORT, db_path: str = ":memory:",
                  host: str = "127.0.0.1", max_redeliveries: int = 3,
-                 lease_s: float = DEFAULT_LEASE_S):
+                 lease_s: float = DEFAULT_LEASE_S,
+                 expire_interval_s: float = 1.0):
         if not HAS_ZMQ:
             raise PublishError("pyzmq is not available")
         self.host = host
@@ -304,6 +327,15 @@ class Broker:
         self.store = _QueueStore(db_path)
         self.max_redeliveries = max_redeliveries
         self.lease_s = lease_s
+        # Lease-expiry sweep cadence: the sweep used to run on EVERY
+        # fetch, fine with one consumer per stage but a broker-loop
+        # saturator under worker pools (N workers × 20 idle polls/s
+        # each = hundreds of full-table parked-row scans per second on
+        # the single request thread — counts()/depth() clients then
+        # time out). Expiry only needs lease granularity (30 s), so
+        # once a second is already 30× finer than required.
+        self.expire_interval_s = expire_interval_s
+        self._last_expire = 0.0   # only touched on the run() thread
         self._ctx = zmq.Context.instance()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -319,6 +351,12 @@ class Broker:
             # publisher confirm + the key's pending depth, so every
             # producer gets backpressure feedback with its confirm
             return {"ok": True, "id": mid, "depth": depth}
+        if op == "pub_batch":
+            depths = self.store.enqueue_many(
+                [(it["rk"], json.dumps(it["envelope"]))
+                 for it in req.get("items", [])])
+            return {"ok": True, "n": len(req.get("items", [])),
+                    "depths": depths}
         if op == "depth":
             return {"ok": True, "depth": self.store.depth(req["rk"])}
         if op == "bind":
@@ -326,7 +364,14 @@ class Broker:
                             req.get("group", DEFAULT_GROUP))
             return {"ok": True}
         if op == "fetch":
-            self.store.expire_leases()
+            # sweep cadence tracks the lease: a test broker with a
+            # 50 ms lease sweeps (nearly) every fetch, the production
+            # 30 s lease sweeps at most once a second
+            now = time.time()
+            if now - self._last_expire >= min(self.expire_interval_s,
+                                              self.lease_s / 2):
+                self._last_expire = now
+                self.store.expire_leases()
             rows = self.store.fetch(req["rks"],
                                     req.get("group", DEFAULT_GROUP),
                                     int(req.get("max", 16)), self.lease_s)
@@ -597,6 +642,10 @@ class BrokerPublisher(EventPublisher):
         self.faults = resolve_boundary(faults)
         #: rk -> (last known pending depth, monotonic stamp)
         self._depths: dict[str, tuple[int, float]] = {}
+        # publish-window buffer (grouped publishes): THREAD-local —
+        # a service's worker pool shares one publisher, and each
+        # worker's wave must flush only its own buffered follow-ups
+        self._window = threading.local()
         self._stop = threading.Event()
         self._replay_lock = threading.Lock()
         self._replayer: threading.Thread | None = None
@@ -623,6 +672,14 @@ class BrokerPublisher(EventPublisher):
         # replay, DLQ/startup requeue) keeps it, so at-least-once
         # delivery never orphans a trace.
         env = dict(trace.inject(envelope, routing_key))
+        buf = getattr(self._window, "buf", None)
+        if buf is not None:
+            # Inside a publish window (batched wave dispatch): buffer —
+            # the publish span is already recorded with the correct
+            # per-envelope parent above; the broker sees the whole
+            # window as ONE pub_batch request at flush.
+            buf.append((routing_key, env))
+            return
         outage: BaseException | None = None
         if self.faults is not None:
             try:
@@ -643,6 +700,101 @@ class BrokerPublisher(EventPublisher):
                 self._pace(routing_key, int(reply.get("depth", 0)))
                 return
         self._park(routing_key, env, outage)
+
+    @contextlib.contextmanager
+    def publish_window(self):
+        """Grouped publishes for one batched dispatch: every
+        ``publish`` inside the window buffers (spans and trace stamps
+        recorded immediately, with their real per-envelope parents)
+        and the window exit sends ONE ``pub_batch`` broker request —
+        one round-trip and one broker-side transaction for the wave's
+        whole follow-up fan-out. Reentrant-safe per thread (an inner
+        window joins the outer one); the outage path parks the whole
+        buffer in the outbox in order, so ride-through semantics are
+        identical to per-publish. Raises :class:`BusSaturated` only
+        when the outbox overflows — the caller (wave dispatch) nacks
+        the wave and redelivery regenerates the publishes."""
+        outer = getattr(self._window, "buf", None)
+        if outer is not None:
+            yield          # nested: the outer window owns the flush
+            return
+        buf: list[tuple[str, dict]] = []
+        self._window.buf = buf
+        try:
+            yield
+        finally:
+            # flush even when the body raised: envelopes whose
+            # finishers already succeeded are about to be acked — their
+            # follow-ups must reach the broker (or the outbox)
+            self._window.buf = None
+            self._flush_window(buf)
+
+    def _flush_window(self, buf: list[tuple[str, dict]]) -> None:
+        if not buf:
+            return
+        outage: BaseException | None = None
+        if self.faults is not None:
+            try:
+                # one boundary fire per flush: the wave pays one
+                # publish round-trip, so it offers one fault window
+                self.faults.check("publish")
+            except Exception as exc:
+                outage = exc
+        # Sub-batch cap: an UNBOUNDED pub_batch would land a whole
+        # wave's fan-out past the watermark before pacing could see it
+        # (the overload arm measured depth = wave size, not watermark).
+        # Capping each broker request at HALF the watermark restores
+        # pacing granularity — worst transient = existing backlog (hw,
+        # where pacing engages) + one sub-batch (hw/2) = 1.5×hw,
+        # strictly inside the 2×hw depth SLO the watermark is sized
+        # against — while an unwatermarked publisher still gets
+        # bounded transactions.
+        cap = max(1, self.high_watermark // 2) \
+            if self.high_watermark > 0 else 128
+        start = 0
+        while outage is None and start < len(buf):
+            if self.outbox.depth() > 0:
+                break                   # park behind the backlog
+            chunk = buf[start:start + cap]
+            try:
+                reply = self._client.request({
+                    "op": "pub_batch",
+                    "items": [{"rk": rk, "envelope": env}
+                              for rk, env in chunk]})
+            except PublishError as exc:
+                outage = exc
+                break
+            start += len(chunk)
+            self._bump("confirmed", len(chunk))
+            depths = {rk: int(d) for rk, d in
+                      (reply.get("depths") or {}).items()}
+            for rk, d in depths.items():
+                self._note_depth(rk, d)
+            for rk, d in depths.items():
+                if self.high_watermark and d >= self.high_watermark:
+                    # one pace against the hottest key is enough:
+                    # _pace re-polls until IT drains, which bounds
+                    # the producer exactly like per-publish pacing
+                    self._pace(rk, d)
+                    break
+        # Broker away (or injected fault): park the window's REMAINDER
+        # in publish order — the replay thread preserves FIFO, so the
+        # ride-through contract is unchanged under grouping. If the
+        # outbox hits its cap mid-park, the un-parked tail cannot go
+        # anywhere: count every dropped envelope as overflow (visible
+        # in outbox_stats) and raise the structured BusSaturated — the
+        # wave dispatch nacks its envelopes on this raise, and
+        # redelivery regenerates ALL the wave's publishes (the parked
+        # portion's replay duplicates are absorbed by idempotent ids).
+        remainder = buf[start:]
+        for k, (rk, env) in enumerate(remainder):
+            try:
+                self._park(rk, env, outage)
+            except BusSaturated:
+                dropped = len(remainder) - k
+                if dropped > 1:        # _park counted the first one
+                    self._bump("overflow", dropped - 1)
+                raise
 
     def _park(self, routing_key: str, env: dict,
               cause: BaseException | None) -> None:
@@ -849,13 +1001,18 @@ class BrokerSubscriber(EventSubscriber):
         self._client = client if client is not None else _Client(
             address, timeout_ms=self._timeout_ms, retries=self._retries)
         self.poll_interval_s = float(cfg.get("poll_interval_s", 0.05))
-        self.batch = int(cfg.get("batch", 16))
+        # Prefetch: how many envelopes one fetch leases (the broker-side
+        # `max`). `prefetch` is the config-surface name (`bus.prefetch`,
+        # plumbed per service by the runner so pool sizing and prefetch
+        # tune together); `batch` kept as the legacy alias.
+        self.batch = int(cfg.get("prefetch", cfg.get("batch", 16)))
         self.group = group or cfg.get("group") or DEFAULT_GROUP
         self.faults = resolve_boundary(faults)
         #: shared with the owning pipeline's collector by the runner
         self.metrics = NoopMetrics()
         self.logger = get_logger()
         self._routes: dict[str, EventCallback] = {}
+        self._batch_routes: dict[str, Any] = {}
         self._counts_client: _Client | None = None
         self._stop = threading.Event()
 
@@ -864,6 +1021,17 @@ class BrokerSubscriber(EventSubscriber):
             self._routes[rk] = callback
         self._client.request({"op": "bind", "rks": list(routing_keys),
                               "group": self.group})
+
+    def subscribe_batch(self, routing_keys, callback) -> bool:
+        """Register a wave callback (``bus/base.py:BatchEventCallback``)
+        for keys already subscribed via :meth:`subscribe`: a fetch wave
+        of same-key envelopes dispatches as ONE callback call with
+        grouped ack/nack round-trips; keys without a batch route (and
+        wave-level callback failures) keep exact per-envelope
+        semantics."""
+        for rk in routing_keys:
+            self._batch_routes[rk] = callback
+        return True
 
     def counts(self, timeout_ms: int | None = None
                ) -> dict[str, dict[str, int]]:
@@ -944,8 +1112,69 @@ class BrokerSubscriber(EventSubscriber):
             # redelivers — at-least-once holds without us crashing.
             pass
 
+    def _settle(self, acks: list[int],
+                nacks: list[tuple[dict, BaseException]]) -> None:
+        """Grouped verdict round-trips for a dispatched wave: ONE ack
+        request for every success (the broker ack op takes an id list),
+        one nack per distinct classification. The injected ``ack``
+        fault covers the whole wave — a consumer crash before settling
+        loses every verdict at once, exactly like the real failure."""
+        if self.faults is not None:
+            try:
+                self.faults.check("ack")
+            except Exception:
+                # consumer died before acking: leases expire, the wave
+                # redelivers — at-least-once, absorbed by idempotency
+                return
+        verdicts: list[dict] = []
+        if acks:
+            verdicts.append({"op": "ack", "ids": acks})
+        transient: list[int] = []
+        for m, exc in nacks:
+            v = self._classify_failure(m, exc)
+            if v.get("poison"):
+                verdicts.append(v)
+            else:
+                transient.extend(v["ids"])
+        if transient:
+            verdicts.append({"op": "nack", "ids": transient})
+        for v in verdicts:
+            try:
+                self._client.request(v)
+            except PublishError:
+                # Broker unreachable: leases expire and redeliver.
+                pass
+
+    def _dispatch_batch(self, rk: str, msgs: list[dict]) -> None:
+        """One wave, one callback call, grouped settle. A wave-level
+        callback raise falls back to per-envelope dispatch so a single
+        bad message degrades to the exact single-dispatch path instead
+        of failing its neighbours (handlers are idempotent by pipeline
+        contract, so the partial re-execution is absorbed)."""
+        from copilot_for_consensus_tpu.obs import trace
+
+        cb = self._batch_routes[rk]
+        for m in msgs:
+            trace.annotate_delivery(m["envelope"],
+                                    int(m.get("attempts", 0)))
+        try:
+            outcomes = cb([m["envelope"] for m in msgs])
+            if outcomes is None:
+                outcomes = [None] * len(msgs)
+        except Exception:
+            for m in msgs:
+                self._dispatch(m)
+            return
+        acks = [m["id"] for m, out in zip(msgs, outcomes) if out is None]
+        nacks = [(m, out) for m, out in zip(msgs, outcomes)
+                 if out is not None]
+        self._settle(acks, nacks)
+
     def drain(self, max_messages: int | None = None) -> int:
-        """Process what's queued now; returns the number handled."""
+        """Process what's queued now; returns the number handled.
+        Fetched waves group into consecutive same-key runs: keys with a
+        registered batch route dispatch as one wave, the rest one by
+        one."""
         n = 0
         while max_messages is None or n < max_messages:
             if self.faults is not None:
@@ -964,9 +1193,18 @@ class BrokerSubscriber(EventSubscriber):
             msgs = reply.get("msgs", [])
             if not msgs:
                 break
-            for m in msgs:
-                self._dispatch(m)
-                n += 1
+            i = 0
+            while i < len(msgs):
+                rk = msgs[i]["rk"]
+                j = i + 1
+                if rk in self._batch_routes:
+                    while j < len(msgs) and msgs[j]["rk"] == rk:
+                        j += 1
+                    self._dispatch_batch(rk, msgs[i:j])
+                else:
+                    self._dispatch(msgs[i])
+                n += j - i
+                i = j
         return n
 
     def start_consuming(self):

@@ -164,6 +164,14 @@ class FaultingDocumentStore(_Wrapper):
         self._check("store_write")
         return self.inner.update_document(collection, doc_id, fields)
 
+    def update_documents(self, collection, doc_ids, fields):
+        # One boundary check per wave: the batched hot paths pay one
+        # store round-trip, so they pay one fault-fire opportunity —
+        # a chaos window lands on the whole wave, whose dispatch then
+        # isolates per message.
+        self._check("store_write")
+        return self.inner.update_documents(collection, doc_ids, fields)
+
     def delete_document(self, collection, doc_id):
         self._check("store_write")
         return self.inner.delete_document(collection, doc_id)

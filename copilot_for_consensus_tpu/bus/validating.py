@@ -100,6 +100,41 @@ class ValidatingSubscriber(EventSubscriber):
 
         self.inner.subscribe(routing_keys, guarded)
 
+    def subscribe_batch(self, routing_keys, callback) -> bool:
+        """Explicit delegation (the base class defines a concrete
+        ``return False`` default — ``__getattr__`` alone would never
+        fire, silently disabling batch dispatch through the wrapper):
+        validates each envelope of the wave, quarantines the invalid
+        ones per-envelope (``PoisonEnvelope`` outcome, same contract as
+        the single-dispatch ``guarded`` path), and forwards only the
+        valid subset to the service's wave callback."""
+
+        def guarded_batch(envelopes):
+            outcomes: list = [None] * len(envelopes)
+            valid_idx: list[int] = []
+            valid: list = []
+            for i, envelope in enumerate(envelopes):
+                try:
+                    validate_envelope(envelope, self.provider)
+                except (SchemaValidationError, FileNotFoundError) as exc:
+                    self.invalid_count += 1
+                    if self.on_invalid is not None:
+                        self.on_invalid(envelope, exc)
+                    outcomes[i] = PoisonEnvelope(
+                        f"schema validation failed: {exc}")
+                else:
+                    valid_idx.append(i)
+                    valid.append(envelope)
+            if valid:
+                inner_out = callback(valid)
+                if inner_out is None:
+                    inner_out = [None] * len(valid)
+                for i, out in zip(valid_idx, inner_out):
+                    outcomes[i] = out
+            return outcomes
+
+        return self.inner.subscribe_batch(routing_keys, guarded_batch)
+
     def start_consuming(self):
         self.inner.start_consuming()
 

@@ -8,6 +8,7 @@ every handled event bumps counters and a latency histogram.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Callable, Mapping
@@ -21,6 +22,7 @@ from copilot_for_consensus_tpu.core.events import Event
 from copilot_for_consensus_tpu.core.retry import (
     RetryExhaustedError,
     RetryPolicy,
+    RetryableError,
 )
 from copilot_for_consensus_tpu.obs import trace
 from copilot_for_consensus_tpu.obs.errors import ErrorReporter
@@ -82,12 +84,31 @@ class BaseService:
         # the bus config sets a high_watermark.
         self.throttle_pause_s = throttle_pause_s
         self._throttle_release = threading.Event()
+        # Saturation snapshot shared across the service's worker pool:
+        # one publisher.saturation() poll per refresh window for the
+        # WHOLE service, not one per event per worker — an N-worker
+        # pool must not multiply broker depth polls by N. TTL follows
+        # the publisher's own staleness budget; publishers without one
+        # (in-proc: saturation() is a lock-cheap local read) poll every
+        # event as before.
+        self._sat_refresh_s = float(
+            getattr(publisher, "saturation_refresh_s", 0.0) or 0.0)
+        self._sat_lock = threading.Lock()
+        self._sat_cache: tuple[float, dict] = (0.0, {})
 
     # -- bus wiring ------------------------------------------------------
 
     def routing_keys(self) -> list[str]:
         from copilot_for_consensus_tpu.core.events import EVENT_TYPES
         return [EVENT_TYPES[t].routing_key for t in self.consumes]
+
+    def wave_routing_keys(self) -> list[str]:
+        """Routing keys of the event types this service can dispatch as
+        a wave (an ``on_wave_<EventType>`` method exists) — what the
+        runner registers for the bus driver's opt-in batch dispatch."""
+        from copilot_for_consensus_tpu.core.events import EVENT_TYPES
+        return [EVENT_TYPES[t].routing_key for t in self.consumes
+                if callable(getattr(self, f"on_wave_{t}", None))]
 
     def handle_envelope(self, envelope: Mapping[str, Any]) -> None:
         """Bus callback. Raises to trigger nack/requeue on transient
@@ -184,18 +205,189 @@ class BaseService:
                     "pipeline_stage_queue_wait_seconds",
                     sp.queue_wait_s, labels={"stage": self.name})
 
+    def _saturation_snapshot(self) -> dict:
+        """The service-level saturation cache: within
+        ``_sat_refresh_s`` of the last poll every worker reuses the
+        snapshot; on expiry ONE caller claims the refresh (stamping the
+        cache first so concurrent workers ride the stale copy instead
+        of stampeding the broker) and polls outside the lock."""
+        sat = getattr(self.publisher, "saturation", None)
+        if not callable(sat):
+            return {}
+        now = time.monotonic()
+        if self._sat_refresh_s > 0:
+            with self._sat_lock:
+                stamp, snap = self._sat_cache
+                if now - stamp < self._sat_refresh_s:
+                    return snap
+                self._sat_cache = (now, snap)   # claim the refresh
+        try:
+            hot = sat()
+        except Exception:
+            hot = {}
+        if self._sat_refresh_s > 0:
+            with self._sat_lock:
+                self._sat_cache = (time.monotonic(), hot)
+        return hot
+
+    # -- batched (wave) dispatch ----------------------------------------
+
+    def handle_envelopes(self, envelopes) -> list:
+        """Batch bus callback (``bus/base.py:BatchEventCallback``): a
+        fetch wave of envelopes dispatched through the stage's
+        ``on_wave_<EventType>`` hot path when one exists — one store
+        multi-get, one bulk write-back, grouped publishes — with one
+        outcome per envelope so the driver's per-message ack/nack/
+        quarantine semantics hold unchanged under batching. Event types
+        without a wave handler (and every envelope of a wave that
+        failed as a whole) take the exact single-dispatch path."""
+        envelopes = list(envelopes)
+        outcomes: list = [None] * len(envelopes)
+        groups: dict[str, list[int]] = {}
+        for i, env in enumerate(envelopes):
+            etype = str(env.get("event_type", "")) \
+                if isinstance(env, Mapping) else ""
+            groups.setdefault(etype, []).append(i)
+        for etype, idxs in groups.items():
+            wave = getattr(self, f"on_wave_{etype}", None) \
+                if etype else None
+            if not callable(wave):
+                for i in idxs:
+                    outcomes[i] = self._dispatch_single(envelopes[i])
+            else:
+                self._handle_wave(etype, wave,
+                                  [envelopes[i] for i in idxs],
+                                  idxs, outcomes)
+        return outcomes
+
+    def _dispatch_single(self, envelope) -> BaseException | None:
+        """One envelope through :meth:`handle_envelope`, its raise
+        captured as the envelope's outcome (what the batch driver
+        classifies exactly like a single-dispatch raise)."""
+        try:
+            self.handle_envelope(envelope)
+            return None
+        except Exception as exc:
+            return exc
+
+    def _handle_wave(self, etype: str, wave_handler: Callable,
+                     envs: list, idxs: list[int], outcomes: list) -> None:
+        """Run one wave: shared phase (store round-trips, no publishes)
+        once for the whole wave, then one stage span + finisher
+        (publishes) per envelope so every envelope records its own
+        amortized residence and its follow-up events parent under ITS
+        span — per-trace correctness under batching.
+
+        A shared-phase failure falls back to per-envelope dispatch:
+        one missing document (the event-before-store-visibility race)
+        must nack only ITS envelope, never the wave."""
+        self._bus_throttle()
+        t0 = time.monotonic()
+        try:
+            events = [Event.from_envelope(env) for env in envs]
+            finishers = wave_handler(events)
+        except Exception as exc:
+            self.metrics.increment(
+                f"{self.name}_wave_fallback_total",
+                labels={"event": etype})
+            self.logger.info("wave fallback to single dispatch",
+                             event=etype, wave=len(envs),
+                             error=str(exc),
+                             error_type=type(exc).__name__)
+            for i, env in zip(idxs, envs):
+                outcomes[i] = self._dispatch_single(env)
+            return
+        amortized = (time.monotonic() - t0) / max(1, len(envs))
+        if finishers is None:
+            finishers = [None] * len(envs)
+        # Grouped publishes: publishers with a publish_window (the
+        # broker driver) buffer every finisher's follow-up events and
+        # flush them as ONE pub_batch round-trip — spans and trace
+        # stamps still record per envelope at publish() time. A flush
+        # failure surfacing here (outbox overflow) is bus-level
+        # trouble for the WHOLE wave: nack everything not already
+        # classified; redelivery regenerates the publishes
+        # (idempotent ids absorb the parked portion's replay).
+        window = getattr(self.publisher, "publish_window", None)
+        try:
+            with (window() if callable(window)
+                  else contextlib.nullcontext()):
+                for (i, env), fin in zip(zip(idxs, envs), finishers):
+                    outcomes[i] = self._finish_wave_envelope(
+                        etype, env, fin, amortized, len(envs))
+        except PublishError as exc:
+            for i in idxs:
+                if outcomes[i] is None:
+                    outcomes[i] = exc
+
+    def _finish_wave_envelope(self, etype: str, envelope,
+                              finisher: Callable | None,
+                              amortized_s: float, wave: int
+                              ) -> BaseException | None:
+        """Per-envelope tail of a wave: stage span (amortized shared
+        time + the finisher's own publishes), stage metrics, and the
+        single-dispatch failure classification — a finisher's
+        PublishError nacks onto the redelivery path, anything else
+        publishes the stage's *Failed event and quarantines."""
+        t0 = time.monotonic()
+        try:
+            with trace.stage_span(self.name, envelope,
+                                  extra_duration_s=amortized_s,
+                                  wave=wave) as sp:
+                try:
+                    if finisher is not None:
+                        finisher()
+                    self.metrics.increment(
+                        f"{self.name}_events_total",
+                        labels={"event": etype, "ok": "true"})
+                except (PublishError, RetryableError):
+                    # Transient trouble in the finisher (bus outage
+                    # past the outbox; a retryable store-visibility
+                    # race like the orchestrator finisher's
+                    # DocumentNotFoundError): nack, redeliver — the
+                    # re-run's writes are idempotent. Classifying
+                    # these as terminal would quarantine work the
+                    # lease/redelivery path exists to recover.
+                    self.metrics.increment(
+                        f"{self.name}_events_total",
+                        labels={"event": etype, "ok": "false"})
+                    raise
+                except Exception as exc:
+                    self.metrics.increment(
+                        f"{self.name}_events_total",
+                        labels={"event": etype, "ok": "false"})
+                    self.logger.error("wave finisher failed",
+                                      event=etype, error=str(exc),
+                                      error_type=type(exc).__name__)
+                    if self.error_reporter is not None:
+                        self.error_reporter.report(exc, {"event": etype})
+                    self._publish_failure(envelope, exc, attempts=1)
+                    raise PoisonEnvelope(
+                        f"{type(exc).__name__}: {exc}") from exc
+                finally:
+                    dt = time.monotonic() - t0 + amortized_s
+                    self.metrics.observe(
+                        f"{self.name}_handle_seconds", dt,
+                        labels={"event": etype})
+                    self.metrics.observe(
+                        "pipeline_stage_duration_seconds", dt,
+                        labels={"stage": self.name})
+                    self.metrics.observe(
+                        "pipeline_stage_queue_wait_seconds",
+                        sp.queue_wait_s, labels={"stage": self.name})
+        except PoisonEnvelope as exc:
+            trace.dump_on_failure(exc.__cause__ or exc)
+            return exc
+        except Exception as exc:
+            return exc
+        return None
+
     def _bus_throttle(self) -> None:
         """One bounded, stop-aware pause per event while the publisher
         reports saturated downstream keys (depth-watermark
         backpressure). A no-op for publishers without depth feedback
         or with no watermark configured."""
-        sat = getattr(self.publisher, "saturation", None)
-        if not callable(sat):
-            return
-        try:
-            hot = sat()
-        except Exception:
-            return
+        hot = self._saturation_snapshot()
         if not hot:
             return
         self.metrics.increment("bus_throttle_total",

@@ -115,6 +115,34 @@ class OrchestrationService(BaseService):
         for tid in thread_ids:
             self.orchestrate_thread(tid, event.correlation_id)
 
+    def on_wave_EmbeddingsGenerated(self, events):
+        """Batched dispatch (services/base.py wave contract): the
+        events arrive one per embed wave but the work is per THREAD —
+        within a fetch wave the same thread recurs many times (bulk
+        ingest emits one event per message), and every trigger before
+        the thread's last one would defer on the unembedded-chunks
+        debounce anyway. Deduplicate: each unique thread orchestrates
+        ONCE, from the finisher of the LAST event that names it (so
+        its SummarizationRequested parents under that envelope's stage
+        span, and a failure nacks the envelope whose redelivery
+        re-covers the thread)."""
+        resolved: list[list[str]] = []
+        owner: dict[str, int] = {}
+        for k, e in enumerate(events):
+            tids = e.thread_ids or self._resolve_threads(e.chunk_ids)
+            resolved.append(tids)
+            for tid in tids:
+                owner[tid] = k          # last event in the wave wins
+        def finisher(k: int, event: ev.EmbeddingsGenerated):
+            def run():
+                for tid in resolved[k]:
+                    if owner[tid] == k:
+                        self.orchestrate_thread(tid,
+                                                event.correlation_id)
+            return run
+
+        return [finisher(k, e) for k, e in enumerate(events)]
+
     def _resolve_threads(self, chunk_ids: list[str]) -> list[str]:
         docs = self.store.query_documents(
             "chunks", {"chunk_id": {"$in": chunk_ids}})

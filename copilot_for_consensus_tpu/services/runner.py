@@ -60,10 +60,17 @@ class Pipeline:
     metrics: InMemoryMetrics
     subscribers: list = field(default_factory=list)
     # Populated when cfg["bus"] names an inter-process driver: one durable
-    # subscriber per service (group = service name), consuming the
-    # external broker directly so ack happens only after the handler
-    # returns — crash before ack ⇒ lease expiry ⇒ redelivery.
+    # subscriber PER WORKER per service (all sharing the service's queue
+    # group, so they compete for messages like the reference's replica
+    # containers), consuming the external broker directly so ack happens
+    # only after the handler returns — crash before ack ⇒ lease expiry ⇒
+    # redelivery.
     ext_subscribers: list = field(default_factory=list)
+    # One StageWorkerPool per owned service on the external-bus tier
+    # (services/pool.py): owns that service's worker subscribers and
+    # their stop-aware consume threads. cfg["services"][<name>]
+    # ["workers"] sizes each pool (default 1).
+    worker_pools: list = field(default_factory=list)
     # Service names this process consumes bus events for (cfg["roles"]);
     # None = all. Other services still exist for their REST/read surface
     # — their events flow to whichever process owns the role.
@@ -211,28 +218,24 @@ class Pipeline:
 
     def run_forever(self, stop) -> None:
         """Blocking pump for server mode: in-proc dispatch, or (external
-        bus) one consume loop per service — each already survives broker
-        outages with backoff-and-reconnect."""
-        import threading
-
+        bus) one StageWorkerPool per service — N stop-aware consume
+        loops each, every loop already surviving broker outages with
+        backoff-and-reconnect."""
         if not self.ext_subscribers:
             return self.broker.run_forever(stop)
-        threads = [threading.Thread(target=sub.start_consuming,
-                                    name=f"bus-consume-{i}", daemon=True)
-                   for i, sub in enumerate(self.ext_subscribers)]
-        for t in threads:
-            t.start()
+        for pool in self.worker_pools:
+            pool.start()
         try:
             stop.wait()
         finally:
             self.stop_throttling()
-            for sub in self.ext_subscribers:
-                sub.stop()
-            for t in threads:
+            for pool in self.worker_pools:
+                pool.stop()
+            for pool in self.worker_pools:
                 # consume loops poll their stop flag each interval;
                 # join so the pump's caller can tear the bus down
                 # without racing an in-flight dispatch
-                t.join(timeout=5.0)
+                pool.join(timeout=5.0)
 
     def ingest_and_run(self, source_id: str) -> dict[str, int]:
         """Trigger a source, run the pipeline to quiescence, return
@@ -476,31 +479,65 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
         roles=frozenset(roles) if roles is not None else None,
         fault_boundary=fault_boundary)
 
+    # Stage scale-out config: cfg["services"][<name>] maps per-service
+    # knobs — "workers" (pool size, default 1), "prefetch" (per-fetch
+    # lease batch, overriding bus.prefetch), "batch" (False disables
+    # wave dispatch for services that define one). ROADMAP item 4: this
+    # is where service concurrency decouples from broker semantics.
+    services_cfg = {str(k): dict(v or {})
+                    for k, v in dict(cfg.get("services") or {}).items()}
+    known_services = {s.name for s in pipeline.services}
+    bad_services = set(services_cfg) - known_services
+    if bad_services:
+        raise ValueError(f"unknown services config keys "
+                         f"{sorted(bad_services)}; known: "
+                         f"{sorted(known_services)}")
     for svc in pipeline.owned_services:
         # One queue group per service: fan-out across services (every
         # stage sees SourceDeletionRequested), competition within one.
         # Same topology on either tier; validation wraps the edge so
         # malformed foreign envelopes quarantine instead of crashing
         # handlers into the DLQ.
+        opts = services_cfg.get(svc.name, {})
         if ext_bus:
             from copilot_for_consensus_tpu.bus.factory import (
                 create_subscriber,
             )
+            from copilot_for_consensus_tpu.services.pool import (
+                StageWorkerPool,
+            )
 
-            sub = create_subscriber({**bus_cfg, "group": svc.name},
-                                    faults=fault_boundary)
-            # Drivers with consumer-side counters/logs (broker dispatch
-            # failures, the servicebus bus_misroute_dropped guard)
-            # share the pipeline's collector — set on the INNER driver:
-            # assigning through the validating wrapper would only
-            # shadow the attribute on the wrapper itself.
-            inner = getattr(sub, "inner", sub)
-            if hasattr(inner, "metrics"):
-                inner.metrics = pipeline.metrics
-            if hasattr(inner, "logger") and svc.logger is not None:
-                inner.logger = svc.logger
-            sub.subscribe(svc.routing_keys(), svc.handle_envelope)
-            pipeline.ext_subscribers.append(sub)
+            workers = max(1, int(opts.get("workers", 1)))
+            sub_cfg = {**bus_cfg, "group": svc.name}
+            if "prefetch" in opts:
+                sub_cfg["prefetch"] = int(opts["prefetch"])
+            wave_keys = (svc.wave_routing_keys()
+                         if opts.get("batch", True) else [])
+            subs = []
+            for _w in range(workers):
+                sub = create_subscriber(dict(sub_cfg),
+                                        faults=fault_boundary)
+                # Drivers with consumer-side counters/logs (broker
+                # dispatch failures, the servicebus bus_misroute_dropped
+                # guard) share the pipeline's collector — set on the
+                # INNER driver: assigning through the validating wrapper
+                # would only shadow the attribute on the wrapper itself.
+                inner = getattr(sub, "inner", sub)
+                if hasattr(inner, "metrics"):
+                    inner.metrics = pipeline.metrics
+                if hasattr(inner, "logger") and svc.logger is not None:
+                    inner.logger = svc.logger
+                sub.subscribe(svc.routing_keys(), svc.handle_envelope)
+                if wave_keys:
+                    # opt-in batch dispatch: fetch waves of these keys
+                    # go through the service's handle_envelopes hot
+                    # path; drivers without batch support return False
+                    # and stay per-envelope
+                    sub.subscribe_batch(wave_keys, svc.handle_envelopes)
+                subs.append(sub)
+                pipeline.ext_subscribers.append(sub)
+            pipeline.worker_pools.append(
+                StageWorkerPool(svc.name, subs, logger=svc.logger))
         else:
             sub = InProcSubscriber(broker=broker, group=svc.name)
             sub.subscribe(svc.routing_keys(), svc.handle_envelope)

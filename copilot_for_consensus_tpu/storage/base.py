@@ -161,6 +161,38 @@ class DocumentStore(abc.ABC):
                 n += 1
         return n
 
+    def get_documents(self, collection: str,
+                      doc_ids: Sequence[str]) -> dict[str, dict[str, Any]]:
+        """Multi-get: ``{doc_id: doc}`` for the ids that exist (missing
+        ids are simply absent — callers decide whether absence is an
+        error). Drivers override with one round-trip; this default
+        loops :meth:`get_document` so every backend keeps exact
+        semantics."""
+        out: dict[str, dict[str, Any]] = {}
+        for doc_id in doc_ids:
+            key = str(doc_id)
+            if key in out:
+                continue
+            doc = self.get_document(collection, key)
+            if doc is not None:
+                out[key] = doc
+        return out
+
+    def update_documents(self, collection: str, doc_ids: Sequence[str],
+                         updates: Mapping[str, Any]) -> int:
+        """Bulk shallow-merge of the SAME updates into many docs;
+        returns how many existed. Drivers override with one
+        transaction; the default loops :meth:`update_document`."""
+        n = 0
+        seen: set[str] = set()
+        for doc_id in doc_ids:
+            key = str(doc_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            n += int(self.update_document(collection, key, updates))
+        return n
+
     def __enter__(self):
         self.connect()
         return self

@@ -72,6 +72,38 @@ class InMemoryDocumentStore(DocumentStore):
             doc.update(copy.deepcopy(dict(updates)))
             return True
 
+    def get_documents(self, collection, doc_ids):
+        # one lock acquisition for the whole wave (the batched hot
+        # paths' multi-get), instead of one per id
+        with self._lock:
+            coll = self._coll(collection)
+            out = {}
+            for doc_id in doc_ids:
+                key = str(doc_id)
+                if key in out:
+                    continue
+                doc = coll.get(key)
+                if doc is not None:
+                    out[key] = copy.deepcopy(doc)
+            return out
+
+    def update_documents(self, collection, doc_ids, updates):
+        with self._lock:
+            coll = self._coll(collection)
+            n = 0
+            seen: set[str] = set()
+            fields = copy.deepcopy(dict(updates))
+            for doc_id in doc_ids:
+                key = str(doc_id)
+                if key in seen:
+                    continue
+                seen.add(key)
+                doc = coll.get(key)
+                if doc is not None:
+                    doc.update(copy.deepcopy(fields))
+                    n += 1
+            return n
+
     def delete_document(self, collection, doc_id):
         with self._lock:
             return self._coll(collection).pop(str(doc_id), None) is not None

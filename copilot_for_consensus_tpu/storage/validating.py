@@ -55,6 +55,26 @@ class ValidatingDocumentStore(DocumentStore):
             self._validate(collection, merged)
         return self.inner.update_document(collection, doc_id, updates)
 
+    def get_documents(self, collection, doc_ids):
+        # Explicit: the base class inherits a concrete loop default, so
+        # without this the wrapper would shadow the inner driver's
+        # one-round-trip multi-get (the race-wrapper-shadow bug class).
+        return self.inner.get_documents(collection, doc_ids)
+
+    def insert_many(self, collection, docs, ignore_duplicates=True):
+        docs = [dict(d) for d in docs]
+        for doc in docs:
+            self._validate(collection, doc)
+        return self.inner.insert_many(collection, docs,
+                                      ignore_duplicates)
+
+    def update_documents(self, collection, doc_ids, updates):
+        fields = dict(updates)
+        current = self.inner.get_documents(collection, doc_ids)
+        for doc in current.values():
+            self._validate(collection, {**doc, **fields})
+        return self.inner.update_documents(collection, doc_ids, fields)
+
     def delete_document(self, collection, doc_id):
         return self.inner.delete_document(collection, doc_id)
 

@@ -1,0 +1,57 @@
+# racecheck fixture: race-thread-lifecycle over the stage-worker-pool
+# shape (services/pool.py) — a pool that spawns per-worker consume
+# threads must give every worker a reachable stop path: a stop-Event-
+# polling loop AND/OR an owner join at shutdown. A fire-and-forget pool
+# races teardown: the broker connection closes under a worker mid-fetch.
+import threading
+import time
+
+
+class BadPool:
+    """Fire-and-forget worker pool: targets spin forever (no stop Event
+    polled) and shutdown() forgets to join — the pool-shutdown bug the
+    StageWorkerPool contract exists to prevent."""
+
+    def __init__(self, subscribers):
+        self.subscribers = list(subscribers)
+        self._threads = []
+
+    def start(self):
+        for sub in self.subscribers:
+            t = threading.Thread(target=self._consume, args=(sub,),
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def _consume(self, sub):
+        while True:
+            time.sleep(0.05)  # jaxlint: disable=blocking-call
+
+    def shutdown(self):
+        self.subscribers.clear()     # workers still running!
+
+
+class GoodPool:
+    """The StageWorkerPool discipline: stop-aware worker loops plus a
+    bounded owner join over the thread list at shutdown."""
+
+    def __init__(self, subscribers):
+        self.subscribers = list(subscribers)
+        self._stop = threading.Event()
+        self._threads = []
+
+    def start(self):
+        for sub in self.subscribers:
+            t = threading.Thread(target=self._consume, args=(sub,),
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def _consume(self, sub):
+        while not self._stop.is_set():
+            self._stop.wait(0.05)
+
+    def shutdown(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)

@@ -268,3 +268,96 @@ def test_telemetry_columns_contract():
         telemetry = None
 
     assert bench.telemetry_columns(Disabled()) == {}
+
+
+def test_pipeline_chaos_preset_enables_worker_pools():
+    """ISSUE 11: the chaos gate must prove its delivery contracts
+    UNDER stage scale-out — competing consumer pools on the host-bound
+    stages, not the old one-consumer-per-service wiring."""
+    assert int(bench.PRESETS["pipeline_chaos"]["BENCH_PIPE_WORKERS"]) >= 2
+
+
+def _scale_bench():
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(bench.__file__).parent
+                           / "scripts"))
+    import scale_bench
+    return scale_bench
+
+
+def test_scale_bench_workers_spec_parsing():
+    sb = _scale_bench()
+    assert sb.parse_workers_spec("") == {}
+    assert sb.parse_workers_spec("1") == {}      # 1 = pre-scale-out
+    assert sb.parse_workers_spec("4") == {
+        "parsing": 4, "chunking": 4, "embedding": 4}
+    assert sb.parse_workers_spec("parsing=2,chunking=6") == {
+        "parsing": 2, "chunking": 6}
+    # prefetch rides the services config next to the pools
+    cfg = sb.services_config({"chunking": 3}, prefetch=32)
+    assert cfg["chunking"] == {"workers": 3, "prefetch": 32}
+    assert cfg["parsing"]["prefetch"] == 32
+
+
+def test_scale_bench_artifact_columns_contract():
+    """The SCALE_BROKER.json columns are a cross-round contract; the
+    scale-out round adds speedup_vs_baseline (vs the 59.6 msg/s
+    single-consumer baseline), per-stage worker counts and the
+    prefetch knob, without renaming the established columns."""
+    sb = _scale_bench()
+    out = sb.broker_artifact(
+        messages=100_000, gen_s=5.0, run_s=167.8, events=337_600,
+        max_depth={"json.parsed": 900}, workers={"chunking": 6},
+        prefetch=64, failure_audit={"events": 0}, stats={"reports": 1},
+        ok=True)
+    assert {"stage", "messages", "generate_s", "pipeline_s",
+            "messages_per_s", "baseline_messages_per_s",
+            "speedup_vs_baseline", "workers", "prefetch",
+            "broker_events", "broker_events_per_s", "max_queue_depth",
+            "queue_depth_slo", "failure_audit", "stats",
+            "ok"} <= set(out)
+    assert out["messages_per_s"] == 595.9
+    assert out["speedup_vs_baseline"] == 10.0
+    assert out["baseline_messages_per_s"] == 59.6
+    # every scalable stage reports a worker count, configured or not
+    assert out["workers"] == {"parsing": 1, "chunking": 6,
+                              "embedding": 1}
+    assert out["prefetch"] == 64
+    assert out["queue_depth_slo"]["worst"] == 900
+    # unconfigured knobs degrade to the pre-scale-out shape
+    base = sb.broker_artifact(
+        messages=10, gen_s=0.0, run_s=1.0, events=30, max_depth={},
+        workers={}, prefetch=0, failure_audit={}, stats={}, ok=False)
+    assert base["workers"] == {"parsing": 1, "chunking": 1,
+                               "embedding": 1}
+    assert base["prefetch"] == 16
+    assert base["queue_depth_slo"]["worst"] == 0
+
+
+import pytest
+
+
+@pytest.mark.slow
+def test_scale_bench_smoke_arm_runs_green():
+    """The CI-runnable small-N arm: broker mode, pools + batching on,
+    toy corpus — asserts the artifact contract end-to-end without
+    touching SCALE_BROKER.json."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    pytest.importorskip("zmq")
+    root = pathlib.Path(bench.__file__).parent
+    out = subprocess.run(
+        [sys.executable, str(root / "scripts" / "scale_bench.py"),
+         "--smoke", "--messages", "240"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    artifact = json.loads(out.stdout.strip().splitlines()[-1])
+    assert artifact["ok"] is True
+    assert artifact["workers"]["chunking"] >= 2
+    assert artifact["speedup_vs_baseline"] > 0
+    assert artifact["stats"]["messages"] == 240

@@ -979,3 +979,333 @@ def test_pipeline_chaos_storm_gate():
     assert "chunking" in out["queue_wait_p95_s"], out
     assert out["backpressure_ok"] and out["storm_ok"], out
     assert out["pipeline_chaos_ok"] is True, out
+
+
+# -- stage scale-out (ISSUE 11): competing consumers + batched dispatch ---
+
+
+def test_queuestore_competing_consumers_never_double_dispatch():
+    """Two fetchers in ONE group over the durable queue store must
+    split the backlog disjointly: fetch atomically moves rows to
+    inflight, so a message can never be leased twice while a lease is
+    live."""
+    store = broker_mod._QueueStore(":memory:")
+    store.bind(["k"], "g")
+    for i in range(30):
+        store.enqueue("k", "{}")
+    a = store.fetch(["k"], "g", 16, 30.0)
+    b = store.fetch(["k"], "g", 16, 30.0)
+    ids_a = {r[0] for r in a}
+    ids_b = {r[0] for r in b}
+    assert not ids_a & ids_b
+    assert len(ids_a | ids_b) == 30
+    store.ack(sorted(ids_a | ids_b))
+    assert store.counts() == {}
+    store.close()
+
+
+def test_queuestore_expired_lease_redelivers_exactly_once():
+    store = broker_mod._QueueStore(":memory:")
+    store.bind(["k"], "g")
+    store.enqueue("k", "{}")
+    (mid, _rk, _env, at0), = store.fetch(["k"], "g", 4, 0.01)
+    assert store.fetch(["k"], "g", 4, 0.01) == []   # leased: invisible
+    time.sleep(0.05)
+    store.expire_leases()
+    redelivered = store.fetch(["k"], "g", 4, 30.0)
+    assert [r[0] for r in redelivered] == [mid]     # same row, once
+    assert store.fetch(["k"], "g", 4, 30.0) == []
+    store.ack([mid])
+    assert store.dead_letters() == []
+    store.close()
+
+
+def test_queuestore_dlq_counts_exact_under_concurrent_nacks():
+    """N worker threads nacking concurrently (half poison, half budget
+    exhaustion) must leave EXACTLY one dead row per message, reasons
+    and attempt counters intact — the competing-consumer quarantine
+    contract."""
+    store = broker_mod._QueueStore(":memory:")
+    store.bind(["k"], "g")
+    for _ in range(12):
+        store.enqueue("k", "{}")
+    rows = store.fetch(["k"], "g", 12, 30.0)
+    assert len(rows) == 12
+    ids = [r[0] for r in rows]
+
+    def poison_nack(batch):
+        store.nack(batch, max_redeliveries=1, poison=True,
+                   reason="schema validation failed: x")
+
+    def budget_nack(batch):
+        store.nack(batch, max_redeliveries=1)
+
+    threads = [threading.Thread(target=poison_nack, args=(ids[i::4],))
+               for i in range(2)]
+    threads += [threading.Thread(target=budget_nack, args=(ids[2 + i::4],))
+                for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dead = store.dead_letters("k")
+    assert len(dead) == 12
+    assert sorted(d[0] for d in dead) == sorted(ids)   # no dup, no loss
+    poisoned = [d for d in dead if d[4].startswith("schema validation")]
+    budgeted = [d for d in dead if d[4] == "redelivery budget exhausted"]
+    assert len(poisoned) == 6 and len(budgeted) == 6
+    assert all(d[3] == 0 for d in poisoned)    # attempts untouched
+    assert all(d[3] == 1 for d in budgeted)
+    store.close()
+
+
+def test_broker_subscriber_prefetch_config_knob():
+    """`bus.prefetch` sizes the per-fetch lease batch (the old
+    hardcoded 16); the legacy `batch` key stays as an alias and
+    prefetch wins when both are set."""
+    stub = StubClient()
+    assert broker_mod.BrokerSubscriber(
+        {"address": "tcp://stub"}, client=stub).batch == 16
+    assert broker_mod.BrokerSubscriber(
+        {"address": "tcp://stub", "prefetch": 48},
+        client=stub).batch == 48
+    assert broker_mod.BrokerSubscriber(
+        {"address": "tcp://stub", "batch": 9}, client=stub).batch == 9
+    assert broker_mod.BrokerSubscriber(
+        {"address": "tcp://stub", "prefetch": 48, "batch": 9},
+        client=stub).batch == 48
+
+
+class StubWaveClient(StubClient):
+    """StubClient whose fetches serve scripted message waves."""
+
+    def __init__(self, waves):
+        super().__init__()
+        self.waves = list(waves)
+
+    def request(self, req):
+        if req.get("op") == "fetch":
+            with self.lock:
+                self.requests.append(dict(req))
+            return {"ok": True,
+                    "msgs": self.waves.pop(0) if self.waves else []}
+        return super().request(req)
+
+
+def _wave_msgs(rk, n, start=1):
+    return [{"id": start + i, "rk": rk, "attempts": 0,
+             "envelope": {"event_type": "JSONParsed", "event_id": f"e{i}",
+                          "data": {"message_doc_id": f"m{i}"}}}
+            for i in range(n)]
+
+
+def test_broker_batch_dispatch_groups_verdicts_per_outcome():
+    """A registered batch route dispatches one fetch wave as ONE
+    callback call; per-envelope outcomes map to grouped verdicts —
+    one ack for the successes, one transient nack, poison nacks with
+    their structured reasons."""
+    from copilot_for_consensus_tpu.core.retry import RetryableError
+
+    stub = StubWaveClient([_wave_msgs("json.parsed", 4)])
+    sub = broker_mod.BrokerSubscriber({"address": "tcp://stub"},
+                                      client=stub)
+    sub.metrics = InMemoryMetrics()
+    waves = []
+
+    def batch_cb(envelopes):
+        waves.append(list(envelopes))
+        return [None, RetryableError("store busy"), None,
+                PoisonEnvelope("schema validation failed: nope")]
+
+    sub.subscribe(["json.parsed"], lambda env: None)
+    assert sub.subscribe_batch(["json.parsed"], batch_cb) is True
+    assert sub.drain(4) == 4
+    assert len(waves) == 1 and len(waves[0]) == 4
+    verdicts = [r for r in stub.requests if r["op"] in ("ack", "nack")]
+    acks = [v for v in verdicts if v["op"] == "ack"]
+    nacks = [v for v in verdicts if v["op"] == "nack"]
+    assert len(acks) == 1 and sorted(acks[0]["ids"]) == [1, 3]
+    transient = [v for v in nacks if not v.get("poison")]
+    poison = [v for v in nacks if v.get("poison")]
+    assert len(transient) == 1 and transient[0]["ids"] == [2]
+    assert len(poison) == 1 and poison[0]["ids"] == [4]
+    assert "schema validation failed" in poison[0]["reason"]
+
+
+def test_broker_batch_callback_raise_falls_back_to_single_dispatch():
+    """A wave-level callback failure degrades to the exact per-envelope
+    path: every message dispatched individually, individually acked."""
+    stub = StubWaveClient([_wave_msgs("json.parsed", 3)])
+    sub = broker_mod.BrokerSubscriber({"address": "tcp://stub"},
+                                      client=stub)
+    sub.metrics = InMemoryMetrics()
+    singles = []
+    sub.subscribe(["json.parsed"], lambda env: singles.append(env))
+
+    def bad_batch(envelopes):
+        raise RuntimeError("whole wave exploded")
+
+    sub.subscribe_batch(["json.parsed"], bad_batch)
+    assert sub.drain(3) == 3
+    assert len(singles) == 3
+    acks = [r for r in stub.requests if r["op"] == "ack"]
+    assert sorted(i for a in acks for i in a["ids"]) == [1, 2, 3]
+    assert not [r for r in stub.requests if r["op"] == "nack"]
+
+
+def test_broker_batch_dispatch_only_groups_registered_keys():
+    """Keys without a batch route keep per-envelope dispatch even when
+    fetched in the same wave as batched keys."""
+    wave = _wave_msgs("json.parsed", 2) + [
+        {"id": 9, "rk": "source.deletion", "attempts": 0,
+         "envelope": {"event_type": "SourceDeletionRequested",
+                      "event_id": "d1", "data": {}}}]
+    stub = StubWaveClient([wave])
+    sub = broker_mod.BrokerSubscriber({"address": "tcp://stub"},
+                                      client=stub)
+    sub.metrics = InMemoryMetrics()
+    singles, batches = [], []
+    sub.subscribe(["json.parsed", "source.deletion"],
+                  lambda env: singles.append(env))
+    sub.subscribe_batch(["json.parsed"],
+                        lambda envs: batches.append(list(envs)))
+    assert sub.drain(3) == 3
+    assert len(batches) == 1 and len(batches[0]) == 2
+    assert len(singles) == 1
+    assert singles[0]["event_type"] == "SourceDeletionRequested"
+
+
+def test_validating_subscriber_batch_quarantines_invalid_per_envelope():
+    """The validating wrapper's batch path must (a) exist explicitly —
+    the base class's concrete `return False` default would otherwise
+    shadow delegation and silently disable batching — and (b) validate
+    per envelope: invalid ones become PoisonEnvelope outcomes without
+    ever reaching the service wave."""
+    captured = {}
+
+    class FakeInner:
+        def subscribe_batch(self, rks, cb):
+            captured["cb"] = cb
+            return True
+
+    invalid_seen = []
+    vsub = ValidatingSubscriber(FakeInner(),
+                                on_invalid=lambda e, x:
+                                invalid_seen.append(e))
+    inner_waves = []
+
+    def service_wave(envelopes):
+        inner_waves.append(list(envelopes))
+        return [None] * len(envelopes)
+
+    assert vsub.subscribe_batch(["archive.ingested"],
+                                service_wave) is True
+    good = ArchiveIngested(archive_id="a1").to_envelope()
+    bad = {"event_type": "ArchiveIngested", "nope": 1}
+    outcomes = captured["cb"]([bad, good, dict(bad)])
+    assert isinstance(outcomes[0], PoisonEnvelope)
+    assert outcomes[1] is None
+    assert isinstance(outcomes[2], PoisonEnvelope)
+    assert len(inner_waves) == 1 and len(inner_waves[0]) == 1
+    assert vsub.invalid_count == 2 and len(invalid_seen) == 2
+
+
+@pytest.mark.slow
+def test_competing_subscribers_on_durable_broker_split_work(tmp_path):
+    """Two real subscribers in one group over the live broker: every
+    message dispatched exactly once across the pool, nothing
+    double-dispatched, nothing lost — the StageWorkerPool's delivery
+    contract."""
+    if not broker_mod.HAS_ZMQ:
+        pytest.skip("pyzmq missing")
+    b = broker_mod.Broker(port=0,
+                          db_path=str(tmp_path / "q.sqlite3")).start()
+    try:
+        pub = broker_mod.BrokerPublisher({"address": b.address})
+        seen: dict[str, list[str]] = {"a": [], "b": []}
+        subs = {}
+        for name in ("a", "b"):
+            sub = broker_mod.BrokerSubscriber(
+                {"address": b.address, "prefetch": 4}, group="svc")
+            sub.subscribe(
+                ["archive.ingested"],
+                lambda env, n=name: seen[n].append(
+                    env["data"]["archive_id"]))
+            subs[name] = sub
+        for i in range(40):
+            pub.publish(ArchiveIngested(archive_id=f"m{i}"))
+        threads = [threading.Thread(target=s.start_consuming)
+                   for s in subs.values()]
+        for t in threads:
+            t.start()
+        assert await_cond(
+            lambda: len(seen["a"]) + len(seen["b"]) >= 40, timeout=20)
+        time.sleep(0.3)          # would-be double dispatches land now
+        for s in subs.values():
+            s.stop()
+        for t in threads:
+            t.join(timeout=5)
+        got = seen["a"] + seen["b"]
+        assert sorted(got) == sorted({f"m{i}" for i in range(40)})
+        assert len(got) == 40                      # exactly once
+        counts = subs["a"].counts(timeout_ms=2000)
+        assert counts.get("archive.ingested", {}).get("pending", 0) == 0
+        for s in subs.values():
+            s.close()
+        pub.close()
+    finally:
+        b.stop()
+
+
+def test_publish_window_groups_wave_publishes_into_one_request():
+    """Grouped publishes: N publish() calls inside a window reach the
+    broker as ONE pub_batch request, in order; depths piggyback."""
+    stub = StubClient()
+    pub = make_publisher(stub)
+    with pub.publish_window():
+        for i in range(5):
+            pub.publish(ArchiveIngested(archive_id=f"a{i}"))
+        # nested window joins the outer one (no premature flush)
+        with pub.publish_window():
+            pub.publish(ArchiveIngested(archive_id="a5"))
+    batches = [r for r in stub.requests if r["op"] == "pub_batch"]
+    singles = [r for r in stub.requests if r["op"] == "pub"]
+    assert len(batches) == 1 and not singles
+    ids = [it["envelope"]["data"]["archive_id"]
+           for it in batches[0]["items"]]
+    assert ids == [f"a{i}" for i in range(6)]
+    assert pub.outbox_stats()["confirmed"] == 6
+    # outside the window, publishes go back to per-event confirms
+    pub.publish(ArchiveIngested(archive_id="solo"))
+    assert [r["op"] for r in stub.requests][-1] == "pub"
+    pub.close()
+
+
+def test_publish_window_outage_parks_whole_window_in_order():
+    stub = StubClient()
+    pub = make_publisher(stub)
+    stub.down = True
+    with pub.publish_window():
+        for i in range(3):
+            pub.publish(ArchiveIngested(archive_id=f"a{i}"))
+    assert pub.outbox.depth() == 3
+    stub.down = False
+    assert await_cond(lambda: pub.outbox.depth() == 0)
+    # replayed oldest-first as singles: order preserved
+    ids = [env["data"]["archive_id"] for _rk, env in stub.published()]
+    assert ids == ["a0", "a1", "a2"]
+    assert pub.outbox_stats()["replayed"] == 3
+    pub.close()
+
+
+def test_queuestore_enqueue_many_one_transaction_depths():
+    store = broker_mod._QueueStore(":memory:")
+    store.bind(["k1"], "g")
+    store.bind(["k2"], "g")
+    depths = store.enqueue_many([("k1", "{}"), ("k2", "{}"),
+                                 ("k1", "{}")])
+    assert depths == {"k1": 2, "k2": 1}
+    counts = store.counts()
+    assert counts["k1"]["pending"] == 2
+    assert counts["k2"]["pending"] == 1
+    store.close()

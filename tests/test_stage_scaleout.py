@@ -1,0 +1,569 @@
+# Stage scale-out (ISSUE 11): StageWorkerPool lifecycle, batched wave
+# dispatch through BaseService.handle_envelopes (per-envelope outcomes,
+# amortized stage spans, fallback isolation), the chunking/parsing
+# batched hot paths, occupancy-aware embed waves, the service-level
+# saturation-snapshot cache, and the runner's services-config wiring.
+import threading
+import time
+
+import pytest
+
+from copilot_for_consensus_tpu.archive.base import InMemoryArchiveStore
+from copilot_for_consensus_tpu.bus.base import PoisonEnvelope
+from copilot_for_consensus_tpu.core import events as ev
+from copilot_for_consensus_tpu.core.retry import RetryConfig, RetryPolicy
+from copilot_for_consensus_tpu.obs import trace
+from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+from copilot_for_consensus_tpu.services.chunking import ChunkingService
+from copilot_for_consensus_tpu.services.embedding import EmbeddingService
+from copilot_for_consensus_tpu.services.parsing import ParsingService
+from copilot_for_consensus_tpu.services.pool import StageWorkerPool
+from copilot_for_consensus_tpu.storage.memory import InMemoryDocumentStore
+
+
+class CapturePublisher:
+    def __init__(self):
+        self.events = []
+
+    def publish(self, event, routing_key=None):
+        # stamp the trace block like real publishers do, so the wave
+        # span-DAG assertions see the publish spans
+        trace.inject(event.to_envelope(), type(event).routing_key)
+        self.events.append(event)
+
+    def publish_envelope(self, envelope, routing_key=None):
+        self.events.append(envelope)
+
+    def of(self, cls):
+        return [e for e in self.events if isinstance(e, cls)]
+
+
+def fast_retry():
+    return RetryPolicy(RetryConfig(max_attempts=2, base_delay=0.001,
+                                   max_delay=0.001))
+
+
+def make_chunking(store=None):
+    store = store or InMemoryDocumentStore()
+    pub = CapturePublisher()
+    svc = ChunkingService(pub, store, retry=fast_retry(),
+                          metrics=InMemoryMetrics())
+    return svc, store, pub
+
+
+def seed_messages(store, n, prefix="m"):
+    ids = []
+    for i in range(n):
+        mid = f"{prefix}{i}"
+        store.insert_document("messages", {
+            "message_doc_id": mid, "archive_id": "a0",
+            "source_id": "s0", "thread_id": f"t{i % 2}",
+            "body": "alpha beta gamma delta " * 12,
+            "chunked": False})
+        ids.append(mid)
+    return ids
+
+
+def parsed_envelopes(ids):
+    return [ev.JSONParsed(message_doc_id=m, archive_id="a0",
+                          thread_id="t0").to_envelope() for m in ids]
+
+
+# -- wave dispatch: chunking ------------------------------------------------
+
+
+def test_chunking_wave_batches_roundtrips_and_publishes_per_message():
+    svc, store, pub = make_chunking()
+    ids = seed_messages(store, 6)
+
+    calls = {"get": 0, "multi": 0}
+    orig_get = store.get_document
+    orig_multi = store.get_documents
+    store.get_document = lambda *a: (calls.__setitem__(
+        "get", calls["get"] + 1) or orig_get(*a))
+    store.get_documents = lambda *a: (calls.__setitem__(
+        "multi", calls["multi"] + 1) or orig_multi(*a))
+
+    outcomes = svc.handle_envelopes(parsed_envelopes(ids))
+    assert outcomes == [None] * 6
+    # ONE multi-get for the wave, zero per-message reads
+    assert calls == {"get": 0, "multi": 1}
+    assert store.count_documents("chunks", {}) >= 6
+    assert all(store.get_document("messages", m)["chunked"]
+               for m in ids)
+    prepared = pub.of(ev.ChunksPrepared)
+    assert sorted(e.message_doc_id for e in prepared) == sorted(ids)
+    for e in prepared:
+        assert e.chunk_ids
+        assert all(store.get_document("chunks", c) for c in e.chunk_ids)
+
+
+def test_chunking_wave_replay_is_idempotent():
+    svc, store, pub = make_chunking()
+    ids = seed_messages(store, 3)
+    envs = parsed_envelopes(ids)
+    assert svc.handle_envelopes(envs) == [None] * 3
+    n_chunks = store.count_documents("chunks", {})
+    # redelivered wave (at-least-once): no duplicate chunks, events
+    # re-publish (downstream embedding skips already-embedded chunks)
+    assert svc.handle_envelopes(envs) == [None] * 3
+    assert store.count_documents("chunks", {}) == n_chunks
+
+
+def test_chunking_wave_missing_message_isolates_to_single_dispatch():
+    """One message missing from the store fails the WAVE, which falls
+    back to per-envelope dispatch: present messages chunk + publish,
+    only the missing one takes the retry/failure path."""
+    svc, store, pub = make_chunking()
+    ids = seed_messages(store, 2)
+    envs = parsed_envelopes(ids + ["ghost"])
+    outcomes = svc.handle_envelopes(envs)
+    assert outcomes[0] is None and outcomes[1] is None
+    assert outcomes[2] is None   # retries exhausted → failure event+ack
+    assert all(store.get_document("messages", m)["chunked"]
+               for m in ids)
+    assert sorted(e.message_doc_id for e in pub.of(ev.ChunksPrepared)) \
+        == sorted(ids)
+    failed = pub.of(ev.ChunkingFailed)
+    assert len(failed) == 1 and failed[0].message_doc_id == "ghost"
+    assert svc.metrics.counter_value(
+        "chunking_wave_fallback_total", {"event": "JSONParsed"}) == 1
+
+
+def test_wave_spans_amortized_per_envelope_with_worker_label():
+    collector = trace.configure(capacity=10_000)
+    svc, store, pub = make_chunking()
+    ids = seed_messages(store, 4)
+    trace.set_worker_label("chunking-w2")
+    try:
+        svc.handle_envelopes(parsed_envelopes(ids))
+    finally:
+        trace.set_worker_label("")
+    stage = [s for s in collector.spans()
+             if s.kind == "stage" and s.service == "chunking"]
+    assert len(stage) == 4
+    for s in stage:
+        assert s.attrs.get("wave") == 4
+        assert s.attrs.get("worker") == "chunking-w2"
+        assert s.duration_s > 0          # amortized share included
+        assert s.status == "ok"
+    # follow-up publishes parent under THEIR envelope's stage span
+    pubs = [s for s in collector.spans() if s.kind == "publish"]
+    stage_ids = {(s.trace_id, s.span_id) for s in stage}
+    assert pubs and all(
+        (p.trace_id, p.parent_span_id) in stage_ids for p in pubs)
+
+
+def test_wave_outcomes_cover_mixed_event_types():
+    """Envelopes of a type without a wave handler ride the single path
+    inside handle_envelopes; outcomes stay positionally aligned."""
+    svc, store, pub = make_chunking()
+    ids = seed_messages(store, 2)
+    envs = parsed_envelopes(ids)
+    deletion = ev.SourceDeletionRequested(
+        source_id="s0", requested_by="ops").to_envelope()
+    outcomes = svc.handle_envelopes([envs[0], deletion, envs[1]])
+    assert outcomes == [None, None, None]
+    assert pub.of(ev.SourceCleanupProgress)
+
+
+# -- wave dispatch: parsing -------------------------------------------------
+
+
+def _tiny_mbox(n, prefix):
+    out = []
+    for i in range(n):
+        out.append(
+            f"From x@y Thu Jan  1 00:00:00 2026\n"
+            f"From: P{i} <p{i}@example.org>\n"
+            f"Message-ID: <{prefix}-{i}@t>\n"
+            f"Subject: Draft {prefix}\n"
+            f"Date: Thu, 1 Jan 2026 00:00:00 +0000\n"
+            f"\nbody {prefix} {i}\n\n")
+    return "".join(out).encode()
+
+
+def make_parsing():
+    store = InMemoryDocumentStore()
+    archive_store = InMemoryArchiveStore()
+    pub = CapturePublisher()
+    svc = ParsingService(pub, store, archive_store, retry=fast_retry(),
+                         metrics=InMemoryMetrics())
+    return svc, store, archive_store, pub
+
+
+def seed_archives(store, archive_store, n_archives=2, msgs=3):
+    ids = []
+    for a in range(n_archives):
+        aid = f"arch{a}"
+        store.insert_document("archives", {
+            "archive_id": aid, "source_id": "s0", "parsed": False})
+        archive_store.save(aid, _tiny_mbox(msgs, f"a{a}"))
+        ids.append(aid)
+    return ids
+
+
+def test_parsing_wave_bulk_inserts_and_publishes_per_archive():
+    svc, store, archive_store, pub = make_parsing()
+    ids = seed_archives(store, archive_store, 2, 3)
+    envs = [ev.ArchiveIngested(archive_id=a, source_id="s0",
+                               archive_uri="u").to_envelope()
+            for a in ids]
+    outcomes = svc.handle_envelopes(envs)
+    assert outcomes == [None, None]
+    assert store.count_documents("messages", {}) == 6
+    assert store.count_documents("threads", {}) >= 2
+    parsed = pub.of(ev.JSONParsed)
+    assert len(parsed) == 6
+    assert {e.archive_id for e in parsed} == set(ids)
+    for a in ids:
+        assert store.get_document("archives", a)["parsed"] is True
+    # redelivered wave: no new inserts; stored-but-unchunked messages
+    # republish (the crash-window cover — duplicates are idempotent
+    # downstream), fully processed ones would stay quiet
+    pub.events.clear()
+    assert svc.handle_envelopes(envs) == [None, None]
+    assert store.count_documents("messages", {}) == 6
+    assert len(pub.of(ev.JSONParsed)) == 6
+
+
+def test_parsing_single_path_uses_bulk_writes():
+    """process_archive (the non-wave path) rides the same batched
+    storing phase: one existing-ids multi-get + one insert_many
+    instead of insert_or_ignore per message."""
+    svc, store, archive_store, pub = make_parsing()
+    (aid,) = seed_archives(store, archive_store, 1, 5)
+    calls = {"ins": 0, "many": 0}
+    orig_ins = store.insert_document
+    orig_many = store.insert_many
+    store.insert_document = lambda *a, **k: (calls.__setitem__(
+        "ins", calls["ins"] + 1) or orig_ins(*a, **k))
+    store.insert_many = lambda *a, **k: (calls.__setitem__(
+        "many", calls["many"] + 1) or orig_many(*a, **k))
+    assert svc.process_archive(aid) == 5
+    assert calls["many"] == 1
+    assert len(pub.of(ev.JSONParsed)) == 5
+
+
+# -- occupancy-aware embed waves -------------------------------------------
+
+
+class VecStore:
+    def __init__(self):
+        self.items = []
+
+    def add_embeddings(self, items):
+        self.items.extend(items)
+
+
+class Provider:
+    dimension = 4
+    model_name = "stub"
+
+    def embed_batch(self, texts):
+        return [[0.0] * 4 for _ in texts]
+
+
+def make_embedding(occ, batch_size=64):
+    store = InMemoryDocumentStore()
+    pub = CapturePublisher()
+    svc = EmbeddingService(pub, store, Provider(), VecStore(),
+                           batch_size=batch_size,
+                           occupancy_fn=lambda: occ,
+                           retry=fast_retry(),
+                           metrics=InMemoryMetrics())
+    return svc, store, pub
+
+
+@pytest.mark.parametrize("occ,expected", [
+    (None, 64),      # no telemetry → fixed base (mock drivers)
+    (0.0, 128),      # idle engine → double wave (fill the tile)
+    (1.0, 32),       # saturated → half wave (protect interactive)
+    (1.5, 32),       # clamped occupancy
+    (2.0 / 3.0, 64)  # the neutral point: base size
+])
+def test_effective_batch_size_tracks_engine_headroom(occ, expected):
+    svc, _store, _pub = make_embedding(occ)
+    assert svc.effective_batch_size() == expected
+
+
+def test_embed_wave_uses_dynamic_size_and_bulk_flag_flip():
+    svc, store, pub = make_embedding(1.0, batch_size=4)   # wave = 2
+    chunk_ids = []
+    for i in range(5):
+        cid = f"c{i}"
+        store.insert_document("chunks", {
+            "chunk_id": cid, "thread_id": "t0", "message_doc_id": "m0",
+            "source_id": "s0", "text": "hello",
+            "embedding_generated": False})
+        chunk_ids.append(cid)
+    waves = []
+    orig = svc.provider.embed_batch
+    svc.provider.embed_batch = lambda texts: (
+        waves.append(len(texts)) or orig(texts))
+    bulk = {"n": 0}
+    orig_bulk = store.update_documents
+    store.update_documents = lambda *a, **k: (bulk.__setitem__(
+        "n", bulk["n"] + 1) or orig_bulk(*a, **k))
+    assert svc.process_chunks(chunk_ids) == 5
+    assert waves == [2, 2, 1]            # occupancy-sized waves
+    assert bulk["n"] == 3                # one bulk flip per wave
+    docs = store.query_documents("chunks", {})
+    assert all(d["embedding_generated"] for d in docs)
+    assert len(pub.of(ev.EmbeddingsGenerated)) == 1
+
+
+# -- service-level saturation snapshot cache --------------------------------
+
+
+def test_saturation_snapshot_shared_across_pool_workers():
+    class CountingPublisher:
+        saturation_refresh_s = 30.0
+
+        def __init__(self):
+            self.polls = 0
+
+        def saturation(self):
+            self.polls += 1
+            return {"json.parsed": 99}
+
+        def publish(self, *a, **k):
+            pass
+
+    from copilot_for_consensus_tpu.services.base import BaseService
+
+    pub = CountingPublisher()
+    svc = BaseService(pub, InMemoryDocumentStore(),
+                      metrics=InMemoryMetrics(),
+                      throttle_pause_s=0.0)
+    for _ in range(20):
+        svc._bus_throttle()
+    # N events (across N workers) share ONE poll per refresh window
+    assert pub.polls == 1
+    # every event still throttled off the shared snapshot
+    assert svc.metrics.counter_value("bus_throttle_total",
+                                     {"service": "base"}) == 20
+
+
+def test_saturation_snapshot_refreshes_after_ttl():
+    class CountingPublisher:
+        saturation_refresh_s = 0.02
+
+        def __init__(self):
+            self.polls = 0
+
+        def saturation(self):
+            self.polls += 1
+            return {}
+
+    from copilot_for_consensus_tpu.services.base import BaseService
+
+    pub = CountingPublisher()
+    svc = BaseService(pub, InMemoryDocumentStore(),
+                      metrics=InMemoryMetrics())
+    svc._bus_throttle()
+    time.sleep(0.04)
+    svc._bus_throttle()
+    assert pub.polls == 2
+
+
+# -- StageWorkerPool lifecycle ---------------------------------------------
+
+
+class StubSubscriber:
+    def __init__(self):
+        self._stop = threading.Event()
+        self.started = threading.Event()
+        self.label_seen = ""
+        self.closed = False
+
+    def start_consuming(self):
+        self.label_seen = trace.worker_label()
+        self.started.set()
+        while not self._stop.wait(0.01):
+            pass
+
+    def stop(self):
+        self._stop.set()
+
+    def close(self):
+        self.closed = True
+
+
+def test_stage_worker_pool_lifecycle_and_labels():
+    subs = [StubSubscriber() for _ in range(3)]
+    pool = StageWorkerPool("chunking", subs)
+    assert pool.workers == 3
+    pool.start()
+    assert all(s.started.wait(2) for s in subs)
+    # idempotent start: no thread leak while workers live
+    pool.start()
+    assert len(pool._threads) == 3
+    assert sorted(s.label_seen for s in subs) == [
+        "chunking-w0", "chunking-w1", "chunking-w2"]
+    pool.stop()
+    assert pool.join(timeout=5)
+    assert not any(t.is_alive() for t in pool._threads)
+    # the worker label never leaks onto the pool owner's thread
+    assert trace.worker_label() == ""
+    pool.close()
+    assert all(s.closed for s in subs)
+
+
+# -- runner wiring ----------------------------------------------------------
+
+
+def test_build_pipeline_rejects_unknown_services_key():
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    with pytest.raises(ValueError, match="unknown services"):
+        build_pipeline({"services": {"chunker": {"workers": 4}}})
+
+
+def test_build_pipeline_inproc_ignores_worker_pools():
+    from copilot_for_consensus_tpu.services.runner import build_pipeline
+
+    p = build_pipeline({"services": {"chunking": {"workers": 4}}})
+    assert p.worker_pools == []          # pools are an ext-bus feature
+    assert len(p.subscribers) == 7
+
+
+def test_embedding_wave_merges_events_and_publishes_per_envelope():
+    svc, store, pub = make_embedding(None, batch_size=64)
+    for i in range(6):
+        store.insert_document("chunks", {
+            "chunk_id": f"c{i}", "thread_id": f"t{i % 2}",
+            "message_doc_id": f"m{i}", "source_id": "s0",
+            "text": "hello", "embedding_generated": False})
+    events = [ev.ChunksPrepared(message_doc_id=f"m{i}", thread_id="",
+                                archive_id="a0",
+                                chunk_ids=[f"c{2 * i}", f"c{2 * i + 1}"]
+                                ).to_envelope() for i in range(3)]
+    provider_calls = []
+    orig = svc.provider.embed_batch
+    svc.provider.embed_batch = lambda texts: (
+        provider_calls.append(len(texts)) or orig(texts))
+    assert svc.handle_envelopes(events) == [None, None, None]
+    # whole wave in ONE provider call (6 ≤ effective batch)
+    assert provider_calls == [6]
+    assert all(d["embedding_generated"]
+               for d in store.query_documents("chunks", {}))
+    gen = pub.of(ev.EmbeddingsGenerated)
+    assert len(gen) == 3                    # one per envelope
+    assert sorted(c for e in gen for c in e.chunk_ids) == [
+        f"c{i}" for i in range(6)]
+    # replayed wave: nothing re-embedded, nothing re-published
+    pub.events.clear()
+    provider_calls.clear()
+    assert svc.handle_envelopes(events) == [None, None, None]
+    assert provider_calls == []
+    assert pub.of(ev.EmbeddingsGenerated) == []
+
+
+def test_orchestrator_wave_dedupes_threads_to_last_event():
+    from copilot_for_consensus_tpu.services.orchestrator import (
+        OrchestrationService,
+    )
+
+    store = InMemoryDocumentStore()
+    pub = CapturePublisher()
+    svc = OrchestrationService(pub, store, retry=fast_retry(),
+                               metrics=InMemoryMetrics())
+    orchestrated = []
+    svc.orchestrate_thread = lambda tid, corr="": orchestrated.append(
+        (tid, corr))
+    events = [
+        ev.EmbeddingsGenerated(chunk_ids=["c1"], thread_ids=["t1"],
+                               correlation_id="e0").to_envelope(),
+        ev.EmbeddingsGenerated(chunk_ids=["c2"],
+                               thread_ids=["t1", "t2"],
+                               correlation_id="e1").to_envelope(),
+        ev.EmbeddingsGenerated(chunk_ids=["c3"], thread_ids=["t1"],
+                               correlation_id="e2").to_envelope(),
+    ]
+    assert svc.handle_envelopes(events) == [None, None, None]
+    # each unique thread orchestrated ONCE, owned by its LAST event
+    assert sorted(orchestrated) == [("t1", "e2"), ("t2", "e1")]
+
+
+# -- review-pass regressions ------------------------------------------------
+
+
+def test_embedding_wave_unknown_event_nacks_not_acks():
+    """An event whose chunks are ALL invisible (store-visibility race)
+    must come back as a retryable outcome — never a silent ack that
+    strands its thread behind the orchestrator debounce — while the
+    rest of the wave proceeds."""
+    from copilot_for_consensus_tpu.core.retry import RetryableError
+
+    svc, store, pub = make_embedding(None)
+    store.insert_document("chunks", {
+        "chunk_id": "c0", "thread_id": "t0", "message_doc_id": "m0",
+        "source_id": "s0", "text": "x", "embedding_generated": False})
+    events = [
+        ev.ChunksPrepared(message_doc_id="m0", thread_id="t0",
+                          archive_id="a", chunk_ids=["c0"]).to_envelope(),
+        ev.ChunksPrepared(message_doc_id="m9", thread_id="t9",
+                          archive_id="a",
+                          chunk_ids=["ghost1", "ghost2"]).to_envelope(),
+    ]
+    outcomes = svc.handle_envelopes(events)
+    assert outcomes[0] is None
+    assert isinstance(outcomes[1], RetryableError)
+    assert len(pub.of(ev.EmbeddingsGenerated)) == 1
+    # no terminal failure event: the envelope redelivers instead
+    assert pub.of(ev.EmbeddingGenerationFailed) == []
+
+
+def test_wave_finisher_retryable_error_is_transient_not_poison():
+    """A RetryableError from a finisher (the orchestrator's
+    DocumentNotFoundError on the thread-doc visibility race) must nack
+    for redelivery, not quarantine + *Failed."""
+    from copilot_for_consensus_tpu.core.retry import (
+        DocumentNotFoundError,
+        RetryableError,
+    )
+    from copilot_for_consensus_tpu.services.orchestrator import (
+        OrchestrationService,
+    )
+
+    store = InMemoryDocumentStore()
+    pub = CapturePublisher()
+    svc = OrchestrationService(pub, store, retry=fast_retry(),
+                               metrics=InMemoryMetrics())
+
+    def raise_nf(tid, corr=""):
+        raise DocumentNotFoundError(f"thread {tid} not in store")
+
+    svc.orchestrate_thread = raise_nf
+    env = ev.EmbeddingsGenerated(chunk_ids=["c1"],
+                                 thread_ids=["t1"]).to_envelope()
+    (outcome,) = svc.handle_envelopes([env])
+    assert isinstance(outcome, RetryableError)
+    assert not isinstance(outcome, PoisonEnvelope)
+    assert pub.of(ev.OrchestrationFailed) == []
+
+
+def test_parsing_wave_redelivery_republish_covers_crash_window():
+    """Messages inserted by a crashed previous attempt (stored,
+    unchunked, events never published) must republish on redelivery —
+    the bulk-insert path widened the old per-message crash window to
+    the whole wave."""
+    svc, store, archive_store, pub = make_parsing()
+    (aid,) = seed_archives(store, archive_store, 1, 3)
+    env = ev.ArchiveIngested(archive_id=aid, source_id="s0",
+                             archive_uri="u").to_envelope()
+    assert svc.handle_envelopes([env]) == [None]
+    assert len(pub.of(ev.JSONParsed)) == 3
+    # crash-window simulation: chunking never ran (chunked stays
+    # False), the event redelivers → the publishes regenerate
+    pub.events.clear()
+    assert svc.handle_envelopes([env]) == [None]
+    assert len(pub.of(ev.JSONParsed)) == 3
+    # once chunked, redelivery goes quiet again
+    for d in store.query_documents("messages", {}):
+        store.update_document("messages", d["message_doc_id"],
+                              {"chunked": True})
+    pub.events.clear()
+    assert svc.handle_envelopes([env]) == [None]
+    assert pub.of(ev.JSONParsed) == []

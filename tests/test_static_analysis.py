@@ -278,6 +278,11 @@ def _race_findings(fixture: str, rule: str):
      "GoodLedger"),
     ("thread_lifecycle.py", "race-thread-lifecycle", "BadPump",
      "GoodPump"),
+    # pool-shutdown tripwire (ISSUE 11): a worker pool whose consume
+    # threads have no stop path must flag; the StageWorkerPool shape
+    # (stop-aware loops + owner join over the list) must stay clean
+    ("pool_shutdown.py", "race-thread-lifecycle", "BadPool",
+     "GoodPool"),
     ("wrapper_shadow.py", "race-wrapper-shadow", "BadWrapper",
      "GoodWrapper"),
 ])

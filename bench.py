@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -191,7 +192,22 @@ PRESETS = {
                        # delivery contracts (lost 0 / dup 0 / exact
                        # quarantine) are proven UNDER competing
                        # consumers + batched waves, not single-threaded
-                       "BENCH_PIPE_WORKERS": "2"},
+                       "BENCH_PIPE_WORKERS": "2",
+                       # process-kill phase (ISSUE 12): a REAL child
+                       # process SIGKILLed after step N of a journaled
+                       # engine storm, then warm-restarted from the
+                       # journal — gates lost 0 / duplicated 0 /
+                       # journal_replayed > 0 / bit-identical (f32)
+                       "BENCH_KILL_REQUESTS": "12",
+                       "BENCH_KILL_NEW_TOKENS": "24",
+                       "BENCH_KILL_STEP": "8",
+                       "BENCH_KILL_SEED": "7",
+                       # graceful-drain arm: a fault-free run drained
+                       # mid-wave (readyz 503 → pools stop → engines
+                       # drain → outbox flush) then warm-resumed —
+                       # gates zero shutdown-caused redeliveries
+                       "BENCH_PIPE_DRAIN_MESSAGES": "400",
+                       "BENCH_PIPE_DRAIN_ARCHIVES": "2"},
     "mixed_traffic": {"BENCH_MAX_LEN": "1024", "BENCH_SLOTS": "32",
                       "BENCH_KV_DTYPE": "bfloat16",
                       "BENCH_NEW_TOKENS": "64",
@@ -328,6 +344,14 @@ def pipeline_chaos_columns(audit: dict) -> dict:
         "queue_wait_p95_s": dict(audit.get("queue_wait_p95_s", {})),
         "bottleneck_stage": str(audit.get("bottleneck_stage", "")),
         "orphan_spans": int(audit.get("orphan_spans", 0)),
+        # process-lifecycle columns (engine/journal.py +
+        # services/lifecycle.py, ISSUE 12): journal rows replayed by
+        # the kill phase's warm restart, and broker redeliveries
+        # CAUSED by the graceful-drain arm's shutdown (zero is the
+        # gate — a clean drain nacks nothing)
+        "journal_replayed": int(audit.get("journal_replayed", 0)),
+        "shutdown_redeliveries": int(
+            audit.get("shutdown_redeliveries", 0)),
     }
 
 
@@ -1020,6 +1044,116 @@ def chaos_headline() -> dict:
 
 # -- pipeline chaos gate (bus/faults.py + broker ride-through) ----------
 
+def journal_kill_phase(tmp, knob) -> dict:
+    """Process-kill chaos (ISSUE 12): three REAL child processes over
+    the journal-storm driver (tools/journal_storm.py) —
+
+    1. reference: uninterrupted journaled run → per-request outputs;
+    2. kill: same storm, SIGKILL after step N (mid-storm: queued
+       requests, active slots, partially-checkpointed tokens);
+    3. resume: fresh process over the SAME journal — the engine
+       warm-restarts, resubmits unfinished work as prompt+generated
+       continuations, and serves it to completion.
+
+    Gate: every request completes exactly once across kill+resume
+    (lost 0, duplicated 0), the resume replayed journal rows
+    (journal_replayed > 0), the journal drained (final depth 0), and
+    every greedy output is bit-identical (f32) to the reference."""
+    import pathlib
+
+    tmp = pathlib.Path(tmp)
+    tmp.mkdir(parents=True, exist_ok=True)
+    requests = int(knob("BENCH_KILL_REQUESTS", "12"))
+    new_tokens = int(knob("BENCH_KILL_NEW_TOKENS", "24"))
+    kill_step = int(knob("BENCH_KILL_STEP", "8"))
+    seed = int(knob("BENCH_KILL_SEED", "7"))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def child(journal, out, result, kill_after=0):
+        cmd = [sys.executable, "-m",
+               "copilot_for_consensus_tpu.tools.journal_storm",
+               "--journal", str(journal), "--out", str(out),
+               "--result", str(result),
+               "--requests", str(requests),
+               "--new-tokens", str(new_tokens), "--seed", str(seed)]
+        if kill_after:
+            cmd += ["--kill-after-step", str(kill_after)]
+        try:
+            return subprocess.run(cmd, env=env, capture_output=True,
+                                  text=True, timeout=300)
+        except subprocess.TimeoutExpired as exc:
+            # a wedged child is a FAILED gate, not a bench crash: the
+            # other arms' results must survive it
+            return subprocess.CompletedProcess(
+                cmd, returncode=-999,
+                stdout="", stderr=f"child timed out: {exc}")
+
+    def read_lines(path):
+        out, dup = {}, 0
+        if not os.path.exists(path):
+            return out, dup
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                d = json.loads(line)
+                if d["cid"] in out:
+                    dup += 1
+                out[d["cid"]] = d["tokens"]
+        return out, dup
+
+    log("pipeline_chaos: kill phase — reference child")
+    r = child(tmp / "ref.sqlite3", tmp / "ref.jsonl", tmp / "ref.json")
+    if r.returncode != 0:
+        log(f"pipeline_chaos: reference child failed: {r.stderr[-400:]}")
+        return {"kill_ok": False, "reason": "reference-child-failed"}
+    ref, _ = read_lines(tmp / "ref.jsonl")
+
+    log(f"pipeline_chaos: kill phase — SIGKILL after step {kill_step}")
+    r = child(tmp / "kill.sqlite3", tmp / "kill.jsonl",
+              tmp / "kill.json", kill_after=kill_step)
+    killed = r.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL,
+                              137)
+    if not killed:
+        log(f"pipeline_chaos: kill child was NOT killed "
+            f"(rc {r.returncode}); storm finished before step "
+            f"{kill_step}?")
+
+    log("pipeline_chaos: kill phase — warm-restart child")
+    r = child(tmp / "kill.sqlite3", tmp / "kill.jsonl",
+              tmp / "resume.json")
+    if r.returncode != 0:
+        log(f"pipeline_chaos: resume child failed: {r.stderr[-400:]}")
+        return {"kill_ok": False, "reason": "resume-child-failed",
+                "process_killed": killed}
+    with open(tmp / "resume.json", encoding="utf-8") as f:
+        resume = json.load(f)
+
+    got, dup = read_lines(tmp / "kill.jsonl")
+    lost = [c for c in ref if c not in got]
+    mismatched = [c for c in got if got[c] != ref.get(c)]
+    out = {
+        "requests": requests,
+        "process_killed": killed,
+        "lost": len(lost),
+        "duplicated": dup,
+        "mismatched": len(mismatched),
+        "journal_replayed": int(resume.get("journal_replayed", 0)),
+        "journal_abandoned": int(resume.get("journal_abandoned", 0)),
+        "journal_depth": int(resume.get("journal_depth", -1)),
+        "bit_identical": not mismatched and not lost,
+    }
+    out["kill_ok"] = bool(
+        killed and not lost and dup == 0 and not mismatched
+        and out["journal_replayed"] > 0 and out["journal_depth"] == 0)
+    log(f"pipeline_chaos: kill phase — lost {out['lost']}, dup "
+        f"{out['duplicated']}, journal_replayed "
+        f"{out['journal_replayed']}, depth {out['journal_depth']}, "
+        f"bit_identical {out['bit_identical']}, ok {out['kill_ok']}")
+    return out
+
+
 def pipeline_chaos_headline() -> dict:
     """Pipeline-wide fault gate (the PR-8 tentpole; see the preset
     comment for the arm/phase script). Runs the REAL deployment
@@ -1083,11 +1217,16 @@ def pipeline_chaos_headline() -> dict:
 
     def run_arm(tmp: pathlib.Path, messages: int, archives: int, *,
                 watermark: int, drag: float = 0.0, faults=None,
-                storm: bool = False) -> dict:
+                storm: bool = False, drain_midway: bool = False
+                ) -> dict:
         """One pipeline arm over a fresh broker + stores. ``drag``
         slows the chunking handler (scripted sustained overload: drain
         deliberately below supply); ``storm`` adds the broker restart
-        and poison phases on top of the ``faults`` plan."""
+        and poison phases on top of the ``faults`` plan;
+        ``drain_midway`` executes the graceful-drain lifecycle
+        (services/lifecycle.py) with waves in flight, then
+        warm-resumes — the SIGTERM-mid-traffic shape, gated on zero
+        shutdown-caused redeliveries."""
         tmp.mkdir(parents=True, exist_ok=True)
         per = messages // archives
         sizes = [per] * (archives - 1) + [messages - per * (archives - 1)]
@@ -1228,6 +1367,42 @@ def pipeline_chaos_headline() -> dict:
                 raw.close()
                 poison_sent = n_poison
 
+        drain_info = None
+        if drain_midway:
+            # Graceful drain with waves in flight (the SIGTERM shape):
+            # readiness flips, pools stop-and-join (in-flight
+            # dispatches finish and ACK — nothing nacked), mock
+            # engines have nothing to drain, outboxes flush. Then
+            # warm-resume (drain aborted → READY, pools respawn) and
+            # run to completion: any redelivery in this FAULT-FREE arm
+            # was caused by the shutdown itself, and the gate is zero.
+            from copilot_for_consensus_tpu.services.lifecycle import (
+                ServiceLifecycle,
+                drain_pipeline,
+            )
+
+            lc = ServiceLifecycle("pipeline")
+            lc.mark_ready()
+            report = drain_pipeline(p, lc, deadline_s=30.0)
+            b = holder["broker"]
+            counts = b.store.counts() if b is not None else {}
+            drain_info = {
+                "consumers_stopped": report["consumers_stopped"],
+                "outbox_flushed": report["outbox_flushed"],
+                "duration_s": report["duration_s"],
+                # a clean drain leaves ZERO leases: nothing to expire,
+                # nothing for the broker to redeliver afterwards
+                "inflight_after_drain": sum(
+                    st.get("inflight", 0) for st in counts.values()),
+                "state_after_drain": lc.state,
+            }
+            log(f"pipeline_chaos: drained mid-wave "
+                f"({drain_info['inflight_after_drain']} leases left) "
+                f"in {drain_info['duration_s']}s; warm-resuming")
+            lc.mark_ready()
+            for pool in p.worker_pools:
+                pool.start()
+
         def busy_now() -> int:
             b = holder["broker"]
             if b is None:
@@ -1340,9 +1515,7 @@ def pipeline_chaos_headline() -> dict:
 
         p.stop_throttling()
         for pool in p.worker_pools:
-            pool.stop()
-        for pool in p.worker_pools:
-            pool.join(timeout=5)
+            pool.stop()      # flips flags AND joins (logs stuck workers)
         for sub in p.ext_subscribers:
             sub.close()
         stop_sampler.set()
@@ -1374,6 +1547,12 @@ def pipeline_chaos_headline() -> dict:
             "threads": threads_n,
             "threads_missing_summary": missing,
             "trace": trace_report,
+            # stage-span deliveries with a redelivery attempt > 0 —
+            # in a fault-free arm every one was shutdown-caused
+            "redelivered_spans": sum(
+                1 for s in trace_collector.spans()
+                if getattr(s, "attempt", 0) > 0),
+            "drain": drain_info,
         }
 
     tmp_root = pathlib.Path(tempfile.mkdtemp(prefix="pipe-chaos-"))
@@ -1407,6 +1586,22 @@ def pipeline_chaos_headline() -> dict:
             f"restart + faults + {n_poison} poison)")
         storm = run_arm(tmp_root / "storm", msgs_storm, n_arch,
                         watermark=hw, faults=storm_plan, storm=True)
+
+        # graceful-drain arm (ISSUE 12): fault-free, drained mid-wave
+        # through the lifecycle sequence then warm-resumed — zero
+        # redeliveries proves shutdown itself nacked nothing
+        msgs_drain = int(knob("BENCH_PIPE_DRAIN_MESSAGES", "400"))
+        n_arch_drain = int(knob("BENCH_PIPE_DRAIN_ARCHIVES", "2"))
+        log(f"pipeline_chaos: graceful-drain arm ({msgs_drain} msgs, "
+            f"drain mid-wave + warm resume)")
+        drain_arm = run_arm(tmp_root / "drain", msgs_drain,
+                            n_arch_drain, watermark=hw,
+                            drain_midway=True)
+
+        # process-kill phase (ISSUE 12): journaled engine storm in a
+        # child process, SIGKILL mid-storm, warm restart from the
+        # journal
+        kill = journal_kill_phase(tmp_root / "kill", knob)
     finally:
         shutil.rmtree(tmp_root, ignore_errors=True)
 
@@ -1421,12 +1616,27 @@ def pipeline_chaos_headline() -> dict:
                 and storm["redelivered"] >= 1
                 and storm["final_depth_max"] < scaled_slo
                 and storm["trace"]["orphan_spans"] == 0)
-    pipeline_chaos_ok = bool(backpressure_ok and storm_ok)
+    # graceful drain: everything still completed, the drain sequence
+    # ran to the end (consumers joined, outbox flushed, zero leases
+    # left behind), and the arm saw ZERO redeliveries — shutdown
+    # itself nacked nothing
+    drain_state = drain_arm.get("drain") or {}
+    graceful_drain_ok = (
+        drain_arm["lost"] == 0
+        and bool(drain_state.get("consumers_stopped"))
+        and bool(drain_state.get("outbox_flushed"))
+        and drain_state.get("inflight_after_drain", 1) == 0
+        and drain_arm["redelivered_spans"] == 0)
+    kill_ok = bool(kill.get("kill_ok"))
+    pipeline_chaos_ok = bool(backpressure_ok and storm_ok
+                             and graceful_drain_ok and kill_ok)
     msg_s = storm["messages"] / max(storm["run_s"], 1e-6)
     audit = {
         **{k: storm[k] for k in
            ("lost", "duplicated", "quarantined", "replayed_publishes",
             "redelivered", "recovered_by_sweep", "final_depth_max")},
+        "journal_replayed": kill.get("journal_replayed", 0),
+        "shutdown_redeliveries": drain_arm["redelivered_spans"],
         "max_depth_backpressure_on": on["worst_depth"],
         "max_depth_backpressure_off": off["worst_depth"],
         # stage attribution from the sustained-overload arm (the
@@ -1444,7 +1654,9 @@ def pipeline_chaos_headline() -> dict:
         f"{storm['redelivered']}, depth on/off {on['worst_depth']}/"
         f"{off['worst_depth']}, bottleneck "
         f"{on['trace']['bottleneck_stage'] or '<none>'}, orphan spans "
-        f"{storm['trace']['orphan_spans']}, ok {pipeline_chaos_ok}")
+        f"{storm['trace']['orphan_spans']}, drain_ok "
+        f"{graceful_drain_ok}, kill_ok {kill_ok}, "
+        f"ok {pipeline_chaos_ok}")
     return {
         "metric": f"host pipeline under seeded storm (broker restart "
                   f"+ store faults + consumer crash + poison + "
@@ -1465,9 +1677,12 @@ def pipeline_chaos_headline() -> dict:
         "faults_fired": storm["faults_fired"],
         "backpressure_ok": backpressure_ok,
         "storm_ok": storm_ok,
+        "graceful_drain_ok": graceful_drain_ok,
+        "kill_ok": kill_ok,
         "pipeline_chaos_ok": pipeline_chaos_ok,
         "max_queue_depth_storm": storm["max_depth"],
         "fault_plan": storm_plan,
+        "kill_phase": kill,
         "arms": {
             "backpressure_off": {k: off[k] for k in
                                  ("messages", "run_s", "worst_depth",
@@ -1479,6 +1694,14 @@ def pipeline_chaos_headline() -> dict:
                                  "throttle_waits", "max_depth")},
             "storm": {k: v for k, v in storm.items()
                       if k != "max_depth"},
+            "graceful_drain": {
+                "messages": drain_arm["messages"],
+                "run_s": drain_arm["run_s"],
+                "lost": drain_arm["lost"],
+                "duplicated": drain_arm["duplicated"],
+                "redelivered_spans": drain_arm["redelivered_spans"],
+                "drain": drain_state,
+            },
         },
     }
 

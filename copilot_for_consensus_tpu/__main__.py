@@ -54,7 +54,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
-    server.stop()
+    # Graceful drain (services/lifecycle.py; docs/runbooks/
+    # rolling-restart.md): readiness flips 503 FIRST, pools stop
+    # consuming (nothing nacked — the broker redelivers nothing after
+    # a clean drain), the engine finishes active slots up to the
+    # drain deadline then evacuates-and-journals the rest, the publish
+    # outbox flushes, and only then does the process exit. A second
+    # signal during the drain is absorbed (the stop event is already
+    # set); SIGKILL remains the hard path the engine journal exists
+    # for.
+    report = server.drain()
+    print(json.dumps({"event": "drained", **report}), flush=True)
     return 0
 
 

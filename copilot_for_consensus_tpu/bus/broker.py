@@ -1015,6 +1015,12 @@ class BrokerSubscriber(EventSubscriber):
         self._batch_routes: dict[str, Any] = {}
         self._counts_client: _Client | None = None
         self._stop = threading.Event()
+        #: (rk, what, started_at) of the in-progress handler dispatch
+        #: — what StageWorkerPool.stop() names when this consumer's
+        #: worker thread fails to join. Written only by the consume
+        #: thread; other threads take a stale-tolerant snapshot read
+        #: (GIL-atomic tuple swap, the azure_monitor counter pattern).
+        self._current: tuple | None = None
 
     def subscribe(self, routing_keys, callback):
         for rk in routing_keys:
@@ -1083,6 +1089,16 @@ class BrokerSubscriber(EventSubscriber):
         return {"op": "nack", "ids": [msg["id"]], "poison": True,
                 "reason": reason[:500]}
 
+    def current_dispatch(self) -> str | None:
+        """Human-readable description of the in-progress handler
+        dispatch (None when idle) — the stuck-worker diagnostic
+        ``StageWorkerPool.stop()`` logs on a join timeout."""
+        cur = self._current
+        if cur is None:
+            return None
+        rk, what, t0 = cur
+        return f"{rk} {what} ({time.monotonic() - t0:.1f}s)"
+
     def _dispatch(self, msg: dict) -> None:
         from copilot_for_consensus_tpu.obs import trace
 
@@ -1093,10 +1109,14 @@ class BrokerSubscriber(EventSubscriber):
             # so a retried delivery's stage span says so
             trace.annotate_delivery(msg["envelope"],
                                     int(msg.get("attempts", 0)))
+            self._current = (msg["rk"], f"id={msg['id']}",
+                             time.monotonic())
             try:
                 cb(msg["envelope"])
             except Exception as exc:
                 verdict = self._classify_failure(msg, exc)
+            finally:
+                self._current = None
         if self.faults is not None:
             try:
                 self.faults.check("ack")
@@ -1157,6 +1177,7 @@ class BrokerSubscriber(EventSubscriber):
         for m in msgs:
             trace.annotate_delivery(m["envelope"],
                                     int(m.get("attempts", 0)))
+        self._current = (rk, f"wave x{len(msgs)}", time.monotonic())
         try:
             outcomes = cb([m["envelope"] for m in msgs])
             if outcomes is None:
@@ -1165,6 +1186,8 @@ class BrokerSubscriber(EventSubscriber):
             for m in msgs:
                 self._dispatch(m)
             return
+        finally:
+            self._current = None
         acks = [m["id"] for m, out in zip(msgs, outcomes) if out is None]
         nacks = [(m, out) for m, out in zip(msgs, outcomes)
                  if out is not None]

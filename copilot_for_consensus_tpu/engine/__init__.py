@@ -12,6 +12,10 @@ from copilot_for_consensus_tpu.engine.faults import (
     FaultSpec,
     InjectedFault,
 )
+from copilot_for_consensus_tpu.engine.journal import (
+    EngineJournal,
+    JournalEntry,
+)
 from copilot_for_consensus_tpu.engine.scheduler import (
     EngineOverloaded,
     Scheduler,
@@ -55,6 +59,8 @@ __all__ = [
     "FaultPlan",
     "FaultInjector",
     "InjectedFault",
+    "EngineJournal",
+    "JournalEntry",
     "CircuitBreaker",
     "EngineFailed",
     "EngineSupervisor",

@@ -180,6 +180,13 @@ class AsyncEngineRunner:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stop = False
+        #: set by stop(): wakes a drain() poll so shutdown never waits
+        #: out the full drain deadline
+        self._stop_evt = threading.Event()
+        #: submits popped off _pending but not yet registered in
+        #: _handles (the dispatcher's handoff window) — drain() must
+        #: not read that instant as idle
+        self._admitting = 0
         self._thread: threading.Thread | None = None
         #: monotonic start of the in-progress eng.step(), None when idle
         #: — what stop() names when the dispatcher fails to join
@@ -199,6 +206,15 @@ class AsyncEngineRunner:
     def start(self) -> "AsyncEngineRunner":
         if self._thread is not None:
             raise RuntimeError("runner already started")
+        if self.supervisor is not None and getattr(
+                self.engine, "journal_replayed", 0):
+            # Restart-time audit (docs/RESILIENCE.md#process-lifecycle):
+            # the engine warm-restarted from a non-empty journal, so
+            # verify/repair its host invariants BEFORE the dispatcher
+            # takes ownership — the same audit that runs after a
+            # contained in-process failure. This thread still owns the
+            # engine here (the dispatcher has not started).
+            self.supervisor.audit(repair=True)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="engine-dispatch")
         self._thread.start()
@@ -218,6 +234,7 @@ class AsyncEngineRunner:
         if fi is not None:
             # shutdown must never wait out a scripted chaos hang
             fi.release_hangs()
+        self._stop_evt.set()
         with self._work:
             self._stop = True
             self._work.notify()
@@ -245,9 +262,83 @@ class AsyncEngineRunner:
                 except Exception:
                     pass   # logging must not mask the condition
             self._thread = None
+        if joined:
+            # Evacuate-and-journal: with the dispatcher joined this
+            # thread owns the engine again — checkpoint every active
+            # slot's accepted tokens so the rows a warm restart resumes
+            # from are as fresh as the work was. Rows are NOT abandoned
+            # on stop: a stop is the crash-only discipline's clean
+            # case, and the journal is what makes restart cost latency
+            # instead of work.
+            self._journal_checkpoint_remaining()
         if self.supervisor is not None:
             self.supervisor.stop()
         return joined
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful-drain wait (services/lifecycle.py): block until the
+        engine has no pending submits, no outstanding handles and no
+        queued/active work, or ``timeout`` expires. Returns True when
+        fully drained. On False the caller proceeds to :meth:`stop`,
+        which checkpoints the remaining work's accepted tokens into
+        the journal — evacuate-and-journal — so the next process
+        resumes it. Stop-aware: a concurrent ``stop()`` ends the wait
+        immediately."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._work:
+                idle = (not self._pending and not self._handles
+                        and not self._admitting
+                        and self._engine_idle(self.engine))
+            if idle:
+                return True
+            if self._stop_evt.wait(0.02):
+                break
+        return False
+
+    def _journal_checkpoint_remaining(self) -> None:
+        """Best-effort final checkpoint of every active slot (engine-
+        owner thread only — callers hold ownership: stop() after a
+        clean join)."""
+        j = getattr(self.engine, "journal", None)
+        if j is None:
+            return
+        try:
+            pairs = []
+            for slot, req in getattr(self.engine, "_active",
+                                     {}).items():
+                gen = self.engine._generated.get(slot)
+                if gen:
+                    pairs.append((req.request_id, gen))
+            if pairs:
+                j.checkpoint_many(pairs)
+        except Exception:
+            pass    # journaling must never break shutdown
+
+    def _journal_abandon(self, request_ids) -> None:
+        """Delete journal rows for requests whose terminal structured
+        failure was DELIVERED to a live caller — the caller owns the
+        retry; replaying at the next restart would duplicate work the
+        caller already saw fail."""
+        j = getattr(self.engine, "journal", None)
+        if j is None:
+            return
+        stitch = getattr(self.engine, "_journal_stitch", None)
+        ckpt = getattr(self.engine, "_journal_ckpt", None)
+        for rid in request_ids:
+            if rid is None or rid < 0:
+                continue    # never submitted: no row exists
+            try:
+                j.record_abandon(rid)
+            except Exception:
+                pass    # journaling must never mask the failure
+            # prune the engine-side per-rid bookkeeping too, or a
+            # long-lived process leaks one entry per abandoned request
+            # (dict pops are GIL-atomic; stale-miss is harmless)
+            if stitch is not None:
+                stitch.pop(rid, None)
+            if ckpt is not None:
+                ckpt.pop(rid, None)
 
     def _step_elapsed(self) -> float:
         t0 = self._step_t0
@@ -336,6 +427,11 @@ class AsyncEngineRunner:
             "failed": self.replay_failed,
             "suspect_failures": self.suspect_failures,
         }
+        j = getattr(self.engine, "journal", None)
+        if j is not None:
+            out["journal"] = j.stats()
+            out["journal_replayed"] = getattr(
+                self.engine, "journal_replayed", 0)
         if self.supervisor is not None:
             s = self.supervisor.stats()
             out["watchdog_trips"] = s["watchdog_trips"]
@@ -361,6 +457,11 @@ class AsyncEngineRunner:
         if getattr(eng, "_chunking", None) \
                 or getattr(eng, "_chunk_pending", None):
             return False
+        if getattr(eng, "_done", None):
+            # completions parked for harvest (e.g. journal-recovered
+            # rows that were already fully generated): one more step()
+            # drains them and retires their journal rows
+            return False
         sched = getattr(eng, "_sched", None)
         return sched is None or sched.queued == 0
 
@@ -378,6 +479,7 @@ class AsyncEngineRunner:
                     stopping = False
                     fresh = self._pending
                     self._pending = []
+                    self._admitting = len(fresh)
             if stopping:
                 # Fail every outstanding handle promptly — a caller
                 # blocked in result() must not sit out its full
@@ -403,12 +505,15 @@ class AsyncEngineRunner:
                     rid = eng.submit(prompt, mnt, **kw)
                 except Exception as exc:
                     h._fail(exc)
+                    with self._work:
+                        self._admitting -= 1
                     continue
                 h.request_id = rid
                 # _handles/_replays are shared with the watchdog
                 # thread's _on_suspect — every mutation holds the lock
                 with self._work:
                     self._handles[rid] = h
+                    self._admitting -= 1
             t0 = time.monotonic()
             self._step_t0 = t0
             if sup is not None:
@@ -439,6 +544,8 @@ class AsyncEngineRunner:
                         self._handles.clear()
                     for h in victims:
                         h._fail(exc)
+                    self._journal_abandon(
+                        h.request_id for h in victims)
                 continue
             finally:
                 if sup is not None:
@@ -490,6 +597,8 @@ class AsyncEngineRunner:
                         self._replays.pop(rid, None)
                     if h is not None:
                         h._fail(exc)
+                self._journal_abandon(
+                    getattr(req, "request_id", None) for req in dropped)
                 sup.audit(repair=True)
 
     # -- failure handling ------------------------------------------------
@@ -509,6 +618,7 @@ class AsyncEngineRunner:
             self._replays.clear()
         for h in victims:
             h._fail(exc)
+        self._journal_abandon(h.request_id for h in victims)
         self.suspect_failures += len(victims)
 
     def _recover(self, exc: BaseException) -> None:
@@ -529,13 +639,16 @@ class AsyncEngineRunner:
             # callback raced past).
             exc_s = sup.last_suspect or EngineSuspect(
                 "engine suspect (watchdog)")
-            for req in sup.purge_queued():
+            purged = sup.purge_queued()
+            for req in purged:
                 rid = getattr(req, "request_id", None)
                 with self._work:
                     h = self._handles.pop(rid, None)
                     self._replays.pop(rid, None)
                 if h is not None:
                     h._fail(exc_s)
+            self._journal_abandon(
+                getattr(req, "request_id", None) for req in purged)
         budget = sup.cfg.replay_budget
         for req, gen in plan.evacuated:
             with self._work:
@@ -563,6 +676,14 @@ class AsyncEngineRunner:
                     prompt_len=meta.prompt_len,
                     tokens=tokens[:meta.max_new_tokens],
                     finish_reason="length"))
+                j = getattr(self.engine, "journal", None)
+                if j is not None:
+                    try:
+                        # completed, just harvested off the failure
+                        # path: the row retires like any completion
+                        j.record_retire(req.request_id)
+                    except Exception:
+                        pass
                 continue
             limit = getattr(self.engine, "prompt_limit", None)
             if attempts > budget or (
@@ -587,6 +708,7 @@ class AsyncEngineRunner:
                     correlation_id=req.correlation_id,
                     attempts=attempts - 1, reason=reason,
                     flight_record=self._last_dump_path))
+                self._journal_abandon([req.request_id])
                 continue
             kw: dict = {}
             if req.cache_eligible_tokens is not None:
@@ -600,21 +722,41 @@ class AsyncEngineRunner:
             if req.deadline_at != float("inf"):
                 kw["deadline_s"] = max(
                     0.0, req.deadline_at - time.monotonic())
+            j = getattr(self.engine, "journal", None)
             try:
                 # The continuation: everything accepted so far becomes
                 # prompt (seeded prefill re-derives the KV the failed
                 # cache held; greedy decode continues bit-identically —
                 # the chunked-prefill identity argument,
-                # docs/RESILIENCE.md).
-                new_rid = self.engine.submit(
-                    list(req.prompt) + list(gen), remaining, **kw)
+                # docs/RESILIENCE.md). With a journal, the
+                # continuation's row is the ATOMIC supersede re-key of
+                # the original's below — record_submit is suppressed so
+                # the journal never holds two live rows for one
+                # request (a crash anywhere here replays exactly one).
+                if j is not None:
+                    self.engine._journal_suppress = True
+                try:
+                    new_rid = self.engine.submit(
+                        list(req.prompt) + list(gen), remaining, **kw)
+                finally:
+                    if j is not None:
+                        self.engine._journal_suppress = False
             except Exception as sub_exc:
                 # e.g. EngineOverloaded while shedding under the
                 # lowered cap — structured, honest, final for this
                 # handle
                 h._fail(sub_exc)
+                self._journal_abandon([req.request_id])
                 continue
             h.request_id = new_rid
+            if j is not None:
+                try:
+                    # re-key the journal row onto the continuation so
+                    # a PROCESS death mid-replay still recovers the
+                    # original request identity
+                    j.supersede(req.request_id, new_rid, tokens)
+                except Exception:
+                    pass
             with self._work:
                 self._handles[new_rid] = h
                 self._replays[new_rid] = _ReplayState(
@@ -653,13 +795,22 @@ class AsyncEngineRunner:
                 f"{type(exc).__name__}: {exc})",
                 reason="engine-unhealthy",
                 flight_record=self._last_dump_path)
-            self.suspect_failures += self._fail_outstanding(term)
-            sup.purge_queued()
+            self.suspect_failures += self._fail_outstanding(
+                term, abandon_journal=True)
+            purged = sup.purge_queued()
+            self._journal_abandon(
+                getattr(req, "request_id", None) for req in purged)
 
-    def _fail_outstanding(self, exc: BaseException) -> int:
+    def _fail_outstanding(self, exc: BaseException, *,
+                          abandon_journal: bool = False) -> int:
         """Fail every pending and in-engine handle with ``exc``
         (lock-held sweep shared by the watchdog callback and the
-        unhealthy terminal gate). Returns how many were failed."""
+        unhealthy terminal gate). Returns how many were failed.
+        ``abandon_journal=True`` (the TERMINAL sweeps: unhealthy,
+        suspect) also deletes the victims' journal rows — the callers
+        were told, so a restart must not replay their work. The STOP
+        sweeps leave rows in place: stop is the crash-only clean case
+        and the journal is what a warm restart resumes from."""
         with self._work:
             victims = ([h for *_r, h in self._pending]
                        + list(self._handles.values()))
@@ -668,6 +819,8 @@ class AsyncEngineRunner:
             self._replays.clear()
         for h in victims:
             h._fail(exc)
+        if abandon_journal:
+            self._journal_abandon(h.request_id for h in victims)
         return len(victims)
 
     def _report_engine_error(self, exc: BaseException) -> None:

@@ -66,6 +66,7 @@ from copilot_for_consensus_tpu.engine.scheduler import (
     jain_index,
     resolve_scheduler,
 )
+from copilot_for_consensus_tpu.engine.journal import resolve_journal
 from copilot_for_consensus_tpu.engine.telemetry import resolve_telemetry
 from copilot_for_consensus_tpu.engine.tokenizer import (
     NgramDraftIndex,
@@ -212,6 +213,7 @@ class GenerationEngine:
         telemetry: Any = True,
         scheduler: Any = None,
         faults: Any = None,
+        journal: Any = None,
     ):
         self.profile_dir = profile_dir
         # Resilience plane (engine/faults.py + engine/supervisor.py;
@@ -223,6 +225,34 @@ class GenerationEngine:
         # same boundary, and may lower ``_slot_cap`` (resource breaker)
         # or veto the verify dispatch (spec breaker).
         self.faults = resolve_faults(faults)
+        # Durable request journal (engine/journal.py;
+        # docs/RESILIENCE.md#process-lifecycle): submits journal before
+        # admission, accepted tokens checkpoint incrementally, retire
+        # deletes — and a non-empty journal at construction warm-
+        # restarts: unfinished requests resubmit as prompt+generated
+        # continuations (the PR-7 replay identity: greedy bit-identical
+        # at f32), so a serving-process SIGKILL costs latency, not work.
+        self.journal = resolve_journal(journal)
+        #: journal rows resubmitted at warm restart this process
+        self.journal_replayed = 0
+        #: journal rows that could NOT be resumed (continuation past
+        #: prompt_limit) — honest loss accounting, never silent
+        self.journal_abandoned = 0
+        #: (new rid, correlation_id) pairs recovered at construction —
+        #: callers that want to harvest/publish recovered completions
+        #: read this to re-attach identities (the journal storm driver)
+        self.journal_recovered: list[tuple[int, str]] = []
+        #: rid → (original prompt_len, resumed token prefix): stitched
+        #: back onto the continuation's completion at harvest
+        self._journal_stitch: dict[int, tuple[int, list[int]]] = {}
+        #: rid → generated-token count at last checkpoint (lag gauge)
+        self._journal_ckpt: dict[int, int] = {}
+        self._journal_steps = 0
+        self._journal_recovering = False
+        #: True while a resubmission's row is provided by an ATOMIC
+        #: journal.supersede re-key instead of record_submit — the
+        #: journal must never hold two live rows for one request
+        self._journal_suppress = False
         self.supervisor: Any = None
         self._last_failed_kind = ""
         self._slot_cap = num_slots
@@ -849,6 +879,13 @@ class GenerationEngine:
         self._row_tokens = 0
         self._row_passes = 0
 
+        # Warm restart LAST: every queue/slot/scheduler structure above
+        # must exist before recovered requests resubmit through the
+        # normal submit() path (which rebuilds the scheduler ledgers
+        # and telemetry spans as a side effect).
+        if self.journal is not None and self.journal.depth():
+            self._recover_from_journal()
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -918,13 +955,43 @@ class GenerationEngine:
             # head no longer matches any cacheable span
             cache_eligible_tokens = 0 if cache_eligible_tokens \
                 is not None else None
-        if self._sched is not None:
+        if self._sched is not None and not self._journal_recovering:
+            # Warm-restart resubmits bypass the shed gate: journaled
+            # work was already admitted once, and shedding it at
+            # restart would turn a crash into silent loss — exactly
+            # what the journal exists to prevent. The recovered burst
+            # still queues through the scheduler (fairness holds).
             self._sched.check_admission(
                 tenant=tenant, priority=priority,
                 prompt_tokens=len(prompt),
                 correlation_id=correlation_id)
         rid = self._next_id
         self._next_id += 1
+        if self.journal is not None:
+            if not self._journal_suppress:
+                # Journal BEFORE the request enters any queue: no
+                # window where admitted work is journal-invisible.
+                # Suppressed for continuation resubmits, whose row is
+                # the atomic supersede re-key of the ORIGINAL row —
+                # never insert-then-re-key, which would leave two live
+                # rows if a crash landed between. Trace parent is
+                # captured here so a restart's engine_replay span can
+                # parent into the originating pipeline trace.
+                from copilot_for_consensus_tpu.obs import (
+                    trace as _trace,
+                )
+
+                ids = _trace.current_ids()
+                self.journal.record_submit(
+                    rid, prompt, max_new_tokens,
+                    cache_eligible_tokens=cache_eligible_tokens,
+                    correlation_id=correlation_id, tenant=tenant,
+                    priority=priority,
+                    deadline_wall=(time.time() + max(0.0, deadline_s)
+                                   if deadline_s is not None else 0.0),
+                    trace_id=ids[0] if ids else "",
+                    span_id=ids[1] if ids else "")
+            self._journal_ckpt[rid] = 0
         if deadline_s is not None:
             self._deadlines_in_use = True
         req = Request(
@@ -960,6 +1027,8 @@ class GenerationEngine:
             self._chunk_step()
         if self._active or self._prefilling:
             self._decode_once()
+        if self.journal is not None:
+            self._journal_tick()
         if self.telemetry is not None:
             self.telemetry.gauge_queue(self.queue_depth,
                                        len(self._active))
@@ -1052,6 +1121,25 @@ class GenerationEngine:
                 self._row_tokens / self._row_passes
                 if self._row_passes else 0.0),
         }
+        return out
+
+    def journal_stats(self) -> dict:
+        """Durable-journal counters for benches/metrics (mirrors
+        ``prefix_stats``). ``replayed`` counts this process's
+        warm-restart resubmissions; ``abandoned`` counts rows that
+        could not be resumed (continuation past ``prompt_limit``);
+        the rest come from :meth:`EngineJournal.stats`."""
+        out = {
+            "enabled": self.journal is not None,
+            "replayed": self.journal_replayed,
+            "abandoned": self.journal_abandoned,
+        }
+        if self.journal is not None:
+            s = self.journal.stats()
+            out["depth"] = s["depth"]
+            out["journaled"] = s["journaled"]
+            out["retired"] = s["retired"]
+            out["checkpoints"] = s["checkpoints"]
         return out
 
     def sched_stats(self) -> dict:
@@ -2005,10 +2093,147 @@ class GenerationEngine:
                 self.spec_stats() if self.spec_decode else None)
         self._free.append(slot)
 
+    def _journal_tick(self) -> None:
+        """Incremental token checkpoints (engine/journal.py): every
+        ``checkpoint_every`` decode steps, and on any step that retired
+        a request (``per-retire``: the surviving slots' progress is
+        durable before the completed work's rows delete). Also exports
+        the journal gauges."""
+        j = self.journal
+        self._journal_steps += 1
+        if self._active and (self._done
+                             or self._journal_steps
+                             >= j.checkpoint_every):
+            self._journal_steps = 0
+            pairs = []
+            for slot, req in self._active.items():
+                gen = self._generated.get(slot)
+                if gen:
+                    pairs.append((req.request_id, gen))
+                    self._journal_ckpt[req.request_id] = len(gen)
+            if pairs:
+                j.checkpoint_many(pairs)
+        if self.telemetry is not None:
+            lag = 0
+            for slot, req in self._active.items():
+                gen = self._generated.get(slot)
+                if gen:
+                    lag = max(lag, len(gen) - self._journal_ckpt.get(
+                        req.request_id, 0))
+            self.telemetry.gauge_journal(j.depth(), lag)
+
     def _drain_done(self) -> list[Completion]:
-        out = list(self._done.values())
+        out = []
+        for c in self._done.values():
+            st = self._journal_stitch.pop(c.request_id, None)
+            if st is not None:
+                # Stitch the continuation back onto the ORIGINAL
+                # identity (the runner's _ReplayState move, one level
+                # down): the harvester sees one completion with the
+                # original prompt length and the full token stream.
+                plen, prefix = st
+                c = Completion(
+                    request_id=c.request_id, prompt_len=plen,
+                    tokens=prefix + c.tokens,
+                    finish_reason=c.finish_reason,
+                    prefill_s=c.prefill_s, decode_s=c.decode_s)
+            out.append(c)
+        if self.journal is not None and out:
+            # Retire at harvest: the row leaves the journal in the same
+            # step() call that returns the completion. A SIGKILL inside
+            # this window replays the request — at-least-once, absorbed
+            # by the pipeline supersede contract (docs/RESILIENCE.md).
+            for c in out:
+                self.journal.record_retire(c.request_id)
+                self._journal_ckpt.pop(c.request_id, None)
         self._done.clear()
         return out
+
+    def _recover_from_journal(self) -> int:
+        """Warm restart (construction time, single-owner thread):
+        resubmit every unfinished journaled request as a
+        prompt+generated continuation through the normal submit path —
+        scheduler ledgers and telemetry spans rebuild as a side effect
+        — and re-key each row onto its continuation id. Requests whose
+        wall-clock deadline expired during the outage complete as
+        honest ``finish_reason="deadline"`` drops; continuations that
+        no longer fit ``prompt_limit`` are abandoned (counted), never
+        silently head-truncated into divergence."""
+        from copilot_for_consensus_tpu.obs import trace as _trace
+
+        entries = self.journal.unfinished()
+        if not entries:
+            return 0
+        # Continuation ids must never collide with journaled ids: a
+        # fresh engine counts from 0, and a reused id would make the
+        # supersede re-key and the retire delete hit the WRONG row.
+        self._next_id = max(self._next_id,
+                            max(e.request_id for e in entries) + 1)
+        now_wall = time.time()
+        self._journal_recovering = True
+        self._journal_suppress = True
+        try:
+            for e in entries:
+                done = min(len(e.tokens), e.max_new_tokens)
+                remaining = e.max_new_tokens - done
+                if e.deadline_wall and e.deadline_wall <= now_wall:
+                    self.deadline_expired += 1
+                    self._done[e.request_id] = Completion(
+                        request_id=e.request_id,
+                        prompt_len=len(e.prompt),
+                        tokens=list(e.tokens)[:done],
+                        finish_reason="deadline")
+                    continue
+                if remaining <= 0:
+                    # Fully generated before the crash (which landed
+                    # between the final checkpoint and the retire):
+                    # emit, don't recompute.
+                    self._done[e.request_id] = Completion(
+                        request_id=e.request_id,
+                        prompt_len=len(e.prompt),
+                        tokens=list(e.tokens)[:e.max_new_tokens],
+                        finish_reason="length")
+                    continue
+                prompt = list(e.prompt) + list(e.tokens)
+                if len(prompt) > self.prompt_limit:
+                    # submit() would head-truncate and the continuation
+                    # would diverge from the fault-free stream — honest
+                    # abandonment over silent divergence.
+                    self.journal.record_abandon(e.request_id)
+                    self.journal_abandoned += 1
+                    continue
+                kw: dict = {}
+                if e.deadline_wall:
+                    kw["deadline_s"] = e.deadline_wall - now_wall
+                rid = self.submit(
+                    prompt, remaining,
+                    cache_eligible_tokens=e.cache_eligible_tokens,
+                    correlation_id=e.correlation_id, tenant=e.tenant,
+                    priority=e.priority or "interactive", **kw)
+                self.journal.supersede(e.request_id, rid, e.tokens)
+                self._journal_stitch[rid] = (len(e.prompt),
+                                             list(e.tokens))
+                self._journal_ckpt[rid] = 0
+                self.journal_recovered.append((rid, e.correlation_id))
+                self.journal_replayed += 1
+                if self.telemetry is not None:
+                    self.telemetry.on_journal_replayed()
+                if e.trace_id and e.span_id:
+                    # attempt-numbered replay annotation in the
+                    # ORIGINATING pipeline trace (never a fresh orphan
+                    # root — parentless recoveries skip the span)
+                    with _trace.span(
+                            "engine_replay", kind="engine_replay",
+                            service="engine",
+                            correlation_id=e.correlation_id,
+                            attempt=e.attempt + 1,
+                            parent=(e.trace_id, e.span_id),
+                            request_id=rid, restart=True):
+                        pass
+        finally:
+            self._journal_recovering = False
+            self._journal_suppress = False
+        return self.journal_replayed
 
 
 # ---------------------------------------------------------------------------

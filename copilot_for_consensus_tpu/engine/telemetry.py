@@ -175,6 +175,23 @@ METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "counter", ("engine",),
         "Requests dropped (not computed) because their per-request "
         "deadline_s expired before completion."),
+    # ---- durable request journal (engine/journal.py;
+    # docs/RESILIENCE.md#process-lifecycle) ----
+    "engine_journal_depth": (
+        "gauge", ("engine",),
+        "Unfinished requests in the durable engine journal (queued + "
+        "in-flight); a depth that never drains while the engine is "
+        "idle means rows leaked (EngineJournalBacklog alert)."),
+    "engine_journal_replayed_total": (
+        "counter", ("engine",),
+        "Journaled requests resubmitted as prompt+generated "
+        "continuations at warm restart (restart costs latency, not "
+        "work)."),
+    "engine_journal_checkpoint_lag": (
+        "gauge", ("engine",),
+        "Largest per-request accepted-token count not yet "
+        "checkpointed to the journal — the tokens a crash right now "
+        "would recompute."),
 }
 
 #: step-record kinds the engines emit (doc + test anchor)
@@ -520,6 +537,18 @@ class EngineTelemetry:
         self.metrics.increment(
             "engine_recovery_deadline_expired_total", float(n),
             self._labels)
+
+    # -- durable request journal (engine/journal.py) --------------------
+
+    def gauge_journal(self, depth: int, checkpoint_lag: int) -> None:
+        m, lb = self.metrics, self._labels
+        m.gauge("engine_journal_depth", float(depth), lb)
+        m.gauge("engine_journal_checkpoint_lag", float(checkpoint_lag),
+                lb)
+
+    def on_journal_replayed(self, n: int = 1) -> None:
+        self.metrics.increment("engine_journal_replayed_total",
+                               float(n), self._labels)
 
     def update_ledgers(self, prefix_stats: dict | None = None,
                        spec_stats: dict | None = None) -> None:

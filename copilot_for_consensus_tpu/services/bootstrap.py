@@ -217,13 +217,32 @@ class _BusGaugeMetrics:
 
 @dataclass
 class PipelineServer:
-    """Single-process deployment: full pipeline + gateway-style router."""
+    """Single-process deployment: full pipeline + gateway-style router.
+
+    Lifecycle (services/lifecycle.py): ``start()`` flips READY once the
+    pump and HTTP surface are up (``/readyz`` 503 before that);
+    ``drain()`` runs the graceful sequence — readiness 503 first, pools
+    stop consuming (nothing nacked), engines finish-or-journal,
+    publish outboxes flush — and only then tears the process surface
+    down. ``stop()`` is the fast path (tests, aborts): no drain
+    ordering, but the engine journal still makes a warm restart cheap.
+    """
 
     pipeline: Any
     http: HTTPServer
     auth_service: Any = None
+    lifecycle: Any = None
+    drain_deadline_s: float = 30.0
     _stop: threading.Event = field(default_factory=threading.Event)
     _pump: threading.Thread | None = None
+
+    def __post_init__(self):
+        if self.lifecycle is None:
+            from copilot_for_consensus_tpu.services.lifecycle import (
+                ServiceLifecycle,
+            )
+            self.lifecycle = ServiceLifecycle(
+                "pipeline", metrics=self.pipeline.metrics)
 
     @property
     def port(self) -> int:
@@ -236,9 +255,58 @@ class PipelineServer:
             name="bus-pump", daemon=True)
         self._pump.start()
         self.http.start()
+        self.lifecycle.mark_ready()
         return self
 
+    def drain(self, deadline_s: float | None = None) -> dict:
+        """Graceful shutdown (the SIGTERM path, ``__main__.py``):
+        drain in order, then stop the pump and HTTP server. Returns
+        the drain report for the operator's exit line."""
+        from copilot_for_consensus_tpu.services.lifecycle import (
+            drain_pipeline,
+        )
+
+        report = drain_pipeline(
+            self.pipeline, self.lifecycle,
+            deadline_s=(self.drain_deadline_s if deadline_s is None
+                        else deadline_s),
+            stop_consumers=self._stop_consumers,
+            logger=get_logger())
+        self._shutdown()
+        return report
+
+    def _stop_consumers(self, timeout: float) -> bool:
+        """Drain step 2 for THIS deployment shape: stop the pump
+        thread (on the in-proc tier the pump IS the consumer; on the
+        ext-bus tier run_forever's teardown stops the worker pools on
+        its way out), then re-join the pools against the drain's
+        remaining budget — the pump's own teardown join uses the short
+        default, and a legitimately long in-flight dispatch deserves
+        the full drain deadline. All bounded by ``timeout``."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        self._stop.set()
+        if self._pump is not None:
+            self._pump.join(timeout=timeout)
+            if self._pump.is_alive():
+                return False
+            self._pump = None
+        return bool(self.pipeline.stop_consuming(
+            max(0.0, deadline - time.monotonic())))
+
     def stop(self) -> None:
+        """Fast teardown (no drain ordering): tests and aborts."""
+        if self.lifecycle.state not in ("stopped",):
+            # readiness must still flip before the pump dies, even on
+            # the fast path — a stopping server is not routable
+            try:
+                self.lifecycle.begin_drain()
+            except ValueError:
+                pass
+        self._shutdown()
+
+    def _shutdown(self) -> None:
         self._stop.set()
         if self._pump is not None:
             # run_forever returns once _stop is set (it waits on it);
@@ -246,6 +314,7 @@ class PipelineServer:
             self._pump.join(timeout=5.0)
             self._pump = None
         self.http.stop()
+        self.lifecycle.mark_stopped()
 
 
 def serve_pipeline(config: Mapping[str, Any] | None = None,
@@ -274,12 +343,25 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
     from copilot_for_consensus_tpu.services.openapi import generate_openapi
     from copilot_for_consensus_tpu.services.ui import ui_router
 
+    from copilot_for_consensus_tpu.services.lifecycle import (
+        ServiceLifecycle,
+    )
+
     cfg = dict(config or {})
     pipeline = build_pipeline(cfg)
+    # Process lifecycle (services/lifecycle.py): /readyz serves 503
+    # until start() flips READY and again the moment a drain begins —
+    # the load balancer stops routing before any consumer stops.
+    # /health stays 200 but reports degraded conditions (supervisor
+    # breakers, engine health) so operators see a limping replica.
+    lifecycle = ServiceLifecycle("pipeline", metrics=pipeline.metrics)
+    lc_cfg = dict(cfg.get("lifecycle") or {})
 
     router = Router()
     router.merge(health_router(
         "pipeline",
+        ready_check=lifecycle.is_ready,
+        degraded=pipeline.degraded,
         stats=pipeline.reporting.stats,
         metrics=_BusGaugeMetrics(pipeline.metrics, pipeline)))
     router.merge(ingestion_router(pipeline.ingestion))
@@ -405,5 +487,7 @@ def serve_pipeline(config: Mapping[str, Any] | None = None,
     server = PipelineServer(
         pipeline=pipeline,
         http=HTTPServer(router, host, port),
-        auth_service=auth_service)
+        auth_service=auth_service,
+        lifecycle=lifecycle,
+        drain_deadline_s=float(lc_cfg.get("drain_deadline_s", 30.0)))
     return server

@@ -236,21 +236,63 @@ class HTTPServer:
                                         daemon=True, name="http-server")
         self._thread.start()
 
-    def stop(self) -> None:
-        self._server.shutdown()
+    def stop(self) -> bool:
+        """Shut the server down. Returns True when the serve thread
+        joined cleanly; False when it did not (a handler wedged past
+        shutdown()) — the leak is logged and the daemon thread
+        abandoned rather than silently dropped (the racecheck
+        race-thread-lifecycle discipline: every thread is either
+        joined or loudly accounted for)."""
+        if self._thread is not None:
+            # shutdown() handshakes with serve_forever and BLOCKS
+            # forever if it never ran — only signal a started server
+            self._server.shutdown()
         self._server.server_close()
+        joined = True
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                joined = False
+                try:
+                    from copilot_for_consensus_tpu.obs.logging import (
+                        get_logger,
+                    )
+                    get_logger().error(
+                        "http server thread failed to join on stop; "
+                        "daemon thread abandoned",
+                        thread=self._thread.name, timeout_s=5)
+                except Exception:
+                    pass   # logging must not mask the condition
+            self._thread = None
+        return joined
 
 
 def health_router(service_name: str, *, ready_check=None, stats=None,
-                  metrics=None) -> Router:
+                  metrics=None, degraded=None) -> Router:
     """The /health /readyz /stats /metrics quartet every service exposes
-    (reference ``embedding/main.py:68-111,396-402``)."""
+    (reference ``embedding/main.py:68-111,396-402``).
+
+    ``degraded`` is a zero-arg callable returning a list of condition
+    strings (open supervisor breakers, an unhealthy engine, ...):
+    /health then reports ``status: degraded`` with the list — still
+    HTTP 200, because the process IS alive and serving; /readyz owns
+    the 503 (routability is ``ready_check``'s call, e.g. the drain
+    lifecycle's)."""
     router = Router()
 
     @router.get("/health")
     def health(req):
+        problems: list = []
+        if degraded is not None:
+            try:
+                problems = list(degraded())
+            except Exception:
+                # the health probe must answer even when the degraded
+                # check itself is broken — and say so
+                problems = ["degraded-check-failed"]
+        if problems:
+            return {"status": "degraded", "service": service_name,
+                    "degraded": problems}
         return {"status": "ok", "service": service_name}
 
     @router.get("/readyz")

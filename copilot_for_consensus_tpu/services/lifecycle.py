@@ -1,0 +1,222 @@
+"""Graceful process lifecycle: STARTING → READY → DRAINING → STOPPED.
+
+Before this module, ``SIGTERM`` (``__main__.py``) just set a stop event:
+``Pipeline.run_forever`` flipped every pool's stop flag and abandoned
+whatever a 5-second join left behind — no readiness flip, no engine
+drain, no outbox flush. A rolling restart therefore cost WORK (in-flight
+engine requests, parked publishes), not just latency, which is exactly
+the contract the reference pipeline gets for free from RabbitMQ
+durability + container restarts (PAPER.md §0).
+
+The drain ordering (:func:`drain_pipeline`) is load-bearing and
+machine-checked by tests/test_lifecycle.py:
+
+1. **Readiness flips first** (`/readyz` → 503 while `/health` stays
+   200): the load balancer stops routing NEW work before anything else
+   changes, so nothing arrives mid-teardown.
+2. **Pools stop consuming**: each worker finishes (and acks) its
+   in-flight dispatch, then exits its fetch loop. Nothing is nacked by
+   shutdown itself — unfetched messages simply stay pending on the
+   broker, and leased work that completed acked normally, so a clean
+   drain causes ZERO broker redeliveries.
+3. **Engines drain**: the generation engine finishes active slots up
+   to ``drain_deadline_s``; whatever remains is evacuated-and-journaled
+   (``engine/journal.py``) for the next process to resume.
+4. **The publish outbox flushes**: parked publishes replay to the
+   broker before exit — a process death must not take undelivered
+   events with it.
+5. Only then does the process exit (``__main__.py`` prints the drain
+   report and returns).
+
+Design notes: docs/RESILIENCE.md#process-lifecycle; operator story:
+docs/runbooks/rolling-restart.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+#: lifecycle states, in order
+STARTING = "starting"
+READY = "ready"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+#: gauge encoding for the ``copilot_lifecycle_state`` series (the
+#: LifecycleStuckDraining alert keys off DRAINING's value)
+STATE_GAUGE = {STARTING: 0.0, READY: 1.0, DRAINING: 2.0, STOPPED: 3.0}
+
+#: legal transitions. DRAINING → READY is deliberate: a drain that is
+#: aborted (operator cancel, bench warm-resume arm) re-enters service.
+_TRANSITIONS = {
+    STARTING: {READY, DRAINING, STOPPED},
+    READY: {DRAINING, STOPPED},
+    DRAINING: {READY, STOPPED},
+    STOPPED: set(),
+}
+
+#: metric-name registry (the BUS_METRICS pattern): the observability
+#: contract tests union this into the known-series set, so alerts and
+#: dashboards can only reference a lifecycle series the code emits.
+LIFECYCLE_METRICS = {
+    "copilot_lifecycle_state": (
+        "gauge", ("service",),
+        "Process lifecycle state: 0 starting, 1 ready, 2 draining, "
+        "3 stopped. /readyz serves 503 in every state but ready."),
+}
+
+
+class ServiceLifecycle:
+    """Thread-safe lifecycle state machine for one process.
+
+    ``is_ready`` is the ``health_router(ready_check=...)`` hook —
+    readiness is true ONLY in READY, which is what makes "flip
+    readiness first" a one-line drain step. Transition listeners fire
+    OUTSIDE the lock (they may call arbitrary code — the racecheck
+    ``race-callback-under-lock`` discipline), in registration order.
+    """
+
+    def __init__(self, service: str = "pipeline", *, metrics: Any = None,
+                 logger: Any = None):
+        self.service = service
+        self.metrics = metrics
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._state = STARTING
+        self._listeners: list[Callable[[str, str], None]] = []
+        #: (state, wall time) transition history — the drain-ordering
+        #: tests read this to prove readiness flipped before consume
+        #: stopped
+        self.history: list[tuple[str, float]] = [(STARTING, time.time())]
+        self._export(STARTING)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def is_ready(self) -> bool:
+        """True ONLY in READY — the /readyz 503 gate for every other
+        state (starting processes aren't routable yet; draining ones
+        must stop receiving; stopped ones are gone)."""
+        with self._lock:
+            return self._state == READY
+
+    def on_transition(self, cb: Callable[[str, str], None]) -> None:
+        """Register ``cb(old_state, new_state)``; fired outside the
+        lock after every successful transition."""
+        with self._lock:
+            self._listeners.append(cb)
+
+    def transition(self, to: str) -> bool:
+        """Move to ``to``. Same-state is a no-op returning False; an
+        illegal move raises (a lifecycle bug must fail loudly, not
+        leave the process half-drained)."""
+        if to not in STATE_GAUGE:
+            raise ValueError(f"unknown lifecycle state {to!r}; one of "
+                             f"{sorted(STATE_GAUGE)}")
+        with self._lock:
+            old = self._state
+            if to == old:
+                return False
+            if to not in _TRANSITIONS[old]:
+                raise ValueError(
+                    f"illegal lifecycle transition {old} -> {to} "
+                    f"(legal: {sorted(_TRANSITIONS[old])})")
+            self._state = to
+            self.history.append((to, time.time()))
+            listeners = list(self._listeners)
+        self._export(to)
+        if self.logger is not None:
+            try:
+                self.logger.info("lifecycle transition",
+                                 service=self.service, state=to,
+                                 previous=old)
+            except Exception:
+                pass    # logging must not break the state machine
+        for cb in listeners:
+            try:
+                cb(old, to)
+            except Exception:
+                pass    # a broken observer must not block shutdown
+        return True
+
+    def mark_ready(self) -> bool:
+        return self.transition(READY)
+
+    def begin_drain(self) -> bool:
+        return self.transition(DRAINING)
+
+    def mark_stopped(self) -> bool:
+        return self.transition(STOPPED)
+
+    def _export(self, state: str) -> None:
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.gauge("lifecycle_state", STATE_GAUGE[state],
+                               labels={"service": self.service})
+        except Exception:
+            pass    # metrics must not break the state machine
+
+
+def drain_pipeline(pipeline: Any, lifecycle: ServiceLifecycle, *,
+                   deadline_s: float = 30.0,
+                   outbox_timeout_s: float = 10.0,
+                   stop_consumers: Any = None,
+                   logger: Any = None) -> dict:
+    """Execute the graceful drain sequence IN ORDER (see the module
+    docstring) against a :class:`~.runner.Pipeline`. Returns a report
+    dict; every step is recorded with its outcome so the operator's
+    exit line says what a failed drain left behind (and the journal
+    has it either way).
+
+    ``stop_consumers`` overrides step 2's default
+    (``pipeline.stop_consuming``) with a ``fn(timeout) -> bool`` that
+    stops THIS deployment's actual consumption — PipelineServer passes
+    its pump-stopping hook, because on the in-proc bus tier the pump
+    thread IS the consumer and ``worker_pools`` is empty (stopping
+    nothing and reporting True would let dispatch keep running under
+    a 'clean' drain)."""
+    t0 = time.monotonic()
+    report: dict[str, Any] = {"deadline_s": deadline_s}
+    # 1. readiness flips FIRST: new work stops routing here before any
+    #    consumer stops. Repeated signals are absorbed (DRAINING →
+    #    DRAINING is a no-op) and a drain on an already-STOPPED
+    #    lifecycle must not crash the shutdown path — the remaining
+    #    steps are themselves idempotent against stopped pools.
+    try:
+        lifecycle.begin_drain()
+        report["readiness_flipped"] = True
+    except ValueError:
+        report["readiness_flipped"] = False   # already stopped
+    # 2. consumers stop: in-flight dispatches finish and ack;
+    #    unfetched messages stay pending; NOTHING is nacked by
+    #    shutdown, so the broker redelivers nothing afterwards. The
+    #    join gets the drain deadline, not the teardown default: a
+    #    legitimately long in-flight dispatch (a whole archive parse
+    #    holds one lease) finishing IS what draining means.
+    stop_fn = stop_consumers if stop_consumers is not None \
+        else pipeline.stop_consuming
+    report["consumers_stopped"] = bool(stop_fn(
+        max(1.0, deadline_s - (time.monotonic() - t0))))
+    # 3. engines finish active slots up to the remaining deadline, then
+    #    evacuate-and-journal the rest (engine/journal.py rows survive
+    #    for the next process).
+    remaining = max(1.0, deadline_s - (time.monotonic() - t0))
+    report["engines"] = pipeline.drain_engines(remaining)
+    # 4. the durable publish outbox flushes: parked publishes reach the
+    #    broker before exit (rows survive either way when outbox_path
+    #    is durable, but a clean exit should not LEAVE latency behind).
+    report["outbox_flushed"] = bool(
+        pipeline.flush_outboxes(outbox_timeout_s))
+    report["duration_s"] = round(time.monotonic() - t0, 3)
+    if logger is not None:
+        try:
+            logger.info("pipeline drained", **{
+                k: v for k, v in report.items() if k != "engines"})
+        except Exception:
+            pass
+    return report

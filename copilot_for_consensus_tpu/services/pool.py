@@ -74,11 +74,48 @@ class StageWorkerPool:
         finally:
             trace.set_worker_label("")
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> bool:
         """Flip every worker's stop flag (the loops poll it between
-        fetches); returns immediately — pair with :meth:`join`."""
+        fetches) and JOIN them against one shared deadline. Returns
+        True when every worker exited; False when one did not (a hung
+        dispatch) — the stuck worker and its current dispatch state
+        are logged and the daemon thread abandoned, never silently
+        (the ``AsyncEngineRunner.stop()`` contract, and the racecheck
+        race-thread-lifecycle discipline: a thread is joined or loudly
+        accounted for)."""
         for sub in self.subscribers:
             sub.stop()
+        if self.join(timeout=timeout):
+            return True
+        with self._lock:
+            threads = list(self._threads)
+        for t, sub in zip(threads, self.subscribers):
+            if not t.is_alive():
+                continue
+            state_fn = getattr(sub, "current_dispatch", None)
+            state = (state_fn() if callable(state_fn) else None) \
+                or "unknown (no dispatch state on this driver)"
+            self._log_stuck(t.name, state, timeout)
+        return False
+
+    def _log_stuck(self, worker: str, state: str,
+                   timeout: float) -> None:
+        log = self.logger
+        if log is None:
+            try:
+                from copilot_for_consensus_tpu.obs.logging import (
+                    get_logger,
+                )
+                log = get_logger()
+            except Exception:
+                return
+        try:
+            log.error("stage worker failed to join on stop; daemon "
+                      "thread abandoned", pool=self.name,
+                      worker=worker, dispatch=state,
+                      timeout_s=timeout)
+        except Exception:
+            pass   # logging must not mask the stuck worker
 
     def join(self, timeout: float = 5.0) -> bool:
         """Join every worker against ONE shared deadline; True when all
@@ -93,6 +130,5 @@ class StageWorkerPool:
     def close(self) -> None:
         """stop + join + release every subscriber's connection."""
         self.stop()
-        self.join()
         for sub in self.subscribers:
             sub.close()

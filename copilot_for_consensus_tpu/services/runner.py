@@ -9,6 +9,8 @@ tpu engines) without touching service code.
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Mapping
 
@@ -216,11 +218,121 @@ class Pipeline:
         for svc in self.services:
             svc.stop_throttling()
 
+    def stop_consuming(self, timeout: float = 5.0) -> bool:
+        """Graceful-drain step 2 (services/lifecycle.py): release any
+        backpressure wait, then stop-and-join every worker pool. Each
+        worker finishes (and acks) its in-flight dispatch before
+        exiting — nothing is nacked by shutdown itself, so unfetched
+        messages simply stay pending and the broker redelivers nothing
+        after a clean drain. Returns False when a worker failed to
+        join (pool.stop logs the stuck dispatch state)."""
+        self.stop_throttling()
+        # Flip EVERY pool's stop flags first, THEN join against one
+        # shared deadline: sequential stop-and-join would bound this
+        # step at n_pools x timeout — two slow pools would blow the
+        # drain deadline (and the container's stop grace period)
+        # before the engine ever got to checkpoint.
+        for pool in self.worker_pools:
+            for sub in pool.subscribers:
+                sub.stop()
+        deadline = time.monotonic() + timeout
+        ok = True
+        for pool in self.worker_pools:
+            ok = pool.stop(timeout=max(
+                0.0, deadline - time.monotonic())) and ok
+        return ok
+
+    def drain_engines(self, deadline_s: float = 30.0) -> dict:
+        """Graceful-drain step 3: let engine-backed drivers finish
+        their active slots up to ``deadline_s``, then evacuate-and-
+        journal the remainder (engine/journal.py). Duck-typed on a
+        driver ``drain(deadline_s)`` method — TPUSummarizer implements
+        it; mock drivers have nothing in flight. Returns per-service
+        ``{name: fully_drained}``."""
+        out: dict[str, bool] = {}
+        # ONE shared deadline across drainers (the stop_consuming
+        # discipline): handing each the full budget sequentially would
+        # bound this step at n_drainers x deadline and blow the
+        # container's stop grace period before the outbox ever flushed
+        deadline = time.monotonic() + deadline_s
+        for name, obj in (
+                ("summarization",
+                 getattr(self.summarization, "summarizer", None)),
+                ("embedding",
+                 getattr(self.embedding, "provider", None))):
+            fn = getattr(obj, "drain", None)
+            if callable(fn):
+                try:
+                    out[name] = bool(fn(max(
+                        0.0, deadline - time.monotonic())))
+                except Exception:
+                    out[name] = False
+        return out
+
+    def flush_outboxes(self, timeout_s: float = 10.0,
+                       stop: "threading.Event | None" = None) -> bool:
+        """Graceful-drain step 4: wait for every publisher's durable
+        outbox to replay to the broker. True when all outboxes reached
+        depth 0 within the budget; rows survive on disk either way
+        when the outbox is durable. Pass ``stop`` to make the wait
+        abortable (an aborted drain returns to READY); without one the
+        poll simply runs out its deadline."""
+        if stop is None:
+            stop = threading.Event()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                depth = self.publisher_stats().get("outbox_depth", 0)
+            except Exception:
+                # unreadable is NOT flushed: keep polling and report
+                # False if it never becomes readable — the drain
+                # report must not claim a clean flush it cannot see
+                depth = None
+            if depth == 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            if stop.wait(0.05):
+                return False
+
+    def degraded(self) -> list[str]:
+        """Degraded-but-alive conditions for the /health body (the
+        readyz 503 is the lifecycle's call; this is operator signal):
+        open supervisor breakers, a suspect or unhealthy engine, and a
+        journal backlog on an idle engine. Best-effort duck-typing —
+        mock drivers report nothing."""
+        out: list[str] = []
+        summ = getattr(self.summarization, "summarizer", None)
+        runner = getattr(summ, "_runner", None)
+        sup = getattr(runner, "supervisor", None)
+        if sup is not None:
+            for b in (sup.verify_breaker, sup.resource_breaker):
+                if b.state != "closed":
+                    out.append(f"engine-breaker:{b.name}:{b.state}")
+            if sup.suspect:
+                out.append("engine-suspect")
+            if sup.unhealthy:
+                out.append("engine-unhealthy")
+        eng = getattr(summ, "engine", None)
+        j = getattr(eng, "journal", None)
+        if j is not None and runner is None:
+            # a journal depth with no dispatcher running means
+            # recovered work is parked and nothing will serve it
+            try:
+                if j.depth():
+                    out.append("engine-journal-backlog")
+            except Exception:
+                pass
+        return out
+
     def run_forever(self, stop) -> None:
         """Blocking pump for server mode: in-proc dispatch, or (external
         bus) one StageWorkerPool per service — N stop-aware consume
         loops each, every loop already surviving broker outages with
-        backoff-and-reconnect."""
+        backoff-and-reconnect. Teardown stops-and-joins every pool;
+        a worker that outlives the join is logged with its current
+        dispatch state by ``StageWorkerPool.stop`` (never silently
+        abandoned)."""
         if not self.ext_subscribers:
             return self.broker.run_forever(stop)
         for pool in self.worker_pools:
@@ -228,14 +340,7 @@ class Pipeline:
         try:
             stop.wait()
         finally:
-            self.stop_throttling()
-            for pool in self.worker_pools:
-                pool.stop()
-            for pool in self.worker_pools:
-                # consume loops poll their stop flag each interval;
-                # join so the pump's caller can tear the bus down
-                # without racing an in-flight dispatch
-                pool.join(timeout=5.0)
+            self.stop_consuming()
 
     def ingest_and_run(self, source_id: str) -> dict[str, int]:
         """Trigger a source, run the pipeline to quiescence, return

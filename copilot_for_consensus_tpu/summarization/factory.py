@@ -48,6 +48,13 @@ def create_summarizer(config: Any = None, **kwargs: Any) -> Summarizer:
             # the engine's dispatcher; deadline_s drops expired work
             supervisor=_cfg_get(config, "supervisor", None),
             deadline_s=_cfg_get(config, "deadline_s", None),
+            # durable request journal (engine/journal.py): a config
+            # dict {"path": ..., "checkpoint_every": ...} or the
+            # "journal_path" string shorthand — either way the engine
+            # warm-restarts from it, so a pipeline-process kill costs
+            # latency, not work
+            journal=(_cfg_get(config, "journal", None)
+                     or _cfg_get(config, "journal_path", None)),
             **kwargs,
         )
     if driver in ("openai", "azure_openai"):

@@ -81,7 +81,8 @@ class TPUSummarizer(Summarizer):
                  cache_scope: str = "full",
                  profile_dir: str | None = None,
                  tenant: str = "", priority: str = "",
-                 supervisor=None, deadline_s: float | None = None):
+                 supervisor=None, deadline_s: float | None = None,
+                 journal=None):
         # jax imports deferred: host-only processes must not load them.
         from copilot_for_consensus_tpu.engine.tokenizer import (
             ByteTokenizer,
@@ -103,6 +104,11 @@ class TPUSummarizer(Summarizer):
         #: (expired work is dropped, not computed)
         self.supervisor = supervisor
         self.deadline_s = deadline_s
+        #: durable request journal (engine/journal.py): a path / config
+        #: dict / EngineJournal, handed to the engine so a serving-
+        #: process death costs latency (warm restart resumes from the
+        #: journal), not work. None disables.
+        self.journal = journal
         #: obs/errors.py reporter for engine dispatch failures — set by
         #: the owning service (SummarizationService wires its own); the
         #: lazily-built AsyncEngineRunner picks it up so an engine
@@ -123,7 +129,7 @@ class TPUSummarizer(Summarizer):
                 engine = GenerationEngine.from_checkpoint(
                     checkpoint, mesh=mesh, num_slots=num_slots,
                     max_len=max_len, profile_dir=profile_dir,
-                    kv_dtype=kv_dtype,
+                    kv_dtype=kv_dtype, journal=journal,
                     dtype=dtype if dtype is not None else jnp.bfloat16)
                 self._model = f"checkpoint:{checkpoint}"
                 if tokenizer is None:
@@ -147,7 +153,7 @@ class TPUSummarizer(Summarizer):
                     cfg, params, mesh=mesh, num_slots=num_slots,
                     max_len=min(max_len, cfg.max_seq_len),
                     profile_dir=profile_dir, kv_dtype=kv_dtype,
-                    quantize=quantize,
+                    quantize=quantize, journal=journal,
                     dtype=dtype if dtype is not None else jnp.bfloat16)
         self.engine = engine
         if long_engine is None and long_context:
@@ -327,6 +333,20 @@ class TPUSummarizer(Summarizer):
             )
 
         return wait
+
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Graceful-drain hook (``Pipeline.drain_engines``): wait for
+        the dispatcher to finish queued + active work up to
+        ``deadline_s``, then stop it — whatever did not finish stays
+        checkpointed in the engine journal for the next process to
+        resume. True when the engine fully drained."""
+        runner = getattr(self, "_runner", None)
+        if runner is None:
+            return True
+        drained = runner.drain(deadline_s)
+        runner.stop()
+        self._runner = None
+        return drained
 
     def close(self) -> None:
         runner = getattr(self, "_runner", None)

@@ -210,6 +210,7 @@ def test_pipeline_chaos_columns_contract():
              "stage_p95_s": {"chunking": 0.4},
              "queue_wait_p95_s": {"chunking": 1.2},
              "bottleneck_stage": "chunking", "orphan_spans": 0,
+             "journal_replayed": 7, "shutdown_redeliveries": 0,
              "extra_key_ignored": 1}
     cols = bench.pipeline_chaos_columns(audit)
     assert set(cols) == {"lost", "duplicated", "quarantined",
@@ -219,15 +220,23 @@ def test_pipeline_chaos_columns_contract():
                          "max_depth_backpressure_off",
                          "final_depth_max",
                          # distributed-tracing columns (obs/trace.py +
-                         # tools/tracepath.py, this round's tentpole)
+                         # tools/tracepath.py, PR-10 tentpole)
                          "stage_p95_s", "queue_wait_p95_s",
-                         "bottleneck_stage", "orphan_spans"}
+                         "bottleneck_stage", "orphan_spans",
+                         # process-lifecycle columns (engine/journal
+                         # + services/lifecycle, ISSUE 12): the kill
+                         # phase's warm-restart replays and the
+                         # graceful-drain arm's shutdown-caused
+                         # redeliveries (zero is the gate)
+                         "journal_replayed", "shutdown_redeliveries"}
     assert cols["quarantined"] == 5
     assert cols["replayed_publishes"] == 104
     assert cols["max_depth_backpressure_off"] == 88
     assert cols["bottleneck_stage"] == "chunking"
     assert cols["stage_p95_s"] == {"chunking": 0.4}
     assert cols["orphan_spans"] == 0
+    assert cols["journal_replayed"] == 7
+    assert cols["shutdown_redeliveries"] == 0
     # empty audit degrades to zeros/empties, not KeyErrors
     empty = bench.pipeline_chaos_columns({})
     assert empty["bottleneck_stage"] == ""
@@ -275,6 +284,18 @@ def test_pipeline_chaos_preset_enables_worker_pools():
     UNDER stage scale-out — competing consumer pools on the host-bound
     stages, not the old one-consumer-per-service wiring."""
     assert int(bench.PRESETS["pipeline_chaos"]["BENCH_PIPE_WORKERS"]) >= 2
+
+
+def test_pipeline_chaos_preset_has_kill_and_drain_knobs():
+    """ISSUE 12: the chaos gate grew a process-kill phase (journaled
+    engine storm SIGKILLed in a child process, warm-restarted from the
+    journal) and a graceful-drain arm — both must stay in the preset."""
+    p = bench.PRESETS["pipeline_chaos"]
+    assert int(p["BENCH_KILL_REQUESTS"]) > 0
+    assert int(p["BENCH_KILL_STEP"]) > 0
+    assert int(p["BENCH_KILL_NEW_TOKENS"]) > 0
+    assert int(p["BENCH_PIPE_DRAIN_MESSAGES"]) > 0
+    assert int(p["BENCH_PIPE_DRAIN_ARCHIVES"]) > 0
 
 
 def _scale_bench():

@@ -83,6 +83,14 @@ from copilot_for_consensus_tpu.obs.trace import (  # noqa: E402
 )
 
 KNOWN_SERIES |= set(_pipeline_series())
+
+# Process-lifecycle series (services/lifecycle.py) — the drain state
+# machine's gauge, same registry-next-to-emitter discipline.
+from copilot_for_consensus_tpu.services.lifecycle import (  # noqa: E402
+    LIFECYCLE_METRICS,
+)
+
+KNOWN_SERIES |= set(LIFECYCLE_METRICS)
 # [a-z0-9_]: engine series contain digits (engine_e2e_seconds)
 _SERIES_RE = re.compile(r"\b(copilot_[a-z0-9_]+|up|push_time_seconds)\b")
 
@@ -254,6 +262,9 @@ def test_telemetry_registry_matches_actual_emission():
     tele.gauge_quarantined(1)
     tele.on_released_pins(2)
     tele.on_deadline_expired()
+    # durable request journal (engine/journal.py)
+    tele.gauge_journal(2, checkpoint_lag=5)
+    tele.on_journal_replayed()
     tele.on_retire(1, new_tokens=8, finish_reason="eos")
     tele.update_ledgers(
         prefix_stats={"enabled": True, "hit_rate": 0.5},

@@ -143,6 +143,27 @@ PRESETS = {
     # is a correctness gate, not a throughput shape; mixed_traffic's
     # kv-dtype pin is the same move one level down
     # (docs/RESILIENCE.md#replay-semantics).
+    # Paged KV capacity (GenerationEngine(kv_pool_blocks=...) +
+    # ops/paged_attention.py): many concurrent short-decode streams
+    # whose prompts share a 128-token head. The pool is sized at the
+    # contiguous engine's 128-slot HBM budget (1024 blocks x 64 =
+    # 65536 cache positions == 128 slots x max_len 512), but slots
+    # stop reserving max_len each: blocks allocate on demand, prefix
+    # hits admit by POINTER (table append, zero copy), so the same
+    # memory sustains MORE concurrent streams than the 128-slot
+    # ceiling of BENCH_r02/r03. Columns: max_concurrent_streams (the
+    # engine's peak active ledger — the gate is > 128),
+    # kv_pool_fragmentation (reserved-but-dead fraction of allocated
+    # blocks), zero_copy_hit_rate (pointer admissions / paged
+    # admissions; > 0 proves the no-gather hit path).
+    "paged_capacity": {"BENCH_PROMPT_LEN": "192", "BENCH_MAX_LEN": "512",
+                       "BENCH_NEW_TOKENS": "64", "BENCH_SLOTS": "256",
+                       "BENCH_PAGED": "1",
+                       "BENCH_KV_POOL_BLOCKS": "1024",
+                       "BENCH_SHARED_PREFIX": "128",
+                       "BENCH_PREFIX_BLOCKS": "64",
+                       "BENCH_DECODE_WINDOW": "32",
+                       "BENCH_WINDOWS_PER_DISPATCH": "1"},
     "chaos": {"BENCH_MAX_LEN": "512", "BENCH_SLOTS": "16",
               "BENCH_CHAOS_DTYPE": "float32",
               "BENCH_NEW_TOKENS": "48",
@@ -232,6 +253,12 @@ PRESET_CONTRACT_MODULES = {
     "cap3072": ["copilot_for_consensus_tpu.engine.generation"],
     "shared_prefix": ["copilot_for_consensus_tpu.engine.generation",
                       "copilot_for_consensus_tpu.engine.prefix_cache"],
+    # the generation contract declares the paged dispatch family
+    # (admit/seeded/decode/verify/chunk over the block pool: donation
+    # aliases on both pool halves, the engine.generation-kv layout
+    # group, the engine.generation-kv-table block-table group)
+    "paged_capacity": ["copilot_for_consensus_tpu.engine.generation",
+                       "copilot_for_consensus_tpu.engine.prefix_cache"],
     # the generation contract already declares the _verify entrypoint
     # (donation alias, kv-layout group, draft-length bucket coverage)
     "spec_decode": ["copilot_for_consensus_tpu.engine.generation"],
@@ -289,6 +316,24 @@ def spec_columns(ss0: dict, ss1: dict) -> dict:
         "draft_hit_rate": round(hits / lookups, 3) if lookups else 0.0,
         "mean_accepted_per_step": round(acc / rows, 3) if rows else 0.0,
         "tokens_per_weight_pass": round(rt / rp, 3) if rp else 0.0,
+    }
+
+
+def paged_columns(kv0: dict, kv1: dict) -> dict:
+    """paged_capacity columns: the engine's paged-KV ledger
+    (``GenerationEngine.kv_pool_stats``). ``zero_copy_hit_rate`` is a
+    timed-run delta (the warmup's cold misses are the trie filling);
+    ``max_concurrent_streams`` and ``kv_pool_fragmentation`` read the
+    engine-lifetime peak / final allocation state."""
+    admits = kv1.get("paged_admits", 0) - kv0.get("paged_admits", 0)
+    hits = kv1.get("zero_copy_admits", 0) - kv0.get("zero_copy_admits",
+                                                    0)
+    return {
+        "max_concurrent_streams": int(kv1.get("peak_active", 0)),
+        "kv_pool_fragmentation": float(
+            kv1.get("fragmentation_ratio", 0.0)),
+        "zero_copy_hit_rate": round(hits / admits, 3) if admits
+        else 0.0,
     }
 
 
@@ -1757,6 +1802,13 @@ def headline() -> dict:
     # Speculative decoding (spec_decode preset): prompt-lookup drafts
     # + multi-token verify dispatch; prompts are built copy-heavy.
     spec_on = knob("BENCH_SPEC_DECODE", "0") == "1"
+    # Paged KV (paged_capacity preset, or BENCH_PAGED=1 on any engine
+    # preset — e.g. shared_prefix re-run paged to show the savings
+    # survive with the copies removed): the block pool replaces the
+    # per-slot contiguous cache; BENCH_KV_POOL_BLOCKS sizes it.
+    paged_on = knob("BENCH_PAGED", "0") == "1"
+    kv_pool_blocks = int(knob("BENCH_KV_POOL_BLOCKS",
+                              "1024" if paged_on else "0"))
     # Flight recorder / telemetry (engine/telemetry.py): default ON —
     # the artifact's TTFT/ITL/occupancy columns come from it.
     # BENCH_TELEMETRY=0 is the overhead-measurement arm (run
@@ -1804,6 +1856,7 @@ def headline() -> dict:
         max_len=max_len,
         prefill_buckets=buckets,
         prefix_cache_blocks=prefix_blocks,
+        kv_pool_blocks=kv_pool_blocks if paged_on else 0,
         dtype=jnp.bfloat16,
         kv_dtype=kv_name,
         seed=0,
@@ -1872,6 +1925,7 @@ def headline() -> dict:
     admit_s0 = eng.admitted_s
     ps0 = eng.prefix_stats()
     ss0 = eng.spec_stats()
+    kv0 = eng.kv_pool_stats()
     t0 = time.monotonic()
     comps = eng.generate(prompts, max_new_tokens=new_tokens)
     elapsed = time.monotonic() - t0
@@ -1917,6 +1971,12 @@ def headline() -> dict:
         log(f"spec decode: draft hit rate {out['draft_hit_rate']}, "
             f"{out['mean_accepted_per_step']} accepted/step, "
             f"{out['tokens_per_weight_pass']} tokens/weight-pass")
+    if paged_on:
+        out.update(paged_columns(kv0, eng.kv_pool_stats()))
+        log(f"paged kv: {out['max_concurrent_streams']} peak "
+            f"concurrent streams, fragmentation "
+            f"{out['kv_pool_fragmentation']}, zero-copy hit rate "
+            f"{out['zero_copy_hit_rate']}")
     return out
 
 

@@ -204,6 +204,7 @@ class GenerationEngine:
         piggyback_min_prompt: int = 10**9,
         admit_hold_strict: bool = False,
         prefix_cache_blocks: int = 0,
+        kv_pool_blocks: int = 0,
         spec_decode: bool = False,
         spec_draft_lens: tuple[int, ...] = (0, 4, 8),
         spec_ngram: int = 3,
@@ -409,19 +410,88 @@ class GenerationEngine:
         # weight-bandwidth-bound so tokens/step scales with slots. e4m3's
         # dynamic range covers KV activations; no per-tensor scales kept.
         self.kv_dtype = resolve_kv_dtype(kv_dtype, dtype)
-        cache = decoder.init_cache(cfg, num_slots, self.max_len,
-                                   dtype=self.kv_dtype)
-        if mesh is not None:
-            # Replicate cache axes the mesh doesn't divide (e.g. tp larger
-            # than the kv-head count — standard GQA serving replicates kv).
-            rules = dict(DEFAULT_RULES)
-            if cfg.n_kv_heads % mesh.shape["tp"]:
-                rules["kv_heads"] = None
-            if num_slots % mesh.shape["dp"]:
-                rules["batch"] = None
-            cache = shard_pytree(cache, decoder.cache_logical_axes(), mesh,
-                                 rules)
-        self._cache = cache
+
+        # ---- paged KV (kv_pool_blocks > 0): one block pool under
+        # admission, decode, verify and chunked prefill ----------------
+        # Slots stop reserving max_len columns each; positions map onto
+        # pool blocks through per-slot block tables, blocks allocate on
+        # demand, prefix hits are pointer handoffs, and the slot
+        # ceiling lifts to whatever the pool holds. The contiguous
+        # per-slot cache below is NOT allocated. Design: docs/
+        # ENGINE_PREFIX_CACHE.md ("Paged KV") + ops/paged_attention.py.
+        self.paged = bool(kv_pool_blocks)
+        self._pool = None
+        if self.paged:
+            from copilot_for_consensus_tpu.engine.kv_pool import (
+                BlockPool,
+            )
+            if mesh is not None:
+                raise ValueError(
+                    "kv_pool_blocks requires mesh=None: block tables "
+                    "are host-built per process and a dp-sharded pool "
+                    "would scatter one slot's timeline across shards")
+            block = self.prefill_chunk
+            if 128 % block:
+                raise ValueError(
+                    f"kv_pool_blocks requires prefill_chunk (the block "
+                    f"size) to divide 128, got {block}: decode kv "
+                    f"extents bucket to 128-aligned widths and every "
+                    f"bucket must be block-aligned")
+            if self.max_len % block:
+                raise ValueError(
+                    f"kv_pool_blocks requires max_len % prefill_chunk "
+                    f"== 0, got {self.max_len} % {block}")
+            self._block = block
+            self._max_blocks = self.max_len // block
+            #: per-dispatch write margin: a decode window, a verify
+            #: wave, or a chunk continuation never writes further than
+            #: this past a slot's committed length
+            self._write_margin = max(
+                self._dispatch_steps,
+                max(spec_draft_lens, default=0) + 1)
+            #: worst-case blocks one slot can ever hold (the free-block
+            #: admission accounting's unit)
+            if kv_pool_blocks < self._max_blocks + 1:
+                raise ValueError(
+                    f"kv_pool_blocks={kv_pool_blocks} cannot hold even "
+                    f"one max_len={self.max_len} slot "
+                    f"({self._max_blocks} blocks) plus headroom")
+            self._pool = BlockPool(cfg, num_blocks=kv_pool_blocks,
+                                   block_size=block,
+                                   kv_dtype=self.kv_dtype)
+            #: slot → block table (pool block ids, position p lives at
+            #: table[p // block] offset p % block) and the index where
+            #: OWNED blocks start (entries before it are BORROWED from
+            #: the prefix trie — shared, read-only, pinned via the
+            #: request's PrefixMatch until retire)
+            self._tables: list[list[int]] = [[] for _ in range(num_slots)]
+            self._owned_from: list[int] = [0] * num_slots
+            #: zero-copy admission ledger: seeded admits that appended
+            #: matched block ids instead of gathering pool→slot copies
+            self.zero_copy_admits = 0
+            self.paged_admits = 0
+            #: high-water mark of concurrently active streams
+            self.peak_active = 0
+            # Piggyback packing binds rows to contiguous slot-cache
+            # spans; the paged layout serves the same overlap goal via
+            # chunked prefill, so the (default-off) path stays off.
+            self._piggyback_ok = False
+            self._cache = None
+        else:
+            cache = decoder.init_cache(cfg, num_slots, self.max_len,
+                                       dtype=self.kv_dtype)
+            if mesh is not None:
+                # Replicate cache axes the mesh doesn't divide (e.g. tp
+                # larger than the kv-head count — standard GQA serving
+                # replicates kv).
+                rules = dict(DEFAULT_RULES)
+                if cfg.n_kv_heads % mesh.shape["tp"]:
+                    rules["kv_heads"] = None
+                if num_slots % mesh.shape["dp"]:
+                    rules["batch"] = None
+                cache = shard_pytree(cache, decoder.cache_logical_axes(),
+                                     mesh, rules)
+            self._cache = cache
 
         # ---- jitted programs -------------------------------------------
         impl = attn_impl
@@ -484,9 +554,14 @@ class GenerationEngine:
             from copilot_for_consensus_tpu.engine.prefix_cache import (
                 PrefixCache,
             )
+            # Paged engines share ONE pool between active slots and the
+            # trie (prefix_cache_blocks acts as an enable flag; the
+            # budget is kv_pool_blocks): publish is an adopt_blocks
+            # refcount handoff, hits are pointer admissions.
             self._prefix = PrefixCache(
                 cfg, num_blocks=prefix_cache_blocks,
-                block_size=self.prefill_chunk, kv_dtype=self.kv_dtype)
+                block_size=self.prefill_chunk, kv_dtype=self.kv_dtype,
+                shared=self._pool if self.paged else None)
 
         def _admit_seeded(params, tokens, lengths, pool_k, pool_v,
                           bids_flat, pref_lens, cache, slots, key):
@@ -830,6 +905,167 @@ class GenerationEngine:
         self._chunk_fn = jax.jit(_prefill_chunk, donate_argnums=(4,),
                                  static_argnames=("kv_len",))
 
+        # ---- paged dispatch programs (kv_pool_blocks > 0) --------------
+        # Every paged program is the contiguous program composed with
+        # the block-table indirection of ops/paged_attention.py: gather
+        # the working-set VIEW the tables describe — the XLA REFERENCE
+        # route, a pure reordering, so greedy decode is bit-identical
+        # at f32 — run the UNCHANGED decoder program over it, and
+        # scatter the fresh KV back into the pool at host-built
+        # (block, offset) maps. This reference route is what the
+        # engine dispatches run on EVERY backend today; the Pallas
+        # kernel (ops.paged_attention.paged_decode_attention,
+        # parity-held to this exact semantics) reads the pool by
+        # scalar-prefetched pointer instead, and wiring it into the
+        # windowed decode body (it needs (m, l, o) outputs to join the
+        # window/done/cur pieces' joint softmax) is the named follow-up
+        # alongside the multi-chip item (ROADMAP). The pool halves are
+        # donated — they are the one long-lived KV allocation and must
+        # never double-buffer.
+        if self.paged:
+            from copilot_for_consensus_tpu.ops.paged_attention import (
+                paged_gather_kv,
+            )
+
+            def _pool_scatter(pool_k, pool_v, k_new, v_new, sbids,
+                              soffs):
+                """Scatter fresh KV [L, R, Hkv, S, Dh] into the pool at
+                per-(row, column) maps [R, S]: column j of row i lands
+                in pool block ``sbids[i, j]`` offset ``soffs[i, j]``.
+                OOB block ids (parked rows, masked padding) drop."""
+                k_upd = k_new.transpose(1, 3, 0, 2, 4)
+                v_upd = v_new.transpose(1, 3, 0, 2, 4)
+                pk = pool_k.at[:, sbids, :, soffs, :].set(
+                    k_upd.astype(pool_k.dtype), mode="drop")
+                pv = pool_v.at[:, sbids, :, soffs, :].set(
+                    v_upd.astype(pool_v.dtype), mode="drop")
+                return pk, pv
+
+            def _view_take(view, positions, steps):
+                """Read the dispatch's freshly merged columns back out
+                of the view: [L, B, Hkv, W, Dh]-shaped gather at
+                positions + [0, steps) per row (parked rows clamp —
+                their scatter map is OOB and drops)."""
+                b = view.shape[1]
+                s_v = view.shape[3]
+                bidx = jnp.broadcast_to(jnp.arange(b)[:, None],
+                                        (b, steps))
+                pidx = jnp.clip(
+                    positions[:, None] + jnp.arange(steps)[None, :],
+                    0, s_v - 1)
+                return view[:, bidx, :, pidx, :].transpose(2, 0, 3, 1, 4)
+
+            def _admit_paged(params, tokens, lengths, pool_k, pool_v,
+                             sbids, soffs, key):
+                """Paged admission wave: prefill + pool scatter + first
+                token sample as ONE program. The scratch ferries the
+                fresh KV straight into pool blocks — no per-slot
+                contiguous cache exists to insert into."""
+                scratch = decoder.init_cache(cfg, tokens.shape[0],
+                                             tokens.shape[1],
+                                             dtype=self.kv_dtype)
+                logits, scratch = decoder.prefill(params, tokens,
+                                                  lengths, cfg, scratch,
+                                                  attn_impl=impl)
+                pool_k, pool_v = _pool_scatter(
+                    pool_k, pool_v, scratch["k"], scratch["v"], sbids,
+                    soffs)
+                first = sample(logits, key, self.sampling)
+                return first, pool_k, pool_v
+
+            self._admit_paged_fn = jax.jit(_admit_paged,
+                                           donate_argnums=(3, 4))
+
+            def _admit_seeded_paged(params, tokens, lengths, pool_k,
+                                    pool_v, bids_flat, pref_lens,
+                                    sbids, soffs, key):
+                """Zero-copy seeded admission: the matched prefix is
+                READ from its pool blocks for the suffix attention
+                (pointer indirection — the blocks were appended to the
+                slot's table host-side, nothing is copied into any
+                per-slot cache), the suffix prefills at the per-row
+                offset, and only the fresh suffix KV scatters into the
+                slot's OWN blocks."""
+                n, sbuc = tokens.shape
+                nb = bids_flat.shape[0] // n
+                pk, pv = paged_gather_kv(pool_k, pool_v,
+                                         bids_flat.reshape(n, nb))
+                scratch = decoder.init_cache(cfg, n, sbuc,
+                                             dtype=self.kv_dtype)
+                logits, scratch = decoder.prefill_seeded(
+                    params, tokens, lengths, pk, pv, pref_lens, cfg,
+                    scratch)
+                pool_k, pool_v = _pool_scatter(
+                    pool_k, pool_v, scratch["k"], scratch["v"], sbids,
+                    soffs)
+                first = sample(logits, key, self.sampling)
+                return first, pool_k, pool_v
+
+            self._admit_seeded_paged_fn = jax.jit(
+                _admit_seeded_paged, donate_argnums=(3, 4))
+
+            def _decode_paged(params, tokens, positions, pool_k,
+                              pool_v, gbids, sbids, soffs, key, *,
+                              kv_len, n_windows=1):
+                """Windowed decode over the block tables: gather the
+                view ``gbids`` describes (wide enough for this
+                dispatch's writes), run the contiguous window program
+                over it unchanged, scatter the freshly merged columns
+                back into the pool."""
+                vk, vv = paged_gather_kv(pool_k, pool_v, gbids)
+                toks, view = _decode(params, tokens, positions,
+                                     {"k": vk, "v": vv}, key,
+                                     kv_len=kv_len,
+                                     n_windows=n_windows)
+                steps = n_windows * self.decode_window
+                k_new = _view_take(view["k"], positions, steps)
+                v_new = _view_take(view["v"], positions, steps)
+                pool_k, pool_v = _pool_scatter(pool_k, pool_v, k_new,
+                                               v_new, sbids, soffs)
+                return toks, pool_k, pool_v
+
+            self._decode_paged_fn = jax.jit(
+                _decode_paged, donate_argnums=(3, 4),
+                static_argnames=("kv_len", "n_windows"))
+
+            def _verify_paged(params, tokens, qlens, positions,
+                              pool_k, pool_v, gbids, sbids, soffs,
+                              key, *, kv_len):
+                vk, vv = paged_gather_kv(pool_k, pool_v, gbids)
+                out, n_accept, view = _verify(
+                    params, tokens, qlens, positions,
+                    {"k": vk, "v": vv}, key, kv_len=kv_len)
+                k_new = _view_take(view["k"], positions,
+                                   tokens.shape[1])
+                v_new = _view_take(view["v"], positions,
+                                   tokens.shape[1])
+                pool_k, pool_v = _pool_scatter(pool_k, pool_v, k_new,
+                                               v_new, sbids, soffs)
+                return out, n_accept, pool_k, pool_v
+
+            self._verify_paged_fn = jax.jit(
+                _verify_paged, donate_argnums=(4, 5),
+                static_argnames=("kv_len",))
+
+            def _chunk_paged(params, tokens, qlens, positions, pool_k,
+                             pool_v, gbids, sbids, soffs, key, *,
+                             kv_len):
+                vk, vv = paged_gather_kv(pool_k, pool_v, gbids)
+                first, view = _prefill_chunk(
+                    params, tokens, qlens, positions,
+                    {"k": vk, "v": vv}, key, kv_len=kv_len)
+                k_new = _view_take(view["k"], positions,
+                                   tokens.shape[1])
+                v_new = _view_take(view["v"], positions,
+                                   tokens.shape[1])
+                pool_k, pool_v = _pool_scatter(pool_k, pool_v, k_new,
+                                               v_new, sbids, soffs)
+                return first, pool_k, pool_v
+
+            self._chunk_paged_fn = jax.jit(
+                _chunk_paged, donate_argnums=(4, 5),
+                static_argnames=("kv_len",))
+
         # ---- host-side slot state --------------------------------------
         self._free = list(range(num_slots))
         self._active: dict[int, Request] = {}          # slot → request
@@ -1025,6 +1261,10 @@ class GenerationEngine:
         self._admit()
         if self._chunk_pending or self._chunking:
             self._chunk_step()
+        if self.paged:
+            self.peak_active = max(self.peak_active,
+                                   len(self._active)
+                                   + len(self._chunking))
         if self._active or self._prefilling:
             self._decode_once()
         if self.journal is not None:
@@ -1032,6 +1272,14 @@ class GenerationEngine:
         if self.telemetry is not None:
             self.telemetry.gauge_queue(self.queue_depth,
                                        len(self._active))
+            if self.paged:
+                # gauges straight off the pool counters — the full
+                # kv_pool_stats() (headroom walk over active slots +
+                # trie) is a stats/bench API, too heavy for every step
+                self.telemetry.gauge_kv_pool(
+                    self._pool.free_blocks, self._pool.pinned_blocks,
+                    round(self._pool.fragmentation(
+                        self._used_tokens()), 4))
         return self._drain_done()
 
     def generate(self, prompts: list[list[int]],
@@ -1262,6 +1510,8 @@ class GenerationEngine:
             if req.deadline_at <= now:
                 del self._chunking[slot]
                 self._positions[slot] = self.max_len
+                if self.paged:
+                    self._paged_release_slot(slot)
                 self._free.append(slot)
                 expired.append(req)
         if self._sched is not None:
@@ -1365,6 +1615,13 @@ class GenerationEngine:
         # cached span never enters the prefill transient, which is
         # exactly why a shared-prefix wave packs more rows per dispatch.
         longest = 0
+        # Free-BLOCK accounting (paged engines): the wave takes a
+        # request only while its worst-case block footprint fits the
+        # pool headroom (free + trie-evictable minus what active work
+        # may still claim) — the slot count stops being the capacity
+        # bound, the pool is.
+        headroom = self._block_headroom() if self.paged else 0
+        pending_need = 0
         while (self._queue and self._free and len(batch) < 128
                and self._occupied + len(batch) < self._slot_cap):
             head = self._queue[0]
@@ -1382,6 +1639,18 @@ class GenerationEngine:
             if batch and (len(batch) + 1) * _next_bucket(
                     longest, self.buckets) > self.admission_token_budget:
                 break
+            if self.paged:
+                # Charge the FULL worst case, borrowed prefix included:
+                # admitting a seeded row pins its matched blocks (they
+                # leave the evictable headroom this gate was computed
+                # against), so discounting them would let the invariant
+                # go negative by exactly the matched span — the
+                # mid-decode KVPoolExhausted this accounting exists to
+                # make unreachable.
+                need = self._worst_blocks_total(head)
+                if pending_need + need > headroom:
+                    break
+                pending_need += need
             m = None
             if self._prefix is not None:
                 m = self._prefix.lookup(head.prompt, digests=digs)
@@ -1410,6 +1679,21 @@ class GenerationEngine:
         seq = self.telemetry.next_step() if self.telemetry is not None \
             else None
         try:
+            if self.paged:
+                # Build the rows' block tables BEFORE the dispatch:
+                # matched block ids are appended by POINTER (borrowed
+                # from the trie, pinned via the row's PrefixMatch —
+                # the zero-copy admission), suffix blocks allocate on
+                # demand. All-or-nothing per row, so the unwind below
+                # can free exactly what was taken.
+                for i, (slot, req) in enumerate(batch):
+                    tbl = list(matches[i].block_ids) \
+                        if matches[i] is not None else []
+                    self._owned_from[slot] = len(tbl)
+                    need = self._pool.blocks_for(plens[i]) - len(tbl)
+                    if need > 0:
+                        tbl.extend(self._alloc_blocks(need))
+                    self._tables[slot] = tbl
             with step_annotation(wave_kind, seq), \
                     self._dispatch_boundary(wave_kind):
                 if seeded:
@@ -1433,22 +1717,51 @@ class GenerationEngine:
                             bids[i, :len(matches[i].block_ids)] = \
                                 matches[i].block_ids
                             pref_lens[i] = matches[i].tokens
-                    first_dev, self._cache = self._admit_seeded_fn(
-                        self.params, jnp.asarray(tokens),
-                        jnp.asarray(lengths),
-                        self._prefix.pool["k"], self._prefix.pool["v"],
-                        jnp.asarray(bids.reshape(-1)),
-                        jnp.asarray(pref_lens),
-                        self._cache, jnp.asarray(slots), sub)
+                    if self.paged:
+                        rows = [(i, self._tables[slot],
+                                 plens[i] - suffix_lens[i],
+                                 suffix_lens[i])
+                                for i, (slot, _r) in enumerate(batch)]
+                        sbids, soffs = self._write_maps(rows, bucket, n)
+                        first_dev, pk, pv = self._admit_seeded_paged_fn(
+                            self.params, jnp.asarray(tokens),
+                            jnp.asarray(lengths),
+                            self._pool.k, self._pool.v,
+                            jnp.asarray(bids.reshape(-1)),
+                            jnp.asarray(pref_lens),
+                            jnp.asarray(sbids), jnp.asarray(soffs),
+                            sub)
+                        self._pool.k, self._pool.v = pk, pv
+                    else:
+                        first_dev, self._cache = self._admit_seeded_fn(
+                            self.params, jnp.asarray(tokens),
+                            jnp.asarray(lengths),
+                            self._prefix.pool["k"],
+                            self._prefix.pool["v"],
+                            jnp.asarray(bids.reshape(-1)),
+                            jnp.asarray(pref_lens),
+                            self._cache, jnp.asarray(slots), sub)
                 else:
                     for i, (slot, req) in enumerate(batch):
                         tokens[i, :plens[i]] = req.prompt
                         lengths[i] = plens[i]
                         slots[i] = slot
-                    first_dev, self._cache = self._admit_fn(
-                        self.params, jnp.asarray(tokens),
-                        jnp.asarray(lengths),
-                        self._cache, jnp.asarray(slots), sub)
+                    if self.paged:
+                        rows = [(i, self._tables[slot], 0, plens[i])
+                                for i, (slot, _r) in enumerate(batch)]
+                        sbids, soffs = self._write_maps(rows, bucket, n)
+                        first_dev, pk, pv = self._admit_paged_fn(
+                            self.params, jnp.asarray(tokens),
+                            jnp.asarray(lengths),
+                            self._pool.k, self._pool.v,
+                            jnp.asarray(sbids), jnp.asarray(soffs),
+                            sub)
+                        self._pool.k, self._pool.v = pk, pv
+                    else:
+                        first_dev, self._cache = self._admit_fn(
+                            self.params, jnp.asarray(tokens),
+                            jnp.asarray(lengths),
+                            self._cache, jnp.asarray(slots), sub)
                 first = _host_fetch(first_dev)     # the ONE host sync
         except Exception:
             # Lossless unwind (crash containment): the wave's requests
@@ -1457,9 +1770,12 @@ class GenerationEngine:
             # the lookup pins, so an admit failure costs one retried
             # wave, never a lost request. (Retried lookups re-count in
             # the prefix stats; the savings ledger only counts
-            # successful waves, so it stays honest.)
+            # successful waves, so it stays honest.) Paged rows also
+            # hand their freshly allocated owned blocks back.
             for i, (slot, req) in enumerate(batch):
                 self._free.append(slot)
+                if self.paged:
+                    self._paged_release_slot(slot)
                 if matches[i] is not None:
                     self._prefix.release(matches[i])
             self._queue[0:0] = [req for _slot, req in batch]
@@ -1474,6 +1790,12 @@ class GenerationEngine:
         self.prefill_tokens += sum(suffix_lens)
         self.prefill_tokens_saved += sum(
             m.tokens for m in matches if m is not None)
+        if self.paged:
+            self.paged_admits += len(batch)
+            hits = sum(1 for m in matches if m is not None)
+            self.zero_copy_admits += hits
+            if hits and self.telemetry is not None:
+                self.telemetry.on_zero_copy_admits(hits)
         for i, (slot, req) in enumerate(batch):
             tok = int(first[i])
             if matches[i] is not None:
@@ -1533,6 +1855,162 @@ class GenerationEngine:
         return bucket
 
     # ------------------------------------------------------------------
+    # paged KV host plumbing (kv_pool_blocks > 0)
+    # ------------------------------------------------------------------
+
+    def _worst_blocks_total(self, req: Request) -> int:
+        """Most blocks this request's slot can ever hold (borrowed +
+        owned): its full timeline — prompt, generation budget, and the
+        per-dispatch write margin — capped at the cache ceiling. The
+        free-block admission accounting reserves this much headroom
+        per admitted request, which is what makes mid-decode pool
+        exhaustion structurally unreachable (the paged replacement for
+        the contiguous engine's per-slot max_len reservation — an
+        ACCOUNTING number now, not an allocation)."""
+        span = min(len(req.prompt) + req.max_new_tokens
+                   + self._write_margin, self.max_len)
+        return self._pool.blocks_for(span)
+
+    def _block_headroom(self) -> int:
+        """Free + trie-evictable blocks minus what already-admitted
+        work may still allocate. Admission (wave, seeded, chunked)
+        only proceeds while a candidate's worst case fits in here."""
+        need = 0
+        for slot, req in self._active.items():
+            need += max(0, self._worst_blocks_total(req)
+                        - len(self._tables[slot]))
+        for slot, entry in self._chunking.items():
+            need += max(0, self._worst_blocks_total(entry[0])
+                        - len(self._tables[slot]))
+        evictable = self._prefix.evictable_blocks \
+            if self._prefix is not None else 0
+        return self._pool.free_blocks + evictable - need
+
+    def _alloc_blocks(self, n: int) -> list[int]:
+        """Allocate ``n`` pool blocks, reclaiming idle prefix-cache
+        leaves first when the free list runs short — cached-but-idle
+        prefixes yield to live timelines. Raises
+        :class:`KVPoolExhausted` (classified as resource exhaustion by
+        the supervisor) if the pool truly cannot serve, which the
+        admission accounting makes unreachable on the serving path."""
+        if n > self._pool.free_blocks and self._prefix is not None:
+            self._prefix.reclaim(n - self._pool.free_blocks)
+        return self._pool.alloc(n)
+
+    def _ensure_blocks(self, slot: int, upto: int) -> None:
+        """Grow the slot's table to cover positions [0, upto)."""
+        tbl = self._tables[slot]
+        need = self._pool.blocks_for(upto) - len(tbl)
+        if need > 0:
+            tbl.extend(self._alloc_blocks(need))
+
+    def _paged_release_slot(self, slot: int, keep=frozenset()) -> None:
+        """Return the slot's OWNED blocks to the pool (minus any the
+        trie adopted at publish) and clear its table. Borrowed entries
+        are the trie's — the request's PrefixMatch release is their
+        handback."""
+        tbl = self._tables[slot]
+        owned = [b for b in tbl[self._owned_from[slot]:]
+                 if b not in keep]
+        if owned:
+            self._pool.free(owned)
+        self._tables[slot] = []
+        self._owned_from[slot] = 0
+
+    def _gather_bids(self, width_tokens: int) -> "np.ndarray":
+        """[num_slots, width/block] block-id view map for a read of
+        ``width_tokens`` columns per slot; rows pad OOB past their
+        table (clamped garbage, masked by lengths downstream)."""
+        from copilot_for_consensus_tpu.engine.kv_pool import (
+            BLOCK_TABLE_DTYPE,
+        )
+
+        nb = -(-width_tokens // self._block)
+        arr = np.full((self.num_slots, nb), self._pool.num_blocks,
+                      dtype=BLOCK_TABLE_DTYPE)
+        for s in range(self.num_slots):
+            tbl = self._tables[s]
+            n = min(nb, len(tbl))
+            if n:
+                arr[s, :n] = tbl[:n]
+        return arr
+
+    def _write_maps(self, rows, width: int, n_rows: int):
+        """Per-(row, column) pool write maps for one dispatch:
+        ``rows`` is ``[(row_idx, table, start_pos, n_valid)]`` — column
+        j of row i targets block ``table[(start+j) // block]`` offset
+        ``(start+j) % block`` for j < n_valid; everything else carries
+        the OOB block id and drops in the scatter."""
+        from copilot_for_consensus_tpu.engine.kv_pool import (
+            BLOCK_TABLE_DTYPE,
+        )
+
+        bids = np.full((n_rows, width), self._pool.num_blocks,
+                       dtype=BLOCK_TABLE_DTYPE)
+        offs = np.zeros((n_rows, width), dtype=BLOCK_TABLE_DTYPE)
+        for idx, tbl, start, n_valid in rows:
+            # columns at/past max_len are dead padding in every
+            # dispatch (the contiguous merge drops them OOB); masking
+            # them here keeps the map inside the table
+            n = min(n_valid, width, self.max_len - start)
+            if n <= 0:
+                continue
+            pos = start + np.arange(n)
+            bids[idx, :n] = np.asarray(tbl, dtype=BLOCK_TABLE_DTYPE)[
+                pos // self._block]
+            offs[idx, :n] = pos % self._block
+        return bids, offs
+
+    def _view_width(self, kv_len: int, steps: int) -> int:
+        """Gather-view width for a dispatch that reads ``kv_len``
+        committed columns and writes up to ``steps`` more: block-
+        rounded so the view's reshape stays exact."""
+        blk = self._block
+        return kv_len + (-(-steps // blk)) * blk
+
+    def _used_tokens(self) -> int:
+        """Live cache positions across the pool's owners: committed
+        slot timelines (minus their borrowed prefix spans — those live
+        in trie blocks and are counted once via node_count, not per
+        borrower), chunk fills, and published blocks (always full)."""
+        used = sum(int(self._positions[s])
+                   - self._owned_from[s] * self._block
+                   for s in self._active)
+        used += sum(e[1] for e in self._chunking.values())
+        if self._prefix is not None:
+            used += self._prefix.node_count * self._block
+        return used
+
+    def kv_pool_stats(self) -> dict:
+        """Paged-KV counters for benches/metrics (mirrors
+        ``prefix_stats``). ``fragmentation_ratio`` is internal: the
+        reserved-but-dead fraction of allocated blocks;
+        ``zero_copy_hit_rate`` is seeded (pointer) admissions over all
+        paged admissions. Stats/bench API — the per-step gauges read
+        the pool counters directly instead (hot-path economy)."""
+        out = {"enabled": self.paged}
+        if not self.paged:
+            return out
+        used_tokens = self._used_tokens()
+        out.update({
+            "num_blocks": self._pool.num_blocks,
+            "block_size": self._block,
+            "free_blocks": self._pool.free_blocks,
+            "blocks_in_use": self._pool.blocks_in_use,
+            "pinned_blocks": self._pool.pinned_blocks,
+            "fragmentation_ratio": round(
+                self._pool.fragmentation(used_tokens), 4),
+            "zero_copy_admits": self.zero_copy_admits,
+            "paged_admits": self.paged_admits,
+            "zero_copy_hit_rate": (
+                self.zero_copy_admits / self.paged_admits
+                if self.paged_admits else 0.0),
+            "peak_active": self.peak_active,
+            "headroom_blocks": self._block_headroom(),
+        })
+        return out
+
+    # ------------------------------------------------------------------
     # SLO-aware scheduling (engine/scheduler.py)
     # ------------------------------------------------------------------
 
@@ -1563,7 +2041,11 @@ class GenerationEngine:
         sched.observe(queued=self.queue_depth,
                       active=len(self._active),
                       num_slots=self.num_slots,
-                      telemetry=self.telemetry)
+                      telemetry=self.telemetry,
+                      free_blocks=(self._block_headroom()
+                                   if self.paged else None),
+                      total_blocks=(self._pool.num_blocks
+                                    if self.paged else None))
         staged = (len(self._queue) + len(self._prefilling)
                   + len(self._chunk_pending))
         room = len(self._free) - staged
@@ -1595,6 +2077,9 @@ class GenerationEngine:
         position). Free/active rows park OOB and drop."""
         while self._chunk_pending and self._free \
                 and self._occupied < self._slot_cap:
+            if self.paged and self._worst_blocks_total(
+                    self._chunk_pending[0]) > self._block_headroom():
+                break       # free-block accounting: the pool is full
             req = self._chunk_pending.pop(0)
             slot = self._free.pop(0)
             self._chunking[slot] = [req, 0, time.monotonic()]
@@ -1630,15 +2115,39 @@ class GenerationEngine:
                 self._dispatch_boundary("prefill_chunk"):
             with quant.pallas_qmatmul_override(
                     self._decode_pallas_override):
-                first_dev, self._cache = self._chunk_fn(
-                    self.params,
-                    jnp.asarray(tokens),
-                    jnp.asarray(qlens),
-                    jnp.asarray(positions),
-                    self._cache,
-                    sub,
-                    kv_len=self._kv_extent(hi),
-                )
+                if self.paged:
+                    kv_len = self._kv_extent(hi)
+                    for slot, n in fed.items():
+                        self._ensure_blocks(
+                            slot, self._chunking[slot][1] + n)
+                    rows = [(slot, self._tables[slot],
+                             self._chunking[slot][1], n)
+                            for slot, n in fed.items()]
+                    sbids, soffs = self._write_maps(rows, width,
+                                                    self.num_slots)
+                    first_dev, pk, pv = self._chunk_paged_fn(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray(qlens),
+                        jnp.asarray(positions),
+                        self._pool.k, self._pool.v,
+                        jnp.asarray(self._gather_bids(
+                            self._view_width(kv_len, width))),
+                        jnp.asarray(sbids), jnp.asarray(soffs),
+                        sub,
+                        kv_len=kv_len,
+                    )
+                    self._pool.k, self._pool.v = pk, pv
+                else:
+                    first_dev, self._cache = self._chunk_fn(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray(qlens),
+                        jnp.asarray(positions),
+                        self._cache,
+                        sub,
+                        kv_len=self._kv_extent(hi),
+                    )
             first = _host_fetch(first_dev)
         step_s = time.monotonic() - t0
         self.chunk_s += step_s
@@ -1718,15 +2227,40 @@ class GenerationEngine:
                 # decode program without touching other programs/engines
                 with quant.pallas_qmatmul_override(
                         self._decode_pallas_override):
-                    toks, self._cache = self._decode_fn(
-                        self.params,
-                        jnp.asarray(self._next_tok),
-                        jnp.asarray(self._positions),
-                        self._cache,
-                        sub,
-                        kv_len=self._kv_bucket(),
-                        n_windows=self.windows_per_dispatch,
-                    )
+                    if self.paged:
+                        kv_len = self._kv_bucket()
+                        for slot in self._active:
+                            self._ensure_blocks(
+                                slot, int(self._positions[slot])
+                                + window)
+                        rows = [(s, self._tables[s],
+                                 int(self._positions[s]), window)
+                                for s in self._active]
+                        sbids, soffs = self._write_maps(
+                            rows, window, self.num_slots)
+                        toks, pk, pv = self._decode_paged_fn(
+                            self.params,
+                            jnp.asarray(self._next_tok),
+                            jnp.asarray(self._positions),
+                            self._pool.k, self._pool.v,
+                            jnp.asarray(self._gather_bids(
+                                self._view_width(kv_len, window))),
+                            jnp.asarray(sbids), jnp.asarray(soffs),
+                            sub,
+                            kv_len=kv_len,
+                            n_windows=self.windows_per_dispatch,
+                        )
+                        self._pool.k, self._pool.v = pk, pv
+                    else:
+                        toks, self._cache = self._decode_fn(
+                            self.params,
+                            jnp.asarray(self._next_tok),
+                            jnp.asarray(self._positions),
+                            self._cache,
+                            sub,
+                            kv_len=self._kv_bucket(),
+                            n_windows=self.windows_per_dispatch,
+                        )
                 toks = _host_fetch(toks)                 # [steps, slots]
                 self.plain_s += time.monotonic() - t0
                 self.plain_dispatches += 1
@@ -1859,15 +2393,45 @@ class GenerationEngine:
                 self._dispatch_boundary("verify"):
             with quant.pallas_qmatmul_override(
                     self._decode_pallas_override):
-                out_dev, acc_dev, self._cache = self._verify_fn(
-                    self.params,
-                    jnp.asarray(tokens),
-                    jnp.asarray(qlens),
-                    jnp.asarray(self._positions),
-                    self._cache,
-                    sub,
-                    kv_len=self._kv_bucket(),
-                )
+                if self.paged:
+                    kv_len = self._kv_bucket()
+                    # The dispatch width s is global; near-cap rows'
+                    # columns past max_len are dead padding (the
+                    # contiguous merge drops them OOB) — cap the table
+                    # growth at max_len so no slot ever allocates past
+                    # its admission-time worst-case reservation.
+                    for slot in self._active:
+                        self._ensure_blocks(
+                            slot, min(int(self._positions[slot]) + s,
+                                      self.max_len))
+                    rows = [(sl, self._tables[sl],
+                             int(self._positions[sl]), s)
+                            for sl in self._active]
+                    sbids, soffs = self._write_maps(rows, s,
+                                                    self.num_slots)
+                    out_dev, acc_dev, pk, pv = self._verify_paged_fn(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray(qlens),
+                        jnp.asarray(self._positions),
+                        self._pool.k, self._pool.v,
+                        jnp.asarray(self._gather_bids(
+                            self._view_width(kv_len, s))),
+                        jnp.asarray(sbids), jnp.asarray(soffs),
+                        sub,
+                        kv_len=kv_len,
+                    )
+                    self._pool.k, self._pool.v = pk, pv
+                else:
+                    out_dev, acc_dev, self._cache = self._verify_fn(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray(qlens),
+                        jnp.asarray(self._positions),
+                        self._cache,
+                        sub,
+                        kv_len=self._kv_bucket(),
+                    )
             out = _host_fetch(out_dev)                     # [slots, S]
             acc = _host_fetch(acc_dev)                     # [slots]
         step_s = time.monotonic() - t0
@@ -2050,6 +2614,7 @@ class GenerationEngine:
         self._positions[slot] = self.max_len   # park OOB (see __init__)
         self._draft_index.pop(slot, None)
         req = self._active.pop(slot)
+        adopted: frozenset | set = frozenset()
         if self._prefix is not None:
             # Publish BEFORE the slot returns to the free list: the
             # cache still holds this prompt's KV at [0, plen). Prompt
@@ -2061,15 +2626,28 @@ class GenerationEngine:
             # the whole step — down with it.
             try:
                 with self._dispatch_boundary("prefix_publish"):
-                    self._prefix.publish(
-                        req.prompt, self._cache, slot,
-                        eligible_tokens=req.cache_eligible_tokens)
+                    if self.paged:
+                        # Refcount handoff, zero device work: the trie
+                        # adopts the slot's own prompt-prefix blocks
+                        # by id (docs/ENGINE_PREFIX_CACHE.md).
+                        adopted = self._prefix.adopt_blocks(
+                            req.prompt, self._tables[slot],
+                            self._owned_from[slot],
+                            eligible_tokens=req.cache_eligible_tokens)
+                    else:
+                        self._prefix.publish(
+                            req.prompt, self._cache, slot,
+                            eligible_tokens=req.cache_eligible_tokens)
             except Exception:
                 self.prefix_publish_failures += 1
             finally:
                 m = self._prefix_pins.pop(req.request_id, None)
                 if m is not None:
                     self._prefix.release(m)
+        if self.paged:
+            # tail blocks (generated-token KV + unpublished prompt
+            # tail) go straight back to the allocator
+            self._paged_release_slot(slot, keep=adopted)
         gen = self._generated.pop(slot)
         if gen and gen[-1] in self._eos_set:
             gen = gen[:-1]
@@ -2341,4 +2919,109 @@ def _shardcheck_generation_engine():
                   S((2, chunk), i32), S((2, chunk), i32)),
             donate_argnums=(0,), kv_group=group,
             kv_caches=(("prefix-pool", pool),)),
+    ] + _paged_contract_cases(cfg, group)
+
+
+def _paged_contract_cases(cfg, group):
+    """The paged engine's dispatch contracts (kv_pool_blocks > 0):
+
+    * every paged dispatch donates BOTH pool halves (the one long-lived
+      KV allocation — a dropped alias double-buffers the whole pool);
+    * the pool rides the same ``engine.generation-kv`` layout group as
+      the contiguous slot cache (one (L, Hkv, Dh, dtype) convention
+      under both layouts — the bit-identity gate depends on it);
+    * block tables form their own ``engine.generation-kv-table`` layout
+      group: the anchor case declares the canonical
+      ``kv_pool.BLOCK_TABLE_DTYPE`` and every dispatch's table must
+      match it — flipping the dispatch-side table dtype (the tripwire
+      in tests/test_shardcheck.py) is a ``shard-kv-layout`` finding.
+    """
+    import functools
+
+    from copilot_for_consensus_tpu.engine.kv_pool import (
+        BLOCK_TABLE_DTYPE,
+    )
+
+    eng = GenerationEngine(cfg, num_slots=4, max_len=64,
+                           prefill_buckets=(16, 32), decode_window=4,
+                           windows_per_dispatch=1, prefill_chunk=8,
+                           prefill_rows=2, prefix_cache_blocks=4,
+                           kv_pool_blocks=16, spec_decode=True,
+                           spec_draft_lens=(0, 2, 4))
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    table_dtype = jnp.int32       # dispatch-side block-table dtype
+    pool = {"k": S(eng._pool.k.shape, eng._pool.k.dtype),
+            "v": S(eng._pool.v.shape, eng._pool.v.dtype)}
+    key = jax.random.PRNGKey(0)
+    n, bucket = 4, 16
+    b = eng.num_slots
+    w = eng._dispatch_steps
+    s_v = max(eng.spec_draft_lens) + 1
+    kv_len = 64
+    nb_view = eng._view_width(kv_len, w) // eng._block
+    tgroup = "engine.generation-kv-table"
+
+    def tbl(rows, width):
+        return S((rows, width), table_dtype)
+
+    return [
+        # the canonical table layout, declared FIRST so it is the
+        # group's reference signature (kv_pool.BLOCK_TABLE_DTYPE)
+        ContractCase(
+            label="paged-table-layout", kv_group=tgroup,
+            kv_caches=(("block-table",
+                        {"table": S((b, nb_view),
+                                    jnp.dtype(BLOCK_TABLE_DTYPE))}),)),
+        ContractCase(
+            label="admit-paged", fn=eng._admit_paged_fn,
+            args=(eng.params, S((n, bucket), i32), S((n,), i32),
+                  pool["k"], pool["v"], tbl(n, bucket), tbl(n, bucket),
+                  key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool", pool),),
+            buckets=eng.buckets, bucket_covers=(eng.prompt_limit,)),
+        ContractCase(
+            label="admit-seeded-paged", fn=eng._admit_seeded_paged_fn,
+            args=(eng.params, S((n, bucket), i32), S((n,), i32),
+                  pool["k"], pool["v"], S((n * 2,), i32), S((n,), i32),
+                  tbl(n, bucket), tbl(n, bucket), key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool", pool),)),
+        ContractCase(
+            label="decode-paged",
+            fn=functools.partial(eng._decode_paged_fn, kv_len=kv_len,
+                                 n_windows=1),
+            args=(eng.params, S((b,), i32), S((b,), i32),
+                  pool["k"], pool["v"],
+                  S((b, nb_view), jnp.dtype(BLOCK_TABLE_DTYPE)),
+                  tbl(b, w), tbl(b, w), key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool", pool),)),
+        ContractCase(
+            label="decode-paged-table", kv_group=tgroup,
+            kv_caches=(("block-table",
+                        {"table": S((b, nb_view), table_dtype)}),)),
+        ContractCase(
+            label="verify-paged",
+            fn=functools.partial(eng._verify_paged_fn, kv_len=kv_len),
+            args=(eng.params, S((b, s_v), i32), S((b,), i32),
+                  S((b,), i32), pool["k"], pool["v"],
+                  S((b, eng._view_width(kv_len, s_v) // eng._block),
+                    jnp.dtype(BLOCK_TABLE_DTYPE)),
+                  tbl(b, s_v), tbl(b, s_v), key),
+            donate_argnums=(4, 5), kv_group=group,
+            kv_caches=(("kv-pool", pool),),
+            buckets=tuple(k + 1 for k in eng.spec_draft_lens),
+            bucket_covers=(max(eng.spec_draft_lens) + 1,)),
+        ContractCase(
+            label="chunk-paged",
+            fn=functools.partial(eng._chunk_paged_fn, kv_len=kv_len),
+            args=(eng.params, S((b, eng._block), i32), S((b,), i32),
+                  S((b,), i32), pool["k"], pool["v"],
+                  S((b, eng._view_width(kv_len, eng._block)
+                     // eng._block), jnp.dtype(BLOCK_TABLE_DTYPE)),
+                  tbl(b, eng._block), tbl(b, eng._block), key),
+            donate_argnums=(4, 5), kv_group=group,
+            kv_caches=(("kv-pool", pool),)),
     ]

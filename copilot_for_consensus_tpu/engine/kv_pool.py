@@ -1,0 +1,209 @@
+"""Bounded device KV block pool — the paged engine's native layout.
+
+One pool ``[L, num_blocks, Hkv, block, Dh]`` backs EVERY KV byte the
+paged engine serves from: active slots map logical positions onto pool
+blocks through per-slot block tables (``[slots, max_blocks]`` int32,
+position ``p`` of a slot lives at ``table[p // block]`` offset
+``p % block``), and the radix prefix cache's published nodes reference
+the same blocks by id — so a prefix hit is a pointer handoff (append the
+matched ids to the slot's table, pin them) and publish-on-retire is a
+refcount handoff (the trie adopts the slot's own blocks), neither of
+which moves a byte of KV. This is the vLLM PagedAttention block-pool
+design (Kwon et al., SOSP 2023) adapted to this engine's host-side
+single-owner discipline; the device-side indirection lives in
+``ops/paged_attention.py``.
+
+The allocator here is pure host Python (one owner thread — the engine's
+dispatcher; see docs/RESILIENCE.md), but its invariants are
+load-bearing enough to be machine-checked twice: property tests drive
+random alloc/free/pin/release sequences (tests/test_engine_paged.py)
+and ``supervisor.audit()`` cross-checks block ownership against the
+engine's live tables after a failure.
+
+Invariants (violations raise — a silent double-assign would let two
+requests share one KV timeline, the exact corruption the contiguous
+engine's slot free-list repair exists to prevent):
+
+* a block id is in exactly one place: the free list, or assigned;
+* ``free`` refuses ids that are already free (double-free) and ids
+  with a nonzero pin count (a pinned block is visible to a reader —
+  freeing it would let the allocator hand it to a writer);
+* pins are counted, never boolean: the trie pins each published block
+  once for itself, and lookups pin matched nodes per active request.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:                      # import-light for host-only tooling/tests
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover - jax is a hard dep in serving
+    jnp = None
+
+#: dtype of every block table the paged dispatches consume — declared
+#: once so the host arrays, the shardcheck contract declarations, and
+#: the Pallas kernel's scalar-prefetch spec cannot drift apart
+#: (analysis: engine.generation-kv-table layout group).
+BLOCK_TABLE_DTYPE = np.int32
+
+
+class KVPoolExhausted(RuntimeError):
+    """The pool has no free block for a write the dispatch needs.
+
+    Admission gating (free-block accounting in the engine + scheduler)
+    exists to make this unreachable on the serving path; reaching it
+    anyway is classified as resource exhaustion by the supervisor
+    (``is_resource_exhaustion``), which lowers the admission cap and
+    contains the step."""
+
+    def __init__(self, message: str, *, needed: int = 0, free: int = 0):
+        super().__init__(message)
+        self.needed = needed
+        self.free = free
+        #: supervisor classification hook (engine/supervisor.py)
+        self.resource_exhausted = True
+
+
+class BlockPool:
+    """Device KV blocks + the host allocator that owns them.
+
+    ``k``/``v``: ``[L, num_blocks, Hkv, block, Dh]`` in the serving
+    cache dtype. ``num_blocks`` doubles as the OOB sentinel id: gathers
+    clamp (masked downstream), scatters drop — the same padding
+    discipline as the contiguous engine's OOB slot ids.
+    """
+
+    def __init__(self, cfg, *, num_blocks: int, block_size: int,
+                 kv_dtype=None):
+        if num_blocks < 1:
+            raise ValueError("kv pool needs num_blocks >= 1")
+        if block_size < 1:
+            raise ValueError("kv pool needs block_size >= 1")
+        self.cfg = cfg
+        self.block = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.kv_dtype = kv_dtype if kv_dtype is not None else jnp.bfloat16
+        shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads, block_size,
+                 cfg.head_dim)
+        self.k = jnp.zeros(shape, self.kv_dtype)
+        self.v = jnp.zeros(shape, self.kv_dtype)
+        self._free: list[int] = list(range(num_blocks))
+        self._is_free = np.ones(num_blocks, dtype=bool)
+        self._pins = np.zeros(num_blocks, dtype=np.int64)
+        #: lifetime accounting (telemetry + benches)
+        self.allocs_total = 0
+        self.frees_total = 0
+
+    # -- introspection --------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def pinned_blocks(self) -> int:
+        """Blocks with at least one outstanding pin (shared/published
+        blocks a reader may be attending over)."""
+        return int(np.count_nonzero(self._pins))
+
+    def pins(self, bid: int) -> int:
+        return int(self._pins[bid])
+
+    def is_free(self, bid: int) -> bool:
+        return bool(self._is_free[bid])
+
+    # -- allocation -----------------------------------------------------
+
+    def alloc(self, n: int = 1) -> list[int]:
+        """Take ``n`` blocks off the free list. All-or-nothing: a
+        partial grant would leave the caller's table covering less of
+        the timeline than its positions claim."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise KVPoolExhausted(
+                f"kv pool exhausted: need {n} blocks, {len(self._free)} "
+                f"free of {self.num_blocks}",
+                needed=n, free=len(self._free))
+        out = [self._free.pop() for _ in range(n)]
+        for bid in out:
+            self._is_free[bid] = False
+        self.allocs_total += n
+        return out
+
+    def free(self, bids) -> None:
+        """Return blocks to the free list. Double-free and
+        free-while-pinned raise: both mean two owners believed they
+        held the block, and handing it out again would alias two KV
+        timelines."""
+        for bid in bids:
+            bid = int(bid)
+            if not 0 <= bid < self.num_blocks:
+                raise ValueError(f"free of out-of-range block {bid}")
+            if self._is_free[bid]:
+                raise ValueError(f"double free of block {bid}")
+            if self._pins[bid]:
+                raise ValueError(
+                    f"free of pinned block {bid} "
+                    f"({int(self._pins[bid])} pins outstanding)")
+            self._is_free[bid] = True
+            self._free.append(bid)
+            self.frees_total += 1
+
+    def pin(self, bids) -> None:
+        """Count a reader/owner reference on assigned blocks. Pinning a
+        free block raises — nothing should hold a reference the
+        allocator could hand to a writer."""
+        for bid in bids:
+            bid = int(bid)
+            if self._is_free[bid]:
+                raise ValueError(f"pin of free block {bid}")
+            self._pins[bid] += 1
+
+    def release(self, bids) -> None:
+        for bid in bids:
+            bid = int(bid)
+            if self._pins[bid] <= 0:
+                raise ValueError(f"release underflow on block {bid}")
+            self._pins[bid] -= 1
+
+    # -- repair (supervisor.audit) --------------------------------------
+
+    def rebuild_free_list(self, owned: set[int]) -> list[int]:
+        """Recompute the free list as ``all - owned`` (audit repair
+        after a failure left the allocator and the engine's tables
+        disagreeing). Pins on blocks nobody owns are cleared — the
+        owner that held them is gone. Returns the ids whose free/used
+        state changed."""
+        changed = []
+        for bid in range(self.num_blocks):
+            want_free = bid not in owned
+            if want_free and not self._is_free[bid]:
+                self._pins[bid] = 0
+                changed.append(bid)
+            elif not want_free and self._is_free[bid]:
+                changed.append(bid)
+            self._is_free[bid] = want_free
+        self._free = [b for b in range(self.num_blocks)
+                      if self._is_free[b]]
+        return changed
+
+    # -- geometry helpers ------------------------------------------------
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` positions."""
+        return -(-max(0, int(n_tokens)) // self.block)
+
+    def fragmentation(self, used_tokens: int) -> float:
+        """Internal fragmentation of the allocated blocks: the fraction
+        of reserved-but-dead positions (tail slack of partially filled
+        blocks). 0.0 when nothing is allocated."""
+        cap = self.blocks_in_use * self.block
+        if cap <= 0:
+            return 0.0
+        return max(0.0, 1.0 - used_tokens / cap)

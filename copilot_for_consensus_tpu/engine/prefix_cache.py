@@ -104,25 +104,50 @@ class _PoolPrograms:
 
 
 class PrefixCache:
-    """Radix trie + bounded device block pool + LRU/refcount policy."""
+    """Radix trie + bounded device block pool + LRU/refcount policy.
+
+    Two ownership modes:
+
+    * **Owned pool** (default, the contiguous engine): this cache owns
+      its ``pool`` arrays and free list; ``publish`` COPIES prompt KV
+      cache→pool on retire (one jitted scatter).
+    * **Shared pool** (``shared=`` a :class:`engine.kv_pool.BlockPool`,
+      the paged engine): the trie references blocks of the engine-wide
+      pool by id. Publish becomes :meth:`adopt_blocks` — a refcount
+      HANDOFF of the retiring slot's own blocks, no device copy — and
+      eviction returns blocks to the shared allocator. The trie pins
+      each adopted block once in the pool for itself; per-request match
+      pins stay node-level exactly as before.
+    """
 
     def __init__(self, cfg, *, num_blocks: int, block_size: int,
-                 kv_dtype=jnp.bfloat16):
+                 kv_dtype=jnp.bfloat16, shared=None):
         if num_blocks < 1:
             raise ValueError("prefix cache needs num_blocks >= 1")
         if block_size < 1:
             raise ValueError("prefix cache needs block_size >= 1")
         self.cfg = cfg
         self.block = int(block_size)
-        self.num_blocks = int(num_blocks)
-        self.kv_dtype = kv_dtype
-        shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads, block_size,
-                 cfg.head_dim)
-        #: device-resident KV blocks; ``num_blocks`` is the OOB sentinel
-        #: id (gathers clamp, scatters drop).
-        self.pool = {"k": jnp.zeros(shape, kv_dtype),
-                     "v": jnp.zeros(shape, kv_dtype)}
-        self._free: list[int] = list(range(num_blocks))
+        self.shared = shared
+        if shared is not None:
+            if shared.block != self.block:
+                raise ValueError(
+                    f"shared pool block size {shared.block} != prefix "
+                    f"cache block size {block_size}")
+            self.num_blocks = shared.num_blocks
+            self.kv_dtype = shared.kv_dtype
+            self.pool = None          # the engine owns the arrays
+            self._free = None
+        else:
+            self.num_blocks = int(num_blocks)
+            self.kv_dtype = kv_dtype
+            shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads,
+                     block_size, cfg.head_dim)
+            #: device-resident KV blocks; ``num_blocks`` is the OOB
+            #: sentinel id (gathers clamp, scatters drop).
+            self.pool = {"k": jnp.zeros(shape, kv_dtype),
+                         "v": jnp.zeros(shape, kv_dtype)}
+            self._free: list[int] | None = list(range(num_blocks))
         self._root = _Node(b"", None, -1)
         self._nodes: list[_Node] = []       # every live non-root node
         self._tick = 0
@@ -155,11 +180,22 @@ class PrefixCache:
 
     @property
     def blocks_in_use(self) -> int:
+        if self.shared is not None:
+            return len(self._nodes)
         return self.num_blocks - len(self._free)
 
     @property
     def node_count(self) -> int:
         return len(self._nodes)
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Blocks the cache could hand back under pool pressure: every
+        unpinned node (interior nodes become evictable once their
+        descendants go, so the whole refcount-0 population is
+        reclaimable by cascaded LRU eviction). The paged engine's
+        free-block admission accounting counts these as headroom."""
+        return sum(1 for n in self._nodes if n.refcount == 0)
 
     @property
     def pinned_refcount(self) -> int:
@@ -177,9 +213,17 @@ class PrefixCache:
         the trie; ``release`` on it stays safe because it only
         decrements node refcounts we are discarding anyway)."""
         n = len(self._nodes)
+        if self.shared is not None:
+            # return every trie block to the shared allocator (the
+            # engine evacuated its slots first, so borrowed references
+            # are gone and the trie's own pin is the last one)
+            for node in self._nodes:
+                self.shared.release([node.block_id])
+                self.shared.free([node.block_id])
         self._nodes.clear()
         self._root.children.clear()
-        self._free = list(range(self.num_blocks))
+        if self.shared is None:
+            self._free = list(range(self.num_blocks))
         self.stats.blocks_evicted += n
         return n
 
@@ -270,14 +314,34 @@ class PrefixCache:
             return False
         victim.parent.children.pop(victim.digest, None)
         self._nodes.remove(victim)
-        self._free.append(victim.block_id)
+        if self.shared is not None:
+            self.shared.release([victim.block_id])
+            self.shared.free([victim.block_id])
+        else:
+            self._free.append(victim.block_id)
         self.stats.blocks_evicted += 1
         return True
 
     def _alloc(self) -> int | None:
+        if self.shared is not None:
+            # shared-pool mode allocates only through adopt_blocks —
+            # the trie never copies, so it never needs a fresh block
+            raise RuntimeError(
+                "PrefixCache._alloc in shared-pool mode (use "
+                "adopt_blocks)")
         if not self._free and not self._evict_one():
             return None
         return self._free.pop()
+
+    def reclaim(self, n: int) -> int:
+        """Evict up to ``n`` unpinned leaves back to the shared pool —
+        the paged engine calls this when the allocator runs dry, so
+        cached-but-idle prefixes yield to live decode timelines.
+        Returns how many blocks were actually reclaimed."""
+        got = 0
+        while got < n and self._evict_one():
+            got += 1
+        return got
 
     # -- publish ----------------------------------------------------------
 
@@ -301,6 +365,10 @@ class PrefixCache:
         small pool isn't churned by thread-unique context tails.
         Dedup is free: blocks already in the trie are just LRU-touched.
         """
+        if self.shared is not None:
+            raise RuntimeError(
+                "copy-publish on a shared-pool PrefixCache (the paged "
+                "engine publishes by adopt_blocks refcount handoff)")
         self._tick += 1
         limit = len(tokens)
         if eligible_tokens is not None:
@@ -338,6 +406,80 @@ class PrefixCache:
             self._copy_blocks(cache, slot, new_rows)
             self.stats.blocks_published += len(new_rows)
         return len(new_rows)
+
+    def adopt_blocks(self, tokens, table, owned_from: int,
+                     eligible_tokens: int | None = None) -> set[int]:
+        """Shared-pool publish: the refcount handoff that replaces the
+        cache→pool copy. ``table`` is the retiring slot's block table;
+        its first ``owned_from`` entries are BORROWED (they came from a
+        prefix match and already live in the trie, pinned by the
+        match), the rest are slot-owned. For each block-aligned prompt
+        block: an existing trie node is LRU-touched (dedup — a racing
+        earlier retiree published the same span first); a new node
+        ADOPTS the slot's own block by id — the pool pin moves to the
+        trie, zero bytes copied. Returns the adopted block ids — the
+        caller frees the slot's remaining owned blocks, NOT these.
+
+        ``eligible_tokens`` caps publish depth exactly as in the copy
+        path."""
+        if self.shared is None:
+            raise RuntimeError(
+                "adopt_blocks on an owned-pool PrefixCache (use "
+                "publish)")
+        self._tick += 1
+        limit = len(tokens)
+        if eligible_tokens is not None:
+            limit = min(limit, max(0, int(eligible_tokens)))
+        n_blocks = min(limit // self.block, len(table))
+        adopted: set[int] = set()
+        if n_blocks == 0:
+            return adopted
+        # TRANSACTIONAL in three phases — the caller frees the slot's
+        # non-adopted blocks right after this returns, so a partial
+        # adoption (some blocks pinned, exception, empty return) would
+        # turn the publish-failure containment in _retire into an
+        # uncontained free-of-pinned-block error. Phase 1 (digests) and
+        # phase 2 (validation) touch no state; phase 3 cannot raise.
+        digests = list(self._block_digests(tokens, n_blocks))
+        # phase 1: walk the existing path (dedup — LRU touches only).
+        # The path is linear, so the first missing child means every
+        # deeper node is missing too.
+        node = self._root
+        j = 0
+        while j < n_blocks:
+            child = node.children.get(digests[j])
+            if child is None:
+                break
+            child.last_used = self._tick
+            node = child
+            j += 1
+        if j >= n_blocks:
+            return adopted
+        if j < owned_from:
+            # a borrowed block whose node is gone can only mean the
+            # trie was flushed out from under an active match —
+            # nothing to hand off.
+            self.stats.publish_skips += 1
+            return adopted
+        # phase 2: validate every block to adopt BEFORE mutating
+        bids = [int(table[i]) for i in range(j, n_blocks)]
+        if any(not 0 <= b < self.shared.num_blocks
+               or self.shared.is_free(b) for b in bids):
+            # corrupted table entry: adopt nothing (the caller frees
+            # the slot's owned blocks; audit repairs the rest)
+            self.stats.publish_skips += 1
+            return adopted
+        # phase 3: apply — plain appends, dict inserts, validated pins
+        for i, bid in zip(range(j, n_blocks), bids):
+            child = _Node(digests[i], node, bid)
+            node.children[digests[i]] = child
+            self._nodes.append(child)
+            self.shared.pin([bid])            # the trie's own reference
+            adopted.add(bid)
+            self.stats.blocks_published += 1
+            child.last_used = self._tick
+            node = child
+        return adopted
 
     def _copy_blocks(self, cache: dict, slot: int,
                      rows: list[tuple[int, int]]) -> None:

@@ -148,6 +148,13 @@ class SchedulerConfig:
     #: embedding burst hard cap per call (0 = unlimited): a burst
     #: larger than this sheds instead of monopolizing the device
     embed_max_burst_texts: int = 0
+    #: paged-KV (kv_pool_blocks) free-block shed thresholds: fractions
+    #: of the pool below which the batch lane sheds / everything sheds.
+    #: The ratios apply to the engine's HEADROOM (free + evictable
+    #: minus admitted worst-case claims), so pressure shows before the
+    #: allocator actually runs dry.
+    kv_low_ratio: float = 0.10
+    kv_critical_ratio: float = 0.02
 
 
 @dataclass
@@ -317,7 +324,9 @@ class Scheduler:
     # -- closed loop ----------------------------------------------------
 
     def observe(self, *, queued: int, active: int, num_slots: int,
-                telemetry: Any = None, now: float | None = None) -> dict:
+                telemetry: Any = None, now: float | None = None,
+                free_blocks: int | None = None,
+                total_blocks: int | None = None) -> dict:
         """Recompute the overload level and Retry-After estimate from
         the engine's own signals. Called once per engine step (and from
         tests with synthetic traces).
@@ -327,7 +336,15 @@ class Scheduler:
         with idle slots is admission hysteresis, not overload — or when
         the queue passes ``batch_shed_depth``; level 2 (everything
         sheds) at ``max_queue_depth``. The queue-depth terms mean the
-        loop degrades gracefully when telemetry is disabled."""
+        loop degrades gracefully when telemetry is disabled.
+
+        Paged engines (``kv_pool_blocks``) report FREE-BLOCK headroom
+        (``free_blocks``: free + evictable minus admitted work's
+        worst-case remaining claims, out of ``total_blocks``) — the
+        load-shedding signal moves from free-slot counting to
+        free-block accounting: under ``kv_low_ratio`` of the pool the
+        batch lane sheds, under ``kv_critical_ratio`` everything does,
+        whatever the queue depth says."""
         now = time.monotonic() if now is None else now
         self._engine_staged = max(0, queued - self.queued)
         tele = telemetry if telemetry is not None else self.telemetry
@@ -354,6 +371,13 @@ class Scheduler:
             level = 1
         if queued >= self.cfg.max_queue_depth:
             level = 2
+        kv_ratio = None
+        if free_blocks is not None and total_blocks:
+            kv_ratio = max(0.0, free_blocks) / total_blocks
+            if kv_ratio < self.cfg.kv_critical_ratio:
+                level = 2
+            elif kv_ratio < self.cfg.kv_low_ratio:
+                level = max(level, 1)
         level = max(level, min(2, self.pressure))
         self.overload_level = level
         # Honest Retry-After: time to drain the current backlog at the
@@ -376,6 +400,8 @@ class Scheduler:
             "overload_level": level,
             "retry_after_s": round(self.retry_after_s, 3),
         }
+        if kv_ratio is not None:
+            self.last_signals["kv_headroom_ratio"] = round(kv_ratio, 4)
         self._export_gauges()
         return self.last_signals
 

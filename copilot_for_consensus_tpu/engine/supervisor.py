@@ -121,6 +121,11 @@ _RESOURCE_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory",
 
 
 def is_resource_exhaustion(exc: BaseException) -> bool:
+    # KVPoolExhausted (engine/kv_pool.py) self-classifies: a dry block
+    # pool is capacity pressure, not corruption — the resource breaker
+    # (lowered admission cap) is the right response.
+    if getattr(exc, "resource_exhausted", False):
+        return True
     msg = str(exc)
     return isinstance(exc, MemoryError) or any(
         m in msg for m in _RESOURCE_MARKERS)
@@ -523,6 +528,7 @@ class EngineSupervisor:
         replay continues from. Chunking requests restart from token
         zero (their partial cache fill is not trusted)."""
         eng = self.engine
+        paged = bool(getattr(eng, "paged", False))
         out: list = []
         for slot, req in list(getattr(eng, "_active", {}).items()):
             gen = eng._generated.pop(slot, [])
@@ -531,11 +537,17 @@ class EngineSupervisor:
             eng._draft_index.pop(slot, None)
             eng._t_prefill.pop(slot, None)
             self._release_pin(req.request_id)
+            if paged:
+                # owned blocks back to the pool (BEFORE any prefix
+                # flush — a flush must only ever see trie-owned blocks)
+                eng._paged_release_slot(slot)
             eng._free.append(slot)
             out.append((req, list(gen)))
         for slot in list(getattr(eng, "_chunking", {})):
             req = eng._chunking.pop(slot)[0]
             eng._positions[slot] = eng.max_len
+            if paged:
+                eng._paged_release_slot(slot)
             eng._free.append(slot)
             out.append((req, []))
         return out
@@ -630,6 +642,48 @@ class EngineSupervisor:
         if pin_leaks:
             findings["leaked_pins"] = pin_leaks
 
+        # -- paged KV: block-table exclusivity + allocator agreement --
+        # (the paged mirror of the free-list repair above: a block
+        # owned by two slots, or owned AND free, would alias two KV
+        # timelines — docs/ENGINE_PREFIX_CACHE.md#paged-kv)
+        paged = bool(getattr(eng, "paged", False))
+        block_conflicts: set[int] = set()
+        owned_blocks: set[int] = set()
+        if paged:
+            pool = eng._pool
+            prefix = getattr(eng, "_prefix", None)
+            trie_blocks = {n.block_id for n in prefix._nodes} \
+                if prefix is not None else set()
+            owned_blocks |= trie_blocks
+            owner_of: dict[int, int] = {}
+            for slot in range(eng.num_slots):
+                tbl = eng._tables[slot]
+                of = eng._owned_from[slot]
+                if tbl and slot not in active and slot not in chunking:
+                    # a table on a slot no request tracks is an orphan:
+                    # its blocks are unaccounted-for
+                    findings.setdefault("block_table_orphans",
+                                        []).append(slot)
+                    block_conflicts.add(slot)
+                    continue
+                for i, bid in enumerate(tbl):
+                    if i < of:
+                        # borrowed entries must be trie blocks
+                        if bid not in trie_blocks:
+                            block_conflicts.add(slot)
+                        continue
+                    if bid in owner_of or bid in trie_blocks \
+                            or pool.is_free(bid):
+                        block_conflicts.add(slot)
+                        if bid in owner_of:
+                            block_conflicts.add(owner_of[bid])
+                    owner_of[bid] = slot
+            if block_conflicts:
+                findings["block_table_overlap"] = sorted(
+                    block_conflicts)
+            owned_blocks |= {b for b, s in owner_of.items()
+                             if s not in block_conflicts}
+
         sched = getattr(eng, "_sched", None)
         sched_drift: dict[str, tuple[int, int]] = {}
         if sched is not None and repair:
@@ -657,6 +711,30 @@ class EngineSupervisor:
             for rid in pin_leaks:
                 self._release_pin(rid)
                 self.released_pins += 1
+            if paged:
+                for slot in sorted(block_conflicts):
+                    # irreconcilable ownership: nothing about the
+                    # slot's blocks can be trusted — drop its request
+                    # (the journal/replay plane re-serves it) and
+                    # quarantine the slot; the free-list rebuild below
+                    # reclaims whatever nobody legitimately owns
+                    eng._tables[slot] = []
+                    eng._owned_from[slot] = 0
+                    req = eng._active.pop(slot, None)
+                    if req is None:
+                        ch = eng._chunking.pop(slot, None)
+                        req = ch[0] if ch else None
+                    if req is not None:
+                        self._release_pin(req.request_id)
+                    eng._generated.pop(slot, None)
+                    eng._draft_index.pop(slot, None)
+                    eng._positions[slot] = eng.max_len
+                    eng._free = [s for s in eng._free if s != slot]
+                    if slot not in self.quarantined:
+                        self.quarantined.append(slot)
+                drift = eng._pool.rebuild_free_list(owned_blocks)
+                if drift:
+                    findings["block_freelist_drift"] = sorted(drift)
             if self.telemetry is not None:
                 try:
                     if pin_leaks:

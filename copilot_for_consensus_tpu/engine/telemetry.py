@@ -175,6 +175,27 @@ METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "counter", ("engine",),
         "Requests dropped (not computed) because their per-request "
         "deadline_s expired before completion."),
+    # ---- paged KV block pool (engine/kv_pool.py +
+    # GenerationEngine(kv_pool_blocks=...); docs/ENGINE_PREFIX_CACHE.md
+    # "Paged KV") ----
+    "engine_kv_pool_free_blocks": (
+        "gauge", ("engine",),
+        "Free blocks in the paged KV pool (allocator free list; the "
+        "EngineKVPoolExhausted alert watches this against a standing "
+        "queue)."),
+    "engine_kv_pool_pinned_blocks": (
+        "gauge", ("engine",),
+        "Pool blocks with outstanding pins — published prefix blocks "
+        "the trie (and any admission reading them) holds."),
+    "engine_kv_pool_fragmentation_ratio": (
+        "gauge", ("engine",),
+        "Internal fragmentation of allocated blocks: reserved-but-"
+        "dead fraction (tail slack of partially filled blocks)."),
+    "engine_kv_pool_zero_copy_admits_total": (
+        "counter", ("engine",),
+        "Seeded admissions that appended matched block ids to the "
+        "slot's table instead of gathering a pool→slot copy "
+        "(pointer-only prefix admission)."),
     # ---- durable request journal (engine/journal.py;
     # docs/RESILIENCE.md#process-lifecycle) ----
     "engine_journal_depth": (
@@ -537,6 +558,21 @@ class EngineTelemetry:
         self.metrics.increment(
             "engine_recovery_deadline_expired_total", float(n),
             self._labels)
+
+    # -- paged KV block pool (engine/kv_pool.py) ------------------------
+
+    def gauge_kv_pool(self, free_blocks: int, pinned_blocks: int,
+                      fragmentation_ratio: float) -> None:
+        m, lb = self.metrics, self._labels
+        m.gauge("engine_kv_pool_free_blocks", float(free_blocks), lb)
+        m.gauge("engine_kv_pool_pinned_blocks", float(pinned_blocks),
+                lb)
+        m.gauge("engine_kv_pool_fragmentation_ratio",
+                float(fragmentation_ratio), lb)
+
+    def on_zero_copy_admits(self, n: int = 1) -> None:
+        self.metrics.increment("engine_kv_pool_zero_copy_admits_total",
+                               float(n), self._labels)
 
     # -- durable request journal (engine/journal.py) --------------------
 

@@ -197,6 +197,45 @@ def prefill_attention_seeded(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_all.dtype), v_all)
 
 
+def _grouped_scores(qg: jax.Array, k: jax.Array) -> jax.Array:
+    """Unscaled GQA scores [B, Hkv, G, S] of grouped queries against
+    one KV piece [B, Hkv, S, D] (fp32 accumulation, the shared
+    numerics of every decode-attention entry point)."""
+    d = qg.shape[-1]
+    return jnp.einsum("bhgd,bhsd->bhgs", qg, k,
+                      preferred_element_type=jnp.float32) * (d ** -0.5)
+
+
+def _piece_mask(pos_abs: jax.Array, valid_below: jax.Array,
+                q_pos: jax.Array, window: int) -> jax.Array:
+    """The one masking rule every decode KV piece obeys: a column at
+    absolute position ``pos_abs`` is attendable iff it is strictly
+    below the piece's valid bound and — under a sliding window — within
+    ``window`` positions of the query's own absolute position
+    ``q_pos``. ``decode_attention`` is the single-piece instance
+    (bound = lengths, q_pos = lengths - 1);
+    ``decode_attention_prefix_window`` applies it per piece against
+    the dispatch timeline."""
+    mask = pos_abs < valid_below
+    if window > 0:
+        mask &= pos_abs > q_pos - window
+    return mask
+
+
+def _joint_probs(pieces_logits: list[jax.Array]) -> list[jax.Array]:
+    """One softmax over the concatenated (already masked) score pieces,
+    split back per piece — numerically identical to attention over one
+    contiguous cache holding all pieces back to back. Fully-masked rows
+    (parked slots) produce NaN probabilities and are zeroed."""
+    logits = jnp.concatenate(pieces_logits, axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    if len(pieces_logits) == 1:
+        return [probs]
+    splits = np.cumsum([p.shape[-1] for p in pieces_logits])[:-1]
+    return jnp.split(probs, splits, axis=-1)
+
+
 @functools.partial(jax.jit, static_argnames=("window", "kv_len"))
 def decode_attention(
     q: jax.Array,
@@ -214,6 +253,13 @@ def decode_attention(
     decode is HBM-bound, so attending over only the occupied prefix
     instead of all of S_max is a direct bandwidth saving; the engine
     buckets it so only a handful of shapes compile.
+
+    This is the SINGLE-piece instance of the shared decode-attention
+    core (``_grouped_scores`` / ``_piece_mask`` / ``_joint_probs``)
+    that ``decode_attention_prefix_window`` composes over four pieces —
+    and the reference semantics the paged kernel
+    (``ops/paged_attention.py``) must match bit-for-bit on its XLA
+    path.
     """
     if kv_len is not None and kv_len < k_cache.shape[2]:
         k_cache = k_cache[:, :, :kv_len]
@@ -227,16 +273,12 @@ def decode_attention(
     hkv, s_max = k_cache.shape[1], k_cache.shape[2]
     group = hq // hkv
     qg = q.reshape(b, hkv, group, d)
-    logits = jnp.einsum(
-        "bhgd,bhsd->bhgs", qg, k_cache, preferred_element_type=jnp.float32
-    ) * (d ** -0.5)
+    logits = _grouped_scores(qg, k_cache)
     pos = jnp.arange(s_max)[None, None, None, :]
-    mask = pos < lengths[:, None, None, None]
-    if window > 0:
-        mask &= pos > lengths[:, None, None, None] - 1 - window
+    mask = _piece_mask(pos, lengths[:, None, None, None],
+                       lengths[:, None, None, None] - 1, window)
     logits = jnp.where(mask, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1)
-    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    probs = _joint_probs([logits])[0]
     out = jnp.einsum("bhgs,bhsd->bhgd", probs.astype(v_cache.dtype), v_cache)
     return out.reshape(b, hq, d)
 
@@ -292,51 +334,44 @@ def decode_attention_prefix_window(
     n_done = 0 if k_done is None else k_done.shape[2]
     group = hq // hkv
     qg = q.reshape(b, hkv, group, d)
-    scl = d ** -0.5
 
-    lp = jnp.einsum("bhgd,bhsd->bhgs", qg, k_pref,
-                    preferred_element_type=jnp.float32) * scl
-    lw = jnp.einsum("bhgd,bhwd->bhgw", qg, k_win,
-                    preferred_element_type=jnp.float32) * scl
+    lp = _grouped_scores(qg, k_pref)
+    lw = _grouped_scores(qg, k_win)
     lc = jnp.einsum("bhgd,bhd->bhg", qg, k_cur,
-                    preferred_element_type=jnp.float32)[..., None] * scl
+                    preferred_element_type=jnp.float32)[..., None] \
+        * (d ** -0.5)
 
     # The dispatch's own columns start at prefix_lengths: done columns
     # at +[0, n_done), current-window column i at +n_done+i; the token
-    # itself sits at +n_done+w.
-    cur_pos = prefix_lengths + n_done + w         # absolute position [B]
+    # itself sits at +n_done+w. Every piece runs the same masking rule
+    # (_piece_mask) against that timeline.
+    cur_pos = (prefix_lengths + n_done + w)[:, None, None, None]  # [B]
     pos_p = jnp.arange(s_max)[None, None, None, :]
-    mask_p = pos_p < prefix_lengths[:, None, None, None]
-    if window > 0:
-        mask_p &= pos_p > (cur_pos - window)[:, None, None, None]
+    mask_p = _piece_mask(pos_p, prefix_lengths[:, None, None, None],
+                         cur_pos, window)
     iw = jnp.arange(n_win)[None, None, None, :]
-    mask_w = iw < w                               # strictly earlier steps
-    if window > 0:
-        pos_w = prefix_lengths[:, None, None, None] + n_done + iw
-        mask_w &= pos_w > (cur_pos - window)[:, None, None, None]
+    pos_w = prefix_lengths[:, None, None, None] + n_done + iw
+    # valid bound for the window piece: strictly earlier steps, i.e.
+    # columns below the current absolute position
+    mask_w = _piece_mask(pos_w, cur_pos, cur_pos, window)
     lp = jnp.where(mask_p, lp, -jnp.inf)
     lw = jnp.where(mask_w, lw, -jnp.inf)
     pieces_l = [lp]
     pieces_v = [v_pref]
     if n_done:
         k_done = k_done.astype(dt)
-        ld = jnp.einsum("bhgd,bhwd->bhgw", qg, k_done,
-                        preferred_element_type=jnp.float32) * scl
-        if window > 0:
-            idn = jnp.arange(n_done)[None, None, None, :]
-            pos_dn = prefix_lengths[:, None, None, None] + idn
-            ld = jnp.where(
-                pos_dn > (cur_pos - window)[:, None, None, None],
-                ld, -jnp.inf)
+        ld = _grouped_scores(qg, k_done)
+        idn = jnp.arange(n_done)[None, None, None, :]
+        pos_dn = prefix_lengths[:, None, None, None] + idn
+        # done columns are all committed (always below cur_pos); only
+        # the window bound can mask them
+        mask_dn = _piece_mask(pos_dn, cur_pos, cur_pos, window)
+        ld = jnp.where(mask_dn, ld, -jnp.inf)
         pieces_l.append(ld)
         pieces_v.append(v_done.astype(dt))
     pieces_l += [lw, lc]
 
-    logits = jnp.concatenate(pieces_l, axis=-1)
-    probs = jax.nn.softmax(logits, axis=-1)
-    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
-    splits = np.cumsum([p.shape[-1] for p in pieces_l])[:-1]
-    parts = jnp.split(probs, splits, axis=-1)
+    parts = _joint_probs(pieces_l)
     out = jnp.einsum("bhgs,bhsd->bhgd", parts[0].astype(dt), v_pref)
     if n_done:
         out += jnp.einsum("bhgw,bhwd->bhgd", parts[1].astype(dt),

@@ -1,0 +1,237 @@
+"""Paged decode attention: block-table indirection into the KV pool.
+
+The paged engine (``GenerationEngine(kv_pool_blocks=...)``) stores KV
+in one bounded block pool ``[L, num_blocks, Hkv, block, Dh]``
+(``engine/kv_pool.py``) and addresses it through per-slot block tables
+``[B, max_blocks]`` int32 — position ``p`` of slot ``b`` lives at pool
+block ``tables[b, p // block]``, offset ``p % block``. Two routes serve
+attention over that layout:
+
+* **Pallas TPU kernel** (``impl="pallas"``): the block table rides the
+  scalar-prefetch lane of a ``PrefetchScalarGridSpec``, so each grid
+  step DMAs exactly the physical block the table names — the pool is
+  read by POINTER, no gathered contiguous copy ever materializes.
+  Flash-style online softmax across the block axis; GQA (grouped
+  queries per kv head), sliding-window masking, and fp8 pools
+  (dequantized on load) all supported, matching ``decode_attention``'s
+  contract.
+* **XLA reference** (``impl="xla"``, the CPU/e2e-gate route): gather
+  the tables' blocks into the contiguous view the block table DESCRIBES
+  and run the unified ``ops.attention.decode_attention`` over it. A
+  gather is a pure reordering, so this path is bit-identical to the
+  contiguous engine at f32 — which is what lets the existing e2e suites
+  gate the paged refactor on CPU.
+
+``paged_gather_kv`` is the same reference materialization at the
+stacked-cache level; the engine's paged dispatches use it to build the
+per-dispatch working-set view its (unchanged) decoder programs read —
+on every backend, today. The Pallas kernel is the drop-in TPU
+replacement for that gather (same q/lengths/window/dtype contract,
+parity-tested), but the engine's windowed decode joins FOUR KV pieces
+in one softmax, so routing it through the kernel needs the kernel's
+(max, sum, out) accumulators exposed for cross-piece combination —
+that wiring is deliberately left with the multi-chip serving item
+(ROADMAP item 1) rather than half-done here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from copilot_for_consensus_tpu.ops.attention import decode_attention
+
+try:  # Pallas TPU lowering — import-light so host-only tools survive
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover - pallas ships with jax on tpu
+    HAS_PALLAS = False
+
+
+def paged_gather_layer(pool_k_l: jax.Array, pool_v_l: jax.Array,
+                       tables: jax.Array
+                       ) -> tuple[jax.Array, jax.Array]:
+    """Materialize the contiguous per-slot KV view one layer's block
+    table describes: ``[NBtot, Hkv, blk, D]`` pool + ``[B, NB]`` table
+    → ``[B, Hkv, NB*blk, D]``. Out-of-range (pad) table entries clamp;
+    their garbage columns sit at positions the caller's length mask
+    already excludes."""
+    b, nb = tables.shape
+    hkv, blk, d = pool_k_l.shape[1], pool_k_l.shape[2], pool_k_l.shape[3]
+    k = pool_k_l[tables]                       # [B, NB, Hkv, blk, D]
+    v = pool_v_l[tables]
+    k = k.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nb * blk, d)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nb * blk, d)
+    return k, v
+
+
+def paged_gather_kv(pool_k: jax.Array, pool_v: jax.Array,
+                    tables: jax.Array
+                    ) -> tuple[jax.Array, jax.Array]:
+    """Stacked-cache variant of :func:`paged_gather_layer`:
+    ``[L, NBtot, Hkv, blk, D]`` pool + ``[B, NB]`` table →
+    ``[L, B, Hkv, NB*blk, D]`` — exactly the slot-cache slice the
+    contiguous engine's decoder programs read, which is why the paged
+    dispatches can reuse them unchanged (and why greedy decode is
+    bit-identical between the two layouts at f32)."""
+    n_l = pool_k.shape[0]
+    b, nb = tables.shape
+    hkv, blk, d = pool_k.shape[2], pool_k.shape[3], pool_k.shape[4]
+    k = pool_k[:, tables]                      # [L, B, NB, Hkv, blk, D]
+    v = pool_v[:, tables]
+    k = k.transpose(0, 1, 3, 2, 4, 5).reshape(n_l, b, hkv, nb * blk, d)
+    v = v.transpose(0, 1, 3, 2, 4, 5).reshape(n_l, b, hkv, nb * blk, d)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                         out_ref, m_ref, l_ref, acc_ref, *,
+                         block: int, window: int, scale: float):
+    """One (slot, kv-head, table-entry) grid step: score the slot's
+    grouped queries against ONE physical pool block and fold it into
+    the flash-style running (max, sum, acc) accumulators. The block
+    to read was chosen by the BlockSpec index map from the
+    scalar-prefetched table — the kernel body only ever sees the
+    block the table named."""
+    b_i = pl.program_id(0)
+    i = pl.program_id(2)
+    n_i = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)              # [G, D]
+    k = k_ref[0, 0].astype(jnp.float32)              # [blk, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [G, blk]
+
+    length = lengths_ref[b_i]
+    pos = i * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = pos < length
+    if window > 0:
+        mask &= pos > length - 1 - window
+    s = jnp.where(mask, s, -jnp.inf)
+
+    m_prev = m_ref[:]                                # [G, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # fully-masked rows keep m = -inf; exp(-inf - -inf) is NaN, so the
+    # subtrahend is pinned finite there (l and acc stay 0 regardless).
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev),
+                      jnp.exp(m_prev - m_safe), 0.0)
+    p = jnp.exp(s - m_safe)                          # exp(-inf)=0 pads
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
+
+    @pl.when(i == n_i - 1)
+    def _finalize():
+        l = l_ref[:]
+        out = acc_ref[:] / jnp.where(l > 0, l, 1.0)
+        # fully-masked rows (parked slots, length 0) emit exact zeros —
+        # the same value the XLA reference's NaN guard produces
+        out_ref[0, 0] = jnp.where(l > 0, out, 0.0).astype(out_ref.dtype)
+
+
+def paged_decode_attention_pallas(
+    q: jax.Array,
+    pool_k_l: jax.Array,
+    pool_v_l: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    window: int = 0,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """The Pallas route: single-token paged decode attention for one
+    layer. q: [B, Hq, D]; pool halves: [NBtot, Hkv, blk, D] (any KV
+    dtype — fp8 dequantizes on load); tables: [B, NB] int32 (pad
+    entries >= NBtot clamp and must be length-masked); lengths: [B]
+    committed positions per slot. Returns [B, Hq, D] in q's dtype."""
+    b, hq, d = q.shape
+    nbtot, hkv, blk, _ = pool_k_l.shape
+    nb = tables.shape[1]
+    group = hq // hkv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qg = q.reshape(b, hkv, group, d)
+    # pad table ids into range for the index map (OOB blocks carry
+    # garbage that the length mask already excludes)
+    tables = jnp.minimum(tables.astype(jnp.int32), nbtot - 1)
+
+    grid = (b, hkv, nb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,                 # the block table
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b,), lambda bi, hi, i, tbl: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, group, d),
+                         lambda bi, hi, i, tbl: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, blk, d),
+                         lambda bi, hi, i, tbl: (tbl[bi, i], hi, 0, 0)),
+            pl.BlockSpec((1, 1, blk, d),
+                         lambda bi, hi, i, tbl: (tbl[bi, i], hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda bi, hi, i, tbl: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, block=blk,
+                          window=window, scale=d ** -0.5),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+        interpret=interpret,
+    )(tables, lengths.astype(jnp.int32), qg, pool_k_l, pool_v_l)
+    return out.reshape(b, hq, d)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    pool_k_l: jax.Array,
+    pool_v_l: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    window: int = 0,
+    impl: str = "auto",
+) -> jax.Array:
+    """Single-token decode attention through a block table.
+
+    Semantics are EXACTLY ``decode_attention(q, view_k, view_v,
+    lengths, window)`` where ``view_*`` is the contiguous per-slot
+    cache the table describes (``paged_gather_layer``) — GQA grouping,
+    sliding-window masking relative to ``lengths - 1``, fp8 dequant,
+    fully-masked rows emitting zeros. ``impl="xla"`` IS that
+    composition (bit-identical at f32, the CPU e2e gate's route);
+    ``impl="pallas"`` reads the pool by pointer instead of gathering
+    (TPU serving route; parity-tested against the reference)."""
+    if impl == "auto":
+        impl = "pallas" if (jax.default_backend() == "tpu"
+                            and HAS_PALLAS) else "xla"
+    if impl == "pallas":
+        return paged_decode_attention_pallas(
+            q, pool_k_l, pool_v_l, tables, lengths, window=window)
+    k, v = paged_gather_layer(pool_k_l, pool_v_l, tables)
+    return decode_attention(q, k, v, lengths, window=window)

@@ -108,6 +108,41 @@ def test_preset_artifact_columns_unchanged():
     assert bench.spec_columns(ss0, ss0)["tokens_per_weight_pass"] == 0.0
 
 
+def test_paged_capacity_preset_registered():
+    """ISSUE 14: the paged-KV capacity gate — paged engine ON, pool
+    sized at the contiguous 128-slot HBM budget (1024 x 64-token
+    blocks == 128 slots x max_len 512), slot count ABOVE the 128
+    ceiling, and a shared prefix so the zero-copy hit path exercises.
+    The shardcheck preflight must trace the paged dispatch family."""
+    assert "paged_capacity" in bench.PRESETS
+    p = bench.PRESETS["paged_capacity"]
+    assert p["BENCH_PAGED"] == "1"
+    assert int(p["BENCH_SLOTS"]) > 128
+    assert int(p["BENCH_KV_POOL_BLOCKS"]) * 64 \
+        == 128 * int(p["BENCH_MAX_LEN"])
+    assert int(p["BENCH_SHARED_PREFIX"]) > 0
+    assert int(p["BENCH_PREFIX_BLOCKS"]) > 0
+    assert "copilot_for_consensus_tpu.engine.generation" in \
+        bench.PRESET_CONTRACT_MODULES["paged_capacity"]
+
+
+def test_paged_columns_contract():
+    """paged_capacity's artifact columns are a cross-round contract:
+    max_concurrent_streams / kv_pool_fragmentation /
+    zero_copy_hit_rate (timed-run delta, zero-delta safe)."""
+    kv0 = {"paged_admits": 4, "zero_copy_admits": 0,
+           "peak_active": 3, "fragmentation_ratio": 0.5}
+    kv1 = {"paged_admits": 14, "zero_copy_admits": 8,
+           "peak_active": 170, "fragmentation_ratio": 0.12}
+    cols = bench.paged_columns(kv0, kv1)
+    assert set(cols) == {"max_concurrent_streams",
+                         "kv_pool_fragmentation", "zero_copy_hit_rate"}
+    assert cols["max_concurrent_streams"] == 170
+    assert cols["kv_pool_fragmentation"] == 0.12
+    assert cols["zero_copy_hit_rate"] == 0.8
+    assert bench.paged_columns(kv0, kv0)["zero_copy_hit_rate"] == 0.0
+
+
 def test_mixed_traffic_preset_registered():
     """The scheduler gate's preset (ISSUE 6): adversarial mix with at
     least two tenants, contract-traced through BOTH the generation

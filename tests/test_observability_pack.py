@@ -262,6 +262,9 @@ def test_telemetry_registry_matches_actual_emission():
     tele.gauge_quarantined(1)
     tele.on_released_pins(2)
     tele.on_deadline_expired()
+    # paged KV block pool (engine/kv_pool.py)
+    tele.gauge_kv_pool(12, pinned_blocks=3, fragmentation_ratio=0.25)
+    tele.on_zero_copy_admits(2)
     # durable request journal (engine/journal.py)
     tele.gauge_journal(2, checkpoint_lag=5)
     tele.on_journal_replayed()

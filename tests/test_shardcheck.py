@@ -143,14 +143,32 @@ def test_mesh_axis_typo_in_ulysses_fails_the_lane(tmp_path):
 def test_kv_dtype_mismatch_in_generation_fails_the_lane(tmp_path):
     """Build the slot cache in a different dtype than the prefix pool:
     the five engine programs no longer share one KV-cache layout."""
-    needle = ("        cache = decoder.init_cache(cfg, num_slots, "
+    needle = ("            cache = decoder.init_cache(cfg, num_slots, "
               "self.max_len,\n"
-              "                                   dtype=self.kv_dtype)")
+              "                                       "
+              "dtype=self.kv_dtype)")
     findings = _mutated_findings(
         tmp_path, _GEN, needle,
         needle.replace("dtype=self.kv_dtype", "dtype=jnp.float32"),
         "generation_kvdtype_mutated")
     assert any(f.rule == "shard-kv-layout" for f in findings), findings
+
+
+def test_block_table_dtype_flip_fails_the_lane(tmp_path):
+    """Flip the paged dispatches' declared block-table dtype: the
+    ``engine.generation-kv-table`` layout group no longer agrees with
+    the canonical ``kv_pool.BLOCK_TABLE_DTYPE`` anchor — the drift
+    class where host-built tables and the kernel's scalar-prefetch
+    spec stop describing the same indirection."""
+    needle = ("    table_dtype = jnp.int32       "
+              "# dispatch-side block-table dtype")
+    findings = _mutated_findings(
+        tmp_path, _GEN, needle,
+        needle.replace("jnp.int32", "jnp.int16"),
+        "generation_tabledtype_mutated")
+    assert any(f.rule == "shard-kv-layout"
+               and "engine.generation-kv-table" in f.message
+               for f in findings), findings
 
 
 def test_shape_mismatched_donated_arg_fails_the_lane(tmp_path):

@@ -229,6 +229,34 @@ PRESETS = {
                        # gates zero shutdown-caused redeliveries
                        "BENCH_PIPE_DRAIN_MESSAGES": "400",
                        "BENCH_PIPE_DRAIN_ARCHIVES": "2"},
+    # Multi-chip paged serving (ISSUE 15): the mesh-sharded block pool
+    # + disaggregated prefill/decode roles, verified on VIRTUAL CPU
+    # devices (children force JAX_PLATFORMS=cpu +
+    # --xla_force_host_platform_device_count, the same platform the
+    # test suite and shardcheck use — docs/PERF.md#multi-chip-serving
+    # is honest that tok/s SCALING on virtual devices measures
+    # partitioning overhead, not speedup; real-mesh numbers need real
+    # chips). Two arms: tok/s + TTFT across 1/2/4/8 virtual chips
+    # (scaling_efficiency column), and a disaggregated
+    # prefill/decode-role split (two engines, two threads, block-
+    # granular KV handoff) whose decode ITL p95 must stay within
+    # BENCH_MC_ITL_TOL of the co-located arm's WHILE prefill waves
+    # keep arriving.
+    "multichip_serving": {"BENCH_MC_CHIPS": "1,2,4,8",
+                          "BENCH_MC_TP": "2",
+                          "BENCH_MODEL": "tiny",
+                          "BENCH_SLOTS": "8",
+                          "BENCH_MAX_LEN": "128",
+                          "BENCH_PROMPT_LEN": "32",
+                          "BENCH_NEW_TOKENS": "16",
+                          "BENCH_PREFILL_CHUNK": "16",
+                          "BENCH_KV_POOL_BLOCKS": "64",
+                          "BENCH_QUANTIZE": "0",
+                          "BENCH_KV_DTYPE": "float32",
+                          "BENCH_DECODE_WINDOW": "4",
+                          "BENCH_MC_LONG_NEW": "48",
+                          "BENCH_MC_ARRIVALS": "2",
+                          "BENCH_MC_ITL_TOL": "1.5"},
     "mixed_traffic": {"BENCH_MAX_LEN": "1024", "BENCH_SLOTS": "32",
                       "BENCH_KV_DTYPE": "bfloat16",
                       "BENCH_NEW_TOKENS": "64",
@@ -277,6 +305,16 @@ PRESET_CONTRACT_MODULES = {
     # entrypoints at all — the preflight skips instead of tracing the
     # default engine set a pipeline storm never dispatches to
     "pipeline_chaos": [],
+    # the generation contract now declares the MESH-sharded paged
+    # dispatch family (admit/seeded/decode/verify/chunk through the dp
+    # shard_map indirection + the KV-handoff import: donation on both
+    # pool halves, the shared engine.generation-kv layout group, the
+    # pool's PartitionSpec divisibility, block-table dtype under dp);
+    # mesh/sharding carry the serving-mesh and rules contracts the
+    # sharded engine builds on
+    "multichip_serving": ["copilot_for_consensus_tpu.engine.generation",
+                          "copilot_for_consensus_tpu.parallel.mesh",
+                          "copilot_for_consensus_tpu.parallel.sharding"],
 }
 
 
@@ -397,6 +435,33 @@ def pipeline_chaos_columns(audit: dict) -> dict:
         "journal_replayed": int(audit.get("journal_replayed", 0)),
         "shutdown_redeliveries": int(
             audit.get("shutdown_redeliveries", 0)),
+    }
+
+
+def multichip_columns(scaling: dict, disagg: dict) -> dict:
+    """multichip_serving columns: per-chip-count throughput rows plus
+    the disaggregated-arm latency comparison — the cross-round
+    contract (tests/test_bench.py). ``scaling`` maps chip count →
+    child result ({"tok_s", "ttft_p99_s"}); ``disagg`` is the
+    role-split child's result."""
+    chips = sorted(int(c) for c in scaling)
+    top = chips[-1]
+    base = float(scaling[chips[0]].get("tok_s", 0.0)) or 1e-9
+    top_tok = float(scaling[top].get("tok_s", 0.0))
+    return {
+        "chips": top,
+        "tok_s_per_chip": round(top_tok / top, 2),
+        "scaling_efficiency": round(
+            (top_tok / base) / (top / chips[0]), 4),
+        "ttft_p99_s": float(scaling[top].get("ttft_p99_s", 0.0)),
+        "handoff_ms": float(disagg.get("handoff_ms", 0.0)),
+        "itl_p95_coloc_s": float(disagg.get("itl_p95_coloc_s", 0.0)),
+        "itl_p95_disagg_s": float(disagg.get("itl_p95_disagg_s", 0.0)),
+        "handoffs": int(disagg.get("handoffs", 0)),
+        "scaling": {str(c): {
+            "tok_s": round(float(scaling[c].get("tok_s", 0.0)), 2),
+            "ttft_p99_s": float(scaling[c].get("ttft_p99_s", 0.0)),
+        } for c in chips},
     }
 
 
@@ -1751,6 +1816,276 @@ def pipeline_chaos_headline() -> dict:
     }
 
 
+# -- multichip_serving (ISSUE 15): subprocess-per-chip-count ------------
+#
+# Every measurement runs in a CHILD interpreter whose XLA_FLAGS pin the
+# virtual device count BEFORE jax initializes (the same trick the test
+# conftest uses) — the parent never imports jax, so one chip count's
+# platform state cannot leak into the next.
+
+
+def _mc_knob(name: str, default: str) -> str:
+    preset_vals = PRESETS.get("multichip_serving", {})
+    return os.environ.get(name, preset_vals.get(name, default))
+
+
+def _mc_child_env(chips: int, mode: str) -> dict:
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={chips}",
+        "BENCH_MC_CHILD": mode,
+        "BENCH_PRESET": "", "BENCH_PREFLIGHT": "0",
+        "BENCH_NO_PROBE": "1", "BENCH_EXTRA": "0",
+    }
+
+
+def multichip_serving_headline() -> dict:
+    chip_counts = [int(c) for c in
+                   _mc_knob("BENCH_MC_CHIPS", "1,2,4,8").split(",")]
+    me = os.path.abspath(__file__)
+    py = sys.executable
+    scaling: dict[int, dict] = {}
+    rows = []
+    ok = True
+    for chips in chip_counts:
+        row = _run_row(f"scale-{chips}", [py, me],
+                       _mc_child_env(chips, f"scale:{chips}"),
+                       timeout=900.0)
+        rows.append(row)
+        if not row.get("ok"):
+            ok = False
+        scaling[chips] = row
+    disagg = _run_row("disagg", [py, me],
+                      _mc_child_env(max(chip_counts), "disagg"),
+                      timeout=900.0)
+    rows.append(disagg)
+    if not disagg.get("ok"):
+        ok = False
+    cols = multichip_columns(scaling, disagg)
+    tol = float(_mc_knob("BENCH_MC_ITL_TOL", "1.5"))
+    itl_ok = (disagg.get("ok", False)
+              and cols["itl_p95_disagg_s"]
+              <= tol * max(cols["itl_p95_coloc_s"], 1e-9))
+    out = {
+        "metric": "multi-chip sharded-paged serving "
+                  f"({max(chip_counts)} virtual CPU chips, "
+                  "dp-sharded block pool + prefill/decode role split)",
+        "value": cols["tok_s_per_chip"],
+        "unit": "tok/s/chip",
+        "vs_baseline": 0.0,     # virtual chips: no cross-hw baseline
+        "multichip_ok": bool(ok and itl_ok),
+        "itl_flat_ok": bool(itl_ok),
+        "itl_tolerance": tol,
+        "rows": rows,
+    }
+    out.update(cols)
+    if not (ok and itl_ok):
+        out["ok"] = False
+        out["reason"] = ("disaggregated decode ITL p95 "
+                         f"{cols['itl_p95_disagg_s']}s > {tol}x "
+                         f"co-located {cols['itl_p95_coloc_s']}s"
+                         if ok else "a multichip child row failed")
+    return out
+
+
+def _mc_build_engine(mesh, role="both", **overrides):
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu.engine.generation import (
+        GenerationEngine,
+    )
+    from copilot_for_consensus_tpu.models import decoder_config
+
+    cfg = decoder_config(_mc_knob("BENCH_MODEL", "tiny"))
+    kw = dict(
+        num_slots=int(_mc_knob("BENCH_SLOTS", "8")),
+        max_len=int(_mc_knob("BENCH_MAX_LEN", "128")),
+        prefill_buckets=(int(_mc_knob("BENCH_PROMPT_LEN", "32")),),
+        dtype=jnp.float32,
+        kv_dtype=_mc_knob("BENCH_KV_DTYPE", "float32"),
+        attn_impl="xla",
+        quantize=False,
+        decode_window=int(_mc_knob("BENCH_DECODE_WINDOW", "4")),
+        prefill_chunk=int(_mc_knob("BENCH_PREFILL_CHUNK", "16")),
+        kv_pool_blocks=int(_mc_knob("BENCH_KV_POOL_BLOCKS", "64")),
+        mesh=mesh, role=role, seed=0,
+    )
+    kw.update(overrides)
+    return GenerationEngine(cfg, **kw), cfg
+
+
+def _mc_mesh(chips: int):
+    if chips == 1:
+        return None
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    tp = min(int(_mc_knob("BENCH_MC_TP", "2")), chips)
+    while chips % tp:
+        tp //= 2
+    return build_mesh(MeshConfig(dp=chips // tp, tp=tp))
+
+
+def _mc_child_scale(chips: int) -> dict:
+    import numpy as np
+
+    eng, cfg = _mc_build_engine(_mc_mesh(chips))
+    rng = np.random.default_rng(0)
+    plen = int(_mc_knob("BENCH_PROMPT_LEN", "32"))
+    new = int(_mc_knob("BENCH_NEW_TOKENS", "16"))
+    prompts = [rng.integers(3, cfg.vocab_size, size=plen).tolist()
+               for _ in range(eng.num_slots)]
+    eng.generate(prompts, max_new_tokens=new)          # warmup/compile
+    t0 = time.monotonic()
+    comps = eng.generate(prompts, max_new_tokens=new)
+    elapsed = time.monotonic() - t0
+    total_new = sum(len(c.tokens) for c in comps)
+    tele = telemetry_columns(eng, last_n=eng.num_slots)
+    return {"chips": chips, "tok_s": round(total_new / elapsed, 2),
+            "ttft_p99_s": tele.get("ttft_p99_s", 0.0),
+            "elapsed_s": round(elapsed, 2)}
+
+
+def _mc_child_disagg() -> dict:
+    """Two arms on the full virtual mesh: co-located engine vs a real
+    two-thread prefill-role/decode-role deployment with block-granular
+    KV handoffs. Long decode streams measure ITL while short prefill
+    arrivals keep hitting admission the whole run — the exact spike
+    disaggregation exists to remove."""
+    import queue as queue_mod
+    import threading
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    plen = int(_mc_knob("BENCH_PROMPT_LEN", "32"))
+    long_new = int(_mc_knob("BENCH_MC_LONG_NEW", "48"))
+    arrivals_per_step = int(_mc_knob("BENCH_MC_ARRIVALS", "2"))
+
+    def _prompts(n, size):
+        return [rng.integers(3, 500, size=size).tolist()
+                for _ in range(n)]
+
+    def _long_itls(telemetry, long_plen):
+        itls = sorted(t.itl_s for t in telemetry.completed
+                      if t.prompt_len == long_plen and t.new_tokens > 1)
+        if not itls:
+            return 0.0
+        return itls[min(len(itls) - 1, int(0.95 * (len(itls) - 1)))]
+
+    # ---- co-located arm: admission waves share the decode loop ----
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=2, tp=2),
+                      devices=_mc_devices()[:4])
+    eng, cfg = _mc_build_engine(mesh)
+    longs = _prompts(4, plen)
+    shorts = _prompts(64, plen - 1)
+    # warmup both programs
+    eng.generate(_prompts(2, plen) + _prompts(2, plen - 1),
+                 max_new_tokens=4)
+    long_ids = {eng.submit(p, max_new_tokens=long_new) for p in longs}
+    done: set = set()
+    si = 0
+    while not long_ids <= done:
+        for _ in range(arrivals_per_step):
+            if si < len(shorts):
+                eng.submit(shorts[si], max_new_tokens=4)
+                si += 1
+        for c in eng.step():
+            done.add(c.request_id)
+    itl_coloc = _long_itls(eng.telemetry, plen)
+
+    # ---- disaggregated arm: prefill chips feed decode chips -------
+    devs = _mc_devices()
+    pre_mesh = build_mesh(MeshConfig(dp=2, tp=2), devices=devs[:4])
+    dec_mesh = build_mesh(MeshConfig(dp=2, tp=2), devices=devs[4:8])
+    pre, _ = _mc_build_engine(pre_mesh, role="prefill")
+    dec, _ = _mc_build_engine(dec_mesh, role="decode")
+    handoffs: "queue_mod.Queue" = queue_mod.Queue()
+    stop = threading.Event()
+    waits: list[float] = []
+
+    def prefill_loop():
+        si = 0
+        for p in longs:
+            pre.submit(p, max_new_tokens=long_new)
+        while not stop.is_set():
+            if si < len(shorts):
+                for _ in range(arrivals_per_step):
+                    if si < len(shorts):
+                        pre.submit(shorts[si], max_new_tokens=4)
+                        si += 1
+            pre.step()
+            for h in pre.take_prefilled():
+                handoffs.put(h)
+
+    t = threading.Thread(target=prefill_loop, daemon=True)
+    # decode engine warmup BEFORE the race starts (compile off-clock)
+    dec_w, _ = _mc_build_engine(dec_mesh)
+    dec_w.generate(_prompts(2, plen), max_new_tokens=4)
+    del dec_w
+    t.start()
+    need = len(longs)
+    got = 0
+    pending = []
+    while got < need:
+        try:
+            pending.append(handoffs.get(timeout=0.05))
+        except queue_mod.Empty:
+            pass
+        still = []
+        for h in pending:
+            rid = dec.admit_prefilled(h)
+            if rid is None:
+                still.append(h)
+            else:
+                waits.append(max(0.0, time.monotonic() - h.ready_at))
+                if dec.telemetry is not None:
+                    dec.telemetry.on_handoff(h.blocks, waits[-1])
+        pending = still
+        for c in dec.step():
+            if c.prompt_len == plen:
+                got += 1
+    stop.set()
+    t.join(timeout=10)
+    itl_disagg = _long_itls(dec.telemetry, plen)
+    return {
+        "itl_p95_coloc_s": round(itl_coloc, 6),
+        "itl_p95_disagg_s": round(itl_disagg, 6),
+        "handoff_ms": round(
+            1000 * sum(waits) / len(waits), 3) if waits else 0.0,
+        "handoffs": len(waits),
+    }
+
+
+def _mc_devices():
+    import jax
+
+    return jax.devices()
+
+
+def _mc_child_main(mode: str) -> None:
+    # the parent set JAX_PLATFORMS/XLA_FLAGS in our env, but the
+    # container's sitecustomize may have initialized the axon plugin —
+    # force the cpu platform the same way tests/conftest.py does
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if mode.startswith("scale:"):
+        out = _mc_child_scale(int(mode.split(":", 1)[1]))
+    elif mode == "disagg":
+        out = _mc_child_disagg()
+    else:
+        raise SystemExit(f"unknown BENCH_MC_CHILD mode {mode!r}")
+    print(json.dumps(out))
+
+
 # -- headline -----------------------------------------------------------
 
 def headline() -> dict:
@@ -1758,6 +2093,10 @@ def headline() -> dict:
         # Host-only pipeline gate (mock inference drivers): no jax, no
         # device — dispatched before the import below on purpose.
         return pipeline_chaos_headline()
+    if os.environ.get("BENCH_PRESET", "") == "multichip_serving":
+        # Subprocess-per-chip-count orchestration: the parent never
+        # imports jax (each child pins its own virtual device count).
+        return multichip_serving_headline()
     import jax
 
     if os.environ.get("BENCH_PRESET", "") == "mixed_traffic":
@@ -1981,6 +2320,12 @@ def headline() -> dict:
 
 
 def main() -> None:
+    # multichip child mode: one measurement in a pinned-device-count
+    # interpreter (dispatched before anything imports jax)
+    mc_child = os.environ.get("BENCH_MC_CHILD", "")
+    if mc_child:
+        _mc_child_main(mc_child)
+        return
     # A typo'd preset must fail LOUDLY: silently running the default
     # shapes under the requested label would record a mislabeled
     # artifact the next round trusts. ("" = no preset — extra_rows pins
@@ -2005,7 +2350,10 @@ def main() -> None:
         print(json.dumps(preflight_artifact))
         sys.exit(2)
     if (os.environ.get("BENCH_NO_PROBE", "0") != "1"
-            and preset != "pipeline_chaos"):
+            and preset not in ("pipeline_chaos", "multichip_serving")):
+        # multichip_serving runs entirely on virtual CPU devices in
+        # child interpreters — probing the TPU backend would gate it
+        # on hardware it never touches (same as pipeline_chaos).
         # pipeline_chaos never touches the accelerator (mock inference
         # drivers): probing the TPU backend would gate a host-pipeline
         # run on hardware it doesn't use.

@@ -77,6 +77,7 @@ from copilot_for_consensus_tpu.models import decoder, quant
 from copilot_for_consensus_tpu.models.configs import DecoderConfig
 from copilot_for_consensus_tpu.parallel.sharding import (
     DEFAULT_RULES,
+    serving_param_rules,
     shard_pytree,
 )
 
@@ -126,6 +127,29 @@ class Completion:
     finish_reason: str            # "eos" | "length" | "deadline"
     prefill_s: float = 0.0
     decode_s: float = 0.0
+
+
+@dataclass
+class PrefilledHandoff:
+    """One finished prefill exported for the disaggregated KV handoff
+    (prefill-role → decode-role). ``kv_k``/``kv_v`` are the slot's
+    pool blocks gathered dense ``[L, 1, Hkv, NBpad*block, Dh]`` —
+    device arrays, moved device-to-device by the importing engine's
+    ``jax.device_put`` onto its own mesh; only the first
+    ``prompt_len`` columns are live. The refcount story: the source
+    slot's pins/blocks were released at export (after the shard trie
+    adopted the prompt prefix), and the importing engine allocates
+    fresh blocks whose sole owner is the new slot — ownership moves,
+    never aliases."""
+
+    request: Request
+    first_token: int
+    prompt_len: int
+    kv_k: Any
+    kv_v: Any
+    blocks: int                   # live (un-padded) block count
+    ready_at: float               # monotonic: when the prefill parked
+    prefill_s: float = 0.0
 
 
 def _next_bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -205,6 +229,8 @@ class GenerationEngine:
         admit_hold_strict: bool = False,
         prefix_cache_blocks: int = 0,
         kv_pool_blocks: int = 0,
+        role: str = "both",
+        handoff_high: int = 0,
         spec_decode: bool = False,
         spec_draft_lens: tuple[int, ...] = (0, 4, 8),
         spec_ngram: int = 3,
@@ -399,8 +425,12 @@ class GenerationEngine:
         if mesh is not None:
             # shard_pytree device_puts numpy leaves shard-by-shard, so a
             # host-resident (mmap'd) checkpoint never fully materializes
-            # on one device.
-            params = shard_pytree(params, axes, mesh)
+            # on one device. Head-structured axes tp does not divide
+            # replicate instead of splitting within head_dim
+            # (serving_param_rules — the PR-15 root cause of the mesh
+            # bit-identity failure).
+            params = shard_pytree(params, axes, mesh,
+                                  serving_param_rules(cfg, mesh))
         else:
             params = jax.tree.map(jnp.asarray, params)
         self.params = params
@@ -421,15 +451,67 @@ class GenerationEngine:
         # ENGINE_PREFIX_CACHE.md ("Paged KV") + ops/paged_attention.py.
         self.paged = bool(kv_pool_blocks)
         self._pool = None
+        # Disaggregated serving role (engine/roles.py): "both" is the
+        # co-located default; "prefill" parks finished prefills for a
+        # block-granular KV handoff instead of decoding them, "decode"
+        # additionally accepts handed-off timelines via
+        # ``admit_prefilled``. Roles ride the paged layout — the block
+        # pool IS the handoff substrate.
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', "
+                f"got {role!r}")
+        if role != "both" and not self.paged:
+            raise ValueError(
+                "prefill/decode roles require kv_pool_blocks: the "
+                "block-granular KV handoff moves pool blocks, not "
+                "contiguous slot caches")
+        self.role = role
+        #: slot → (request, first_token, prompt_len, ready_at) parked
+        #: for handoff (prefill role); the slot's blocks keep the
+        #: prompt KV until ``take_prefilled`` exports them
+        self._handoff: dict[int, tuple] = {}
+        self.handoff_exported = 0
+        self.handoff_imported = 0
+        #: release hold: the prefill role stops releasing scheduler
+        #: waves when this many finished prefills await handoff
+        #: (parked here + exported-but-unadmitted, reported by the
+        #: wrapper via set_handoff_external — decode is the
+        #: bottleneck; prefilling further ahead only pins pool
+        #: blocks). Parked entries are slot-keyed so they cap at
+        #: num_slots: the default fires at HALF the slots parked,
+        #: which is reachable, not ornamental.
+        self._handoff_high = int(handoff_high) or max(1,
+                                                      num_slots // 2)
+        #: handoffs exported but not yet admitted downstream (the
+        #: DisaggregatedEngine reports its pending-queue depth here so
+        #: the backlog signal covers the whole handoff pipeline)
+        self._handoff_external = 0
+        #: dp degree of the paged layout (1 when unsharded) — the
+        #: block pool's shard count and the slot partition
+        self._dp = 1
+        self._slots_ps = num_slots
         if self.paged:
             from copilot_for_consensus_tpu.engine.kv_pool import (
                 BlockPool,
             )
             if mesh is not None:
-                raise ValueError(
-                    "kv_pool_blocks requires mesh=None: block tables "
-                    "are host-built per process and a dp-sharded pool "
-                    "would scatter one slot's timeline across shards")
+                # Sharded paged serving: dp splits the BLOCK axis (and
+                # the slot partition), tp splits kv-heads inside each
+                # block (replicated when indivisible). Axes beyond
+                # dp×tp have no paged dispatch plumbing yet.
+                for ax in ("pp", "sp", "ep"):
+                    if mesh.shape.get(ax, 1) != 1:
+                        raise ValueError(
+                            f"kv_pool_blocks shards over dp×tp only; "
+                            f"mesh has {ax}={mesh.shape[ax]}")
+                self._dp = int(mesh.shape["dp"])
+                if num_slots % self._dp:
+                    raise ValueError(
+                        f"kv_pool_blocks on a mesh requires num_slots "
+                        f"({num_slots}) divisible by dp ({self._dp}): "
+                        f"slots partition over the dp shards")
+                self._slots_ps = num_slots // self._dp
             block = self.prefill_chunk
             if 128 % block:
                 raise ValueError(
@@ -451,14 +533,15 @@ class GenerationEngine:
                 max(spec_draft_lens, default=0) + 1)
             #: worst-case blocks one slot can ever hold (the free-block
             #: admission accounting's unit)
-            if kv_pool_blocks < self._max_blocks + 1:
+            if kv_pool_blocks < (self._max_blocks + 1) * self._dp:
                 raise ValueError(
                     f"kv_pool_blocks={kv_pool_blocks} cannot hold even "
                     f"one max_len={self.max_len} slot "
-                    f"({self._max_blocks} blocks) plus headroom")
+                    f"({self._max_blocks} blocks) plus headroom per "
+                    f"dp shard (dp={self._dp})")
             self._pool = BlockPool(cfg, num_blocks=kv_pool_blocks,
                                    block_size=block,
-                                   kv_dtype=self.kv_dtype)
+                                   kv_dtype=self.kv_dtype, mesh=mesh)
             #: slot → block table (pool block ids, position p lives at
             #: table[p // block] offset p % block) and the index where
             #: OWNED blocks start (entries before it are BORROWED from
@@ -533,18 +616,25 @@ class GenerationEngine:
         # pool, scatters them into the slot's cache prefix, and
         # prefills ONLY the suffix — TTFT and admission FLOPs drop by
         # the shared-prefix fraction. Block size = prefill_chunk.
-        self._prefix = None
+        #: one radix trie per dp shard (a zero-copy hit appends POINTERS
+        #: into the slot's own shard's pool slice, so cached prefixes
+        #: are shard-local by construction; dp=1 = one trie, the
+        #: original design). ``_prefix`` below is the single-shard
+        #: compatibility view.
+        self._prefixes: list[Any] = []
         self._prefix_pins: dict[int, Any] = {}   # request_id → PrefixMatch
         #: prompt tokens actually prefilled / skipped via prefix reuse —
         #: the bench's savings accounting (prefix_stats()).
         self.prefill_tokens = 0
         self.prefill_tokens_saved = 0
         if prefix_cache_blocks:
-            if mesh is not None:
+            if mesh is not None and not self.paged:
                 raise ValueError(
-                    "prefix_cache_blocks requires mesh=None: the block "
+                    "prefix_cache_blocks on a mesh requires the paged "
+                    "engine (kv_pool_blocks): the contiguous block "
                     "pool and a dp-sharded slot cache would live on "
-                    "different shards")
+                    "different shards; the paged pool shards WITH its "
+                    "per-shard tries")
             if cfg.sliding_window and cfg.sliding_window < self.max_len:
                 raise ValueError(
                     "prefix_cache_blocks requires full attention: a "
@@ -558,10 +648,13 @@ class GenerationEngine:
             # trie (prefix_cache_blocks acts as an enable flag; the
             # budget is kv_pool_blocks): publish is an adopt_blocks
             # refcount handoff, hits are pointer admissions.
-            self._prefix = PrefixCache(
-                cfg, num_blocks=prefix_cache_blocks,
-                block_size=self.prefill_chunk, kv_dtype=self.kv_dtype,
-                shared=self._pool if self.paged else None)
+            self._prefixes = [
+                PrefixCache(
+                    cfg, num_blocks=prefix_cache_blocks,
+                    block_size=self.prefill_chunk,
+                    kv_dtype=self.kv_dtype,
+                    shared=self._pool if self.paged else None)
+                for _ in range(self._dp if self.paged else 1)]
 
         def _admit_seeded(params, tokens, lengths, pool_k, pool_v,
                           bids_flat, pref_lens, cache, slots, key):
@@ -973,11 +1066,8 @@ class GenerationEngine:
                 first = sample(logits, key, self.sampling)
                 return first, pool_k, pool_v
 
-            self._admit_paged_fn = jax.jit(_admit_paged,
-                                           donate_argnums=(3, 4))
-
             def _admit_seeded_paged(params, tokens, lengths, pool_k,
-                                    pool_v, bids_flat, pref_lens,
+                                    pool_v, bids, pref_lens,
                                     sbids, soffs, key):
                 """Zero-copy seeded admission: the matched prefix is
                 READ from its pool blocks for the suffix attention
@@ -985,11 +1075,11 @@ class GenerationEngine:
                 slot's table host-side, nothing is copied into any
                 per-slot cache), the suffix prefills at the per-row
                 offset, and only the fresh suffix KV scatters into the
-                slot's OWN blocks."""
+                slot's OWN blocks. ``bids``: [N, NB] — 2-D so the dp
+                shard_map splits the row axis with its rows' block ids
+                (shard-local under dp sharding)."""
                 n, sbuc = tokens.shape
-                nb = bids_flat.shape[0] // n
-                pk, pv = paged_gather_kv(pool_k, pool_v,
-                                         bids_flat.reshape(n, nb))
+                pk, pv = paged_gather_kv(pool_k, pool_v, bids)
                 scratch = decoder.init_cache(cfg, n, sbuc,
                                              dtype=self.kv_dtype)
                 logits, scratch = decoder.prefill_seeded(
@@ -1000,9 +1090,6 @@ class GenerationEngine:
                     soffs)
                 first = sample(logits, key, self.sampling)
                 return first, pool_k, pool_v
-
-            self._admit_seeded_paged_fn = jax.jit(
-                _admit_seeded_paged, donate_argnums=(3, 4))
 
             def _decode_paged(params, tokens, positions, pool_k,
                               pool_v, gbids, sbids, soffs, key, *,
@@ -1024,10 +1111,6 @@ class GenerationEngine:
                                                v_new, sbids, soffs)
                 return toks, pool_k, pool_v
 
-            self._decode_paged_fn = jax.jit(
-                _decode_paged, donate_argnums=(3, 4),
-                static_argnames=("kv_len", "n_windows"))
-
             def _verify_paged(params, tokens, qlens, positions,
                               pool_k, pool_v, gbids, sbids, soffs,
                               key, *, kv_len):
@@ -1042,10 +1125,6 @@ class GenerationEngine:
                 pool_k, pool_v = _pool_scatter(pool_k, pool_v, k_new,
                                                v_new, sbids, soffs)
                 return out, n_accept, pool_k, pool_v
-
-            self._verify_paged_fn = jax.jit(
-                _verify_paged, donate_argnums=(4, 5),
-                static_argnames=("kv_len",))
 
             def _chunk_paged(params, tokens, qlens, positions, pool_k,
                              pool_v, gbids, sbids, soffs, key, *,
@@ -1062,9 +1141,192 @@ class GenerationEngine:
                                                v_new, sbids, soffs)
                 return first, pool_k, pool_v
 
-            self._chunk_paged_fn = jax.jit(
-                _chunk_paged, donate_argnums=(4, 5),
-                static_argnames=("kv_len",))
+            if mesh is None:
+                self._admit_paged_fn = jax.jit(
+                    _admit_paged, donate_argnums=(3, 4))
+                self._admit_seeded_paged_fn = jax.jit(
+                    _admit_seeded_paged, donate_argnums=(3, 4))
+                self._decode_paged_fn = jax.jit(
+                    _decode_paged, donate_argnums=(3, 4),
+                    static_argnames=("kv_len", "n_windows"))
+                self._verify_paged_fn = jax.jit(
+                    _verify_paged, donate_argnums=(4, 5),
+                    static_argnames=("kv_len",))
+                self._chunk_paged_fn = jax.jit(
+                    _chunk_paged, donate_argnums=(4, 5),
+                    static_argnames=("kv_len",))
+            else:
+                # ---- mesh-sharded paged dispatches ------------------
+                # The block-table INDIRECTION (pool gather / pool
+                # scatter — the two ops GSPMD cannot partition: their
+                # indices are per-shard-local by the allocator's
+                # design) runs under shard_map with dp MANUAL: each
+                # body sees its own pool slice, its own slot rows, and
+                # the shard-local ids the host built. The decoder math
+                # between them — the UNCHANGED contiguous programs —
+                # runs under plain GSPMD over tp×dp inside the same
+                # jit, exactly the partitioning the contiguous mesh
+                # engine serves with (and the one the bit-identity
+                # test pins). tp stays an AUTO axis inside the
+                # shard_map pieces so a tp-sharded kv-head axis passes
+                # straight through; pp/sp/ep are size-1 here (checked
+                # above). Both pool halves stay donated through the
+                # outer jit — the one long-lived KV allocation must
+                # never double-buffer, sharded or not.
+                try:                              # jax >= 0.5
+                    from jax import shard_map
+                except ImportError:               # this toolchain
+                    from jax.experimental.shard_map import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                auto = frozenset({"tp"})
+                POOL = P(None, "dp", None, None, None)
+                VIEW = P(None, "dp", None, None, None)  # batch on dp
+                ROW2 = P("dp", None)
+
+                gather_sm = shard_map(
+                    paged_gather_kv, mesh,
+                    in_specs=(POOL, POOL, ROW2),
+                    out_specs=(VIEW, VIEW),
+                    check_rep=False, auto=auto)
+                scatter_sm = shard_map(
+                    _pool_scatter, mesh,
+                    in_specs=(POOL, POOL, VIEW, VIEW, ROW2, ROW2),
+                    out_specs=(POOL, POOL),
+                    check_rep=False, auto=auto)
+
+                def _admit_paged_mesh(params, tokens, lengths, pool_k,
+                                      pool_v, sbids, soffs, key):
+                    scratch = decoder.init_cache(
+                        cfg, tokens.shape[0], tokens.shape[1],
+                        dtype=self.kv_dtype)
+                    logits, scratch = decoder.prefill(
+                        params, tokens, lengths, cfg, scratch,
+                        attn_impl=impl)
+                    pool_k, pool_v = scatter_sm(
+                        pool_k, pool_v, scratch["k"], scratch["v"],
+                        sbids, soffs)
+                    first = sample(logits, key, self.sampling)
+                    return first, pool_k, pool_v
+
+                self._admit_paged_fn = jax.jit(
+                    _admit_paged_mesh, donate_argnums=(3, 4))
+
+                def _admit_seeded_paged_mesh(params, tokens, lengths,
+                                             pool_k, pool_v, bids,
+                                             pref_lens, sbids, soffs,
+                                             key):
+                    pk, pv = gather_sm(pool_k, pool_v, bids)
+                    scratch = decoder.init_cache(
+                        cfg, tokens.shape[0], tokens.shape[1],
+                        dtype=self.kv_dtype)
+                    logits, scratch = decoder.prefill_seeded(
+                        params, tokens, lengths, pk, pv, pref_lens,
+                        cfg, scratch)
+                    pool_k, pool_v = scatter_sm(
+                        pool_k, pool_v, scratch["k"], scratch["v"],
+                        sbids, soffs)
+                    first = sample(logits, key, self.sampling)
+                    return first, pool_k, pool_v
+
+                self._admit_seeded_paged_fn = jax.jit(
+                    _admit_seeded_paged_mesh, donate_argnums=(3, 4))
+
+                def _decode_paged_mesh(params, tokens, positions,
+                                       pool_k, pool_v, gbids, sbids,
+                                       soffs, key, *, kv_len,
+                                       n_windows=1):
+                    vk, vv = gather_sm(pool_k, pool_v, gbids)
+                    toks, view = _decode(params, tokens, positions,
+                                         {"k": vk, "v": vv}, key,
+                                         kv_len=kv_len,
+                                         n_windows=n_windows)
+                    steps = n_windows * self.decode_window
+                    k_new = _view_take(view["k"], positions, steps)
+                    v_new = _view_take(view["v"], positions, steps)
+                    pool_k, pool_v = scatter_sm(pool_k, pool_v,
+                                                k_new, v_new, sbids,
+                                                soffs)
+                    return toks, pool_k, pool_v
+
+                self._decode_paged_fn = jax.jit(
+                    _decode_paged_mesh, donate_argnums=(3, 4),
+                    static_argnames=("kv_len", "n_windows"))
+
+                def _verify_paged_mesh(params, tokens, qlens,
+                                       positions, pool_k, pool_v,
+                                       gbids, sbids, soffs, key, *,
+                                       kv_len):
+                    vk, vv = gather_sm(pool_k, pool_v, gbids)
+                    out, n_accept, view = _verify(
+                        params, tokens, qlens, positions,
+                        {"k": vk, "v": vv}, key, kv_len=kv_len)
+                    k_new = _view_take(view["k"], positions,
+                                       tokens.shape[1])
+                    v_new = _view_take(view["v"], positions,
+                                       tokens.shape[1])
+                    pool_k, pool_v = scatter_sm(pool_k, pool_v,
+                                                k_new, v_new, sbids,
+                                                soffs)
+                    return out, n_accept, pool_k, pool_v
+
+                self._verify_paged_fn = jax.jit(
+                    _verify_paged_mesh, donate_argnums=(4, 5),
+                    static_argnames=("kv_len",))
+
+                def _chunk_paged_mesh(params, tokens, qlens,
+                                      positions, pool_k, pool_v,
+                                      gbids, sbids, soffs, key, *,
+                                      kv_len):
+                    vk, vv = gather_sm(pool_k, pool_v, gbids)
+                    first, view = _prefill_chunk(
+                        params, tokens, qlens, positions,
+                        {"k": vk, "v": vv}, key, kv_len=kv_len)
+                    k_new = _view_take(view["k"], positions,
+                                       tokens.shape[1])
+                    v_new = _view_take(view["v"], positions,
+                                       tokens.shape[1])
+                    pool_k, pool_v = scatter_sm(pool_k, pool_v,
+                                                k_new, v_new, sbids,
+                                                soffs)
+                    return first, pool_k, pool_v
+
+                self._chunk_paged_fn = jax.jit(
+                    _chunk_paged_mesh, donate_argnums=(4, 5),
+                    static_argnames=("kv_len",))
+
+            # ---- KV handoff programs (disaggregated roles) ---------
+            # Export gathers a parked slot's blocks into one dense
+            # [L, 1, Hkv, NB*blk, Dh] view (plain jit: GLOBAL block
+            # ids — GSPMD reads the dp-sharded pool directly); import
+            # scatters a handed-off view into freshly allocated
+            # blocks of THIS engine's pool. Import donates both pool
+            # halves (same no-double-buffer rule as every paged
+            # dispatch); export copies out by design — the source
+            # blocks are freed right after.
+            def _export_kv(pool_k, pool_v, bids):
+                return paged_gather_kv(pool_k, pool_v, bids)
+
+            # deliberate non-donation: the export is a pure READ of
+            # the LIVE pool — the source blocks keep serving, and are
+            # freed host-side only after the handoff object exists —
+            # so donating would invalidate buffers the very next
+            # dispatch reads.
+            # jaxlint: disable=donation
+            self._export_fn = jax.jit(_export_kv)
+
+            def _import_kv(pool_k, pool_v, k_new, v_new, sbids,
+                           soffs):
+                k_upd = k_new.transpose(1, 3, 0, 2, 4)
+                v_upd = v_new.transpose(1, 3, 0, 2, 4)
+                pk = pool_k.at[:, sbids, :, soffs, :].set(
+                    k_upd.astype(pool_k.dtype), mode="drop")
+                pv = pool_v.at[:, sbids, :, soffs, :].set(
+                    v_upd.astype(pool_v.dtype), mode="drop")
+                return pk, pv
+
+            self._import_fn = jax.jit(_import_kv,
+                                      donate_argnums=(0, 1))
 
         # ---- host-side slot state --------------------------------------
         self._free = list(range(num_slots))
@@ -1148,6 +1410,14 @@ class GenerationEngine:
                                                 meta.get("eos_id", 2)))
         return cls(cfg, params, dtype=dtype,
                    quantize=meta.get("quantized") or False, **engine_kw)
+
+    @property
+    def _prefix(self):
+        """Single-trie compatibility view (every pre-mesh caller):
+        shard-aware code paths index ``_prefixes`` by dp shard
+        directly. With one shard (mesh=None, or dp=1) this IS the
+        engine's prefix cache, unchanged."""
+        return self._prefixes[0] if self._prefixes else None
 
     @property
     def prompt_limit(self) -> int:
@@ -1262,9 +1532,7 @@ class GenerationEngine:
         if self._chunk_pending or self._chunking:
             self._chunk_step()
         if self.paged:
-            self.peak_active = max(self.peak_active,
-                                   len(self._active)
-                                   + len(self._chunking))
+            self.peak_active = max(self.peak_active, self._occupied)
         if self._active or self._prefilling:
             self._decode_once()
         if self.journal is not None:
@@ -1272,6 +1540,10 @@ class GenerationEngine:
         if self.telemetry is not None:
             self.telemetry.gauge_queue(self.queue_depth,
                                        len(self._active))
+            if self.role != "both":
+                self.telemetry.gauge_role_occupancy(
+                    self.role, self._occupied / self.num_slots
+                    if self.num_slots else 0.0)
             if self.paged:
                 # gauges straight off the pool counters — the full
                 # kv_pool_stats() (headroom walk over active slots +
@@ -1326,16 +1598,23 @@ class GenerationEngine:
         over admission lookups; ``prefill_tokens``/``..._saved`` are
         engine-wide prompt-token accounting (wave + piggyback paths)."""
         out = {
-            "enabled": self._prefix is not None,
+            "enabled": bool(self._prefixes),
             "prefill_tokens": self.prefill_tokens,
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "publish_failures": self.prefix_publish_failures,
         }
-        if self._prefix is not None:
-            s = self._prefix.stats
-            out.update(s.as_dict())
-            out["hit_rate"] = s.hits / s.lookups if s.lookups else 0.0
-            out["blocks_in_use"] = self._prefix.blocks_in_use
+        if self._prefixes:
+            # aggregate across the per-dp-shard tries (one trie with
+            # mesh=None — the original single-cache ledger, unchanged)
+            agg: dict[str, int] = {}
+            for p in self._prefixes:
+                for k, v in p.stats.as_dict().items():
+                    agg[k] = agg.get(k, 0) + v
+            out.update(agg)
+            out["hit_rate"] = (agg["hits"] / agg["lookups"]
+                               if agg["lookups"] else 0.0)
+            out["blocks_in_use"] = sum(p.blocks_in_use
+                                       for p in self._prefixes)
         return out
 
     def spec_stats(self) -> dict:
@@ -1473,7 +1752,10 @@ class GenerationEngine:
 
     @property
     def _occupied(self) -> int:
-        return len(self._active) + len(self._chunking)
+        # handoff-parked slots hold blocks until exported — they count
+        # against the occupancy cap like any live timeline
+        return (len(self._active) + len(self._chunking)
+                + len(self._handoff))
 
     def _expire_deadlines(self) -> None:
         """Drop every request whose ``deadline_at`` has passed —
@@ -1512,6 +1794,16 @@ class GenerationEngine:
                 self._positions[slot] = self.max_len
                 if self.paged:
                     self._paged_release_slot(slot)
+                self._free.append(slot)
+                expired.append(req)
+        for slot in list(self._handoff):
+            req = self._handoff[slot][0]
+            if req.deadline_at <= now:
+                del self._handoff[slot]
+                m = self._prefix_pins.pop(req.request_id, None)
+                if m is not None and self._prefixes:
+                    self._prefixes[0].release(m)
+                self._paged_release_slot(slot)
                 self._free.append(slot)
                 expired.append(req)
         if self._sched is not None:
@@ -1619,26 +1911,29 @@ class GenerationEngine:
         # request only while its worst-case block footprint fits the
         # pool headroom (free + trie-evictable minus what active work
         # may still claim) — the slot count stops being the capacity
-        # bound, the pool is.
-        headroom = self._block_headroom() if self.paged else 0
-        pending_need = 0
+        # bound, the pool is. Sharded engines account PER DP SHARD and
+        # place each request on a shard with a free slot, headroom for
+        # its worst case, and (tie-break) the longest prefix match in
+        # that shard's trie — prefix-aware shard placement.
+        if self.paged:
+            headroom = {s: self._shard_headroom(s)
+                        for s in range(self._dp)}
+            free_by_shard: dict[int, list[int]] = {
+                s: [] for s in range(self._dp)}
+            for sl in self._free:
+                free_by_shard[self._slot_shard(sl)].append(sl)
         while (self._queue and self._free and len(batch) < 128
                and self._occupied + len(batch) < self._slot_cap):
             head = self._queue[0]
-            suffix = len(head.prompt)
             digs = None
-            if self._prefix is not None:
+            if self._prefixes:
                 # stat-free peek for the budget decision: a request the
                 # budget defers would otherwise be looked up (and
                 # counted in hits/tokens_matched) once per wave it
                 # waits — inflating the stats the bench reports
                 digs = self._req_digests(head)
-                suffix -= self._prefix.match_tokens(head.prompt,
-                                                    digests=digs)
-            longest = max(longest, suffix)
-            if batch and (len(batch) + 1) * _next_bucket(
-                    longest, self.buckets) > self.admission_token_budget:
-                break
+            shard = 0
+            match_len = 0
             if self.paged:
                 # Charge the FULL worst case, borrowed prefix included:
                 # admitting a seeded row pins its matched blocks (they
@@ -1648,15 +1943,39 @@ class GenerationEngine:
                 # mid-decode KVPoolExhausted this accounting exists to
                 # make unreachable.
                 need = self._worst_blocks_total(head)
-                if pending_need + need > headroom:
+                cand = None
+                for s in range(self._dp):
+                    if not free_by_shard[s] or need > headroom[s]:
+                        continue
+                    mt = self._prefixes[s].match_tokens(
+                        head.prompt, digests=digs) \
+                        if self._prefixes else 0
+                    if cand is None or mt > cand[1]:
+                        cand = (s, mt)
+                if cand is None:
                     break
-                pending_need += need
+                shard, match_len = cand
+            elif self._prefix is not None:
+                match_len = self._prefix.match_tokens(head.prompt,
+                                                      digests=digs)
+            suffix = len(head.prompt) - match_len
+            longest = max(longest, suffix)
+            if batch and (len(batch) + 1) * _next_bucket(
+                    longest, self.buckets) > self.admission_token_budget:
+                break
             m = None
-            if self._prefix is not None:
-                m = self._prefix.lookup(head.prompt, digests=digs)
+            if self._prefixes:
+                m = self._prefixes[shard].lookup(head.prompt,
+                                                 digests=digs)
                 if m.tokens == 0:       # miss: nothing pinned
                     m = None
-            batch.append((self._free.pop(0), self._queue.pop(0)))
+            if self.paged:
+                headroom[shard] -= self._worst_blocks_total(head)
+                slot = free_by_shard[shard].pop(0)
+                self._free.remove(slot)
+            else:
+                slot = self._free.pop(0)
+            batch.append((slot, self._queue.pop(0)))
             matches.append(m)
         if not batch:
             return     # occupancy cap (supervisor resource breaker)
@@ -1666,10 +1985,28 @@ class GenerationEngine:
         bucket = _next_bucket(max(suffix_lens), self.buckets)
         # Pad N to the next power of two: bounds compile-shape count at
         # log2(num_slots) per bucket. Padded rows prefill garbage and are
-        # dropped by the out-of-range slot id in the insert.
-        n = 1
-        while n < len(batch):
-            n *= 2
+        # dropped by the out-of-range slot id in the insert. Sharded
+        # waves lay rows out [dp, rows_per_shard] row-major — the dp
+        # shard_map splits the row axis, so a row MUST sit in the
+        # stripe of the shard that owns its slot's blocks.
+        if self.paged and self._dp > 1:
+            by_shard: dict[int, list[int]] = {}
+            for i, (slot, _req) in enumerate(batch):
+                by_shard.setdefault(self._slot_shard(slot),
+                                    []).append(i)
+            rows_ps = 1
+            while rows_ps < max(len(v) for v in by_shard.values()):
+                rows_ps *= 2
+            n = rows_ps * self._dp
+            row_of = {}
+            for s, idxs in by_shard.items():
+                for j, i in enumerate(idxs):
+                    row_of[i] = s * rows_ps + j
+        else:
+            n = 1
+            while n < len(batch):
+                n *= 2
+            row_of = {i: i for i in range(len(batch))}
         tokens = np.zeros((n, bucket), dtype=np.int32)
         lengths = np.ones((n,), dtype=np.int32)
         slots = np.full((n,), self.num_slots, dtype=np.int32)  # OOB pad
@@ -1692,7 +2029,8 @@ class GenerationEngine:
                     self._owned_from[slot] = len(tbl)
                     need = self._pool.blocks_for(plens[i]) - len(tbl)
                     if need > 0:
-                        tbl.extend(self._alloc_blocks(need))
+                        tbl.extend(self._alloc_blocks(
+                            need, self._slot_shard(slot)))
                     self._tables[slot] = tbl
             with step_annotation(wave_kind, seq), \
                     self._dispatch_boundary(wave_kind):
@@ -1700,25 +2038,34 @@ class GenerationEngine:
                     # Seeded wave: rows prefill only their suffix; the
                     # matched blocks gather from the pool inside the
                     # same program. NB pads to a power of two (same
-                    # compile-count bounding as N).
+                    # compile-count bounding as N). Paged engines carry
+                    # SHARD-LOCAL ids with the per-shard OOB sentinel
+                    # (the dp shard_map indexes local pool slices);
+                    # the contiguous prefix pool keeps its own ids.
+                    bps = self._pool.blocks_per_shard if self.paged \
+                        else self._prefix.num_blocks
                     nb = 1
                     while nb < max(len(m.block_ids) for m in matches
                                    if m is not None):
                         nb *= 2
-                    bids = np.full((n, nb), self._prefix.num_blocks,
+                    bids = np.full((n, nb), bps,
                                    dtype=np.int32)           # OOB pad
                     pref_lens = np.zeros((n,), dtype=np.int32)
                     for i, (slot, req) in enumerate(batch):
+                        r = row_of[i]
                         suf = req.prompt[plens[i] - suffix_lens[i]:]
-                        tokens[i, :len(suf)] = suf
-                        lengths[i] = len(suf)
-                        slots[i] = slot
+                        tokens[r, :len(suf)] = suf
+                        lengths[r] = len(suf)
+                        slots[r] = slot
                         if matches[i] is not None:
-                            bids[i, :len(matches[i].block_ids)] = \
-                                matches[i].block_ids
-                            pref_lens[i] = matches[i].tokens
+                            bids[r, :len(matches[i].block_ids)] = \
+                                np.asarray(matches[i].block_ids,
+                                           dtype=np.int32) % bps \
+                                if self.paged \
+                                else matches[i].block_ids
+                            pref_lens[r] = matches[i].tokens
                     if self.paged:
-                        rows = [(i, self._tables[slot],
+                        rows = [(row_of[i], self._tables[slot],
                                  plens[i] - suffix_lens[i],
                                  suffix_lens[i])
                                 for i, (slot, _r) in enumerate(batch)]
@@ -1727,7 +2074,7 @@ class GenerationEngine:
                             self.params, jnp.asarray(tokens),
                             jnp.asarray(lengths),
                             self._pool.k, self._pool.v,
-                            jnp.asarray(bids.reshape(-1)),
+                            jnp.asarray(bids),
                             jnp.asarray(pref_lens),
                             jnp.asarray(sbids), jnp.asarray(soffs),
                             sub)
@@ -1743,11 +2090,13 @@ class GenerationEngine:
                             self._cache, jnp.asarray(slots), sub)
                 else:
                     for i, (slot, req) in enumerate(batch):
-                        tokens[i, :plens[i]] = req.prompt
-                        lengths[i] = plens[i]
-                        slots[i] = slot
+                        r = row_of[i]
+                        tokens[r, :plens[i]] = req.prompt
+                        lengths[r] = plens[i]
+                        slots[r] = slot
                     if self.paged:
-                        rows = [(i, self._tables[slot], 0, plens[i])
+                        rows = [(row_of[i], self._tables[slot], 0,
+                                 plens[i])
                                 for i, (slot, _r) in enumerate(batch)]
                         sbids, soffs = self._write_maps(rows, bucket, n)
                         first_dev, pk, pv = self._admit_paged_fn(
@@ -1797,7 +2146,7 @@ class GenerationEngine:
             if hits and self.telemetry is not None:
                 self.telemetry.on_zero_copy_admits(hits)
         for i, (slot, req) in enumerate(batch):
-            tok = int(first[i])
+            tok = int(first[row_of[i]])
             if matches[i] is not None:
                 # pinned until retirement: an active slot's seeded
                 # prefix blocks must not be evicted out from under a
@@ -1811,6 +2160,14 @@ class GenerationEngine:
                     prefix_hit_tokens=(matches[i].tokens
                                        if matches[i] is not None
                                        else 0))
+            if (self.role == "prefill" and tok not in self._eos_set
+                    and req.max_new_tokens > 1):
+                # Disaggregated prefill role: the prompt KV is done and
+                # the first token sampled — park for the block-granular
+                # handoff instead of decoding here. The slot (and its
+                # blocks) stay held until ``take_prefilled`` exports.
+                self._park_handoff(slot, req, tok, plens[i], prefill_s)
+                continue
             self._active[slot] = req
             self._generated[slot] = [tok]
             self._spec_track(slot, req, tok)
@@ -1871,38 +2228,57 @@ class GenerationEngine:
                    + self._write_margin, self.max_len)
         return self._pool.blocks_for(span)
 
-    def _block_headroom(self) -> int:
-        """Free + trie-evictable blocks minus what already-admitted
-        work may still allocate. Admission (wave, seeded, chunked)
-        only proceeds while a candidate's worst case fits in here."""
+    def _slot_shard(self, slot: int) -> int:
+        """The dp shard a slot (and therefore every block in its
+        table) lives on. Slots partition contiguously: shard s owns
+        slots [s*slots_ps, (s+1)*slots_ps)."""
+        return slot // self._slots_ps
+
+    def _shard_headroom(self, shard: int) -> int:
+        """Free + trie-evictable blocks of ONE dp shard minus what
+        already-admitted work on that shard may still allocate.
+        Admission (wave, seeded, chunked, handoff import) only places
+        a request on a shard whose headroom fits its worst case."""
         need = 0
         for slot, req in self._active.items():
-            need += max(0, self._worst_blocks_total(req)
-                        - len(self._tables[slot]))
+            if self._slot_shard(slot) == shard:
+                need += max(0, self._worst_blocks_total(req)
+                            - len(self._tables[slot]))
         for slot, entry in self._chunking.items():
-            need += max(0, self._worst_blocks_total(entry[0])
-                        - len(self._tables[slot]))
-        evictable = self._prefix.evictable_blocks \
-            if self._prefix is not None else 0
-        return self._pool.free_blocks + evictable - need
+            if self._slot_shard(slot) == shard:
+                need += max(0, self._worst_blocks_total(entry[0])
+                            - len(self._tables[slot]))
+        evictable = self._prefixes[shard].evictable_blocks \
+            if self._prefixes else 0
+        return (self._pool.free_blocks_shard(shard) + evictable
+                - need)
 
-    def _alloc_blocks(self, n: int) -> list[int]:
-        """Allocate ``n`` pool blocks, reclaiming idle prefix-cache
-        leaves first when the free list runs short — cached-but-idle
-        prefixes yield to live timelines. Raises
-        :class:`KVPoolExhausted` (classified as resource exhaustion by
-        the supervisor) if the pool truly cannot serve, which the
-        admission accounting makes unreachable on the serving path."""
-        if n > self._pool.free_blocks and self._prefix is not None:
-            self._prefix.reclaim(n - self._pool.free_blocks)
-        return self._pool.alloc(n)
+    def _block_headroom(self) -> int:
+        """Pool-wide headroom: the sum of per-shard headrooms (one
+        shard with mesh=None — the original global accounting)."""
+        return sum(self._shard_headroom(s) for s in range(self._dp))
+
+    def _alloc_blocks(self, n: int, shard: int = 0) -> list[int]:
+        """Allocate ``n`` pool blocks on ``shard``, reclaiming idle
+        prefix-cache leaves of THAT shard's trie first when its free
+        list runs short — cached-but-idle prefixes yield to live
+        timelines. Raises :class:`KVPoolExhausted` (classified as
+        resource exhaustion by the supervisor) if the shard truly
+        cannot serve, which the admission accounting makes
+        unreachable on the serving path."""
+        free = self._pool.free_blocks_shard(shard)
+        if n > free and self._prefixes:
+            self._prefixes[shard].reclaim(n - free)
+        return self._pool.alloc(n, shard=shard)
 
     def _ensure_blocks(self, slot: int, upto: int) -> None:
-        """Grow the slot's table to cover positions [0, upto)."""
+        """Grow the slot's table to cover positions [0, upto) with
+        blocks from the slot's own dp shard."""
         tbl = self._tables[slot]
         need = self._pool.blocks_for(upto) - len(tbl)
         if need > 0:
-            tbl.extend(self._alloc_blocks(need))
+            tbl.extend(self._alloc_blocks(need,
+                                          self._slot_shard(slot)))
 
     def _paged_release_slot(self, slot: int, keep=frozenset()) -> None:
         """Return the slot's OWNED blocks to the pool (minus any the
@@ -1917,22 +2293,211 @@ class GenerationEngine:
         self._tables[slot] = []
         self._owned_from[slot] = 0
 
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode KV handoff (engine/roles.py)
+    # ------------------------------------------------------------------
+
+    def _park_handoff(self, slot: int, req: Request, first_tok: int,
+                      prompt_len: int, prefill_s: float) -> None:
+        """Prefill-role parking: the slot's blocks hold the finished
+        prompt KV (plus the sampled first token on the host side)
+        until ``take_prefilled`` exports them. Parked slots sit OOB
+        for every decode dispatch, exactly like free slots."""
+        self._positions[slot] = self.max_len
+        self._handoff[slot] = [req, first_tok, prompt_len,
+                               time.monotonic(), prefill_s]
+
+    def set_handoff_external(self, n: int) -> None:
+        """Report exported-but-unadmitted handoffs queued OUTSIDE this
+        engine (the DisaggregatedEngine's pending list) so the
+        release hold and the scheduler's ``handoff_backlog`` shed
+        signal see the whole handoff pipeline's depth, not just the
+        slot-capped parked set."""
+        self._handoff_external = max(0, int(n))
+
+    def take_prefilled(self, limit: int | None = None
+                       ) -> list[PrefilledHandoff]:
+        """Export parked finished prefills as block-granular KV
+        handoffs (prefill role). Per slot: gather its blocks dense in
+        ONE jitted read (global ids — GSPMD reads the dp-sharded pool
+        directly), publish the prompt prefix to the slot's shard trie
+        (later same-prefix prompts still hit on the prefill chips),
+        then release pins + owned blocks and free the slot. The
+        journal row retires here: from the prefill role's point of
+        view the work is done once the handoff exists; the decode
+        role re-journals it on import (docs/RESILIENCE.md)."""
+        out: list[PrefilledHandoff] = []
+        for slot in list(self._handoff):
+            if limit is not None and len(out) >= limit:
+                break
+            req, tok, plen, ready_at, prefill_s = \
+                self._handoff.pop(slot)
+            tbl = list(self._tables[slot])
+            nb = self._pool.blocks_for(plen)
+            nbp = 1
+            while nbp < nb:
+                nbp *= 2
+            bids = np.full((1, nbp), self._pool.num_blocks,
+                           dtype=np.int32)     # OOB pad: clamped, dead
+            bids[0, :nb] = tbl[:nb]
+            with self._dispatch_boundary("kv_export"):
+                kv_k, kv_v = self._export_fn(
+                    self._pool.k, self._pool.v, jnp.asarray(bids))
+            adopted: frozenset | set = frozenset()
+            pc = self._prefixes[self._slot_shard(slot)] \
+                if self._prefixes else None
+            if pc is not None:
+                try:
+                    with self._dispatch_boundary("prefix_publish"):
+                        adopted = pc.adopt_blocks(
+                            req.prompt, tbl, self._owned_from[slot],
+                            eligible_tokens=req.cache_eligible_tokens)
+                except Exception:
+                    self.prefix_publish_failures += 1
+                finally:
+                    m = self._prefix_pins.pop(req.request_id, None)
+                    if m is not None:
+                        pc.release(m)
+            self._paged_release_slot(slot, keep=adopted)
+            self._free.append(slot)
+            self.handoff_exported += 1
+            if self.telemetry is not None:
+                self.telemetry.on_retire(req.request_id, new_tokens=1,
+                                         finish_reason="handoff")
+            if self.journal is not None:
+                self.journal.record_retire(req.request_id)
+                self._journal_ckpt.pop(req.request_id, None)
+            out.append(PrefilledHandoff(
+                request=req, first_token=tok, prompt_len=plen,
+                kv_k=kv_k, kv_v=kv_v, blocks=nb, ready_at=ready_at,
+                prefill_s=prefill_s))
+        return out
+
+    def admit_prefilled(self, handoff: PrefilledHandoff, *,
+                        correlation_id: str | None = None
+                        ) -> int | None:
+        """Decode-role import: accept a handed-off finished prefill.
+        Allocates fresh blocks on a dp shard with slot + headroom,
+        moves the KV device-to-device onto this engine's mesh,
+        scatters it into the new blocks (both pool halves donated),
+        and activates the slot at ``positions == prompt_len`` with
+        the already-sampled first token — decode continues
+        bit-identically (greedy f32) to a co-located engine, because
+        the handoff moved the exact KV bytes. Returns the new request
+        id, or None when no slot/blocks fit right now — the caller
+        re-parks the handoff, which is the backpressure signal toward
+        the prefill role."""
+        if not self.paged:
+            raise ValueError("admit_prefilled requires kv_pool_blocks")
+        if self.role == "prefill":
+            raise ValueError(
+                "admit_prefilled on a prefill-role engine")
+        req0 = handoff.request
+        plen = handoff.prompt_len
+        span = min(plen + req0.max_new_tokens + self._write_margin,
+                   self.max_len)
+        need = self._pool.blocks_for(span)
+        slot = None
+        for s in range(self._dp):
+            cand = next((x for x in self._free
+                         if self._slot_shard(x) == s), None)
+            if cand is not None and need <= self._shard_headroom(s) \
+                    and self._occupied < self._slot_cap:
+                slot = cand
+                break
+        if slot is None:
+            return None
+        rid = self._next_id
+        self._next_id += 1
+        corr = correlation_id if correlation_id is not None \
+            else req0.correlation_id
+        req = Request(
+            rid, list(req0.prompt), req0.max_new_tokens,
+            cache_eligible_tokens=req0.cache_eligible_tokens,
+            correlation_id=corr, tenant=req0.tenant,
+            priority=req0.priority, deadline_at=req0.deadline_at)
+        if req.deadline_at != float("inf"):
+            # submit() is never called on this path: arm the per-step
+            # expiry sweep or a handed-off deadline would never fire
+            self._deadlines_in_use = True
+        if self.journal is not None:
+            self.journal.record_submit(
+                rid, req.prompt, req.max_new_tokens,
+                cache_eligible_tokens=req.cache_eligible_tokens,
+                correlation_id=corr, tenant=req.tenant,
+                priority=req.priority)
+            self.journal.checkpoint_many(
+                [(rid, [handoff.first_token])])
+            self._journal_ckpt[rid] = 1
+        nb = self._pool.blocks_for(plen)
+        tbl = self._alloc_blocks(nb, self._slot_shard(slot))
+        width = handoff.kv_k.shape[3]       # NBpad * block
+        sbids = np.full((1, width), self._pool.num_blocks,
+                        dtype=np.int32)     # GLOBAL ids (plain jit)
+        soffs = np.zeros((1, width), dtype=np.int32)
+        pos = np.arange(plen)
+        sbids[0, :plen] = np.asarray(tbl, dtype=np.int32)[
+            pos // self._block]
+        soffs[0, :plen] = pos % self._block
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            target = NamedSharding(self.mesh, PartitionSpec())
+        else:
+            target = jax.devices()[0]
+        with self._dispatch_boundary("kv_import"):
+            kv_k = jax.device_put(handoff.kv_k, target)
+            kv_v = jax.device_put(handoff.kv_v, target)
+            self._pool.k, self._pool.v = self._import_fn(
+                self._pool.k, self._pool.v, kv_k, kv_v,
+                jnp.asarray(sbids), jnp.asarray(soffs))
+        self._free.remove(slot)
+        self._tables[slot] = tbl
+        self._owned_from[slot] = 0
+        self.handoff_imported += 1
+        now = time.monotonic()
+        if self.telemetry is not None:
+            self.telemetry.on_submit(rid, len(req.prompt), corr)
+            self.telemetry.on_admit(rid, wave_start=now,
+                                    admit_kind="handoff")
+        tok = int(handoff.first_token)
+        self._active[slot] = req
+        self._generated[slot] = [tok]
+        self._spec_track(slot, req, tok)
+        self._positions[slot] = plen
+        self._next_tok[slot] = tok
+        self._t_prefill[slot] = handoff.prefill_s
+        req.decode_started_at = now
+        if tok in self._eos_set or req.max_new_tokens <= 1:
+            self._retire(slot,
+                         "eos" if tok in self._eos_set else "length")
+        return rid
+
     def _gather_bids(self, width_tokens: int) -> "np.ndarray":
         """[num_slots, width/block] block-id view map for a read of
         ``width_tokens`` columns per slot; rows pad OOB past their
-        table (clamped garbage, masked by lengths downstream)."""
+        table (clamped garbage, masked by lengths downstream).
+
+        Ids are SHARD-LOCAL (``gid % blocks_per_shard`` — a slot's
+        blocks never leave its dp shard, so the modulo IS the base
+        subtraction) with the per-shard block count as the OOB
+        sentinel: inside the dp shard_map each body indexes only its
+        own pool slice. One shard (mesh=None) makes local == global
+        and the sentinel == num_blocks, the original map."""
         from copilot_for_consensus_tpu.engine.kv_pool import (
             BLOCK_TABLE_DTYPE,
         )
 
+        bps = self._pool.blocks_per_shard
         nb = -(-width_tokens // self._block)
-        arr = np.full((self.num_slots, nb), self._pool.num_blocks,
+        arr = np.full((self.num_slots, nb), bps,
                       dtype=BLOCK_TABLE_DTYPE)
         for s in range(self.num_slots):
             tbl = self._tables[s]
             n = min(nb, len(tbl))
             if n:
-                arr[s, :n] = tbl[:n]
+                arr[s, :n] = np.asarray(
+                    tbl[:n], dtype=BLOCK_TABLE_DTYPE) % bps
         return arr
 
     def _write_maps(self, rows, width: int, n_rows: int):
@@ -1940,13 +2505,14 @@ class GenerationEngine:
         ``rows`` is ``[(row_idx, table, start_pos, n_valid)]`` — column
         j of row i targets block ``table[(start+j) // block]`` offset
         ``(start+j) % block`` for j < n_valid; everything else carries
-        the OOB block id and drops in the scatter."""
+        the OOB block id and drops in the scatter. Ids are shard-local
+        with the per-shard OOB sentinel (see ``_gather_bids``)."""
         from copilot_for_consensus_tpu.engine.kv_pool import (
             BLOCK_TABLE_DTYPE,
         )
 
-        bids = np.full((n_rows, width), self._pool.num_blocks,
-                       dtype=BLOCK_TABLE_DTYPE)
+        bps = self._pool.blocks_per_shard
+        bids = np.full((n_rows, width), bps, dtype=BLOCK_TABLE_DTYPE)
         offs = np.zeros((n_rows, width), dtype=BLOCK_TABLE_DTYPE)
         for idx, tbl, start, n_valid in rows:
             # columns at/past max_len are dead padding in every
@@ -1957,7 +2523,7 @@ class GenerationEngine:
                 continue
             pos = start + np.arange(n)
             bids[idx, :n] = np.asarray(tbl, dtype=BLOCK_TABLE_DTYPE)[
-                pos // self._block]
+                pos // self._block] % bps
             offs[idx, :n] = pos % self._block
         return bids, offs
 
@@ -1977,8 +2543,10 @@ class GenerationEngine:
                    - self._owned_from[s] * self._block
                    for s in self._active)
         used += sum(e[1] for e in self._chunking.values())
-        if self._prefix is not None:
-            used += self._prefix.node_count * self._block
+        # handoff-parked slots hold their prompt KV until exported
+        used += sum(h[2] - self._owned_from[s] * self._block
+                    for s, h in self._handoff.items())
+        used += sum(p.node_count for p in self._prefixes) * self._block
         return used
 
     def kv_pool_stats(self) -> dict:
@@ -2007,6 +2575,11 @@ class GenerationEngine:
                 if self.paged_admits else 0.0),
             "peak_active": self.peak_active,
             "headroom_blocks": self._block_headroom(),
+            "dp_shards": self._dp,
+            "role": self.role,
+            "handoff_parked": len(self._handoff),
+            "handoff_exported": self.handoff_exported,
+            "handoff_imported": self.handoff_imported,
         })
         return out
 
@@ -2017,11 +2590,15 @@ class GenerationEngine:
     def _sched_cost(self, req: Request) -> int:
         """What this request will actually prefill: its prompt minus
         the prefix-cache match — the DRR charge AND the chunk-vs-wave
-        routing size, so cached prompts cost their suffix."""
-        if self._prefix is None:
+        routing size, so cached prompts cost their suffix. Sharded
+        engines take the BEST match across the per-dp-shard tries
+        (the admission router places the request on that shard)."""
+        if not self._prefixes:
             return len(req.prompt)
-        return max(1, len(req.prompt) - self._prefix.match_tokens(
-            req.prompt, digests=self._req_digests(req)))
+        digs = self._req_digests(req)
+        best = max(p.match_tokens(req.prompt, digests=digs)
+                   for p in self._prefixes)
+        return max(1, len(req.prompt) - best)
 
     def _placement_key(self, req: Request):
         """Prefix-cache-aware placement key: the first radix block
@@ -2038,6 +2615,7 @@ class GenerationEngine:
         one wave's token budget of requests (DRR order), route
         long-prompt cache misses to the chunked-prefill path."""
         sched = self._sched
+        backlog = len(self._handoff) + self._handoff_external
         sched.observe(queued=self.queue_depth,
                       active=len(self._active),
                       num_slots=self.num_slots,
@@ -2045,7 +2623,17 @@ class GenerationEngine:
                       free_blocks=(self._block_headroom()
                                    if self.paged else None),
                       total_blocks=(self._pool.num_blocks
-                                    if self.paged else None))
+                                    if self.paged else None),
+                      handoff_backlog=(backlog
+                                       if self.role == "prefill"
+                                       else None))
+        if self.role == "prefill" and backlog >= self._handoff_high:
+            # Role-aware release hold: finished prefills are piling up
+            # faster than the decode role drains them — releasing more
+            # waves would only pin pool blocks behind the handoff.
+            # Decode ITL on the decode chips stays flat; the shed loop
+            # (handoff_backlog signal) handles the door.
+            return
         staged = (len(self._queue) + len(self._prefilling)
                   + len(self._chunk_pending))
         room = len(self._free) - staged
@@ -2077,11 +2665,27 @@ class GenerationEngine:
         position). Free/active rows park OOB and drop."""
         while self._chunk_pending and self._free \
                 and self._occupied < self._slot_cap:
-            if self.paged and self._worst_blocks_total(
-                    self._chunk_pending[0]) > self._block_headroom():
-                break       # free-block accounting: the pool is full
-            req = self._chunk_pending.pop(0)
-            slot = self._free.pop(0)
+            if self.paged:
+                # free-block accounting per dp shard: place the chunk
+                # on a shard with a free slot AND headroom for its
+                # worst case (first fit, shard order — chunked prompts
+                # are cache misses, so there is no prefix to chase)
+                need = self._worst_blocks_total(self._chunk_pending[0])
+                slot = None
+                for s in range(self._dp):
+                    cand = next((x for x in self._free
+                                 if self._slot_shard(x) == s), None)
+                    if cand is not None \
+                            and need <= self._shard_headroom(s):
+                        slot = cand
+                        break
+                if slot is None:
+                    break   # every shard's pool is full
+                self._free.remove(slot)
+                req = self._chunk_pending.pop(0)
+            else:
+                req = self._chunk_pending.pop(0)
+                slot = self._free.pop(0)
             self._chunking[slot] = [req, 0, time.monotonic()]
         if not self._chunking:
             return
@@ -2168,6 +2772,12 @@ class GenerationEngine:
                 self.telemetry.on_admit(req.request_id,
                                         wave_start=started,
                                         admit_kind="chunked")
+            if (self.role == "prefill" and tok not in self._eos_set
+                    and req.max_new_tokens > 1):
+                # chunked prefills hand off exactly like wave admits
+                self._park_handoff(slot, req, tok, len(req.prompt),
+                                   now - started)
+                continue
             self._active[slot] = req
             self._generated[slot] = [tok]
             self._spec_track(slot, req, tok)
@@ -2615,7 +3225,9 @@ class GenerationEngine:
         self._draft_index.pop(slot, None)
         req = self._active.pop(slot)
         adopted: frozenset | set = frozenset()
-        if self._prefix is not None:
+        pc = self._prefixes[self._slot_shard(slot)] \
+            if self._prefixes else None
+        if pc is not None:
             # Publish BEFORE the slot returns to the free list: the
             # cache still holds this prompt's KV at [0, plen). Prompt
             # KV is temperature-independent (it never saw a sampled
@@ -2627,15 +3239,16 @@ class GenerationEngine:
             try:
                 with self._dispatch_boundary("prefix_publish"):
                     if self.paged:
-                        # Refcount handoff, zero device work: the trie
-                        # adopts the slot's own prompt-prefix blocks
-                        # by id (docs/ENGINE_PREFIX_CACHE.md).
-                        adopted = self._prefix.adopt_blocks(
+                        # Refcount handoff, zero device work: the
+                        # slot's own shard's trie adopts its
+                        # prompt-prefix blocks by id
+                        # (docs/ENGINE_PREFIX_CACHE.md).
+                        adopted = pc.adopt_blocks(
                             req.prompt, self._tables[slot],
                             self._owned_from[slot],
                             eligible_tokens=req.cache_eligible_tokens)
                     else:
-                        self._prefix.publish(
+                        pc.publish(
                             req.prompt, self._cache, slot,
                             eligible_tokens=req.cache_eligible_tokens)
             except Exception:
@@ -2643,7 +3256,7 @@ class GenerationEngine:
             finally:
                 m = self._prefix_pins.pop(req.request_id, None)
                 if m is not None:
-                    self._prefix.release(m)
+                    pc.release(m)
         if self.paged:
             # tail blocks (generated-token KV + unpublished prompt
             # tail) go straight back to the allocator
@@ -2919,7 +3532,8 @@ def _shardcheck_generation_engine():
                   S((2, chunk), i32), S((2, chunk), i32)),
             donate_argnums=(0,), kv_group=group,
             kv_caches=(("prefix-pool", pool),)),
-    ] + _paged_contract_cases(cfg, group)
+    ] + _paged_contract_cases(cfg, group) \
+        + _paged_mesh_contract_cases(cfg, group)
 
 
 def _paged_contract_cases(cfg, group):
@@ -2984,7 +3598,7 @@ def _paged_contract_cases(cfg, group):
         ContractCase(
             label="admit-seeded-paged", fn=eng._admit_seeded_paged_fn,
             args=(eng.params, S((n, bucket), i32), S((n,), i32),
-                  pool["k"], pool["v"], S((n * 2,), i32), S((n,), i32),
+                  pool["k"], pool["v"], S((n, 2), i32), S((n,), i32),
                   tbl(n, bucket), tbl(n, bucket), key),
             donate_argnums=(3, 4), kv_group=group,
             kv_caches=(("kv-pool", pool),)),
@@ -3024,4 +3638,136 @@ def _paged_contract_cases(cfg, group):
                   tbl(b, eng._block), tbl(b, eng._block), key),
             donate_argnums=(4, 5), kv_group=group,
             kv_caches=(("kv-pool", pool),)),
+    ]
+
+
+def _paged_mesh_contract_cases(cfg, group):
+    """The MESH-sharded paged dispatch contracts (kv_pool_blocks > 0 on
+    a dp×tp mesh — ISSUE 15):
+
+    * every sharded dispatch still donates BOTH pool halves through
+      the outer jit (the shard_map indirection must not cost the pool
+      a double-buffer);
+    * the sharded pool rides the same ``engine.generation-kv`` layout
+      group as the single-device pool and the contiguous slot cache —
+      dp/tp sharding must never change the (L, Hkv, Dh, dtype)
+      convention the bit-identity gate depends on;
+    * the pool's PartitionSpec is declared as a divisibility contract:
+      the BLOCK axis must divide dp (per-shard allocators own equal
+      slices); kv-heads replicate here (tiny config: tp ∤ Hkv — the
+      same fallback rule the engine applies);
+    * the dispatch-side block tables keep the canonical
+      ``kv_pool.BLOCK_TABLE_DTYPE`` under dp sharding
+      (``engine.generation-kv-table`` group membership);
+    * the KV handoff import (disaggregated roles) donates both pool
+      halves like every other pool writer.
+    """
+    import functools
+
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        require_devices,
+    )
+    from copilot_for_consensus_tpu.engine.kv_pool import (
+        BLOCK_TABLE_DTYPE,
+    )
+    from copilot_for_consensus_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    require_devices(8)
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    eng = GenerationEngine(cfg, num_slots=4, max_len=64,
+                           prefill_buckets=(16, 32), decode_window=4,
+                           windows_per_dispatch=1, prefill_chunk=8,
+                           prefix_cache_blocks=4, kv_pool_blocks=32,
+                           spec_decode=True, spec_draft_lens=(0, 2, 4),
+                           mesh=mesh)
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    pool = {"k": S(eng._pool.k.shape, eng._pool.k.dtype),
+            "v": S(eng._pool.v.shape, eng._pool.v.dtype)}
+    key = jax.random.PRNGKey(0)
+    n, bucket = 4, 16
+    b = eng.num_slots
+    w = eng._dispatch_steps
+    s_v = max(eng.spec_draft_lens) + 1
+    kv_len = 64
+    nb_view = eng._view_width(kv_len, w) // eng._block
+    tgroup = "engine.generation-kv-table"
+    # the pool's PartitionSpec as a divisibility contract: blocks/dp
+    pool_logical = {"k": (None, "kv_blocks", "kv_heads", None, None),
+                    "v": (None, "kv_blocks", "kv_heads", None, None)}
+    pool_rules = {"kv_blocks": "dp",
+                  # tiny config: tp ∤ Hkv → replicated, the engine's
+                  # own fallback (BlockPool.spec does the same)
+                  "kv_heads": None}
+
+    def tbl(rows, width):
+        return S((rows, width), jnp.dtype(BLOCK_TABLE_DTYPE))
+
+    return [
+        ContractCase(
+            label="pool-partition-spec", mesh=mesh, rules=pool_rules,
+            logical=(("kv-pool-mesh", pool, pool_logical),)),
+        ContractCase(
+            label="admit-paged-mesh", fn=eng._admit_paged_fn,
+            args=(eng.params, S((n, bucket), i32), S((n,), i32),
+                  pool["k"], pool["v"], tbl(n, bucket),
+                  tbl(n, bucket), key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool-mesh", pool),),
+            buckets=eng.buckets, bucket_covers=(eng.prompt_limit,)),
+        ContractCase(
+            label="admit-seeded-paged-mesh",
+            fn=eng._admit_seeded_paged_fn,
+            args=(eng.params, S((n, bucket), i32), S((n,), i32),
+                  pool["k"], pool["v"], tbl(n, 2), S((n,), i32),
+                  tbl(n, bucket), tbl(n, bucket), key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool-mesh", pool),)),
+        ContractCase(
+            label="decode-paged-mesh",
+            fn=functools.partial(eng._decode_paged_fn, kv_len=kv_len,
+                                 n_windows=1),
+            args=(eng.params, S((b,), i32), S((b,), i32),
+                  pool["k"], pool["v"], tbl(b, nb_view),
+                  tbl(b, w), tbl(b, w), key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool-mesh", pool),)),
+        ContractCase(
+            label="decode-paged-mesh-table", kv_group=tgroup,
+            kv_caches=(("block-table",
+                        {"table": tbl(b, nb_view)}),)),
+        ContractCase(
+            label="verify-paged-mesh",
+            fn=functools.partial(eng._verify_paged_fn, kv_len=kv_len),
+            args=(eng.params, S((b, s_v), i32), S((b,), i32),
+                  S((b,), i32), pool["k"], pool["v"],
+                  tbl(b, eng._view_width(kv_len, s_v) // eng._block),
+                  tbl(b, s_v), tbl(b, s_v), key),
+            donate_argnums=(4, 5), kv_group=group,
+            kv_caches=(("kv-pool-mesh", pool),),
+            buckets=tuple(k + 1 for k in eng.spec_draft_lens),
+            bucket_covers=(max(eng.spec_draft_lens) + 1,)),
+        ContractCase(
+            label="chunk-paged-mesh",
+            fn=functools.partial(eng._chunk_paged_fn, kv_len=kv_len),
+            args=(eng.params, S((b, eng._block), i32), S((b,), i32),
+                  S((b,), i32), pool["k"], pool["v"],
+                  tbl(b, eng._view_width(kv_len, eng._block)
+                      // eng._block),
+                  tbl(b, eng._block), tbl(b, eng._block), key),
+            donate_argnums=(4, 5), kv_group=group,
+            kv_caches=(("kv-pool-mesh", pool),)),
+        ContractCase(
+            label="kv-handoff-import", fn=eng._import_fn,
+            args=(pool["k"], pool["v"],
+                  S((cfg.n_layers, 1, cfg.n_kv_heads, 16,
+                     cfg.head_dim), eng.kv_dtype),
+                  S((cfg.n_layers, 1, cfg.n_kv_heads, 16,
+                     cfg.head_dim), eng.kv_dtype),
+                  S((1, 16), i32), S((1, 16), i32)),
+            donate_argnums=(0, 1), kv_group=group,
+            kv_caches=(("kv-pool-mesh", pool),)),
     ]

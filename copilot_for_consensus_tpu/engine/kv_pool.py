@@ -37,8 +37,10 @@ from __future__ import annotations
 import numpy as np
 
 try:                      # import-light for host-only tooling/tests
+    import jax
     import jax.numpy as jnp
 except Exception:  # pragma: no cover - jax is a hard dep in serving
+    jax = None
     jnp = None
 
 #: dtype of every block table the paged dispatches consume — declared
@@ -75,7 +77,7 @@ class BlockPool:
     """
 
     def __init__(self, cfg, *, num_blocks: int, block_size: int,
-                 kv_dtype=None):
+                 kv_dtype=None, mesh=None):
         if num_blocks < 1:
             raise ValueError("kv pool needs num_blocks >= 1")
         if block_size < 1:
@@ -84,11 +86,50 @@ class BlockPool:
         self.block = int(block_size)
         self.num_blocks = int(num_blocks)
         self.kv_dtype = kv_dtype if kv_dtype is not None else jnp.bfloat16
+        self.mesh = mesh
+        #: dp shards the BLOCK axis: shard s owns the contiguous global
+        #: id range [s*blocks_per_shard, (s+1)*blocks_per_shard). Host
+        #: code speaks GLOBAL ids throughout; the engine localizes them
+        #: (id - shard base) only when building dispatch index arrays,
+        #: because inside the shard_map body each shard sees only its
+        #: own pool slice.
+        self.num_shards = int(mesh.shape["dp"]) if mesh is not None else 1
+        if num_blocks % self.num_shards:
+            raise ValueError(
+                f"kv pool num_blocks {num_blocks} must divide evenly "
+                f"over dp={self.num_shards} shards")
+        self.blocks_per_shard = num_blocks // self.num_shards
         shape = (cfg.n_layers, num_blocks, cfg.n_kv_heads, block_size,
                  cfg.head_dim)
-        self.k = jnp.zeros(shape, self.kv_dtype)
-        self.v = jnp.zeros(shape, self.kv_dtype)
-        self._free: list[int] = list(range(num_blocks))
+        if mesh is None:
+            self.spec = None
+            self.k = jnp.zeros(shape, self.kv_dtype)
+            self.v = jnp.zeros(shape, self.kv_dtype)
+        else:
+            # tp splits the kv-head axis per the engine's cache rules;
+            # replicate when tp doesn't divide it (standard GQA
+            # serving — same fallback as the contiguous cache).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            kv_tp = "tp" if cfg.n_kv_heads % mesh.shape["tp"] == 0 \
+                else None
+            self.spec = PartitionSpec(None, "dp", kv_tp, None, None)
+            sharding = NamedSharding(mesh, self.spec)
+            # allocate sharded directly — a transient full-pool array
+            # on device 0 would be the largest allocation of the build
+            zeros = jax.jit(
+                lambda: jnp.zeros(shape, self.kv_dtype),
+                out_shardings=sharding)
+            self.k = zeros()
+            self.v = zeros()
+        #: per-shard free lists over disjoint global-id ranges — the
+        #: "per-shard host allocators" of the multi-chip design: a
+        #: slot's blocks must all live in the slot's dp shard, so
+        #: alloc() takes the shard and never crosses ranges.
+        self._free_by_shard: list[list[int]] = [
+            list(range(s * self.blocks_per_shard,
+                       (s + 1) * self.blocks_per_shard))
+            for s in range(self.num_shards)]
         self._is_free = np.ones(num_blocks, dtype=bool)
         self._pins = np.zeros(num_blocks, dtype=np.int64)
         #: lifetime accounting (telemetry + benches)
@@ -97,13 +138,24 @@ class BlockPool:
 
     # -- introspection --------------------------------------------------
 
+    def shard_of(self, bid: int) -> int:
+        return int(bid) // self.blocks_per_shard
+
+    def local_id(self, bid: int) -> int:
+        """Shard-local block id (what the dispatch index arrays carry
+        under dp sharding — each shard_map body indexes its own slice)."""
+        return int(bid) % self.blocks_per_shard
+
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free_by_shard)
+
+    def free_blocks_shard(self, shard: int) -> int:
+        return len(self._free_by_shard[shard])
 
     @property
     def blocks_in_use(self) -> int:
-        return self.num_blocks - len(self._free)
+        return self.num_blocks - self.free_blocks
 
     @property
     def pinned_blocks(self) -> int:
@@ -119,18 +171,23 @@ class BlockPool:
 
     # -- allocation -----------------------------------------------------
 
-    def alloc(self, n: int = 1) -> list[int]:
-        """Take ``n`` blocks off the free list. All-or-nothing: a
-        partial grant would leave the caller's table covering less of
-        the timeline than its positions claim."""
+    def alloc(self, n: int = 1, *, shard: int = 0) -> list[int]:
+        """Take ``n`` blocks off ``shard``'s free list. All-or-nothing:
+        a partial grant would leave the caller's table covering less of
+        the timeline than its positions claim. Allocation never crosses
+        shard ranges — a slot's timeline must stay inside its own dp
+        shard's pool slice."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if not 0 <= shard < self.num_shards:
+            raise ValueError(f"alloc on unknown shard {shard}")
+        free = self._free_by_shard[shard]
+        if n > len(free):
             raise KVPoolExhausted(
-                f"kv pool exhausted: need {n} blocks, {len(self._free)} "
-                f"free of {self.num_blocks}",
-                needed=n, free=len(self._free))
-        out = [self._free.pop() for _ in range(n)]
+                f"kv pool exhausted: need {n} blocks, {len(free)} "
+                f"free of {self.blocks_per_shard} on shard {shard}",
+                needed=n, free=len(free))
+        out = [free.pop() for _ in range(n)]
         for bid in out:
             self._is_free[bid] = False
         self.allocs_total += n
@@ -152,7 +209,7 @@ class BlockPool:
                     f"free of pinned block {bid} "
                     f"({int(self._pins[bid])} pins outstanding)")
             self._is_free[bid] = True
-            self._free.append(bid)
+            self._free_by_shard[self.shard_of(bid)].append(bid)
             self.frees_total += 1
 
     def pin(self, bids) -> None:
@@ -189,8 +246,11 @@ class BlockPool:
             elif not want_free and self._is_free[bid]:
                 changed.append(bid)
             self._is_free[bid] = want_free
-        self._free = [b for b in range(self.num_blocks)
-                      if self._is_free[b]]
+        self._free_by_shard = [
+            [b for b in range(s * self.blocks_per_shard,
+                              (s + 1) * self.blocks_per_shard)
+             if self._is_free[b]]
+            for s in range(self.num_shards)]
         return changed
 
     # -- geometry helpers ------------------------------------------------

@@ -1,0 +1,239 @@
+"""Disaggregated prefill/decode serving over a device mesh.
+
+Prefill and decode have opposite hardware appetites: a prefill wave is
+one MXU-bound weight pass over thousands of prompt tokens, a decode
+step is a bandwidth-bound matvec over every active stream — co-locating
+them makes every admission wave a multi-hundred-ms ITL spike for the
+streams already decoding (the DistServe/Splitwise observation; the
+SLO scheduler's chunked prefill bounds the spike, disaggregation
+REMOVES it). This module splits a machine's devices into a
+prefill-role and a decode-role :class:`GenerationEngine` instance:
+
+* **RoleConfig** partitions the device list by dp group: the first
+  ``prefill_dp × tp`` devices form the prefill mesh, the rest the
+  decode mesh. Both engines run the mesh-sharded paged layout
+  (``kv_pool_blocks`` — the block pool is the handoff substrate).
+* **Prefill engine** (``role="prefill"``): admission waves and chunked
+  prefill run here; a finished prefill (prompt KV + sampled first
+  token) PARKS instead of decoding (``GenerationEngine._park_handoff``).
+* **KV handoff**: :meth:`DisaggregatedEngine.step` drains parked
+  prefills with ``take_prefilled`` (one jitted dense gather of the
+  slot's blocks), moves the KV to the decode mesh with
+  ``jax.device_put`` (device-to-device; on the virtual CPU mesh this
+  is a host copy — docs/PERF.md#multi-chip-serving is honest about
+  it), and ``admit_prefilled`` scatters it into freshly allocated
+  blocks of the decode pool — table re-keyed, refcounts preserved by
+  construction (source blocks released after the shard trie adopted
+  the prompt prefix; destination blocks born slot-owned).
+* **Backpressure**: ``admit_prefilled`` returning None re-parks the
+  handoff; the prefill engine's scheduler sees the parked depth
+  (``handoff_backlog`` signal + the engine's ``handoff_high`` release
+  hold), so prefill chips stop running ahead of decode capacity and
+  decode ITL stays flat while prefill waves saturate their own chips.
+
+Greedy f32 outputs are bit-identical to a co-located engine: the
+handoff moves the exact KV bytes and the first token was already
+sampled from the same prefill program.
+
+Journal/supervision semantics per role instance:
+docs/RESILIENCE.md#disaggregated-roles.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+
+from copilot_for_consensus_tpu.engine.generation import (
+    Completion,
+    GenerationEngine,
+    PrefilledHandoff,
+)
+from copilot_for_consensus_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+)
+
+
+@dataclass(frozen=True)
+class RoleConfig:
+    """How to split a device list into prefill- and decode-role
+    meshes. ``prefill_dp``/``decode_dp`` are dp-group counts; each
+    role's mesh is ``dp × tp``. ``decode_dp=0`` takes the remainder.
+    The split is by position in the device list — on a real TPU slice
+    that keeps each role on ICI-contiguous chips."""
+
+    prefill_dp: int = 1
+    decode_dp: int = 0
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> "RoleConfig":
+        pre = self.prefill_dp * self.tp
+        if pre >= n_devices:
+            raise ValueError(
+                f"prefill role takes {pre} devices of {n_devices}; "
+                f"nothing left for decode")
+        rest = n_devices - pre
+        dec = self.decode_dp
+        if dec == 0:
+            if rest % self.tp:
+                raise ValueError(
+                    f"remaining {rest} devices do not divide tp="
+                    f"{self.tp}")
+            dec = rest // self.tp
+        if dec * self.tp != rest:
+            raise ValueError(
+                f"role split {pre}+{dec * self.tp} != {n_devices} "
+                f"devices")
+        return RoleConfig(self.prefill_dp, dec, self.tp)
+
+
+class DisaggregatedEngine:
+    """Prefill-role + decode-role engine pair behind the familiar
+    ``submit``/``step``/``generate`` surface. Single-owner like the
+    engines it wraps: drive it from one thread.
+
+    ``engine_kw`` is shared engine configuration (paged geometry,
+    dtypes, prefill buckets ...); ``prefill_kw``/``decode_kw`` overlay
+    per-role (e.g. a scheduler on the prefill side only — the decode
+    side admits exclusively via handoff). ``num_slots`` must divide
+    each role's dp."""
+
+    def __init__(self, cfg, params=None, *,
+                 roles: RoleConfig = RoleConfig(),
+                 devices: list | None = None,
+                 engine_kw: dict | None = None,
+                 prefill_kw: dict | None = None,
+                 decode_kw: dict | None = None):
+        devs = list(devices if devices is not None else jax.devices())
+        roles = roles.resolve(len(devs))
+        self.roles = roles
+        n_pre = roles.prefill_dp * roles.tp
+        self.prefill_mesh = build_mesh(
+            MeshConfig(dp=roles.prefill_dp, tp=roles.tp),
+            devices=devs[:n_pre])
+        self.decode_mesh = build_mesh(
+            MeshConfig(dp=roles.decode_dp, tp=roles.tp),
+            devices=devs[n_pre:])
+        kw = dict(engine_kw or {})
+        if not kw.get("kv_pool_blocks"):
+            raise ValueError(
+                "DisaggregatedEngine requires kv_pool_blocks: the "
+                "block pool is the KV-handoff substrate")
+        pkw = {**kw, **(prefill_kw or {})}
+        dkw = {**kw, **(decode_kw or {})}
+        # decode-role engines admit via handoff only — a scheduler on
+        # that side would gate a queue that never fills
+        dkw.setdefault("scheduler", None)
+        self.prefill = GenerationEngine(
+            cfg, params, mesh=self.prefill_mesh, role="prefill",
+            **pkw)
+        self.decode = GenerationEngine(
+            cfg, params, mesh=self.decode_mesh, role="decode", **dkw)
+        #: handoffs exported from the prefill pool but not yet
+        #: admitted into the decode pool (decode-side backpressure)
+        self._pending: list[PrefilledHandoff] = []
+        #: decode-engine rid → public rid (completion re-keying)
+        self._rid_map: dict[int, int] = {}
+        #: prefill-engine rid → public rid
+        self._pre_map: dict[int, int] = {}
+        self._next_public = 0
+        self.handoffs = 0
+        self.handoff_blocks = 0
+        self.handoff_wait_s = 0.0
+
+    # -- public surface --------------------------------------------------
+
+    def submit(self, prompt: list[int], max_new_tokens: int = 256,
+               **kw) -> int:
+        rid_pre = self.prefill.submit(prompt, max_new_tokens, **kw)
+        rid_pub = self._next_public
+        self._next_public += 1
+        self._pre_map[rid_pre] = rid_pub
+        return rid_pub
+
+    def step(self) -> list[Completion]:
+        """One cooperative turn: prefill engine steps (admission +
+        chunked prefill), finished prefills hand off to the decode
+        engine as far as its capacity allows, decode engine steps.
+        Completions come back under the PUBLIC request ids."""
+        out: list[Completion] = []
+        # requests that finished AT the prefill (first-token EOS,
+        # max_new_tokens<=1, deadline) complete directly
+        for c in self.prefill.step():
+            out.append(self._rekey(c, self._pre_map.pop(
+                c.request_id, c.request_id)))
+        # Drain parked prefills through the KV handoff — but only as
+        # many as the decode side could plausibly seat: an exported
+        # handoff holds a dense device copy of its prompt KV, so
+        # draining past decode capacity would grow ``_pending``
+        # without bound AND empty the prefill engine's parked set,
+        # defeating its handoff_backlog shed signal / release hold.
+        # Un-exported prefills stay parked (blocks, not dense copies)
+        # where the backpressure plane can see them.
+        room = max(0, len(self.decode._free) - len(self._pending))
+        if room:
+            self._pending.extend(self.prefill.take_prefilled(
+                limit=room))
+        self.prefill.set_handoff_external(len(self._pending))
+        still: list[PrefilledHandoff] = []
+        for h in self._pending:
+            rid_dec = self.decode.admit_prefilled(h)
+            if rid_dec is None:
+                still.append(h)       # decode full: re-park
+                continue
+            pub = self._pre_map.pop(h.request.request_id,
+                                    h.request.request_id)
+            self._rid_map[rid_dec] = pub
+            wait = max(0.0, time.monotonic() - h.ready_at)
+            self.handoffs += 1
+            self.handoff_blocks += h.blocks
+            self.handoff_wait_s += wait
+            tele = self.prefill.telemetry
+            if tele is not None:
+                tele.on_handoff(h.blocks, wait)
+        self._pending = still
+        for c in self.decode.step():
+            out.append(self._rekey(c, self._rid_map.pop(
+                c.request_id, c.request_id)))
+        return out
+
+    def generate(self, prompts: list[list[int]],
+                 max_new_tokens: int = 256, **kw) -> list[Completion]:
+        ids = [self.submit(p, max_new_tokens, **kw) for p in prompts]
+        results: dict[int, Completion] = {}
+        while len(results) < len(ids):
+            for c in self.step():
+                results[c.request_id] = c
+        return [results[i] for i in ids]
+
+    @property
+    def queue_depth(self) -> int:
+        return (self.prefill.queue_depth + len(self._pending)
+                + len(self.prefill._handoff)
+                + self.decode.queue_depth)
+
+    def stats(self) -> dict:
+        """Role-split ledger for benches/metrics."""
+        return {
+            "handoffs": self.handoffs,
+            "handoff_blocks": self.handoff_blocks,
+            "handoff_wait_mean_s": (self.handoff_wait_s / self.handoffs
+                                    if self.handoffs else 0.0),
+            "pending_handoffs": len(self._pending),
+            "prefill": self.prefill.kv_pool_stats(),
+            "decode": self.decode.kv_pool_stats(),
+        }
+
+    # -- internals -------------------------------------------------------
+
+    @staticmethod
+    def _rekey(c: Completion, public_id: int) -> Completion:
+        if c.request_id == public_id:
+            return c
+        return Completion(
+            request_id=public_id, prompt_len=c.prompt_len,
+            tokens=c.tokens, finish_reason=c.finish_reason,
+            prefill_s=c.prefill_s, decode_s=c.decode_s)

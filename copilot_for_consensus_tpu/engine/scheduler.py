@@ -155,6 +155,19 @@ class SchedulerConfig:
     #: allocator actually runs dry.
     kv_low_ratio: float = 0.10
     kv_critical_ratio: float = 0.02
+    #: disaggregated-role shed thresholds (engine ``role="prefill"``):
+    #: finished prefills awaiting handoff (slot-parked + exported-but-
+    #: unadmitted) beyond this depth shed the batch lane (decode chips
+    #: are the bottleneck — prefilling further ahead only pins pool
+    #: blocks behind the handoff); at 2x everything sheds. The engine
+    #: additionally stops RELEASING waves at its ``handoff_high`` mark
+    #: (default num_slots/2), so the shed levels here are the
+    #: door-side mirror of that hold — decode ITL stays flat while
+    #: prefill chips saturate on work decode can actually take. Size
+    #: this to the role PAIR: the backlog signal is bounded by
+    #: prefill slots + the wrapper's capacity-capped pending queue,
+    #: so a threshold above that sum can never fire.
+    handoff_shed_depth: int = 16
 
 
 @dataclass
@@ -326,7 +339,8 @@ class Scheduler:
     def observe(self, *, queued: int, active: int, num_slots: int,
                 telemetry: Any = None, now: float | None = None,
                 free_blocks: int | None = None,
-                total_blocks: int | None = None) -> dict:
+                total_blocks: int | None = None,
+                handoff_backlog: int | None = None) -> dict:
         """Recompute the overload level and Retry-After estimate from
         the engine's own signals. Called once per engine step (and from
         tests with synthetic traces).
@@ -378,6 +392,14 @@ class Scheduler:
                 level = 2
             elif kv_ratio < self.cfg.kv_low_ratio:
                 level = max(level, 1)
+        if handoff_backlog is not None and self.cfg.handoff_shed_depth:
+            # prefill-role engines: parked handoffs mean the DECODE
+            # side is the bottleneck — shed at the door instead of
+            # prefilling work nothing can decode yet
+            if handoff_backlog >= 2 * self.cfg.handoff_shed_depth:
+                level = 2
+            elif handoff_backlog >= self.cfg.handoff_shed_depth:
+                level = max(level, 1)
         level = max(level, min(2, self.pressure))
         self.overload_level = level
         # Honest Retry-After: time to drain the current backlog at the
@@ -402,6 +424,8 @@ class Scheduler:
         }
         if kv_ratio is not None:
             self.last_signals["kv_headroom_ratio"] = round(kv_ratio, 4)
+        if handoff_backlog is not None:
+            self.last_signals["handoff_backlog"] = int(handoff_backlog)
         self._export_gauges()
         return self.last_signals
 

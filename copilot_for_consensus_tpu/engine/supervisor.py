@@ -513,11 +513,17 @@ class EngineSupervisor:
             resource=is_resource_exhaustion(exc),
             suspect=was_suspect)
         if not injected:
-            prefix = getattr(eng, "_prefix", None)
-            if prefix is not None and hasattr(prefix, "flush"):
-                # Device state is suspect: pool blocks of unknown
-                # integrity must never seed a future admission wave.
-                prefix.flush()
+            # Device state is suspect: pool blocks of unknown
+            # integrity must never seed a future admission wave.
+            # Sharded engines carry one trie per dp shard — flush
+            # them all.
+            prefixes = getattr(eng, "_prefixes", None)
+            if not prefixes:
+                p = getattr(eng, "_prefix", None)
+                prefixes = [p] if p is not None else []
+            for prefix in prefixes:
+                if hasattr(prefix, "flush"):
+                    prefix.flush()
         plan.audit = self.audit(repair=True)
         return plan
 
@@ -550,6 +556,17 @@ class EngineSupervisor:
                 eng._paged_release_slot(slot)
             eng._free.append(slot)
             out.append((req, []))
+        for slot in list(getattr(eng, "_handoff", {})):
+            # prefill-role parked handoffs: the first token was
+            # sampled, so the replay continuation carries it
+            entry = eng._handoff.pop(slot)
+            req, tok = entry[0], entry[1]
+            eng._positions[slot] = eng.max_len
+            self._release_pin(req.request_id)
+            if paged:
+                eng._paged_release_slot(slot)
+            eng._free.append(slot)
+            out.append((req, [int(tok)]))
         return out
 
     def purge_queued(self) -> list:
@@ -619,16 +636,23 @@ class EngineSupervisor:
         findings: dict[str, Any] = {}
         active = set(getattr(eng, "_active", {}))
         chunking = set(getattr(eng, "_chunking", {}))
+        handoff = set(getattr(eng, "_handoff", {}))
         free = list(getattr(eng, "_free", []))
         quarantined = set(self.quarantined)
 
         dup_free = sorted({s for s in free if free.count(s) > 1})
-        overlap = sorted((set(free) & active) | (set(free) & chunking))
-        known = set(free) | active | chunking | quarantined
+        overlap = sorted((set(free) & active) | (set(free) & chunking)
+                         | (set(free) & handoff))
+        known = set(free) | active | chunking | handoff | quarantined
         lost = sorted(set(range(eng.num_slots)) - known)
         gen_orphans = sorted(set(getattr(eng, "_generated", {})) - active)
         active_rids = {r.request_id
                        for r in getattr(eng, "_active", {}).values()}
+        # handoff-parked requests still BORROW their matched trie
+        # blocks until export — releasing their pins here would let
+        # the trie evict KV a parked table references
+        active_rids |= {h[0].request_id
+                        for h in getattr(eng, "_handoff", {}).values()}
         pin_leaks = sorted(rid for rid in getattr(eng, "_prefix_pins", {})
                            if rid not in active_rids)
         if dup_free:
@@ -651,15 +675,19 @@ class EngineSupervisor:
         owned_blocks: set[int] = set()
         if paged:
             pool = eng._pool
-            prefix = getattr(eng, "_prefix", None)
-            trie_blocks = {n.block_id for n in prefix._nodes} \
-                if prefix is not None else set()
+            prefixes = getattr(eng, "_prefixes", None)
+            if prefixes is None:
+                p = getattr(eng, "_prefix", None)
+                prefixes = [p] if p is not None else []
+            trie_blocks = {n.block_id for p in prefixes
+                           for n in p._nodes}
             owned_blocks |= trie_blocks
             owner_of: dict[int, int] = {}
             for slot in range(eng.num_slots):
                 tbl = eng._tables[slot]
                 of = eng._owned_from[slot]
-                if tbl and slot not in active and slot not in chunking:
+                if tbl and slot not in active and slot not in chunking \
+                        and slot not in handoff:
                     # a table on a slot no request tracks is an orphan:
                     # its blocks are unaccounted-for
                     findings.setdefault("block_table_orphans",
@@ -724,6 +752,10 @@ class EngineSupervisor:
                     if req is None:
                         ch = eng._chunking.pop(slot, None)
                         req = ch[0] if ch else None
+                    if req is None:
+                        h = getattr(eng, "_handoff", {}).pop(slot,
+                                                             None)
+                        req = h[0] if h else None
                     if req is not None:
                         self._release_pin(req.request_id)
                     eng._generated.pop(slot, None)

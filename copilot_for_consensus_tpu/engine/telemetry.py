@@ -71,7 +71,8 @@ from copilot_for_consensus_tpu.obs.metrics import (
 METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
     "engine_requests_total": (
         "counter", ("engine", "finish_reason"),
-        "Requests retired, by finish reason (eos|length|error)."),
+        "Requests retired, by finish reason "
+        "(eos|length|deadline|error|handoff)."),
     "engine_tokens_total": (
         "counter", ("engine", "kind"),
         "Tokens through the engine: kind=prompt (prefilled), "
@@ -196,6 +197,22 @@ METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "Seeded admissions that appended matched block ids to the "
         "slot's table instead of gathering a pool→slot copy "
         "(pointer-only prefix admission)."),
+    # ---- disaggregated prefill/decode roles (engine/roles.py +
+    # GenerationEngine(role=...); docs/PERF.md#multi-chip-serving) ----
+    "engine_role_occupancy": (
+        "gauge", ("engine", "role"),
+        "Occupied slots / total slots per role instance (active + "
+        "chunking + handoff-parked) — the prefill/decode split's "
+        "saturation view."),
+    "engine_role_handoff_blocks_total": (
+        "counter", ("engine",),
+        "KV pool blocks moved through the prefill→decode handoff "
+        "(block-granular device-to-device transfers)."),
+    "engine_role_handoff_wait_seconds": (
+        "histogram", ("engine",),
+        "Prefill-ready → decode-admitted wait per handed-off request "
+        "(the disaggregation tax; the EngineKVHandoffStalled alert "
+        "watches its p99 against a standing handoff backlog)."),
     # ---- durable request journal (engine/journal.py;
     # docs/RESILIENCE.md#process-lifecycle) ----
     "engine_journal_depth": (
@@ -573,6 +590,22 @@ class EngineTelemetry:
     def on_zero_copy_admits(self, n: int = 1) -> None:
         self.metrics.increment("engine_kv_pool_zero_copy_admits_total",
                                float(n), self._labels)
+
+    # -- disaggregated roles (engine/roles.py) --------------------------
+
+    def gauge_role_occupancy(self, role: str, occupancy: float) -> None:
+        self.metrics.gauge("engine_role_occupancy", float(occupancy),
+                           {**self._labels, "role": role or "both"})
+
+    def on_handoff(self, blocks: int, wait_s: float) -> None:
+        """One prefill→decode KV handoff completed: ``blocks`` pool
+        blocks moved, ``wait_s`` between prefill-ready and
+        decode-admit (the DisaggregatedEngine wrapper drives this)."""
+        m, lb = self.metrics, self._labels
+        m.increment("engine_role_handoff_blocks_total", float(blocks),
+                    lb)
+        m.observe("engine_role_handoff_wait_seconds", float(wait_s),
+                  lb)
 
     # -- durable request journal (engine/journal.py) --------------------
 

@@ -39,6 +39,33 @@ DEFAULT_RULES: LogicalAxisRules = {
 }
 
 
+def serving_param_rules(cfg, mesh: Mesh,
+                        rules: LogicalAxisRules | None = None
+                        ) -> LogicalAxisRules:
+    """DEFAULT_RULES adjusted for a decoder config on a concrete mesh:
+    replicate any HEAD-structured axis the tp degree does not divide.
+
+    The fused projection leaves (``wk``/``wv``: ``[.., Hkv*Dh]``) are
+    always divisible by tp in bytes, so a naive rules table shards them
+    even when ``tp > n_kv_heads`` — which splits WITHIN ``head_dim``.
+    That is semantically cursed (RoPE's rotate-half pairs columns
+    ``i``/``i+Dh/2`` across the shard boundary) and, root-caused in
+    PR 15, actually MISCOMPILES on the XLA CPU partitioner at some
+    tile shapes (dp=2×tp=4 over Hkv=2 produced logits off by ~0.9 —
+    the long-documented ``test_engine_on_mesh_matches_single_device``
+    "environment failure"). Standard GQA serving replicates KV when tp
+    exceeds the kv-head count; this helper applies exactly that rule,
+    mirroring the cache-side fallback the engine has always had."""
+    rules = dict(rules or DEFAULT_RULES)
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1:
+        if cfg.n_kv_heads % tp:
+            rules["kv_heads"] = None
+        if cfg.n_heads % tp:
+            rules["heads"] = None
+    return rules
+
+
 def logical_to_spec(axes: Sequence[str | None],
                     rules: LogicalAxisRules | None = None) -> PartitionSpec:
     rules = rules or DEFAULT_RULES

@@ -417,3 +417,51 @@ def test_scale_bench_smoke_arm_runs_green():
     assert artifact["workers"]["chunking"] >= 2
     assert artifact["speedup_vs_baseline"] > 0
     assert artifact["stats"]["messages"] == 240
+
+
+def test_multichip_serving_preset_registered():
+    """ISSUE 15: the multi-chip sharded-paged serving gate — paged
+    pool sized so every dp degree in the chip sweep gets equal shards
+    with per-slot headroom, and the preflight traces the MESH-sharded
+    dispatch family plus the serving mesh/rules contracts."""
+    assert "multichip_serving" in bench.PRESETS
+    p = bench.PRESETS["multichip_serving"]
+    chips = [int(c) for c in p["BENCH_MC_CHIPS"].split(",")]
+    assert chips[0] == 1 and chips[-1] == 8
+    tp = int(p["BENCH_MC_TP"])
+    blocks = int(p["BENCH_KV_POOL_BLOCKS"])
+    slots = int(p["BENCH_SLOTS"])
+    max_blocks = int(p["BENCH_MAX_LEN"]) // int(p["BENCH_PREFILL_CHUNK"])
+    for c in chips:
+        dp = c // tp if c > tp else 1
+        assert blocks % dp == 0
+        assert slots % dp == 0
+        assert blocks // dp >= max_blocks + 1
+    assert float(p["BENCH_MC_ITL_TOL"]) >= 1.0
+    mods = bench.PRESET_CONTRACT_MODULES["multichip_serving"]
+    assert "copilot_for_consensus_tpu.engine.generation" in mods
+    assert "copilot_for_consensus_tpu.parallel.mesh" in mods
+    assert "copilot_for_consensus_tpu.parallel.sharding" in mods
+
+
+def test_multichip_columns_contract():
+    """multichip_serving's artifact columns are a cross-round
+    contract: chips / tok_s_per_chip / scaling_efficiency /
+    ttft_p99_s / handoff_ms plus the two-arm ITL comparison."""
+    scaling = {1: {"tok_s": 100.0, "ttft_p99_s": 0.01},
+               2: {"tok_s": 180.0, "ttft_p99_s": 0.012},
+               4: {"tok_s": 320.0, "ttft_p99_s": 0.015},
+               8: {"tok_s": 560.0, "ttft_p99_s": 0.02}}
+    disagg = {"itl_p95_coloc_s": 0.3, "itl_p95_disagg_s": 0.05,
+              "handoff_ms": 12.5, "handoffs": 9}
+    cols = bench.multichip_columns(scaling, disagg)
+    assert cols["chips"] == 8
+    assert cols["tok_s_per_chip"] == 70.0
+    assert cols["scaling_efficiency"] == 0.7
+    assert cols["ttft_p99_s"] == 0.02
+    assert cols["handoff_ms"] == 12.5
+    assert cols["itl_p95_disagg_s"] == 0.05
+    assert set(cols["scaling"]) == {"1", "2", "4", "8"}
+    # degenerate single-chip sweep stays well-formed
+    one = bench.multichip_columns({1: {"tok_s": 0.0}}, {})
+    assert one["scaling_efficiency"] == 0.0

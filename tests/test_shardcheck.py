@@ -370,3 +370,43 @@ def test_bench_preflight_blocks_on_contract_violation():
     assert line["ok"] is False
     assert "shardcheck preflight failed" in line["reason"]
     assert any("shard-donation" in f for f in line["findings"])
+
+
+def test_mesh_scatter_out_spec_flip_fails_the_lane(tmp_path):
+    """Flip the mesh scatter's pool out_specs to replicated: the
+    shard_map returns a shard-local-shaped pool as the global result,
+    so the donated pool halves lose their shape-matching outputs —
+    exactly the pool-PartitionSpec drift the sharded dispatches must
+    never ship (ISSUE 15 tripwire)."""
+    needle = ("                    in_specs=(POOL, POOL, VIEW, VIEW, "
+              "ROW2, ROW2),\n"
+              "                    out_specs=(POOL, POOL),")
+    findings = _mutated_findings(
+        tmp_path, _GEN, needle,
+        needle.replace(
+            "out_specs=(POOL, POOL),",
+            "out_specs=(P(None, None, None, None, None),\n"
+            "                               "
+            "P(None, None, None, None, None)),"),
+        "generation_mesh_poolspec_mutated")
+    assert any(f.rule == "shard-donation"
+               and "paged-mesh" in f.context
+               for f in findings), findings
+
+
+def test_mesh_handoff_import_dropped_donation_fails_the_lane(tmp_path):
+    """Cast the KV-handoff import's pool outputs: the donated pool
+    halves no longer alias and every handoff would double-buffer the
+    whole decode pool."""
+    needle = "                return pk, pv\n\n            " \
+             "self._import_fn = jax.jit(_import_kv,"
+    findings = _mutated_findings(
+        tmp_path, _GEN, needle,
+        needle.replace(
+            "                return pk, pv",
+            "                return pk.astype(jnp.float16), "
+            "pv.astype(jnp.float16)"),
+        "generation_handoff_donation_mutated")
+    assert any(f.rule == "shard-donation"
+               and "kv-handoff-import" in f.context
+               for f in findings), findings

@@ -163,7 +163,14 @@ PRESETS = {
                        "BENCH_SHARED_PREFIX": "128",
                        "BENCH_PREFIX_BLOCKS": "64",
                        "BENCH_DECODE_WINDOW": "32",
-                       "BENCH_WINDOWS_PER_DISPATCH": "1"},
+                       "BENCH_WINDOWS_PER_DISPATCH": "1",
+                       # kernel route (ISSUE 16): the headline arm lets
+                       # the engine auto-select (Pallas on TPU, XLA
+                       # reference elsewhere) and the second arm pins
+                       # kv_kernel="pallas" to report the gather-free
+                       # route's tok/s next to it (kernel_route column)
+                       "BENCH_KV_KERNEL": "auto",
+                       "BENCH_KV_KERNEL_ARM": "1"},
     "chaos": {"BENCH_MAX_LEN": "512", "BENCH_SLOTS": "16",
               "BENCH_CHAOS_DTYPE": "float32",
               "BENCH_NEW_TOKENS": "48",
@@ -256,7 +263,13 @@ PRESETS = {
                           "BENCH_DECODE_WINDOW": "4",
                           "BENCH_MC_LONG_NEW": "48",
                           "BENCH_MC_ARRIVALS": "2",
-                          "BENCH_MC_ITL_TOL": "1.5"},
+                          "BENCH_MC_ITL_TOL": "1.5",
+                          # kernel route (ISSUE 16): scale children
+                          # auto-select (reference on virtual CPU
+                          # devices); one extra child at the top chip
+                          # count pins "pallas" so the mesh kernel
+                          # route is exercised + reported every round
+                          "BENCH_KV_KERNEL": "auto"},
     "mixed_traffic": {"BENCH_MAX_LEN": "1024", "BENCH_SLOTS": "32",
                       "BENCH_KV_DTYPE": "bfloat16",
                       "BENCH_NEW_TOKENS": "64",
@@ -372,6 +385,24 @@ def paged_columns(kv0: dict, kv1: dict) -> dict:
             kv1.get("fragmentation_ratio", 0.0)),
         "zero_copy_hit_rate": round(hits / admits, 3) if admits
         else 0.0,
+    }
+
+
+def kernel_route_columns(route: str, ref_tok_s: float,
+                         kernel_tok_s: float) -> dict:
+    """Kernel-route arm columns (ISSUE 16): which paged-attention
+    dispatch route the arm's engine actually resolved (``kernel``
+    proves the Pallas no-gather route compiled, not the XLA
+    reference), its throughput, and the ratio against the headline
+    arm. Zero-safe: a failed headline arm reports delta 0.0 instead
+    of dividing by zero. On CPU the kernel runs in interpret mode, so
+    the delta there measures the interpreter, not the gather
+    elimination — docs/PERF.md#kernel-route."""
+    return {
+        "kv_route": str(route),
+        "kernel_tok_s": round(float(kernel_tok_s), 2),
+        "kernel_tok_s_delta": round(kernel_tok_s / ref_tok_s, 3)
+        if ref_tok_s else 0.0,
     }
 
 
@@ -1861,6 +1892,18 @@ def multichip_serving_headline() -> dict:
     rows.append(disagg)
     if not disagg.get("ok"):
         ok = False
+    # Kernel-route arm (ISSUE 16): one more child at the top chip
+    # count with the Pallas route pinned on — the mesh-sharded kernel
+    # dispatch family compiles (interpret mode on virtual CPU devices)
+    # and its tok/s lands next to the reference child's every round.
+    top = max(chip_counts)
+    kern = _run_row(f"kernel-{top}", [py, me],
+                    {**_mc_child_env(top, f"scale:{top}"),
+                     "BENCH_KV_KERNEL": "pallas"},
+                    timeout=900.0)
+    rows.append(kern)
+    if not kern.get("ok"):
+        ok = False
     cols = multichip_columns(scaling, disagg)
     tol = float(_mc_knob("BENCH_MC_ITL_TOL", "1.5"))
     itl_ok = (disagg.get("ok", False)
@@ -1879,6 +1922,10 @@ def multichip_serving_headline() -> dict:
         "rows": rows,
     }
     out.update(cols)
+    out["kernel_route"] = kernel_route_columns(
+        kern.get("kv_route", ""),
+        float(scaling[top].get("tok_s", 0.0)),
+        float(kern.get("tok_s", 0.0)))
     if not (ok and itl_ok):
         out["ok"] = False
         out["reason"] = ("disaggregated decode ITL p95 "
@@ -1908,6 +1955,7 @@ def _mc_build_engine(mesh, role="both", **overrides):
         decode_window=int(_mc_knob("BENCH_DECODE_WINDOW", "4")),
         prefill_chunk=int(_mc_knob("BENCH_PREFILL_CHUNK", "16")),
         kv_pool_blocks=int(_mc_knob("BENCH_KV_POOL_BLOCKS", "64")),
+        kv_kernel=_mc_knob("BENCH_KV_KERNEL", "auto"),
         mesh=mesh, role=role, seed=0,
     )
     kw.update(overrides)
@@ -1945,6 +1993,7 @@ def _mc_child_scale(chips: int) -> dict:
     tele = telemetry_columns(eng, last_n=eng.num_slots)
     return {"chips": chips, "tok_s": round(total_new / elapsed, 2),
             "ttft_p99_s": tele.get("ttft_p99_s", 0.0),
+            "kv_route": eng._kv_route,
             "elapsed_s": round(elapsed, 2)}
 
 
@@ -2148,6 +2197,23 @@ def headline() -> dict:
     paged_on = knob("BENCH_PAGED", "0") == "1"
     kv_pool_blocks = int(knob("BENCH_KV_POOL_BLOCKS",
                               "1024" if paged_on else "0"))
+    # Paged dispatch route (ISSUE 16): "auto" lets the engine pick per
+    # backend (Pallas kernel on TPU, XLA reference elsewhere);
+    # "pallas"/"reference" pin it. Value typos already failed loudly in
+    # main(); a pinned route without the paged engine fails the same
+    # way here — the engine would raise, but the driver should record
+    # a structured artifact, not a stack trace.
+    kv_kernel = knob("BENCH_KV_KERNEL", "auto")
+    if kv_kernel != "auto" and not paged_on:
+        print(json.dumps({
+            "metric": "bench-kv-kernel",
+            "value": 0.0,
+            "unit": "",
+            "ok": False,
+            "reason": f"BENCH_KV_KERNEL {kv_kernel!r} pins a paged "
+                      "dispatch route but BENCH_PAGED is off",
+        }))
+        sys.exit(2)
     # Flight recorder / telemetry (engine/telemetry.py): default ON —
     # the artifact's TTFT/ITL/occupancy columns come from it.
     # BENCH_TELEMETRY=0 is the overhead-measurement arm (run
@@ -2189,13 +2255,16 @@ def headline() -> dict:
     # tail, so give the admission wave a tail-sized bucket next to the
     # cold-start full-prompt bucket.
     buckets = tuple(sorted({prompt_len, max(1, prompt_len - shared_prefix)}))
-    eng = GenerationEngine(
-        cfg,
+    # Shared ctor kwargs so the kernel-route arm below rebuilds the
+    # EXACT same engine with only kv_kernel flipped — any other drift
+    # between the two arms would make the delta column a lie.
+    eng_kwargs = dict(
         num_slots=slots,
         max_len=max_len,
         prefill_buckets=buckets,
         prefix_cache_blocks=prefix_blocks,
         kv_pool_blocks=kv_pool_blocks if paged_on else 0,
+        kv_kernel=kv_kernel,
         dtype=jnp.bfloat16,
         kv_dtype=kv_name,
         seed=0,
@@ -2214,6 +2283,7 @@ def headline() -> dict:
         spec_decode=spec_on,
         telemetry=tele_on,
     )
+    eng = GenerationEngine(cfg, **eng_kwargs)
     log(f"engine built (random {model} weights, "
         f"{quantize or 'bf16'}) in {time.monotonic() - t0:.1f}s")
 
@@ -2312,10 +2382,32 @@ def headline() -> dict:
             f"{out['tokens_per_weight_pass']} tokens/weight-pass")
     if paged_on:
         out.update(paged_columns(kv0, eng.kv_pool_stats()))
+        # which dispatch route the HEADLINE arm actually compiled —
+        # the engine's resolution, not the knob's request
+        out["kv_route"] = eng._kv_route
         log(f"paged kv: {out['max_concurrent_streams']} peak "
             f"concurrent streams, fragmentation "
             f"{out['kv_pool_fragmentation']}, zero-copy hit rate "
-            f"{out['zero_copy_hit_rate']}")
+            f"{out['zero_copy_hit_rate']} (route {out['kv_route']})")
+    if paged_on and knob("BENCH_KV_KERNEL_ARM", "0") == "1":
+        # Kernel-route arm (ISSUE 16): the same shapes re-run with the
+        # Pallas route pinned on, reported as a tok/s ratio against
+        # the headline arm. The headline engine is dropped first — two
+        # live pools would double the cache HBM footprint mid-bench.
+        del comps
+        del eng
+        eng_k = GenerationEngine(cfg, **{**eng_kwargs,
+                                         "kv_kernel": "pallas"})
+        eng_k.generate(prompts, max_new_tokens=new_tokens)  # warmup
+        t0 = time.monotonic()
+        comps_k = eng_k.generate(prompts, max_new_tokens=new_tokens)
+        k_elapsed = time.monotonic() - t0
+        k_tok_s = sum(len(c.tokens) for c in comps_k) / k_elapsed
+        out["kernel_route"] = kernel_route_columns(
+            eng_k._kv_route, tok_s, k_tok_s)
+        log(f"kernel-route arm: {out['kernel_route']['kernel_tok_s']} "
+            f"tok/s, {out['kernel_route']['kernel_tok_s_delta']}x the "
+            f"{out.get('kv_route', 'reference')} headline arm")
     return out
 
 
@@ -2339,6 +2431,22 @@ def main() -> None:
             "ok": False,
             "reason": f"unknown BENCH_PRESET {preset!r}; "
                       f"valid: {sorted(PRESETS)}",
+        }))
+        sys.exit(2)
+    # Same discipline for the paged dispatch-route knob (ISSUE 16): a
+    # typo'd BENCH_KV_KERNEL silently running the default route would
+    # record an artifact labeled with a route it never measured.
+    kv_kernel = os.environ.get(
+        "BENCH_KV_KERNEL",
+        PRESETS.get(preset, {}).get("BENCH_KV_KERNEL", "auto"))
+    if kv_kernel not in ("auto", "pallas", "reference"):
+        print(json.dumps({
+            "metric": "bench-kv-kernel",
+            "value": 0.0,
+            "unit": "",
+            "ok": False,
+            "reason": f"unknown BENCH_KV_KERNEL {kv_kernel!r}; "
+                      "valid: ['auto', 'pallas', 'reference']",
         }))
         sys.exit(2)
     # Semantic contract preflight (CPU, subprocess): fail fast with a

@@ -229,6 +229,7 @@ class GenerationEngine:
         admit_hold_strict: bool = False,
         prefix_cache_blocks: int = 0,
         kv_pool_blocks: int = 0,
+        kv_kernel: str = "auto",
         role: str = "both",
         handoff_high: int = 0,
         spec_decode: bool = False,
@@ -451,6 +452,43 @@ class GenerationEngine:
         # ENGINE_PREFIX_CACHE.md ("Paged KV") + ops/paged_attention.py.
         self.paged = bool(kv_pool_blocks)
         self._pool = None
+        # Dispatch-route knob for the paged layout: the Pallas paged
+        # kernel reads pool blocks IN PLACE by scalar-prefetched block
+        # table (no working-set gather materializes), the XLA
+        # reference route gathers the view the tables describe. "auto"
+        # picks the kernel on TPU and the reference elsewhere (the
+        # kernel still RUNS off-TPU via interpret mode — that is what
+        # the parity gate exercises — but interpreted Pallas is not a
+        # serving route). Explicit values pin a route for parity
+        # tests and benches.
+        if kv_kernel not in ("auto", "pallas", "reference"):
+            raise ValueError(
+                f"kv_kernel must be 'auto', 'pallas' or 'reference', "
+                f"got {kv_kernel!r}")
+        if kv_kernel != "auto" and not self.paged:
+            raise ValueError(
+                "kv_kernel selects the paged-attention dispatch route "
+                "and requires kv_pool_blocks > 0")
+        self.kv_kernel = kv_kernel
+        if self.paged:
+            from copilot_for_consensus_tpu.ops.paged_attention import (
+                HAS_PALLAS,
+            )
+            if kv_kernel == "pallas" and not HAS_PALLAS:
+                raise ValueError(
+                    "kv_kernel='pallas' requires jax.experimental."
+                    "pallas in this jax build")
+            #: resolved dispatch route, labeled on every StepRecord
+            #: and the copilot_engine_kv_route gauge ("" = contiguous)
+            self._kv_route = "kernel" if (
+                kv_kernel == "pallas"
+                or (kv_kernel == "auto" and HAS_PALLAS
+                    and jax.default_backend() == "tpu")) \
+                else "reference"
+            if self.telemetry is not None:
+                self.telemetry.gauge_kv_route(self._kv_route)
+        else:
+            self._kv_route = ""
         # Disaggregated serving role (engine/roles.py): "both" is the
         # co-located default; "prefill" parks finished prefills for a
         # block-granular KV handoff instead of decoding them, "decode"
@@ -999,24 +1037,42 @@ class GenerationEngine:
                                  static_argnames=("kv_len",))
 
         # ---- paged dispatch programs (kv_pool_blocks > 0) --------------
-        # Every paged program is the contiguous program composed with
-        # the block-table indirection of ops/paged_attention.py: gather
-        # the working-set VIEW the tables describe — the XLA REFERENCE
-        # route, a pure reordering, so greedy decode is bit-identical
-        # at f32 — run the UNCHANGED decoder program over it, and
-        # scatter the fresh KV back into the pool at host-built
-        # (block, offset) maps. This reference route is what the
-        # engine dispatches run on EVERY backend today; the Pallas
-        # kernel (ops.paged_attention.paged_decode_attention,
-        # parity-held to this exact semantics) reads the pool by
-        # scalar-prefetched pointer instead, and wiring it into the
-        # windowed decode body (it needs (m, l, o) outputs to join the
-        # window/done/cur pieces' joint softmax) is the named follow-up
-        # alongside the multi-chip item (ROADMAP). The pool halves are
-        # donated — they are the one long-lived KV allocation and must
-        # never double-buffer.
+        # Two routes serve the same block-table semantics, selected by
+        # ``kv_kernel`` into ``self._kv_route``:
+        #
+        # REFERENCE (``kv_kernel="reference"``, and "auto" off-TPU):
+        # the contiguous program composed with the indirection of
+        # ops/paged_attention.py — gather the working-set VIEW the
+        # tables describe (a pure reordering, so greedy decode is
+        # bit-identical at f32), run the UNCHANGED decoder program
+        # over it, read the freshly merged columns back out of the
+        # view, scatter them into the pool at host-built (block,
+        # offset) maps. Simple and backend-portable, but it
+        # materializes kv_len × rows working-set copies and a
+        # view-sized round trip EVERY dispatch.
+        #
+        # KERNEL (``kv_kernel="pallas"``, and "auto" on TPU): the
+        # Pallas paged kernel (ops.paged_attention.
+        # paged_attention_partial_pallas) scores the committed pool
+        # prefix IN PLACE — block tables ride the scalar-prefetch
+        # lane, the traced layer index selects into the stacked pool
+        # so no per-layer slice materializes either, and fp8 pools
+        # dequantize on load inside the kernel. It emits flash
+        # partials (acc, m, l) that ``ops.attention.combine_partials``
+        # joins with the dispatch-local window/done/cur (decode) or
+        # causal-suffix (seeded) pieces — one joint softmax, same
+        # masking, parity-gated against the reference route under
+        # interpret mode. Fresh KV then scatters as the SAME narrow
+        # per-row write the reference route uses, but straight from
+        # the window buffers: no view gather, no view read-back, no
+        # full-pool-view round trip anywhere in the traced program
+        # (pinned by a no-gather trace test).
+        #
+        # The pool halves are donated on both routes — they are the
+        # one long-lived KV allocation and must never double-buffer.
         if self.paged:
             from copilot_for_consensus_tpu.ops.paged_attention import (
+                paged_attention_partial_pallas,
                 paged_gather_kv,
             )
 
@@ -1293,6 +1349,218 @@ class GenerationEngine:
 
                 self._chunk_paged_fn = jax.jit(
                     _chunk_paged_mesh, donate_argnums=(4, 5),
+                    static_argnames=("kv_len",))
+
+            if self._kv_route == "kernel":
+                # ---- Pallas kernel route ----------------------------
+                # Rebinds the FOUR gathering dispatches (plain paged
+                # admission never gathered — it is route-agnostic)
+                # under the same attribute names, signatures,
+                # donations and static args as the reference
+                # assignments above, so every call site, retrace
+                # bound and shardcheck contract case carries over
+                # unchanged. ``kv_len // block`` committed blocks are
+                # a STATIC slice of the dispatch's gather table (the
+                # view table is always at least that wide): the
+                # kernel only ever reads committed positions — fresh
+                # KV rides the window/suffix buffers until the one
+                # narrow scatter.
+                if mesh is None:
+                    def _partial_for(window):
+                        def call(pool_k, pool_v, tables, li, q_rows,
+                                 lengths, q_pos):
+                            return paged_attention_partial_pallas(
+                                q_rows, pool_k, pool_v, li, tables,
+                                lengths, q_pos, window=window)
+                        return call
+
+                    scatter_kfn = _pool_scatter
+                else:
+                    # dp MANUAL exactly like gather_sm/scatter_sm:
+                    # the kernel indexes its shard-local pool slice
+                    # with the shard-local ids the host built
+                    # (per-shard OOB sentinel clamps in the wrapper,
+                    # same park discipline as the gather). tp stays
+                    # an AUTO axis — the pallas_call is opaque to
+                    # GSPMD, so a tp-sharded kv-head axis replicates
+                    # through it (docs/PERF.md "Kernel route" carries
+                    # the honest accounting).
+                    QROWS = P("dp", None, None, None)
+
+                    def _partial_for(window):
+                        def call(pool_k, pool_v, tables, li, q_rows,
+                                 lengths, q_pos):
+                            return paged_attention_partial_pallas(
+                                q_rows, pool_k, pool_v, li, tables,
+                                lengths, q_pos, window=window)
+                        return shard_map(
+                            call, mesh,
+                            in_specs=(POOL, POOL, ROW2, P(), QROWS,
+                                      P("dp"), P("dp")),
+                            out_specs=(QROWS, QROWS, QROWS),
+                            check_rep=False, auto=auto)
+
+                    scatter_kfn = scatter_sm
+
+                partial_dec = _partial_for(cfg.sliding_window)
+                partial_seed = _partial_for(0)
+
+                def _decode_paged_kernel(params, tokens, positions,
+                                         pool_k, pool_v, gbids,
+                                         sbids, soffs, key, *,
+                                         kv_len, n_windows=1):
+                    """Kernel-route windowed decode: the reference
+                    ``_decode`` body verbatim (same key-split/sample
+                    order, so greedy token streams match) except the
+                    committed pool prefix is scored IN PLACE per
+                    layer and the window buffers scatter straight to
+                    the pool — no view gather, no view read-back."""
+                    tables = gbids[:, :kv_len // self._block]
+
+                    def partial_fn(li, q_rows, lengths, q_pos):
+                        return partial_dec(pool_k, pool_v, tables,
+                                           li, q_rows, lengths,
+                                           q_pos)
+
+                    w_sz = self.decode_window
+                    b = tokens.shape[0]
+                    shape = (cfg.n_layers, b, cfg.n_kv_heads, w_sz,
+                             cfg.head_dim)
+
+                    def run_window(tok, key, done):
+                        k_win = jnp.zeros(shape, self.kv_dtype)
+                        v_win = jnp.zeros(shape, self.kv_dtype)
+                        k_done, v_done = done
+
+                        def body(carry, w):
+                            tok, k_win, v_win, key = carry
+                            key, sub = jax.random.split(key)
+                            logits, k_cols, v_cols = \
+                                decoder.decode_step_windowed_paged(
+                                    params, tok, positions, w, cfg,
+                                    partial_fn, k_win, v_win,
+                                    k_done=k_done, v_done=v_done)
+                            k_win = \
+                                jax.lax.dynamic_update_slice_in_dim(
+                                    k_win, k_cols[:, :, :, None]
+                                    .astype(k_win.dtype), w, axis=3)
+                            v_win = \
+                                jax.lax.dynamic_update_slice_in_dim(
+                                    v_win, v_cols[:, :, :, None]
+                                    .astype(v_win.dtype), w, axis=3)
+                            nxt = sample(logits, sub, self.sampling)
+                            return (nxt, k_win, v_win, key), nxt
+
+                        (tok, k_win, v_win, key), toks = jax.lax.scan(
+                            body, (tok, k_win, v_win, key),
+                            jnp.arange(w_sz))
+                        return tok, key, toks, k_win, v_win
+
+                    tok, done = tokens, (None, None)
+                    outs, wins = [], []
+                    for widx in range(n_windows):
+                        tok, key, toks, k_win, v_win = run_window(
+                            tok, key, done)
+                        outs.append(toks)
+                        wins.append((k_win, v_win))
+                        if widx + 1 < n_windows:
+                            done = (
+                                jnp.concatenate(
+                                    [kw for kw, _ in wins], 3),
+                                jnp.concatenate(
+                                    [vw for _, vw in wins], 3))
+                    if n_windows == 1:
+                        k_all, v_all = wins[0]
+                        toks_all = outs[0]
+                    else:
+                        k_all = jnp.concatenate(
+                            [kw for kw, _ in wins], 3)
+                        v_all = jnp.concatenate(
+                            [vw for _, vw in wins], 3)
+                        toks_all = jnp.concatenate(outs, axis=0)
+                    pool_k, pool_v = scatter_kfn(
+                        pool_k, pool_v, k_all, v_all, sbids, soffs)
+                    return toks_all, pool_k, pool_v
+
+                self._decode_paged_fn = jax.jit(
+                    _decode_paged_kernel, donate_argnums=(3, 4),
+                    static_argnames=("kv_len", "n_windows"))
+
+                def _admit_seeded_paged_kernel(params, tokens,
+                                               lengths, pool_k,
+                                               pool_v, bids,
+                                               pref_lens, sbids,
+                                               soffs, key):
+                    """Zero-copy seeded admission, kernel route: the
+                    matched prefix blocks are scored in place off
+                    ``bids`` (never gathered into a view), the fresh
+                    suffix KV scatters from compute dtype — the same
+                    single compute→kv_dtype cast the reference
+                    scratch takes."""
+                    def partial_fn(li, q_rows, lns, q_pos):
+                        return partial_seed(pool_k, pool_v, bids, li,
+                                            q_rows, lns, q_pos)
+
+                    logits, k_new, v_new = decoder.prefill_seeded_paged(
+                        params, tokens, lengths, pref_lens, cfg,
+                        partial_fn, all_logits=False)
+                    pool_k, pool_v = scatter_kfn(
+                        pool_k, pool_v, k_new, v_new, sbids, soffs)
+                    first = sample(logits, key, self.sampling)
+                    return first, pool_k, pool_v
+
+                self._admit_seeded_paged_fn = jax.jit(
+                    _admit_seeded_paged_kernel, donate_argnums=(3, 4))
+
+                def _verify_paged_kernel(params, tokens, qlens,
+                                         positions, pool_k, pool_v,
+                                         gbids, sbids, soffs, key, *,
+                                         kv_len):
+                    tables = gbids[:, :kv_len // self._block]
+
+                    def partial_fn(li, q_rows, lns, q_pos):
+                        return partial_seed(pool_k, pool_v, tables,
+                                            li, q_rows, lns, q_pos)
+
+                    logits, k_new, v_new = decoder.prefill_seeded_paged(
+                        params, tokens, qlens, positions, cfg,
+                        partial_fn, all_logits=True)
+                    pool_k, pool_v = scatter_kfn(
+                        pool_k, pool_v, k_new, v_new, sbids, soffs)
+                    out, n_accept = verify_draft(
+                        logits, tokens[:, 1:], qlens - 1, key,
+                        self.sampling)
+                    return out, n_accept, pool_k, pool_v
+
+                self._verify_paged_fn = jax.jit(
+                    _verify_paged_kernel, donate_argnums=(4, 5),
+                    static_argnames=("kv_len",))
+
+                def _chunk_paged_kernel(params, tokens, qlens,
+                                        positions, pool_k, pool_v,
+                                        gbids, sbids, soffs, key, *,
+                                        kv_len):
+                    tables = gbids[:, :kv_len // self._block]
+
+                    def partial_fn(li, q_rows, lns, q_pos):
+                        return partial_seed(pool_k, pool_v, tables,
+                                            li, q_rows, lns, q_pos)
+
+                    # all_logits=False: the last-valid-position
+                    # select happens BEFORE the lm_head inside
+                    # prefill_seeded_paged — same values as the
+                    # reference's take-last over [B, S, V], without
+                    # unembedding S-1 discarded positions.
+                    last, k_new, v_new = decoder.prefill_seeded_paged(
+                        params, tokens, qlens, positions, cfg,
+                        partial_fn, all_logits=False)
+                    pool_k, pool_v = scatter_kfn(
+                        pool_k, pool_v, k_new, v_new, sbids, soffs)
+                    first = sample(last, key, self.sampling)
+                    return first, pool_k, pool_v
+
+                self._chunk_paged_fn = jax.jit(
+                    _chunk_paged_kernel, donate_argnums=(4, 5),
                     static_argnames=("kv_len",))
 
             # ---- KV handoff programs (disaggregated roles) ---------
@@ -2135,7 +2403,7 @@ class GenerationEngine:
             self.telemetry.record_step(
                 wave_kind, prefill_s, seq=seq, rows=len(batch),
                 batch=n, tokens=sum(suffix_lens),
-                padded_tokens=n * bucket)
+                padded_tokens=n * bucket, route=self._kv_route)
         self.prefill_tokens += sum(suffix_lens)
         self.prefill_tokens_saved += sum(
             m.tokens for m in matches if m is not None)
@@ -2792,7 +3060,8 @@ class GenerationEngine:
             self.telemetry.record_step(
                 "prefill_chunk", step_s, seq=seq, rows=rows,
                 batch=self.num_slots, tokens=sum(fed.values()),
-                padded_tokens=self.num_slots * width)
+                padded_tokens=self.num_slots * width,
+                route=self._kv_route)
             self.telemetry.on_prefill_chunks(rows)
 
     def _decode_once(self) -> None:
@@ -2916,7 +3185,8 @@ class GenerationEngine:
                 batch=self.num_slots,
                 tokens=harvested_total
                 + (self.piggy_tokens - piggy_tok0),
-                padded_tokens=window * self.num_slots)
+                padded_tokens=window * self.num_slots,
+                route=self._kv_route)
 
     def _spec_allowed(self) -> bool:
         """Spec-decode degraded-mode gate: the supervisor's
@@ -3089,7 +3359,8 @@ class GenerationEngine:
                 tokens=self.spec_emitted_tokens - emitted0,
                 padded_tokens=s * self.num_slots,
                 draft_tokens=sum(len(d) for d in drafts.values()),
-                accepted_tokens=self.spec_accepted_tokens - accepted0)
+                accepted_tokens=self.spec_accepted_tokens - accepted0,
+                route=self._kv_route)
 
     def _pack_prefill(self):
         """Pack whole pending prompts into the W×P chunk grid.
@@ -3548,12 +3819,27 @@ def _paged_contract_cases(cfg, group):
       group: the anchor case declares the canonical
       ``kv_pool.BLOCK_TABLE_DTYPE`` and every dispatch's table must
       match it — flipping the dispatch-side table dtype (the tripwire
-      in tests/test_shardcheck.py) is a ``shard-kv-layout`` finding.
+      in tests/test_shardcheck.py) is a ``shard-kv-layout`` finding;
+    * the KERNEL route's dispatches (``kv_kernel="pallas"``) declare
+      into the SAME ``engine.generation-kv`` group with the same
+      donations and the same table dtype — the two routes must agree
+      on one pool layout, or the ``kv_kernel`` knob would silently
+      change serving semantics;
+    * block packing forms the ``engine.generation-kv-pack`` layout
+      group: the anchor declares the kernel's
+      ``ops.paged_attention.KERNEL_BLOCK_PACK``, the pool layout
+      declares ``kv_pool.POOL_BLOCK_PACK``, and the dispatch side
+      declares its own literal — flipping any one of the three (the
+      block-pack tripwire) is a ``shard-kv-layout`` finding.
     """
     import functools
 
     from copilot_for_consensus_tpu.engine.kv_pool import (
         BLOCK_TABLE_DTYPE,
+        POOL_BLOCK_PACK,
+    )
+    from copilot_for_consensus_tpu.ops.paged_attention import (
+        KERNEL_BLOCK_PACK,
     )
 
     eng = GenerationEngine(cfg, num_slots=4, max_len=64,
@@ -3562,9 +3848,16 @@ def _paged_contract_cases(cfg, group):
                            prefill_rows=2, prefix_cache_blocks=4,
                            kv_pool_blocks=16, spec_decode=True,
                            spec_draft_lens=(0, 2, 4))
+    eng_k = GenerationEngine(cfg, num_slots=4, max_len=64,
+                             prefill_buckets=(16, 32), decode_window=4,
+                             windows_per_dispatch=1, prefill_chunk=8,
+                             prefill_rows=2, prefix_cache_blocks=4,
+                             kv_pool_blocks=16, kv_kernel="pallas",
+                             spec_decode=True, spec_draft_lens=(0, 2, 4))
     S = jax.ShapeDtypeStruct
     i32 = jnp.int32
     table_dtype = jnp.int32       # dispatch-side block-table dtype
+    block_pack = 128              # dispatch-side kernel lane packing
     pool = {"k": S(eng._pool.k.shape, eng._pool.k.dtype),
             "v": S(eng._pool.v.shape, eng._pool.v.dtype)}
     key = jax.random.PRNGKey(0)
@@ -3575,6 +3868,7 @@ def _paged_contract_cases(cfg, group):
     kv_len = 64
     nb_view = eng._view_width(kv_len, w) // eng._block
     tgroup = "engine.generation-kv-table"
+    pgroup = "engine.generation-kv-pack"
 
     def tbl(rows, width):
         return S((rows, width), table_dtype)
@@ -3638,6 +3932,72 @@ def _paged_contract_cases(cfg, group):
                   tbl(b, eng._block), tbl(b, eng._block), key),
             donate_argnums=(4, 5), kv_group=group,
             kv_caches=(("kv-pool", pool),)),
+        # ---- Pallas kernel route (kv_kernel="pallas"): the same four
+        # gathering dispatches rebound over the in-place kernel, same
+        # signatures, same donations, same pool layout group — route
+        # selection must never change the serving contract ----------
+        ContractCase(
+            label="admit-seeded-paged-kernel",
+            fn=eng_k._admit_seeded_paged_fn,
+            args=(eng_k.params, S((n, bucket), i32), S((n,), i32),
+                  pool["k"], pool["v"], S((n, 2), i32), S((n,), i32),
+                  tbl(n, bucket), tbl(n, bucket), key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool", pool),)),
+        ContractCase(
+            label="decode-paged-kernel",
+            fn=functools.partial(eng_k._decode_paged_fn, kv_len=kv_len,
+                                 n_windows=1),
+            args=(eng_k.params, S((b,), i32), S((b,), i32),
+                  pool["k"], pool["v"],
+                  S((b, nb_view), jnp.dtype(BLOCK_TABLE_DTYPE)),
+                  tbl(b, w), tbl(b, w), key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool", pool),)),
+        ContractCase(
+            label="decode-paged-kernel-table", kv_group=tgroup,
+            kv_caches=(("block-table",
+                        {"table": S((b, nb_view), table_dtype)}),)),
+        ContractCase(
+            label="verify-paged-kernel",
+            fn=functools.partial(eng_k._verify_paged_fn,
+                                 kv_len=kv_len),
+            args=(eng_k.params, S((b, s_v), i32), S((b,), i32),
+                  S((b,), i32), pool["k"], pool["v"],
+                  S((b, eng_k._view_width(kv_len, s_v) // eng_k._block),
+                    jnp.dtype(BLOCK_TABLE_DTYPE)),
+                  tbl(b, s_v), tbl(b, s_v), key),
+            donate_argnums=(4, 5), kv_group=group,
+            kv_caches=(("kv-pool", pool),),
+            buckets=tuple(k + 1 for k in eng_k.spec_draft_lens),
+            bucket_covers=(max(eng_k.spec_draft_lens) + 1,)),
+        ContractCase(
+            label="chunk-paged-kernel",
+            fn=functools.partial(eng_k._chunk_paged_fn, kv_len=kv_len),
+            args=(eng_k.params, S((b, eng_k._block), i32),
+                  S((b,), i32), S((b,), i32), pool["k"], pool["v"],
+                  S((b, eng_k._view_width(kv_len, eng_k._block)
+                     // eng_k._block), jnp.dtype(BLOCK_TABLE_DTYPE)),
+                  tbl(b, eng_k._block), tbl(b, eng_k._block), key),
+            donate_argnums=(4, 5), kv_group=group,
+            kv_caches=(("kv-pool", pool),)),
+        # ---- block packing (engine.generation-kv-pack): kernel-side
+        # KERNEL_BLOCK_PACK (anchor), pool-side POOL_BLOCK_PACK, and
+        # the dispatch-side literal must all name the same lane width
+        # — the pool layout, the kernel BlockSpecs, and the engine's
+        # bucket alignment are compiled against it independently ----
+        ContractCase(
+            label="kernel-block-pack-layout", kv_group=pgroup,
+            kv_caches=(("block-pack",
+                        {"pack": S((KERNEL_BLOCK_PACK,), i32)}),)),
+        ContractCase(
+            label="pool-block-pack", kv_group=pgroup,
+            kv_caches=(("block-pack",
+                        {"pack": S((POOL_BLOCK_PACK,), i32)}),)),
+        ContractCase(
+            label="dispatch-block-pack", kv_group=pgroup,
+            kv_caches=(("block-pack",
+                        {"pack": S((block_pack,), i32)}),)),
     ]
 
 
@@ -3683,6 +4043,12 @@ def _paged_mesh_contract_cases(cfg, group):
                            prefix_cache_blocks=4, kv_pool_blocks=32,
                            spec_decode=True, spec_draft_lens=(0, 2, 4),
                            mesh=mesh)
+    eng_k = GenerationEngine(cfg, num_slots=4, max_len=64,
+                             prefill_buckets=(16, 32), decode_window=4,
+                             windows_per_dispatch=1, prefill_chunk=8,
+                             prefix_cache_blocks=4, kv_pool_blocks=32,
+                             kv_kernel="pallas", spec_decode=True,
+                             spec_draft_lens=(0, 2, 4), mesh=mesh)
     S = jax.ShapeDtypeStruct
     i32 = jnp.int32
     pool = {"k": S(eng._pool.k.shape, eng._pool.k.dtype),
@@ -3770,4 +4136,35 @@ def _paged_mesh_contract_cases(cfg, group):
                   S((1, 16), i32), S((1, 16), i32)),
             donate_argnums=(0, 1), kv_group=group,
             kv_caches=(("kv-pool-mesh", pool),)),
+        # ---- kernel route under the mesh: the shard-mapped partial
+        # keeps the dp-sharded pool donated and the shard-local block
+        # tables on the canonical dtype (same layout groups — the
+        # route knob changes how blocks are read, never the sharded
+        # pool contract) -------------------------------------------
+        ContractCase(
+            label="decode-paged-mesh-kernel",
+            fn=functools.partial(eng_k._decode_paged_fn,
+                                 kv_len=kv_len, n_windows=1),
+            args=(eng_k.params, S((b,), i32), S((b,), i32),
+                  pool["k"], pool["v"], tbl(b, nb_view),
+                  tbl(b, w), tbl(b, w), key),
+            donate_argnums=(3, 4), kv_group=group,
+            kv_caches=(("kv-pool-mesh", pool),)),
+        ContractCase(
+            label="decode-paged-mesh-kernel-table", kv_group=tgroup,
+            kv_caches=(("block-table",
+                        {"table": tbl(b, nb_view)}),)),
+        ContractCase(
+            label="verify-paged-mesh-kernel",
+            fn=functools.partial(eng_k._verify_paged_fn,
+                                 kv_len=kv_len),
+            args=(eng_k.params, S((b, s_v), i32), S((b,), i32),
+                  S((b,), i32), pool["k"], pool["v"],
+                  tbl(b, eng_k._view_width(kv_len, s_v)
+                      // eng_k._block),
+                  tbl(b, s_v), tbl(b, s_v), key),
+            donate_argnums=(4, 5), kv_group=group,
+            kv_caches=(("kv-pool-mesh", pool),),
+            buckets=tuple(k + 1 for k in eng_k.spec_draft_lens),
+            bucket_covers=(max(eng_k.spec_draft_lens) + 1,)),
     ]

@@ -49,6 +49,13 @@ except Exception:  # pragma: no cover - jax is a hard dep in serving
 #: (analysis: engine.generation-kv-table layout group).
 BLOCK_TABLE_DTYPE = np.int32
 
+#: TPU lane width the pool's block axis packs against: block_size must
+#: divide it so a block never straddles a lane boundary — the layout
+#: commitment the Pallas kernel route compiles its BlockSpecs against
+#: (ops.paged_attention.KERNEL_BLOCK_PACK is the kernel-side twin;
+#: analysis: engine.generation-kv-pack layout group trips on drift).
+POOL_BLOCK_PACK = 128
+
 
 class KVPoolExhausted(RuntimeError):
     """The pool has no free block for a write the dispatch needs.

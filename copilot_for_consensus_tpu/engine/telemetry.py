@@ -197,6 +197,12 @@ METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "Seeded admissions that appended matched block ids to the "
         "slot's table instead of gathering a pool→slot copy "
         "(pointer-only prefix admission)."),
+    "engine_kv_route": (
+        "gauge", ("engine", "route"),
+        "1 for the paged-attention dispatch route this engine "
+        "resolved (route label: 'kernel' = Pallas in-place block "
+        "reads, 'reference' = XLA working-set gather); dashboards "
+        "join it against throughput to attribute route deltas."),
     # ---- disaggregated prefill/decode roles (engine/roles.py +
     # GenerationEngine(role=...); docs/PERF.md#multi-chip-serving) ----
     "engine_role_occupancy": (
@@ -292,6 +298,8 @@ class StepRecord:
     padded_tokens: int = 0    # batch × bucket the program computed
     draft_tokens: int = 0     # verify waves: drafted
     accepted_tokens: int = 0  # verify waves: accepted
+    route: str = ""           # paged dispatch route: kernel |
+    #                           reference ("" = contiguous layout)
 
     @property
     def occupancy(self) -> float:
@@ -489,13 +497,14 @@ class EngineTelemetry:
                     seq: int | None = None, rows: int = 0,
                     batch: int = 0, tokens: int = 0,
                     padded_tokens: int = 0, draft_tokens: int = 0,
-                    accepted_tokens: int = 0) -> StepRecord:
+                    accepted_tokens: int = 0,
+                    route: str = "") -> StepRecord:
         rec = StepRecord(
             seq=self.recorder.next_seq() if seq is None else seq,
             kind=kind, t_wall=time.time(), duration_s=duration_s,
             rows=rows, batch=batch, tokens=tokens,
             padded_tokens=padded_tokens, draft_tokens=draft_tokens,
-            accepted_tokens=accepted_tokens)
+            accepted_tokens=accepted_tokens, route=route)
         self.recorder.record(rec)
         m, lb = self.metrics, self._labels
         m.observe("engine_step_seconds", duration_s,
@@ -590,6 +599,13 @@ class EngineTelemetry:
     def on_zero_copy_admits(self, n: int = 1) -> None:
         self.metrics.increment("engine_kv_pool_zero_copy_admits_total",
                                float(n), self._labels)
+
+    def gauge_kv_route(self, route: str) -> None:
+        """Resolved paged dispatch route ('kernel' | 'reference'),
+        emitted once at engine build — a label-dimensioned constant
+        gauge, the Prometheus idiom for build info."""
+        self.metrics.gauge("engine_kv_route", 1.0,
+                           {**self._labels, "route": route})
 
     # -- disaggregated roles (engine/roles.py) --------------------------
 

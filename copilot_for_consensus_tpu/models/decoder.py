@@ -16,6 +16,7 @@ Ollama / llama.cpp (``adapters/copilot_summarization/.../factory.py:89-94``,
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -393,6 +394,97 @@ def decode_step_windowed(params: Params, tokens: jax.Array,
 
     x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
     return _unembed(x, params, cfg)[:, 0], k_cols, v_cols
+
+
+def decode_step_windowed_paged(params: Params, tokens: jax.Array,
+                               positions0: jax.Array, w: jax.Array,
+                               cfg: DecoderConfig, partial_fn,
+                               k_win: jax.Array, v_win: jax.Array,
+                               k_done: jax.Array | None = None,
+                               v_done: jax.Array | None = None
+                               ) -> tuple[jax.Array, jax.Array,
+                                          jax.Array]:
+    """Kernel-route twin of :func:`decode_step_windowed`: the big
+    cache piece never appears as an array at all. ``partial_fn(li,
+    qg, lengths, q_pos)`` scores the slot's committed pool blocks in
+    place (the Pallas paged kernel, layer selected by the traced
+    ``li`` on the scalar-prefetch lane — no per-layer pool slice
+    materializes either), and the fresh KV discipline is identical:
+    window buffers in the engine's scan carry, completed windows as a
+    ``k_done`` piece, one pool scatter per dispatch by the caller.
+
+    tokens: [B]; positions0: [B] dispatch-start positions; ``w``:
+    traced in-window step index. Returns ([B, V] fp32 logits, k_cols,
+    v_cols [L, B, Hkv, Dh]) exactly like the reference twin."""
+    x = params["tok_emb"][tokens][:, None, :]               # [B, 1, D]
+    have_done = k_done is not None
+    xs = (params["layers"], jnp.arange(cfg.n_layers))
+    if have_done:
+        xs = xs + (k_done, v_done)
+
+    def body(x, scanned):
+        layer, li = scanned[:2]
+        k_done_l = scanned[2] if have_done else None
+        v_done_l = scanned[3] if have_done else None
+        k_win_l = jax.lax.dynamic_index_in_dim(k_win, li, 0,
+                                               keepdims=False)
+        v_win_l = jax.lax.dynamic_index_in_dim(v_win, li, 0,
+                                               keepdims=False)
+        h, k_cur, v_cur = L.attn_decode_windowed_paged(
+            L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+            layer, cfg, positions0, w,
+            functools.partial(partial_fn, li), k_win_l, v_win_l,
+            k_done_l=k_done_l, v_done_l=v_done_l)
+        x = x + h
+        x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                     layer, cfg)
+        return x, (k_cur, v_cur)
+
+    x, (k_cols, v_cols) = jax.lax.scan(body, x, xs)
+    return _unembed(x, params, cfg)[:, 0], k_cols, v_cols
+
+
+def prefill_seeded_paged(params: Params, tokens: jax.Array,
+                         lengths: jax.Array, prefix_lens: jax.Array,
+                         cfg: DecoderConfig, partial_fn, *,
+                         all_logits: bool
+                         ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Kernel-route seeded suffix pass: one program standing in for
+    :func:`prefill_seeded` (``all_logits=False`` — admission) and
+    :func:`verify_seeded` (``all_logits=True`` — spec-decode verify
+    and chunked prefill), with the seeded prefix scored straight off
+    the paged block pool by ``partial_fn(li, q_rows, lengths,
+    q_pos)`` instead of a gathered ``k_pref`` view. Masking semantics
+    are the reference twins' exactly: prefix columns at or past
+    ``prefix_lens[b]`` are structurally unreadable, suffix attention
+    is causal below ``lengths[b]``.
+
+    tokens: [B, S] right-padded suffix tokens at absolute positions
+    ``prefix_lens[b] + i``. Returns (logits, k_new, v_new
+    [L, B, Hkv, S, Dh] in compute dtype — ``merge_window`` layout for
+    the engine's single pool scatter): logits are [B, S, V] fp32 when
+    ``all_logits`` else the last-valid-position [B, V] (selected
+    BEFORE the lm_head — the same admission OOM guard as
+    ``prefill``)."""
+    x = params["tok_emb"][tokens]
+
+    def body(x, scanned):
+        layer, li = scanned
+        h, k, v = L.attn_prefill_seeded_paged(
+            L.rms_norm(x, layer["attn_norm"], cfg.norm_eps),
+            layer, cfg, functools.partial(partial_fn, li),
+            prefix_lens, lengths=lengths)
+        x = x + h
+        x = x + _ffn(L.rms_norm(x, layer["ffn_norm"], cfg.norm_eps),
+                     layer, cfg)
+        return x, (k, v)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], jnp.arange(cfg.n_layers)))
+    if all_logits:
+        return _unembed(x, params, cfg), k_new, v_new
+    x_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    return _unembed(x_last, params, cfg)[:, 0], k_new, v_new
 
 
 def decode_step_piggyback(params: Params, tokens: jax.Array,

@@ -261,6 +261,75 @@ def attn_decode_windowed(x: jax.Array, layer: dict, cfg: DecoderConfig,
     return qmatmul(o, layer["wo"]), k_cur, v_cur
 
 
+def attn_decode_windowed_paged(x: jax.Array, layer: dict,
+                               cfg: DecoderConfig,
+                               positions0: jax.Array, w: jax.Array,
+                               partial_fn, k_win_l: jax.Array,
+                               v_win_l: jax.Array,
+                               k_done_l: jax.Array | None = None,
+                               v_done_l: jax.Array | None = None):
+    """Kernel-route twin of :func:`attn_decode_windowed`: the big
+    prefix piece never materializes — ``partial_fn(qg, lengths,
+    q_pos)`` returns its flash (acc, m, l) straight off the paged
+    block pool (the Pallas kernel reading blocks by pointer), and the
+    dispatch-local pieces (done windows, current window, self) fold in
+    through one ``combine_partials`` — the same joint softmax the
+    reference computes over its gathered view. Projections, RoPE and
+    the output matmul are shared with the reference twin byte for
+    byte."""
+    from copilot_for_consensus_tpu.ops.attention import (
+        combine_partials,
+        decode_window_partial,
+    )
+
+    b = x.shape[0]
+    hkv, dh = cfg.n_kv_heads, cfg.head_dim
+    n_done = 0 if k_done_l is None else k_done_l.shape[2]
+    q_pos = positions0 + n_done + w
+    q, k, v = _project_qkv(x, layer, cfg, q_pos[:, None])
+    k_cur = k[:, :, 0, :]
+    v_cur = v[:, :, 0, :]
+    qg = q[:, :, 0, :].reshape(b, hkv, cfg.n_heads // hkv, dh)
+    pool_part = partial_fn(qg, positions0, q_pos)
+    local_part = decode_window_partial(
+        qg, k_win_l, v_win_l, k_cur, v_cur, positions0, w,
+        window=cfg.sliding_window, k_done=k_done_l, v_done=v_done_l)
+    o = combine_partials([pool_part, local_part], x.dtype)
+    o = o.reshape(b, 1, cfg.n_heads * dh)
+    return qmatmul(o, layer["wo"]), k_cur, v_cur
+
+
+def attn_prefill_seeded_paged(x: jax.Array, layer: dict,
+                              cfg: DecoderConfig, partial_fn,
+                              prefix_lens: jax.Array,
+                              lengths: jax.Array | None = None):
+    """Kernel-route twin of :func:`attn_prefill_seeded`: the seeded
+    prefix KV is scored in place in the paged block pool —
+    ``partial_fn`` runs the Pallas partial kernel over R = G·S query
+    rows (rows (g, s) flattened row-major) — and the fresh causal
+    suffix joins through ``combine_partials``. Sliding-window models
+    are routed away by the engine exactly as on the reference seeded
+    path. Returns (out [B,S,D_model], k, v) with fresh SUFFIX k/v in
+    [B, Hkv, S, Dh] for the pool scatter at the per-row offset."""
+    from copilot_for_consensus_tpu.ops.attention import (
+        causal_suffix_partial,
+        combine_partials,
+    )
+
+    b, s, _ = x.shape
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    positions = prefix_lens[:, None] + jnp.arange(s)[None, :]
+    q, k, v = _project_qkv(x, layer, cfg, positions)
+    q_rows = q.reshape(b, hkv, hq // hkv, s, dh).reshape(
+        b, hkv, (hq // hkv) * s, dh)
+    pool_part = partial_fn(q_rows, prefix_lens, prefix_lens)
+    suffix_part = causal_suffix_partial(q, k, v, kv_lengths=lengths)
+    o = combine_partials([pool_part, suffix_part], x.dtype)
+    o = o.reshape(b, hq, s, dh).transpose(0, 2, 1, 3).reshape(
+        b, s, hq * dh)
+    return qmatmul(o, layer["wo"]), k, v
+
+
 # ---------------------------------------------------------------------------
 # Feed-forward
 # ---------------------------------------------------------------------------

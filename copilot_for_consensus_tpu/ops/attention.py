@@ -236,6 +236,128 @@ def _joint_probs(pieces_logits: list[jax.Array]) -> list[jax.Array]:
     return jnp.split(probs, splits, axis=-1)
 
 
+def combine_partials(parts: list[tuple[jax.Array, jax.Array, jax.Array]],
+                     dtype) -> jax.Array:
+    """Fold flash-style (acc, m, l) partials from independent KV pieces
+    into the jointly-softmaxed attention output — the reassociation
+    that lets the paged Pallas kernel score the pool piece in place
+    while the dispatch-local pieces stay in XLA, with no concatenated
+    score tensor and no gathered KV copy.
+
+    Each part: acc [..., R, D] = Σ exp(s - m)·v over its piece, m
+    [..., R, 1] running max (-inf when fully masked), l [..., R, 1]
+    = Σ exp(s - m). Rows masked in EVERY piece emit exact zeros — the
+    same value ``_joint_probs``'s NaN guard produces."""
+    m_tot = functools.reduce(jnp.maximum, [m for _, m, _ in parts])
+    m_safe = jnp.where(jnp.isfinite(m_tot), m_tot, 0.0)
+    l_tot = acc_tot = 0.0
+    for acc, m, l in parts:
+        scale = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_tot = l_tot + l * scale
+        acc_tot = acc_tot + acc * scale
+    out = acc_tot / jnp.where(l_tot > 0, l_tot, 1.0)
+    return jnp.where(l_tot > 0, out, 0.0).astype(dtype)
+
+
+def _masked_partial(logits: jax.Array, v_pieces: list[jax.Array]
+                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(acc, m, l) of already-masked score rows [..., R, T] against
+    their stacked values [..., T, D] — the XLA side of a
+    ``combine_partials`` fold (f32 throughout)."""
+    v_all = jnp.concatenate([v.astype(jnp.float32) for v in v_pieces],
+                            axis=-2) if len(v_pieces) > 1 \
+        else v_pieces[0].astype(jnp.float32)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe)                     # exp(-inf)=0 pads
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("...rt,...td->...rd", p, v_all,
+                     preferred_element_type=jnp.float32)
+    return acc, m, l
+
+
+def decode_window_partial(
+    qg: jax.Array,
+    k_win: jax.Array,
+    v_win: jax.Array,
+    k_cur: jax.Array,
+    v_cur: jax.Array,
+    prefix_lengths: jax.Array,
+    w: jax.Array,
+    window: int = 0,
+    k_done: jax.Array | None = None,
+    v_done: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash partial over the DISPATCH-LOCAL pieces of
+    ``decode_attention_prefix_window`` — completed windows, current
+    window, self — everything except the big pool/prefix piece, which
+    the paged kernel scores in place. Masks are the reference path's
+    ``_piece_mask`` against the identical dispatch timeline, so
+    combining this partial with the kernel's pool partial reproduces
+    the reference's joint softmax.
+
+    qg: [B, Hkv, G, D] grouped queries; k_win/v_win: [B, Hkv, W, D];
+    k_cur/v_cur: [B, Hkv, D]. Returns f32 (acc [B, Hkv, G, D],
+    m/l [B, Hkv, G, 1])."""
+    dt = qg.dtype
+    n_win = k_win.shape[2]
+    n_done = 0 if k_done is None else k_done.shape[2]
+    d = qg.shape[-1]
+
+    lw = _grouped_scores(qg, k_win.astype(dt))
+    lc = jnp.einsum("bhgd,bhd->bhg", qg, k_cur.astype(dt),
+                    preferred_element_type=jnp.float32)[..., None] \
+        * (d ** -0.5)
+    cur_pos = (prefix_lengths + n_done + w)[:, None, None, None]
+    iw = jnp.arange(n_win)[None, None, None, :]
+    pos_w = prefix_lengths[:, None, None, None] + n_done + iw
+    mask_w = _piece_mask(pos_w, cur_pos, cur_pos, window)
+    lw = jnp.where(mask_w, lw, -jnp.inf)
+    pieces_l, pieces_v = [], []
+    if n_done:
+        ld = _grouped_scores(qg, k_done.astype(dt))
+        idn = jnp.arange(n_done)[None, None, None, :]
+        pos_dn = prefix_lengths[:, None, None, None] + idn
+        mask_dn = _piece_mask(pos_dn, cur_pos, cur_pos, window)
+        pieces_l.append(jnp.where(mask_dn, ld, -jnp.inf))
+        pieces_v.append(v_done.astype(dt))
+    pieces_l += [lw, lc]
+    pieces_v += [v_win.astype(dt), v_cur.astype(dt)[:, :, None, :]]
+    return _masked_partial(jnp.concatenate(pieces_l, axis=-1), pieces_v)
+
+
+def causal_suffix_partial(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_lengths: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash partial over the fresh causal-suffix piece of
+    ``prefill_attention_seeded`` (``jk <= iq`` and below the row's
+    valid suffix length), with the (g, s) query rows flattened
+    row-major into R = G·S — the row layout the paged kernel's seeded
+    pass scores the pool/prefix piece in, so the two partials zip
+    straight into ``combine_partials``.
+
+    q: [B, Hq, S, D]; k/v: [B, Hkv, S, D]. Returns f32
+    (acc [B, Hkv, G·S, D], m/l [B, Hkv, G·S, 1])."""
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    g = hq // hkv
+    qg = q.reshape(b, hkv, g, s, d)
+    logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    iq = jnp.arange(s)[:, None]
+    jk = jnp.arange(s)[None, :]
+    mask = jnp.broadcast_to((jk <= iq)[None, None, None],
+                            (b, hkv, g, s, s))
+    if kv_lengths is not None:
+        mask = mask & (jk[None, None, None, None]
+                       < kv_lengths[:, None, None, None, None])
+    logits = jnp.where(mask, logits, -jnp.inf)
+    return _masked_partial(logits.reshape(b, hkv, g * s, s), [v])
+
+
 @functools.partial(jax.jit, static_argnames=("window", "kv_len"))
 def decode_attention(
     q: jax.Array,

@@ -23,15 +23,18 @@ attention over that layout:
   gate the paged refactor on CPU.
 
 ``paged_gather_kv`` is the same reference materialization at the
-stacked-cache level; the engine's paged dispatches use it to build the
-per-dispatch working-set view its (unchanged) decoder programs read —
-on every backend, today. The Pallas kernel is the drop-in TPU
-replacement for that gather (same q/lengths/window/dtype contract,
-parity-tested), but the engine's windowed decode joins FOUR KV pieces
-in one softmax, so routing it through the kernel needs the kernel's
-(max, sum, out) accumulators exposed for cross-piece combination —
-that wiring is deliberately left with the multi-chip serving item
-(ROADMAP item 1) rather than half-done here.
+stacked-cache level; the engine's REFERENCE route
+(``kv_kernel="reference"``) uses it to build the per-dispatch
+working-set view its (unchanged) decoder programs read. The KERNEL
+route (``kv_kernel="pallas"``, the TPU default) never materializes
+that view: the engine's windowed decode joins up to FOUR KV pieces in
+one softmax, so :func:`paged_attention_partial_pallas` exposes the
+kernel's flash (acc, max, sum) accumulators over the pool piece and
+``ops.attention.combine_partials`` folds them with the dispatch-local
+pieces — one joint softmax, no gathered copy. The same partial kernel
+scores R = G·S seeded-prefill query rows per kv head, which is how
+admission, chunked prefill, and spec-decode verify ride the
+no-materialization path too.
 """
 
 from __future__ import annotations
@@ -49,6 +52,13 @@ try:  # Pallas TPU lowering — import-light so host-only tools survive
     HAS_PALLAS = True
 except Exception:  # pragma: no cover - pallas ships with jax on tpu
     HAS_PALLAS = False
+
+# TPU lane width the kernel's block axis packs against: pool blocks
+# must divide it so a block never straddles a lane boundary. The pool
+# layout (engine/kv_pool.py POOL_BLOCK_PACK) and the engine's
+# dispatch-side declaration commit to the same value — shardcheck's
+# ``engine.generation-kv-pack`` group trips if either drifts.
+KERNEL_BLOCK_PACK = 128
 
 
 def paged_gather_layer(pool_k_l: jax.Array, pool_v_l: jax.Array,
@@ -92,15 +102,20 @@ def paged_gather_kv(pool_k: jax.Array, pool_v: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
-                         out_ref, m_ref, l_ref, acc_ref, *,
-                         block: int, window: int, scale: float):
-    """One (slot, kv-head, table-entry) grid step: score the slot's
-    grouped queries against ONE physical pool block and fold it into
-    the flash-style running (max, sum, acc) accumulators. The block
-    to read was chosen by the BlockSpec index map from the
-    scalar-prefetched table — the kernel body only ever sees the
-    block the table named."""
+def _paged_partial_kernel(li_ref, tables_ref, lengths_ref, qpos_ref,
+                          q_ref, k_ref, v_ref,
+                          acc_out_ref, m_out_ref, l_out_ref,
+                          m_ref, l_ref, acc_ref, *,
+                          block: int, window: int, scale: float):
+    """One (slot, kv-head, table-entry) grid step: score the slot's R
+    query rows against ONE physical pool block and fold it into the
+    flash-style running (max, sum, acc) accumulators. The block to
+    read was chosen by the BlockSpec index map from the
+    scalar-prefetched (layer index, block table) — the kernel body
+    only ever sees the block the table named. Instead of normalizing,
+    the final step EMITS the raw accumulators so the caller can
+    combine this piece with dispatch-local KV pieces in one joint
+    softmax (``ops.attention.combine_partials``)."""
     b_i = pl.program_id(0)
     i = pl.program_id(2)
     n_i = pl.num_programs(2)
@@ -111,21 +126,21 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32)              # [G, D]
-    k = k_ref[0, 0].astype(jnp.float32)              # [blk, D]
-    v = v_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0].astype(jnp.float32)              # [R, D]
+    k = k_ref[0, 0, 0].astype(jnp.float32)           # [blk, D]
+    v = v_ref[0, 0, 0].astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # [G, blk]
+        preferred_element_type=jnp.float32) * scale  # [R, blk]
 
     length = lengths_ref[b_i]
     pos = i * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     mask = pos < length
     if window > 0:
-        mask &= pos > length - 1 - window
+        mask &= pos > qpos_ref[b_i] - window
     s = jnp.where(mask, s, -jnp.inf)
 
-    m_prev = m_ref[:]                                # [G, 1]
+    m_prev = m_ref[:]                                # [R, 1]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     # fully-masked rows keep m = -inf; exp(-inf - -inf) is NaN, so the
@@ -141,12 +156,101 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
     m_ref[:] = m_new
 
     @pl.when(i == n_i - 1)
-    def _finalize():
-        l = l_ref[:]
-        out = acc_ref[:] / jnp.where(l > 0, l, 1.0)
-        # fully-masked rows (parked slots, length 0) emit exact zeros —
-        # the same value the XLA reference's NaN guard produces
-        out_ref[0, 0] = jnp.where(l > 0, out, 0.0).astype(out_ref.dtype)
+    def _emit():
+        acc_out_ref[0, 0] = acc_ref[:]
+        m_out_ref[0, 0] = m_ref[:]
+        l_out_ref[0, 0] = l_ref[:]
+
+
+def paged_attention_partial_pallas(
+    q_rows: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    li: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    q_pos: jax.Array,
+    *,
+    window: int = 0,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash partials of R query rows per kv head against ONE layer of
+    the STACKED block pool, read in place.
+
+    q_rows: [B, Hkv, R, D] — R is ``group`` for decode (the grouped
+    queries of one token) or ``group * S`` for a seeded suffix pass
+    (rows flattened (g, s) row-major); pool halves: [L, NBtot, Hkv,
+    blk, D] (any KV dtype — fp8 dequantizes on load); ``li``: traced
+    layer index (rides the scalar-prefetch lane next to the table, so
+    the pool is indexed by POINTER — no per-layer slice of the pool
+    ever materializes, which is what lets the decoder's layer scan
+    close over the whole pool); tables: [B, NB] (pad entries >= NBtot
+    clamp and must be length-masked); lengths: [B] valid bound of the
+    pool piece; q_pos: [B] absolute query position (sliding-window
+    masking only — ignored when ``window`` == 0).
+
+    Returns f32 (acc [B, Hkv, R, D], m [B, Hkv, R, 1], l [B, Hkv, R,
+    1]) with the usual flash convention: fully-masked rows carry
+    m = -inf, l = 0 (``combine_partials`` zeroes their output)."""
+    b, hkv, r, d = q_rows.shape
+    nbtot, blk = pool_k.shape[1], pool_k.shape[3]
+    nb = tables.shape[1]
+    if KERNEL_BLOCK_PACK % blk:
+        raise ValueError(
+            f"pool block {blk} must divide KERNEL_BLOCK_PACK "
+            f"{KERNEL_BLOCK_PACK}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # pad table ids into range for the index map (OOB blocks carry
+    # garbage that the length mask already excludes)
+    tables = jnp.minimum(tables.astype(jnp.int32), nbtot - 1)
+    li = jnp.reshape(li, (1,)).astype(jnp.int32)
+
+    grid = (b, hkv, nb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # layer index, block table
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b,), lambda bi, hi, i, li, tbl: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((b,), lambda bi, hi, i, li, tbl: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, r, d),
+                         lambda bi, hi, i, li, tbl: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, blk, d),
+                         lambda bi, hi, i, li, tbl:
+                         (li[0], tbl[bi, i], hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, blk, d),
+                         lambda bi, hi, i, li, tbl:
+                         (li[0], tbl[bi, i], hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, r, d),
+                         lambda bi, hi, i, li, tbl: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, r, 1),
+                         lambda bi, hi, i, li, tbl: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, r, 1),
+                         lambda bi, hi, i, li, tbl: (bi, hi, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((r, 1), jnp.float32),
+            pltpu.VMEM((r, 1), jnp.float32),
+            pltpu.VMEM((r, d), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_paged_partial_kernel, block=blk,
+                          window=window, scale=d ** -0.5),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, r, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, r, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, r, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(li, tables, lengths.astype(jnp.int32), q_pos.astype(jnp.int32),
+      q_rows, pool_k, pool_v)
+    return acc, m, l
 
 
 def paged_decode_attention_pallas(
@@ -163,47 +267,23 @@ def paged_decode_attention_pallas(
     layer. q: [B, Hq, D]; pool halves: [NBtot, Hkv, blk, D] (any KV
     dtype — fp8 dequantizes on load); tables: [B, NB] int32 (pad
     entries >= NBtot clamp and must be length-masked); lengths: [B]
-    committed positions per slot. Returns [B, Hq, D] in q's dtype."""
-    b, hq, d = q.shape
-    nbtot, hkv, blk, _ = pool_k_l.shape
-    nb = tables.shape[1]
-    group = hq // hkv
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    qg = q.reshape(b, hkv, group, d)
-    # pad table ids into range for the index map (OOB blocks carry
-    # garbage that the length mask already excludes)
-    tables = jnp.minimum(tables.astype(jnp.int32), nbtot - 1)
+    committed positions per slot. Returns [B, Hq, D] in q's dtype.
 
-    grid = (b, hkv, nb)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,                 # the block table
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((b,), lambda bi, hi, i, tbl: (0,),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, group, d),
-                         lambda bi, hi, i, tbl: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, blk, d),
-                         lambda bi, hi, i, tbl: (tbl[bi, i], hi, 0, 0)),
-            pl.BlockSpec((1, 1, blk, d),
-                         lambda bi, hi, i, tbl: (tbl[bi, i], hi, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, group, d),
-                               lambda bi, hi, i, tbl: (bi, hi, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, d), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, block=blk,
-                          window=window, scale=d ** -0.5),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
-        interpret=interpret,
-    )(tables, lengths.astype(jnp.int32), qg, pool_k_l, pool_v_l)
+    This is the single-piece instance of the partial kernel: one
+    pool piece, normalized right after — the same IEEE ops the old
+    in-kernel finalize ran, so results are unchanged bit for bit."""
+    b, hq, d = q.shape
+    hkv = pool_k_l.shape[1]
+    group = hq // hkv
+    qg = q.reshape(b, hkv, group, d)
+    acc, m, l = paged_attention_partial_pallas(
+        qg, pool_k_l[None], pool_v_l[None], jnp.zeros((1,), jnp.int32),
+        tables, lengths, lengths - 1, window=window,
+        interpret=interpret)
+    out = acc / jnp.where(l > 0, l, 1.0)
+    # fully-masked rows (parked slots, length 0) emit exact zeros —
+    # the same value the XLA reference's NaN guard produces
+    out = jnp.where(l > 0, out, 0.0).astype(q.dtype)
     return out.reshape(b, hq, d)
 
 

@@ -465,3 +465,54 @@ def test_multichip_columns_contract():
     # degenerate single-chip sweep stays well-formed
     one = bench.multichip_columns({1: {"tok_s": 0.0}}, {})
     assert one["scaling_efficiency"] == 0.0
+
+
+def test_kv_kernel_route_preset_keys():
+    """ISSUE 16: the paged presets carry the dispatch-route knob —
+    paged_capacity auto-selects its headline arm and pins a Pallas
+    kernel-route arm next to it; multichip_serving auto-selects its
+    scale children (the parent adds the pinned kernel child itself)."""
+    p = bench.PRESETS["paged_capacity"]
+    assert p["BENCH_KV_KERNEL"] == "auto"
+    assert p["BENCH_KV_KERNEL_ARM"] == "1"
+    assert bench.PRESETS["multichip_serving"]["BENCH_KV_KERNEL"] \
+        == "auto"
+
+
+def test_kernel_route_columns_contract():
+    """The kernel-route arm's artifact columns are a cross-round
+    contract: the RESOLVED route (kernel proves the Pallas path
+    compiled), its tok/s, and the zero-safe ratio against the
+    headline arm."""
+    cols = bench.kernel_route_columns("kernel", 100.0, 117.0)
+    assert set(cols) == {"kv_route", "kernel_tok_s",
+                         "kernel_tok_s_delta"}
+    assert cols["kv_route"] == "kernel"
+    assert cols["kernel_tok_s"] == 117.0
+    assert cols["kernel_tok_s_delta"] == 1.17
+    # a failed headline arm must not divide by zero
+    assert bench.kernel_route_columns("kernel", 0.0,
+                                      50.0)["kernel_tok_s_delta"] == 0.0
+
+
+def test_unknown_kv_kernel_fails_loudly():
+    """ISSUE 16: a typo'd BENCH_KV_KERNEL must fail rc-2/ok:false the
+    same way a typo'd BENCH_PRESET does — silently running (and
+    mislabeling) the default route would poison the next round's
+    artifact comparison. The check runs before the jax import, so the
+    subprocess exits fast."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "BENCH_KV_KERNEL": "pallass",
+           "BENCH_PRESET": "", "BENCH_MC_CHILD": ""}
+    out = subprocess.run(
+        [sys.executable, bench.__file__],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2, out.stdout + out.stderr
+    artifact = json.loads(out.stdout.strip().splitlines()[-1])
+    assert artifact["ok"] is False
+    assert "BENCH_KV_KERNEL" in artifact["reason"]
+    assert "pallass" in artifact["reason"]

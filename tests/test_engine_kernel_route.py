@@ -1,0 +1,299 @@
+# The Pallas paged kernel as the production decode route (ISSUE 16):
+# interpret-mode parity of the partial kernel + combine_partials fold
+# against the XLA reference across GQA ratios, sliding windows, fp8
+# pools, mixed fill levels, and parked rows; the kv_kernel constructor
+# guards; the no-materialization trace gate (no paged dispatch on the
+# kernel route may call paged_gather_kv — the test fails if the
+# materializing gather reappears in a traced program); and engine-level
+# greedy token equality between the kernel and reference routes across
+# the plain, prefix-cache, spec-decode, and chunked-prefill paths.
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from copilot_for_consensus_tpu.engine.kv_pool import BLOCK_TABLE_DTYPE
+from copilot_for_consensus_tpu.models.configs import decoder_config
+
+CFG = decoder_config("tiny")
+
+
+def _params():
+    from copilot_for_consensus_tpu.models import decoder
+
+    return decoder.init_params(jax.random.PRNGKey(7), CFG,
+                               dtype=jnp.float32)
+
+
+def _engine(params, route, **kw):
+    from copilot_for_consensus_tpu.engine.generation import (
+        GenerationEngine,
+    )
+
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 256)
+    kw.setdefault("prefill_buckets", (64, 128, 192))
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("kv_dtype", jnp.float32)
+    kw.setdefault("attn_impl", "xla")
+    kw.setdefault("decode_window", 4)
+    kw.setdefault("prefill_chunk", 64)
+    kw.setdefault("kv_pool_blocks", 12)
+    return GenerationEngine(CFG, params, kv_kernel=route, **kw)
+
+
+# ---------------------------------------------------------------------------
+# partial kernel: interpret-mode parity against the XLA reference
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("hq,hkv", [(8, 2), (4, 4), (8, 1)])
+@pytest.mark.parametrize("fp8", [False, True])
+@pytest.mark.parametrize("window", [0, 5])
+def test_partial_kernel_decode_parity(hq, hkv, fp8, window):
+    """The kernel route's decode shape: the pool partial alone IS the
+    whole kv prefix, so combine_partials of one piece must match the
+    gathered reference — across GQA ratios, sliding window, fp8
+    dequant-on-load, mixed fill levels, and a parked (length-0) row
+    that must emit exact zeros."""
+    from copilot_for_consensus_tpu.ops.attention import (
+        combine_partials,
+        decode_attention,
+    )
+    from copilot_for_consensus_tpu.ops.paged_attention import (
+        paged_attention_partial_pallas,
+        paged_gather_layer,
+    )
+
+    rng = np.random.default_rng(2)
+    b, d, blk, nbtot, nb, nl, li = 4, 16, 8, 12, 4, 3, 2
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32)
+    pk = jnp.asarray(rng.standard_normal((nl, nbtot, hkv, blk, d)),
+                     jnp.float32)
+    pv = jnp.asarray(rng.standard_normal((nl, nbtot, hkv, blk, d)),
+                     jnp.float32)
+    if fp8:
+        pk = pk.astype(jnp.float8_e4m3fn)
+        pv = pv.astype(jnp.float8_e4m3fn)
+    tables = jnp.asarray(rng.integers(0, nbtot, (b, nb)),
+                         BLOCK_TABLE_DTYPE)
+    # parked row, single token, full table, mid-block fill
+    lengths = jnp.asarray([0, 1, blk * nb, 17], jnp.int32)
+
+    k, v = paged_gather_layer(pk[li], pv[li], tables)
+    ref = decode_attention(q, k, v, lengths, window=window)
+    part = paged_attention_partial_pallas(
+        q.reshape(b, hkv, hq // hkv, d), pk, pv,
+        jnp.asarray([li], jnp.int32), tables, lengths, lengths - 1,
+        window=window, interpret=True)
+    got = combine_partials([part], jnp.float32).reshape(b, hq, d)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               atol=1e-5)
+    assert bool(jnp.all(got[0] == 0.0))        # parked row: exact zeros
+
+
+def test_partial_kernel_seeded_rows_parity():
+    """The seeded shape (R = group * S query rows): pool partial from
+    the kernel + the XLA causal-suffix partial folded by
+    combine_partials must match a dense joint softmax over
+    [pool prefix | causal suffix] — including a zero-prefix row whose
+    pool piece is fully masked."""
+    from copilot_for_consensus_tpu.ops.attention import (
+        causal_suffix_partial,
+        combine_partials,
+    )
+    from copilot_for_consensus_tpu.ops.paged_attention import (
+        paged_attention_partial_pallas,
+        paged_gather_layer,
+    )
+
+    rng = np.random.default_rng(3)
+    b, hkv, g, d, blk, nbtot, nb, s = 2, 2, 2, 16, 8, 10, 3, 4
+    hq = hkv * g
+    q = jnp.asarray(rng.standard_normal((b, hq, s, d)), jnp.float32)
+    pk = jnp.asarray(rng.standard_normal((2, nbtot, hkv, blk, d)),
+                     jnp.float32)
+    pv = jnp.asarray(rng.standard_normal((2, nbtot, hkv, blk, d)),
+                     jnp.float32)
+    ks = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32)
+    vs = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, nbtot, (b, nb)),
+                         BLOCK_TABLE_DTYPE)
+    pref = jnp.asarray([13, 0], jnp.int32)     # mid-block + no prefix
+
+    qr = q.reshape(b, hkv, g, s, d).reshape(b, hkv, g * s, d)
+    pool_part = paged_attention_partial_pallas(
+        qr, pk, pv, jnp.asarray([1], jnp.int32), tables, pref,
+        pref - 1, window=0, interpret=True)
+    suf_part = causal_suffix_partial(q, ks, vs)
+    got = combine_partials([pool_part, suf_part], jnp.float32)
+
+    # dense reference: joint softmax over pool positions < pref[b] and
+    # suffix positions t <= s (row-major (g, s) rows, like the kernel)
+    kp, vp = paged_gather_layer(pk[1], pv[1], tables)   # [b,hkv,P,d]
+    qg = q.reshape(b, hkv, g, s, d)
+    lp = jnp.einsum("bhgsd,bhpd->bhgsp", qg, kp) * (d ** -0.5)
+    lp = jnp.where(jnp.arange(nb * blk)[None, None, None, None]
+                   < pref[:, None, None, None, None], lp, -jnp.inf)
+    ls = jnp.einsum("bhgsd,bhtd->bhgst", qg, ks) * (d ** -0.5)
+    ls = jnp.where(jnp.arange(s)[None, None, None, None]
+                   <= jnp.arange(s)[None, None, None, :, None],
+                   ls, -jnp.inf)
+    probs = jax.nn.softmax(jnp.concatenate([lp, ls], axis=-1), axis=-1)
+    ref = jnp.einsum("bhgsp,bhpd->bhgsd", probs,
+                     jnp.concatenate([vp, vs], axis=-2))
+    np.testing.assert_allclose(
+        np.asarray(ref.reshape(b, hkv, g * s, d)), np.asarray(got),
+        atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# engine construction: the kv_kernel knob's guards and resolution
+# ---------------------------------------------------------------------------
+
+
+def test_kv_kernel_constructor_guards_and_resolution():
+    params = _params()
+    with pytest.raises(ValueError, match="kv_kernel"):
+        _engine(params, "cuda")
+    with pytest.raises(ValueError, match="paged"):
+        _engine(params, "pallas", kv_pool_blocks=0)
+    # contiguous engine: no paged dispatches, no route
+    assert _engine(params, "auto", kv_pool_blocks=0)._kv_route == ""
+    # pinned routes resolve as pinned; auto picks the reference route
+    # on CPU (this suite's backend — the kernel would only interpret)
+    assert _engine(params, "pallas")._kv_route == "kernel"
+    assert _engine(params, "reference")._kv_route == "reference"
+    assert _engine(params, "auto")._kv_route == "reference"
+
+
+# ---------------------------------------------------------------------------
+# no-materialization gate: the kernel route must never gather the pool
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_route_never_traces_the_materializing_gather(monkeypatch):
+    """THE tentpole's accounting: tracing + running every kernel-route
+    paged program (seeded admission, windowed decode, chunked prefill)
+    must not call paged_gather_kv even once — if the working-set
+    materialization reappears in any dispatch body, this fails. The
+    reference engine is the positive control proving the spy sees
+    traced calls."""
+    from copilot_for_consensus_tpu.ops import paged_attention as pa
+
+    calls = {"n": 0}
+    real = pa.paged_gather_kv
+
+    def spy(pool_k, pool_v, bids):
+        calls["n"] += 1
+        return real(pool_k, pool_v, bids)
+
+    monkeypatch.setattr(pa, "paged_gather_kv", spy)
+    params = _params()
+    rng = np.random.default_rng(4)
+    shared = rng.integers(3, CFG.vocab_size, size=70).tolist()
+    prompts = [shared + rng.integers(3, CFG.vocab_size,
+                                     size=10).tolist()
+               for _ in range(3)]
+    ker = _engine(params, "pallas", kv_pool_blocks=16,
+                  prefix_cache_blocks=8)
+    for _round in range(2):          # round 2 traces seeded admission
+        ker.generate(prompts, max_new_tokens=6)
+    assert ker.kv_pool_stats()["zero_copy_admits"] > 0
+    assert calls["n"] == 0, "kernel route materialized the pool"
+    ref = _engine(params, "reference", kv_pool_blocks=16,
+                  prefix_cache_blocks=8)
+    ref.generate(prompts, max_new_tokens=6)
+    assert calls["n"] > 0            # the spy does see traced gathers
+
+
+# ---------------------------------------------------------------------------
+# engine e2e: greedy f32 CPU token equality, kernel vs reference route
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_route_plain_decode_tokens_match_reference():
+    params = _params()
+    ref = _engine(params, "reference")
+    ker = _engine(params, "pallas")
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(3, CFG.vocab_size, size=70).tolist()
+               for _ in range(6)]
+    want = ref.generate(prompts, max_new_tokens=10)
+    got = ker.generate(prompts, max_new_tokens=10)
+    for w, g in zip(want, got):
+        assert w.tokens == g.tokens
+        assert w.finish_reason == g.finish_reason
+    st = ker.kv_pool_stats()
+    assert st["free_blocks"] == st["num_blocks"]   # books still balance
+
+
+def test_kernel_route_prefix_zero_copy_tokens_match_reference():
+    """Seeded admission through the kernel's R > 1 rows: zero-copy
+    prefix hits produce the same greedy streams as the reference
+    route's gather-and-run seeded program."""
+    params = _params()
+    ref = _engine(params, "reference", kv_pool_blocks=16,
+                  prefix_cache_blocks=8)
+    ker = _engine(params, "pallas", kv_pool_blocks=16,
+                  prefix_cache_blocks=8)
+    rng = np.random.default_rng(1)
+    shared = rng.integers(3, CFG.vocab_size, size=128).tolist()
+    prompts = [shared + rng.integers(3, CFG.vocab_size,
+                                     size=30).tolist()
+               for _ in range(6)]
+    for _round in range(2):
+        want = ref.generate(prompts, max_new_tokens=6)
+        got = ker.generate(prompts, max_new_tokens=6)
+        for w, g in zip(want, got):
+            assert w.tokens == g.tokens
+    assert ker.kv_pool_stats()["zero_copy_admits"] > 0
+
+
+@pytest.mark.slow
+def test_kernel_route_spec_decode_tokens_match_reference():
+    params = _params()
+    rng = np.random.default_rng(0)
+    half = 60
+
+    def copy_prompt():
+        head = rng.integers(3, CFG.vocab_size, size=half).tolist()
+        tail = []
+        while len(tail) < half:
+            s0 = int(rng.integers(0, max(1, half - 16)))
+            tail.extend(head[s0:s0 + 16])
+        return head + tail[:half]
+
+    prompts = [copy_prompt() for _ in range(4)]
+    ref = _engine(params, "reference", kv_pool_blocks=16,
+                  spec_decode=True)
+    ker = _engine(params, "pallas", kv_pool_blocks=16,
+                  spec_decode=True)
+    want = ref.generate(prompts, max_new_tokens=16)
+    got = ker.generate(prompts, max_new_tokens=16)
+    for w, g in zip(want, got):
+        assert w.tokens == g.tokens
+    assert ker.spec_stats()["verify_dispatches"] > 0
+
+
+@pytest.mark.slow
+def test_kernel_route_chunked_prefill_tokens_match_reference():
+    from copilot_for_consensus_tpu.engine.scheduler import (
+        Scheduler,
+        SchedulerConfig,
+    )
+
+    params = _params()
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(3, CFG.vocab_size, size=180).tolist()
+               for _ in range(3)]
+    ref = _engine(params, "reference", kv_pool_blocks=16,
+                  scheduler=Scheduler(SchedulerConfig(chunk_tokens=64)))
+    ker = _engine(params, "pallas", kv_pool_blocks=16,
+                  scheduler=Scheduler(SchedulerConfig(chunk_tokens=64)))
+    want = ref.generate(prompts, max_new_tokens=8)
+    got = ker.generate(prompts, max_new_tokens=8)
+    for w, g in zip(want, got):
+        assert w.tokens == g.tokens
+    assert ker.chunk_dispatches > 0

@@ -265,6 +265,7 @@ def test_telemetry_registry_matches_actual_emission():
     # paged KV block pool (engine/kv_pool.py)
     tele.gauge_kv_pool(12, pinned_blocks=3, fragmentation_ratio=0.25)
     tele.on_zero_copy_admits(2)
+    tele.gauge_kv_route("kernel")
     # disaggregated prefill/decode roles (engine/roles.py)
     tele.gauge_role_occupancy("prefill", 0.75)
     tele.on_handoff(blocks=6, wait_s=0.01)

@@ -171,6 +171,24 @@ def test_block_table_dtype_flip_fails_the_lane(tmp_path):
                for f in findings), findings
 
 
+def test_kernel_block_pack_flip_fails_the_lane(tmp_path):
+    """Flip the dispatch side's declared lane packing: the
+    ``engine.generation-kv-pack`` layout group no longer agrees with
+    the kernel's ``KERNEL_BLOCK_PACK`` anchor (and the pool's
+    ``POOL_BLOCK_PACK``) — the drift class where the engine's
+    128-aligned kv buckets and the kernel's BlockSpec packing stop
+    describing the same block layout."""
+    needle = ("    block_pack = 128              "
+              "# dispatch-side kernel lane packing")
+    findings = _mutated_findings(
+        tmp_path, _GEN, needle,
+        needle.replace("= 128", "= 64"),
+        "generation_blockpack_mutated")
+    assert any(f.rule == "shard-kv-layout"
+               and "engine.generation-kv-pack" in f.message
+               for f in findings), findings
+
+
 def test_shape_mismatched_donated_arg_fails_the_lane(tmp_path):
     """Cast the admit program's cache output: the donated cache buffer
     no longer has a matching output, so XLA would drop the alias."""

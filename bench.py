@@ -584,6 +584,71 @@ def shardcheck_preflight() -> dict | None:
     }
 
 
+#: pipeline presets run the dura (durability-contract) rule family
+#: over the planes their storm exercises, the way engine presets run
+#: shardcheck; value = the source roots duracheck scans.
+PRESET_DURA_PATHS = {
+    "pipeline_chaos": ["copilot_for_consensus_tpu/bus",
+                       "copilot_for_consensus_tpu/services"],
+}
+
+
+def duracheck_preflight(paths: list[str] | None = None) -> dict | None:
+    """Run the dura rule family (analysis/duracheck.py: commit/publish
+    crash windows, raw-publish outbox bypasses, ack swallows, journal
+    ordering, idempotent writes, sqlite-ledger hygiene) over the
+    preset's bus/services planes BEFORE the storm. A violation returns
+    an ok:false artifact dict (the caller exits 2, matching
+    shardcheck_preflight) — a handler that silently acks transient
+    failures would otherwise surface as lost-work counts halfway
+    through a chaos run. Analyzer infra trouble warns and lets the
+    bench proceed: the gate must never be the thing that eats the
+    artifact. scale_bench's host-pipeline path calls this too, with
+    its own explicit ``paths``."""
+    if os.environ.get("BENCH_PREFLIGHT", "1") != "1":
+        return None
+    env_paths = os.environ.get("BENCH_DURACHECK_PATHS")
+    if env_paths:
+        # explicit override wins even over caller-passed paths (the
+        # contract tests point this at the fixture corpus)
+        paths = [p.strip() for p in env_paths.split(",") if p.strip()]
+    elif paths is None:
+        paths = PRESET_DURA_PATHS.get(
+            os.environ.get("BENCH_PRESET", ""), [])
+    if not paths:
+        return None
+    log(f"duracheck preflight: {', '.join(paths)}")
+    cmd = [sys.executable, "-m", "copilot_for_consensus_tpu.analysis",
+           "--group", "dura", "--strict",
+           *[os.path.join(REPO, p) for p in paths]]
+    try:
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                           text=True, timeout=300)
+    except Exception as exc:   # infra, not contract
+        log(f"duracheck preflight: {exc!r}; continuing")
+        return None
+    if r.returncode == 0:
+        log("duracheck preflight: CLEAN")
+        return None
+    if r.returncode != 1:
+        # usage error / analyzer crash — environment, not contract
+        log(f"duracheck preflight: analyzer rc {r.returncode} "
+            f"({r.stderr.strip()[-200:]}); continuing")
+        return None
+    rendered = [ln for ln in r.stdout.splitlines() if ln.strip()][:20]
+    for ln in rendered:
+        log(f"duracheck preflight: {ln}")
+    return {
+        "metric": "duracheck-preflight",
+        "value": 0.0,
+        "unit": "",
+        "ok": False,
+        "reason": "duracheck preflight failed: durability-contract "
+                  f"violation(s) in {', '.join(paths)}",
+        "findings": rendered,
+    }
+
+
 # -- backend probe ------------------------------------------------------
 
 _PROBE_SRC = """
@@ -2454,6 +2519,11 @@ def main() -> None:
     # rather than discovering a dropped donation alias or KV-layout
     # mismatch as an OOM mid-run on the TPU.
     preflight_artifact = shardcheck_preflight()
+    if preflight_artifact is None:
+        # pipeline presets gate on the durability contracts instead of
+        # (not before) jitted-entrypoint tracing — engine presets map
+        # to no dura paths and skip this, mirror-image of shardcheck
+        preflight_artifact = duracheck_preflight()
     if preflight_artifact is not None:
         print(json.dumps(preflight_artifact))
         sys.exit(2)

@@ -17,6 +17,14 @@ over the repo and exits non-zero on any non-baselined finding:
   import graph) that ``--fast`` and explicit-path runs skip.
 * ``policy`` group (policy.py): the original validate_python lane
   (syntax, import smoke, mutable defaults, unused imports, bare except).
+* ``dura`` group (duracheck.py): the crash-safety / exactly-once
+  contracts from docs/RESILIENCE.md — commit/publish crash windows,
+  raw publishes bypassing the outbox, handlers swallowing transient
+  failures into silent acks, journal-before-admit / retire-at-harvest
+  ordering, dup-tolerant inserts under at-least-once dispatch, and
+  sqlite-ledger hygiene (WAL, transaction-scoped loops, owner-joined
+  close). Receivers resolve through the effect-provenance model in
+  base.py, not name tokens.
 * ``shard`` group (shardcheck.py): the SEMANTIC pass — traces the
   contract-declared jitted entrypoints with ``jax.eval_shape`` under
   the declared meshes (CPU, virtual devices) and verifies sharding
@@ -45,6 +53,7 @@ import sys
 # pulls jax or spawns anything.
 from copilot_for_consensus_tpu.analysis import (
     concurrency,
+    duracheck,
     jax_rules,
     policy,
     racecheck,
@@ -67,6 +76,7 @@ GROUPS = {
     "concurrency": concurrency.check,
     "race": racecheck.check,
     "policy": policy.check,
+    "dura": duracheck.check,
 }
 
 #: groups that run once per invocation, not per file
@@ -93,6 +103,8 @@ RULES = {
     "policy-unused-import": "policy",
     "policy-import-smoke": "policy",
 }
+# keep in sync with duracheck.RULES (test_static_analysis.py enforces it)
+RULES.update({rule: "dura" for rule in duracheck.RULES})
 # keep in sync with shardcheck.RULES (test_shardcheck.py enforces it)
 RULES.update({rule: "shard" for rule in (
     "shard-rule-axis",

@@ -434,6 +434,208 @@ class LockModel:
 
 
 # ---------------------------------------------------------------------------
+# effect-provenance model (shared by duracheck)
+# ---------------------------------------------------------------------------
+#
+# The durability rules reason about *orderings of effects along a
+# path* — store writes, publishes, journal mutations — so they first
+# need to know which expressions denote an effectful receiver at all.
+# Name tokens ("does it contain 'publisher'?") would misfire on
+# wrappers and miss renamed fields; this model tracks provenance the
+# way LockModel does for threading primitives: a name is a publisher
+# because it was BOUND from a publisher — a tagged constructor
+# parameter (``def __init__(self, publisher, store, ...)``; the
+# ``self.<param> = param`` service convention is trusted even when the
+# assignment happens in a base class the per-module pass can't see),
+# a tagged constructor call (``EngineJournal(...)``,
+# ``sqlite3.connect(...)``), or an alias of either.
+
+#: constructor-parameter name → effect tag (the BaseService wiring
+#: convention every service follows)
+EFFECT_PARAM_TAGS = {
+    "publisher": "publisher",
+    "store": "store",
+    "document_store": "store",
+    "journal": "journal",
+}
+
+#: annotation class name → effect tag (covers renamed parameters:
+#: ``bus: EventPublisher`` is a publisher no matter its spelling)
+EFFECT_ANNOTATION_TAGS = {
+    "EventPublisher": "publisher",
+    "BrokerPublisher": "publisher",
+    "DocumentStore": "store",
+    "EngineJournal": "journal",
+}
+
+#: RHS call → effect tag. ``sqlite3.connect`` must be spelled dotted
+#: (every first-party ledger does); the journal factories match by
+#: tail so relative imports work.
+EFFECT_CTOR_TAGS = {
+    "EngineJournal": "journal",
+    "resolve_journal": "journal",
+    "sqlite3.connect": "sqlite",
+}
+
+
+@dataclass
+class EffectInfo:
+    """One effectful receiver with a stable identity (aliases share
+    the object, so ``db = self._db; db.close()`` closes THE ledger)."""
+
+    tag: str           # "publisher" | "store" | "journal" | "sqlite"
+    name: str          # canonical spelling, e.g. "EngineJournal._db"
+    line: int
+
+
+def _annotation_tag(ann: ast.AST | None) -> str | None:
+    if ann is None:
+        return None
+    names: list[str] = []
+    for n in ast.walk(ann):
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            d = dotted_name(n)
+            if d:
+                names.append(d.rsplit(".", 1)[-1])
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            names.extend(re.findall(r"\w+", n.value))
+    for nm in names:
+        if nm in EFFECT_ANNOTATION_TAGS:
+            return EFFECT_ANNOTATION_TAGS[nm]
+    return None
+
+
+def _param_tag(arg: ast.arg) -> str | None:
+    hit = _annotation_tag(arg.annotation)
+    if hit is not None:
+        return hit
+    return EFFECT_PARAM_TAGS.get(arg.arg)
+
+
+class EffectModel:
+    """Where every effectful receiver in one module is bound. Same
+    three scopes and the same resolution order as :class:`LockModel`:
+    module names, per-class fields, function locals."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.module_vars: dict[str, EffectInfo] = {}
+        self.class_fields: dict[str, dict[str, EffectInfo]] = {}
+        self.fn_locals: dict[tuple[str, str], EffectInfo] = {}
+        if mod.tree is None:
+            return
+        self._collect_params()
+        # Two passes, like LockModel: aliases whose source binds later
+        # in the file resolve on the second walk.
+        for final in (False, True):
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign):
+                    self._bind(node.targets, node.value, node, final)
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    self._bind([node.target], node.value, node, final)
+
+    def _scope_of(self, node: ast.AST) -> tuple[str | None, str | None]:
+        cls = fn = None
+        cur = self.mod.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn is None:
+                fn = self.mod.qualname(cur)
+            elif isinstance(cur, ast.ClassDef) and cls is None:
+                cls = cur.name
+            cur = self.mod.parent(cur)
+        return cls, fn
+
+    def _collect_params(self) -> None:
+        """Tagged parameters bind as function locals; tagged ``__init__``
+        parameters ALSO bind the same-named instance field — the
+        ``self.store = store`` convention, which often executes in a
+        base class another module owns."""
+        assert self.mod.tree is not None
+        for fn in ast.walk(self.mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls, _ = self._scope_of(fn)
+            qn = self.mod.qualname(fn)
+            a = fn.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                tag = _param_tag(arg)
+                if tag is None or arg.arg == "self":
+                    continue
+                info = EffectInfo(tag, arg.arg,
+                                  getattr(fn, "lineno", 1))
+                self.fn_locals.setdefault((qn, arg.arg), info)
+                if cls is not None and fn.name == "__init__":
+                    self.class_fields.setdefault(cls, {}).setdefault(
+                        arg.arg, EffectInfo(
+                            tag, f"{cls}.{arg.arg}", fn.lineno))
+
+    def _ctor_tag(self, value: ast.AST) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        d = dotted_name(value.func)
+        if d in EFFECT_CTOR_TAGS:
+            return EFFECT_CTOR_TAGS[d]
+        tail = d.rsplit(".", 1)[-1]
+        if tail in ("EngineJournal", "resolve_journal"):
+            return EFFECT_CTOR_TAGS[tail]
+        return None
+
+    def _bind(self, targets: list[ast.expr], value: ast.AST,
+              site: ast.AST, final: bool) -> None:
+        tag = self._ctor_tag(value)
+        info: EffectInfo | None = None
+        cls, fn = self._scope_of(site)
+        if tag is not None:
+            info = EffectInfo(tag, "", getattr(site, "lineno", 1))
+        elif isinstance(value, (ast.Name, ast.Attribute)):
+            info = self.resolve(value, site)
+            if info is None:
+                return
+        else:
+            return
+        for t in targets:
+            if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id == "self" \
+                    and cls is not None:
+                if not info.name:
+                    info.name = f"{cls}.{t.attr}"
+                self.class_fields.setdefault(cls, {}).setdefault(
+                    t.attr, info)
+            elif isinstance(t, ast.Name):
+                if fn is not None:
+                    if not info.name:
+                        info.name = t.id
+                    self.fn_locals.setdefault((fn, t.id), info)
+                elif not info.name:
+                    info.name = t.id
+                if fn is None:
+                    self.module_vars.setdefault(t.id, info)
+
+    def resolve(self, expr: ast.AST,
+                use_site: ast.AST) -> EffectInfo | None:
+        """The effectful receiver ``expr`` denotes at ``use_site``."""
+        cls, fn = self._scope_of(use_site)
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            if cls is not None:
+                return self.class_fields.get(cls, {}).get(expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            if fn is not None:
+                hit = self.fn_locals.get((fn, expr.id))
+                if hit is not None:
+                    return hit
+            if cls is not None:
+                hit = self.class_fields.get(cls, {}).get(expr.id)
+                if hit is not None:
+                    return hit
+            return self.module_vars.get(expr.id)
+        return None
+
+
+# ---------------------------------------------------------------------------
 # small AST helpers shared by the rule groups
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,665 @@
+"""Rule group ``dura``: the crash-safety / exactly-once contracts.
+
+docs/RESILIENCE.md states the durability contracts as prose; every
+rule here machine-checks one of them, and every rule is grounded in a
+bug class this repo actually shipped and later fixed:
+
+- **dura-commit-publish-window** — a handler commits a store write and
+  then publishes only the *freshly inserted* rows, so a crash between
+  commit and publish strands the committed rows forever (redelivery
+  filters them out as duplicates and nothing republishes their
+  events). Parsing shipped exactly this; the fix publishes
+  already-stored-but-unfinished rows too (``stored_unchunked``).
+- **dura-raw-publish** — ``publish_envelope`` / raw broker ``pub`` ops
+  outside the bus package bypass the typed-event discipline (schema
+  validation, identity stamping, the outbox/publish_window path).
+- **dura-ack-swallow** — handler code that catches ``RetryableError``
+  or broad ``Exception`` and falls through normally converts a
+  transient failure into a silent ack: the envelope is gone and the
+  work never happened. Handlers must re-raise, return the exception
+  for classification, or publish a ``*Failed`` event.
+- **dura-journal-order** — engine submit paths must
+  ``record_submit`` *before* any queue/scheduler insertion (a crash in
+  the window otherwise loses admitted work), and ``record_retire``
+  only *after* the harvested result is used (retire-at-harvest).
+- **dura-idempotent-write** — inserts reachable from an at-least-once
+  dispatch context must tolerate redelivery: ``ignore_duplicates=True``
+  or an existence-read dedup guard in the same handler.
+- **dura-sqlite-ledger** — first-party sqlite ledgers (journal,
+  outbox, broker queue store, DLQ) must open WAL, scope multi-row
+  write loops in one transaction, and have an owner-joined ``close``.
+
+All receiver reasoning goes through :class:`base.EffectModel`
+provenance (what a name was *bound from*), not name tokens — plus one
+narrow convention fallback: inside a handler class, ``self.store`` /
+``self.publisher`` are trusted as store/publisher even when the
+binding ``__init__`` lives in a base class another module owns.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from copilot_for_consensus_tpu.analysis.base import (
+    EffectModel, Finding, Module, dotted_name, kw,
+)
+
+RULES = (
+    "dura-commit-publish-window",
+    "dura-raw-publish",
+    "dura-ack-swallow",
+    "dura-journal-order",
+    "dura-idempotent-write",
+    "dura-sqlite-ledger",
+)
+
+#: DocumentStore surface, split by effect
+STORE_READS = {
+    "get_document", "get_documents", "query_documents", "count_documents",
+}
+STORE_INSERTS = {"insert_document", "insert_many"}
+STORE_WRITES = STORE_INSERTS | {
+    "upsert_document", "update_document", "update_documents",
+    "replace_document", "delete_document", "delete_documents",
+}
+PUBLISH_METHODS = {"publish", "publish_envelope"}
+
+#: self-attribute methods that insert work into a queue/scheduler
+#: ("add"/"push" are deliberately excluded — too many unrelated uses)
+QUEUE_INSERTS = {"enqueue", "append", "appendleft", "put", "put_nowait"}
+
+#: exception names whose catch is "broad" for ack purposes: catching
+#: one of these around handler work can eat a transient failure
+BROAD_CATCHES = {"Exception", "BaseException",
+                 "RetryableError", "RetryExhaustedError"}
+
+#: method names that make a class a dispatch-context handler
+HANDLER_NAMES = {"handle_envelope", "handle_envelopes"}
+
+#: bus event handlers are named after the CamelCase event type
+#: (``on_JSONParsed`` / ``on_wave_ChunksPrepared``); lowercase ``on_*``
+#: are engine/telemetry callbacks, which are NOT dispatch contexts
+_EVENT_HANDLER_RE = re.compile(r"on_(wave_)?[A-Z]")
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+def _methods(cls: ast.ClassDef) -> list[ast.FunctionDef]:
+    return [n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _handler_classes(mod: Module) -> list[ast.ClassDef]:
+    """Classes whose methods run under at-least-once dispatch: they
+    define an ``on_*`` wave/event handler or the dispatch entrypoints
+    themselves (``handle_envelope``/``handle_envelopes``)."""
+    assert mod.tree is not None
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        names = {m.name for m in _methods(node)}
+        if names & HANDLER_NAMES or any(_EVENT_HANDLER_RE.match(n)
+                                        for n in names):
+            out.append(node)
+    return out
+
+
+def _receiver_tag(effects: EffectModel, call: ast.Call,
+                  handler_scope: bool = False) -> str | None:
+    """Effect tag of ``call``'s receiver (None for plain functions or
+    untagged receivers)."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = call.func.value
+    info = effects.resolve(recv, call)
+    if info is not None:
+        return info.tag
+    if handler_scope:
+        d = dotted_name(recv)
+        if d == "self.store":
+            return "store"
+        if d == "self.publisher":
+            return "publisher"
+    return None
+
+
+def _own_nodes(fn: ast.AST) -> list[ast.AST]:
+    """ast.walk(fn) minus the bodies of nested function defs (a
+    nested finisher is its own ordering domain)."""
+    out: list[ast.AST] = [fn]
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _base_name(expr: ast.AST) -> str | None:
+    """Root Name of a Name / attribute chain (``req.request_id`` →
+    ``req``); None for anything else or ``self``."""
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        cur = cur.value
+    if isinstance(cur, ast.Name) and cur.id != "self":
+        return cur.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# dura-commit-publish-window
+# ---------------------------------------------------------------------------
+
+def _check_commit_publish_window(mod: Module, effects: EffectModel,
+                                 cls: ast.ClassDef) -> list[Finding]:
+    """The PR-11 crash-window shape, per handler method:
+
+    1. an existence read (``existing = store.get_documents(...)``),
+    2. a *fresh* filter — rows NOT in the existence read
+       (``d["id"] not in existing``),
+    3. a store insert commits in the same method, and
+    4. the fresh-only collection flows to a publish (direct args, a
+       publish-bearing ``for`` loop, or the method's return value —
+       helper methods return to a caller that publishes),
+
+    with NO companion *positive* use of the same existence read (the
+    redelivery-republish half: already-stored-but-unfinished rows,
+    e.g. parsing's ``stored_unchunked``). If redelivery republishes
+    nothing for committed rows, a crash between commit and publish
+    loses their downstream events forever.
+    """
+    out: list[Finding] = []
+    for fn in _methods(cls):
+        nodes = list(ast.walk(fn))
+        # the commit half must exist at all
+        has_insert = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr in STORE_INSERTS
+            and _receiver_tag(effects, n, True) == "store"
+            for n in nodes)
+        if not has_insert:
+            continue
+        # pass 1: existence reads + taint propagation, in source order
+        exist: set[str] = set()
+        taints: dict[str, set[tuple[str, str]]] = {}
+        first_site: dict[str, ast.AST] = {}
+
+        def marks_of(rhs: ast.AST) -> set[tuple[str, str]]:
+            marks: set[tuple[str, str]] = set()
+            for n in ast.walk(rhs):
+                if not (isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)):
+                    continue
+                marks |= taints.get(n.id, set())
+                if n.id in exist:
+                    par = mod.parent(n)
+                    if isinstance(par, ast.Compare) \
+                            and n in par.comparators \
+                            and all(isinstance(op, ast.NotIn)
+                                    for op in par.ops):
+                        marks.add(("fresh", n.id))
+                    else:
+                        marks.add(("pos", n.id))
+            return marks
+
+        def bind(name: str, marks: set[tuple[str, str]],
+                 site: ast.AST) -> None:
+            if marks:
+                taints.setdefault(name, set()).update(marks)
+                first_site.setdefault(name, site)
+
+        assigns = [n for n in nodes
+                   if isinstance(n, (ast.Assign, ast.AugAssign))
+                   or (isinstance(n, ast.Call)
+                       and isinstance(n.func, ast.Attribute)
+                       and n.func.attr in ("extend", "append",
+                                           "setdefault", "update"))]
+        for n in sorted(assigns, key=lambda x: x.lineno):
+            if isinstance(n, ast.Assign):
+                if isinstance(n.value, ast.Call) and isinstance(
+                        n.value.func, ast.Attribute) \
+                        and n.value.func.attr in STORE_READS \
+                        and _receiver_tag(effects, n.value, True) == "store":
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            exist.add(t.id)
+                    continue
+                marks = marks_of(n.value)
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        bind(t.id, marks, n)
+                    elif isinstance(t, ast.Subscript):
+                        base = _base_name(t.value)
+                        if base:
+                            bind(base, marks, n)
+            elif isinstance(n, ast.AugAssign):
+                if isinstance(n.target, ast.Name):
+                    bind(n.target.id, marks_of(n.value), n)
+            else:  # mutating container call: to_insert.extend(fresh)
+                base = _base_name(n.func.value)
+                if base:
+                    marks = set()
+                    for a in n.args:
+                        marks |= marks_of(a)
+                    bind(base, marks, n)
+        if not taints:
+            continue
+        # pass 2: which names flow to a publish?
+        published: set[str] = set()
+        for n in nodes:
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) \
+                    and n.func.attr in PUBLISH_METHODS \
+                    and _receiver_tag(effects, n, True) == "publisher":
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Name):
+                            published.add(sub.id)
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                body_pub = any(
+                    isinstance(s, ast.Call)
+                    and isinstance(s.func, ast.Attribute)
+                    and s.func.attr in PUBLISH_METHODS
+                    and _receiver_tag(effects, s, True) == "publisher"
+                    for b in n.body for s in ast.walk(b))
+                if body_pub:
+                    for sub in ast.walk(n.iter):
+                        if isinstance(sub, ast.Name):
+                            published.add(sub.id)
+            elif isinstance(n, ast.Return) and n.value is not None:
+                for sub in ast.walk(n.value):
+                    if isinstance(sub, ast.Name):
+                        published.add(sub.id)
+        # a positive companion anywhere on the publish flow covers the
+        # window: `for r in fresh + stored_unfinished:` is as good as
+        # merging into one name first
+        pos_covered: set[str] = set()
+        for name in published:
+            pos_covered |= {e for k, e in taints.get(name, set())
+                            if k == "pos"}
+        for name, marks in taints.items():
+            if name not in published:
+                continue
+            fresh_es = {e for k, e in marks if k == "fresh"}
+            for e in sorted(fresh_es - pos_covered):
+                f = mod.finding(
+                    "dura-commit-publish-window", first_site[name],
+                    f"`{name}` publishes only rows absent from the "
+                    f"existence read `{e}` while this handler also "
+                    "commits a store insert — a crash between commit "
+                    "and publish strands the committed rows (redelivery "
+                    "filters them as duplicates and nothing republishes "
+                    "their events); also publish the "
+                    "already-stored-but-unfinished rows, the way "
+                    "parsing republishes `stored_unchunked`")
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dura-raw-publish
+# ---------------------------------------------------------------------------
+
+def _check_raw_publish(mod: Module, effects: EffectModel) -> list[Finding]:
+    """``publish_envelope`` and raw broker ``pub`` ops belong to the
+    bus package; everywhere else must publish typed events through
+    ``.publish`` so the outbox/publish_window discipline applies."""
+    if mod.relpath.startswith("copilot_for_consensus_tpu/bus/"):
+        return []
+    out: list[Finding] = []
+    assert mod.tree is not None
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr == "publish_envelope":
+            info = effects.resolve(node.func.value, node)
+            d = dotted_name(node.func.value) or ""
+            if (info is not None and info.tag == "publisher") \
+                    or d.endswith("publisher"):
+                f = mod.finding(
+                    "dura-raw-publish", node,
+                    "raw `publish_envelope` outside the bus package "
+                    "bypasses the typed-event discipline (schema "
+                    "validation, identity stamping, the "
+                    "outbox/publish_window path) — publish a typed "
+                    "Event via `.publish()`")
+                if f is not None:
+                    out.append(f)
+        elif node.func.attr == "request" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Dict):
+                for k, v in zip(arg.keys, arg.values):
+                    if isinstance(k, ast.Constant) and k.value == "op" \
+                            and isinstance(v, ast.Constant) \
+                            and v.value in ("pub", "pub_batch"):
+                        f = mod.finding(
+                            "dura-raw-publish", node,
+                            f"raw broker `{v.value}` op outside the bus "
+                            "package bypasses the outbox — route "
+                            "through an EventPublisher")
+                        if f is not None:
+                            out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dura-ack-swallow
+# ---------------------------------------------------------------------------
+
+def _caught_names(type_expr: ast.AST | None) -> set[str]:
+    if type_expr is None:
+        return {"<bare>"}
+    names: set[str] = set()
+    exprs = type_expr.elts if isinstance(type_expr, ast.Tuple) \
+        else [type_expr]
+    for e in exprs:
+        d = dotted_name(e)
+        if d:
+            names.add(d.rsplit(".", 1)[-1])
+    return names
+
+
+def _classifies(handler: ast.ExceptHandler) -> bool:
+    """Does this except body re-raise, hand the exception back for
+    classification, or publish a ``*Failed`` event?"""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Return) and n.value is not None \
+                and handler.name is not None:
+            if any(isinstance(s, ast.Name) and s.id == handler.name
+                   for s in ast.walk(n.value)):
+                return True
+        if isinstance(n, ast.Call):
+            d = dotted_name(n.func) or ""
+            tail = d.rsplit(".", 1)[-1]
+            if tail == "_publish_failure":
+                return True
+            if tail in PUBLISH_METHODS:
+                for a in ast.walk(n):
+                    if isinstance(a, ast.Call):
+                        ad = dotted_name(a.func) or ""
+                        if ad.rsplit(".", 1)[-1].endswith("Failed"):
+                            return True
+    return False
+
+
+def _check_ack_swallow(mod: Module, cls: ast.ClassDef) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            caught = _caught_names(h.type)
+            if not caught & (BROAD_CATCHES | {"<bare>"}):
+                continue
+            if _classifies(h):
+                continue
+            shown = "bare except" if "<bare>" in caught else \
+                "/".join(sorted(caught & BROAD_CATCHES))
+            f = mod.finding(
+                "dura-ack-swallow", h,
+                f"handler code catches {shown} and falls through "
+                "normally — under at-least-once dispatch this silently "
+                "acks the envelope and the work never happened; "
+                "re-raise, `return exc` for classification, or publish "
+                "a *Failed event")
+            if f is not None:
+                out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dura-journal-order
+# ---------------------------------------------------------------------------
+
+def _check_journal_order(mod: Module, effects: EffectModel) -> list[Finding]:
+    """Journal effects are recognized by provenance OR by the
+    distinctive method names (``record_submit``/``record_retire`` —
+    engine call sites often reach the journal via
+    ``getattr(self.engine, "journal", None)``, which has no static
+    provenance). ``record_abandon`` is exempt from the retire half:
+    abandoning journals requests that were *never* harvested."""
+    out: list[Finding] = []
+    assert mod.tree is not None
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        nodes = _own_nodes(fn)
+        calls = [n for n in nodes
+                 if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)]
+        submits = [c for c in calls if c.func.attr == "record_submit"]
+        if submits:
+            first = min(c.lineno for c in submits)
+            for c in calls:
+                if c.func.attr in QUEUE_INSERTS and c.args \
+                        and c.lineno < first \
+                        and isinstance(c.func.value, ast.Attribute) \
+                        and isinstance(c.func.value.value, ast.Name) \
+                        and c.func.value.value.id == "self":
+                    f = mod.finding(
+                        "dura-journal-order", c,
+                        f"`{dotted_name(c.func)}` inserts into a "
+                        "queue/scheduler before `record_submit` "
+                        f"(line {first}) — journal-before-admit: a "
+                        "crash in that window loses admitted work "
+                        "because restart replays only journaled "
+                        "submits")
+                    if f is not None:
+                        out.append(f)
+        for c in calls:
+            if c.func.attr != "record_retire" or not c.args:
+                continue
+            base = _base_name(c.args[0])
+            if base is None:
+                continue
+            used_before = any(
+                isinstance(n, ast.Name) and n.id == base
+                and isinstance(n.ctx, ast.Load)
+                and getattr(n, "lineno", 0) < c.lineno
+                for n in nodes)
+            if not used_before:
+                f = mod.finding(
+                    "dura-journal-order", c,
+                    f"`record_retire({base}...)` before the harvested "
+                    "result is used — retire-at-harvest: deleting the "
+                    "journal row before the completion is emitted "
+                    "turns a crash into silent loss (use "
+                    "`record_abandon` for never-harvested requests)")
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dura-idempotent-write
+# ---------------------------------------------------------------------------
+
+def _check_idempotent_write(mod: Module, effects: EffectModel,
+                            cls: ast.ClassDef) -> list[Finding]:
+    out: list[Finding] = []
+    for fn in _methods(cls):
+        nodes = list(ast.walk(fn))
+        reads = [n for n in nodes
+                 if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)
+                 and n.func.attr in STORE_READS
+                 and _receiver_tag(effects, n, True) == "store"]
+        for n in nodes:
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in STORE_INSERTS
+                    and _receiver_tag(effects, n, True) == "store"):
+                continue
+            dup = kw(n, "ignore_duplicates")
+            if isinstance(dup, ast.Constant) and dup.value is True:
+                continue
+            if any(r.lineno < n.lineno for r in reads):
+                continue  # existence-read dedup guard in this handler
+            f = mod.finding(
+                "dura-idempotent-write", n,
+                f"`{n.func.attr}` reachable from an at-least-once "
+                "dispatch context without dup tolerance — redelivery "
+                "re-runs this handler and the second insert raises or "
+                "duplicates; pass `ignore_duplicates=True` or guard "
+                "with an existence read")
+            if f is not None:
+                out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dura-sqlite-ledger
+# ---------------------------------------------------------------------------
+
+_MUTATING_SQL = ("INSERT", "UPDATE", "DELETE", "REPLACE")
+
+
+def _sql_is_mutating(call: ast.Call) -> bool:
+    if not call.args:
+        return False
+    a = call.args[0]
+    return isinstance(a, ast.Constant) and isinstance(a.value, str) \
+        and a.value.lstrip().upper().startswith(_MUTATING_SQL)
+
+
+def _check_sqlite_ledger(mod: Module, effects: EffectModel) -> list[Finding]:
+    out: list[Finding] = []
+    assert mod.tree is not None
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        # direct `self.X = sqlite3.connect(...)` bindings only —
+        # attribute-of-attribute targets (per-thread `self._local.conn`)
+        # follow a different discipline and stay out of scope
+        conns: dict[str, ast.AST] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted_name(node.value.func) == "sqlite3.connect":
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        conns.setdefault(t.attr, node)
+        for fld, site in conns.items():
+            info = effects.class_fields.get(cls.name, {}).get(fld)
+
+            def is_conn(expr: ast.AST, use: ast.AST) -> bool:
+                if dotted_name(expr) == f"self.{fld}":
+                    return True
+                got = effects.resolve(expr, use)
+                return got is not None and got is info
+
+            # (a) WAL
+            wal = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "execute" and n.args
+                and isinstance(n.args[0], ast.Constant)
+                and isinstance(n.args[0].value, str)
+                and "journal_mode" in n.args[0].value
+                and is_conn(n.func.value, n)
+                for n in ast.walk(cls))
+            if not wal:
+                f = mod.finding(
+                    "dura-sqlite-ledger", site,
+                    f"sqlite ledger `{cls.name}.{fld}` never sets "
+                    "`PRAGMA journal_mode=WAL` — every first-party "
+                    "ledger opens WAL so readers don't block the "
+                    "writer and a crash can't corrupt the rollback "
+                    "journal (docs/RESILIENCE.md)")
+                if f is not None:
+                    out.append(f)
+            # (b) multi-row write loops inside one transaction
+            for m in _methods(cls):
+                out.extend(_txn_scan(mod, m, m.body, False, is_conn))
+            # (c) owner-joined close
+            closed = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "close"
+                and is_conn(n.func.value, n)
+                for n in ast.walk(cls))
+            if not closed:
+                f = mod.finding(
+                    "dura-sqlite-ledger", site,
+                    f"sqlite ledger `{cls.name}.{fld}` has no "
+                    "owner-joined close — add a `close()` the owning "
+                    "lifecycle calls on shutdown, or the WAL/SHM "
+                    "sidecar files outlive the process and the last "
+                    "checkpoint is skipped")
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+def _txn_scan(mod: Module, fn: ast.AST, stmts: list[ast.stmt],
+              in_txn: bool, is_conn) -> list[Finding]:
+    out: list[Finding] = []
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            entered = in_txn or any(
+                is_conn(item.context_expr, s) for item in s.items)
+            out.extend(_txn_scan(mod, fn, s.body, entered, is_conn))
+        elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+            if not in_txn:
+                for n in s.body:
+                    for sub in ast.walk(n):
+                        if isinstance(sub, ast.Call) \
+                                and isinstance(sub.func, ast.Attribute) \
+                                and sub.func.attr in ("execute",
+                                                      "executemany") \
+                                and is_conn(sub.func.value, sub) \
+                                and _sql_is_mutating(sub):
+                            f = mod.finding(
+                                "dura-sqlite-ledger", sub,
+                                "multi-row ledger write loop outside a "
+                                "transaction — wrap the loop in "
+                                "`with <conn>:` so a crash mid-loop "
+                                "cannot commit a partial batch")
+                            if f is not None:
+                                out.append(f)
+                            break
+            # loop bodies can still open their own transactions
+            out.extend(_txn_scan(mod, fn, list(s.body) + list(s.orelse),
+                                 in_txn, is_conn))
+        elif isinstance(s, ast.Try):
+            for blk in (s.body, s.orelse, s.finalbody,
+                        *[h.body for h in s.handlers]):
+                out.extend(_txn_scan(mod, fn, blk, in_txn, is_conn))
+        elif isinstance(s, ast.If):
+            out.extend(_txn_scan(mod, fn, list(s.body) + list(s.orelse),
+                                 in_txn, is_conn))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def check(mod: Module) -> list[Finding]:
+    if mod.tree is None:
+        return []
+    effects = EffectModel(mod)
+    out: list[Finding] = []
+    out.extend(_check_raw_publish(mod, effects))
+    out.extend(_check_journal_order(mod, effects))
+    out.extend(_check_sqlite_ledger(mod, effects))
+    for cls in _handler_classes(mod):
+        out.extend(_check_commit_publish_window(mod, effects, cls))
+        out.extend(_check_ack_swallow(mod, cls))
+        out.extend(_check_idempotent_write(mod, effects, cls))
+    return out

@@ -223,7 +223,9 @@ class BaseService:
                 self._sat_cache = (now, snap)   # claim the refresh
         try:
             hot = sat()
-        except Exception:
+        # a broken saturation poll must degrade to "no shed signal",
+        # not fail dispatch — no envelope is acked here
+        except Exception:  # jaxlint: disable=dura-ack-swallow
             hot = {}
         if self._sat_refresh_s > 0:
             with self._sat_lock:

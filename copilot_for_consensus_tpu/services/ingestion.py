@@ -134,7 +134,9 @@ class IngestionService(BaseService):
         while time.monotonic() - t0 < self.bus_pause_max_s:
             try:
                 depths = depths_fn()
-            except Exception:
+            # best-effort backpressure probe: if the depth poll dies,
+            # stop pausing and ingest — no envelope is acked here
+            except Exception:  # jaxlint: disable=dura-ack-swallow
                 break
             worst = max(
                 (d for rk, d in depths.items()

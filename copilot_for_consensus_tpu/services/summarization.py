@@ -178,7 +178,11 @@ class SummarizationService(BaseService):
                             attempts=1,
                             correlation_id=ctx.get("correlation_id",
                                                    "")))
-                    except Exception:
+                    # the SummarizationFailed publish above IS the
+                    # classification; if the bus is down too, dying
+                    # here would kill the harvester for every other
+                    # in-flight summary
+                    except Exception:  # jaxlint: disable=dura-ack-swallow
                         pass
                 finally:
                     with self._flight_lock:

@@ -51,9 +51,24 @@ class LogStore:
 
     def __init__(self, db_path: str = ":memory:"):
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        # Same ledger discipline as the journal/outbox: WAL keeps the
+        # HTTP query handlers from blocking the ingest writer, and a
+        # mid-insert crash can't corrupt a rollback journal.
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.executescript(self.SCHEMA)
         self._lock = threading.Lock()
         self.ingested = 0
+
+    def close(self) -> None:
+        """Owner-joined shutdown: checkpoint and release the WAL/SHM
+        sidecars (LogStoreServer.stop calls this). The connection is
+        closed outside the lock, like EngineJournal.close — sqlite's
+        close blocks on in-flight statements on its own."""
+        with self._lock:
+            conn = self._conn
+            conn.commit()
+        conn.close()
 
     def add(self, record: dict[str, Any]) -> None:
         ts = record.get("ts")
@@ -228,6 +243,7 @@ class LogStoreServer:
         self._threads.clear()
         self._tcp.server_close()
         self._http.stop()
+        self.store.close()
 
 
 def main(argv: list[str] | None = None) -> int:

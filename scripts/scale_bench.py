@@ -526,6 +526,21 @@ def main() -> int:
         return _worker(pathlib.Path(args.tmp), args.port, args.worker,
                        args.workers, args.prefetch, args.watermark)
 
+    # Durability-contract preflight: the host pipeline is exactly the
+    # plane the dura rule family governs (commit/publish windows, ack
+    # swallows, ledger hygiene), so gate the run on it the way
+    # bench.py's engine presets gate on shardcheck — same rc-2/
+    # ok:false artifact contract, BENCH_PREFLIGHT=0 skips, analyzer
+    # infra trouble warns and continues.
+    import bench as _bench
+
+    artifact = _bench.duracheck_preflight(
+        paths=["copilot_for_consensus_tpu/bus",
+               "copilot_for_consensus_tpu/services"])
+    if artifact is not None:
+        print(json.dumps(artifact))
+        return 2
+
     if args.smoke:
         args.bus = "broker"
         args.messages = min(args.messages, 400)

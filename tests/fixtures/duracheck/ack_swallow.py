@@ -1,0 +1,43 @@
+"""duracheck fixture: dura-ack-swallow.
+
+Under at-least-once dispatch, a handler that catches ``RetryableError``
+or broad ``Exception`` and falls through normally converts a transient
+failure into a silent ack: the envelope is consumed and the work never
+happened. Handlers must re-raise, return the exception for
+classification, or publish a ``*Failed`` event.
+"""
+
+
+class BadSwallowingHandler:
+    """Counts the failure and falls through — the dispatcher sees a
+    normal return and acks the envelope; the work is gone."""
+
+    def on_JobReady(self, event):
+        try:
+            self.run(event)
+        except RetryableError:
+            self.skipped += 1
+
+
+class GoodClassifyingHandler:
+    """The three legitimate exits: re-raise for the nack/redeliver
+    path, return the exception for per-envelope classification, or
+    publish a ``*Failed`` event as the terminal record."""
+
+    def on_JobReady(self, event):
+        try:
+            self.run(event)
+        except RetryableError:
+            raise
+
+    def on_wave_JobReady(self, events):
+        try:
+            self.run_wave(events)
+        except Exception as exc:
+            return exc
+
+    def on_JobCancelled(self, event):
+        try:
+            self.run(event)
+        except Exception as exc:
+            self.publisher.publish(JobFailed(error=str(exc)))

@@ -1,0 +1,52 @@
+"""duracheck fixture: dura-commit-publish-window.
+
+The PR-11 crash-window class: a handler commits a store insert, then
+publishes only the rows that were ABSENT from its existence read. On
+redelivery after a crash between commit and publish, those rows are
+filtered out as duplicates and their downstream events are never
+published — the rows are stranded forever.
+"""
+
+
+class BadFreshOnlyPublisher:
+    """Publishes only the fresh (not-yet-stored) rows: a crash between
+    the insert commit and the publish loop strands the committed rows —
+    redelivery recomputes ``fresh`` as empty and republishes nothing."""
+
+    def __init__(self, publisher, store):
+        self.publisher = publisher
+        self.store = store
+
+    def on_RowsArrived(self, event):
+        rows = event.rows
+        existing = self.store.get_documents(
+            "rows", [r["id"] for r in rows])
+        fresh = [r for r in rows if r["id"] not in existing]
+        self.store.insert_many("rows", fresh, ignore_duplicates=True)
+        for r in fresh:
+            self.publisher.publish(("RowStored", r["id"]))
+
+
+class GoodRepublishStored:
+    """The redelivery-republish discipline: already-stored rows whose
+    downstream work is unfinished are published too (the
+    ``stored_unchunked`` pattern), so a redelivered envelope closes
+    the window instead of silently acking it."""
+
+    def __init__(self, publisher, store):
+        self.publisher = publisher
+        self.store = store
+
+    def on_RowsArrived(self, event):
+        rows = event.rows
+        existing = self.store.get_documents(
+            "rows", [r["id"] for r in rows])
+        fresh = [r for r in rows if r["id"] not in existing]
+        stored_unfinished = [
+            r for r in rows
+            if (cur := existing.get(r["id"])) is not None
+            and not cur.get("finished")
+        ]
+        self.store.insert_many("rows", fresh, ignore_duplicates=True)
+        for r in fresh + stored_unfinished:
+            self.publisher.publish(("RowStored", r["id"]))

@@ -1,0 +1,35 @@
+"""duracheck fixture: dura-idempotent-write.
+
+Handlers run under at-least-once delivery: a redelivered envelope
+re-runs the handler, so every insert must tolerate the second run —
+``ignore_duplicates=True`` or an existence-read dedup guard.
+"""
+
+
+class BadBlindInsert:
+    """Redelivery re-runs this handler and the second insert raises a
+    duplicate-key error (or worse, duplicates the rows)."""
+
+    def __init__(self, store, publisher):
+        self.store = store
+        self.publisher = publisher
+
+    def on_RowsArrived(self, event):
+        self.store.insert_many("rows", event.rows)
+
+
+class GoodDupTolerantInsert:
+    """Both redelivery-safe shapes: dup-tolerant insert, and an insert
+    guarded by an existence read in the same handler."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def on_RowsArrived(self, event):
+        self.store.insert_many("rows", event.rows,
+                               ignore_duplicates=True)
+
+    def on_RowChanged(self, event):
+        existing = self.store.get_documents("rows", [event.row_id])
+        if event.row_id not in existing:
+            self.store.insert_document("rows", event.row)

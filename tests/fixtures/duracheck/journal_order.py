@@ -1,0 +1,55 @@
+"""duracheck fixture: dura-journal-order.
+
+The PR-12 contract: submit paths journal (``record_submit``) BEFORE
+any queue/scheduler insertion — a crash in the window otherwise admits
+work that restart-replay doesn't know about — and ``record_retire``
+runs only AFTER the harvested result is used, so a crash can't delete
+the journal row before the completion is emitted.
+"""
+
+
+class BadSubmitAfterEnqueue:
+    """Enqueues first: a crash between the enqueue and the journal
+    write admits a request the journal never heard of."""
+
+    def __init__(self, journal):
+        self.journal = journal
+        self._queue = []
+
+    def submit(self, rid, prompt):
+        req = (rid, prompt)
+        self._queue.append(req)
+        self.journal.record_submit(rid, prompt)
+        return rid
+
+
+class BadRetireBeforeHarvest:
+    """Deletes the journal row before the result is used — a crash in
+    between silently loses the completion."""
+
+    def __init__(self, journal):
+        self.journal = journal
+        self._done = []
+
+    def harvest(self, req):
+        self.journal.record_retire(req.request_id)
+        self._done.append(req)
+
+
+class GoodJournalOrder:
+    """Journal-before-admit and retire-at-harvest, in order."""
+
+    def __init__(self, journal):
+        self.journal = journal
+        self._queue = []
+        self._done = []
+
+    def submit(self, rid, prompt):
+        self.journal.record_submit(rid, prompt)
+        req = (rid, prompt)
+        self._queue.append(req)
+        return rid
+
+    def harvest(self, req):
+        self._done.append(req)
+        self.journal.record_retire(req.request_id)

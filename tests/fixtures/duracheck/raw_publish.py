@@ -1,0 +1,37 @@
+"""duracheck fixture: dura-raw-publish.
+
+``publish_envelope`` and raw broker ``pub`` ops belong inside the bus
+package; everywhere else must publish typed events through
+``.publish()`` so schema validation, identity stamping, and the
+outbox/publish_window discipline apply.
+"""
+
+
+class BadRawEnvelopePublisher:
+    """Hands a hand-rolled envelope straight to ``publish_envelope``,
+    skipping the typed-event validation and the outbox path."""
+
+    def __init__(self, publisher):
+        self.publisher = publisher
+
+    def on_ThingHappened(self, event):
+        self.publisher.publish_envelope(event.to_envelope(), "things")
+
+
+class BadRawBrokerOp:
+    """Speaks the broker wire protocol directly — a raw ``pub`` op is
+    invisible to the outbox, so a crash here loses the message."""
+
+    def on_FlushRequested(self, event):
+        self.client.request({"op": "pub", "body": event.payload})
+
+
+class GoodTypedPublisher:
+    """Publishes the typed event; EventPublisher.publish owns the
+    envelope construction and the durability discipline."""
+
+    def __init__(self, publisher):
+        self.publisher = publisher
+
+    def on_ThingHappened(self, event):
+        self.publisher.publish(event)

@@ -1,0 +1,42 @@
+"""duracheck fixture: dura-sqlite-ledger.
+
+First-party sqlite ledgers (journal, outbox, broker queue store, DLQ)
+must open WAL, scope multi-row write loops in one transaction, and
+have an owner-joined close.
+"""
+
+import sqlite3
+
+
+class BadLedger:
+    """All three violations: rollback-journal mode (a crash mid-write
+    can corrupt it), a per-row autocommit loop (a crash mid-loop
+    commits a partial batch), and no close (the WAL/SHM sidecars
+    outlive the process)."""
+
+    def __init__(self, path):
+        self._db = sqlite3.connect(path)
+
+    def add_all(self, rows):
+        for r in rows:
+            self._db.execute("INSERT INTO t (v) VALUES (?)", (r,))
+        self._db.commit()
+
+
+class GoodLedger:
+    """WAL on open, the write loop scoped in one transaction, and a
+    close the owning lifecycle joins on shutdown (via a local alias,
+    the EngineJournal.close idiom)."""
+
+    def __init__(self, path):
+        self._db = sqlite3.connect(path)
+        self._db.execute("PRAGMA journal_mode=WAL")
+
+    def add_all(self, rows):
+        with self._db:
+            for r in rows:
+                self._db.execute("INSERT INTO t (v) VALUES (?)", (r,))
+
+    def close(self):
+        db = self._db
+        db.close()

@@ -133,3 +133,33 @@ def test_create_logger_shipping_driver_and_retention():
         assert srv.store.query(text="old") == []
     finally:
         srv.stop()
+
+
+def test_ledger_discipline_wal_and_owner_joined_close(tmp_path):
+    """duracheck regression (dura-sqlite-ledger): the log ledger opens
+    WAL like every first-party sqlite ledger, and LogStoreServer.stop
+    closes the store so the WAL/SHM sidecars don't outlive the
+    process (and the final checkpoint folds them into the db)."""
+    db = tmp_path / "logs.sqlite3"
+    srv = LogStoreServer(LogStore(str(db)), port=0, http_port=0).start()
+    try:
+        mode = srv.store._conn.execute(
+            "PRAGMA journal_mode").fetchone()[0]
+        assert mode == "wal"
+        srv.store.add({"message": "persisted", "service": "svc"})
+        assert srv.store.count() == 1
+    finally:
+        srv.stop()
+    # stop() closed the connection (owner-joined close) ...
+    import sqlite3
+
+    import pytest as _pytest
+    with _pytest.raises(sqlite3.ProgrammingError):
+        srv.store._conn.execute("SELECT 1")
+    # ... the WAL checkpointed into the main db, and a fresh open
+    # sees the committed record
+    reopened = LogStore(str(db))
+    try:
+        assert reopened.count() == 1
+    finally:
+        reopened.close()

@@ -390,6 +390,67 @@ def test_bench_preflight_blocks_on_contract_violation():
     assert any("shard-donation" in f for f in line["findings"])
 
 
+DURA_FIXTURES = ROOT / "tests" / "fixtures" / "duracheck"
+
+
+def test_bench_dura_preflight_blocks_on_violation():
+    """pipeline_chaos maps to no jitted entrypoints (shardcheck
+    skips), so the dura family is its gate: pointed at the violating
+    fixture corpus, the bench must exit 2 with the same rc-2/ok:false
+    artifact contract before the storm starts."""
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py")],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ,
+             "BENCH_PREFLIGHT": "1",
+             "BENCH_NO_PROBE": "1",
+             "BENCH_EXTRA": "0",
+             "BENCH_PRESET": "pipeline_chaos",
+             "BENCH_DURACHECK_PATHS": "tests/fixtures/duracheck"})
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["ok"] is False
+    assert "duracheck preflight failed" in line["reason"]
+    assert any("dura-" in f for f in line["findings"])
+
+
+def test_scale_bench_gates_on_dura_preflight():
+    """The host-pipeline driver (scripts/scale_bench.py) runs the same
+    gate over bus/ + services/ before building the pipeline."""
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "scale_bench.py")],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ,
+             "BENCH_PREFLIGHT": "1",
+             "BENCH_DURACHECK_PATHS": "tests/fixtures/duracheck"})
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["ok"] is False
+    assert "duracheck preflight failed" in line["reason"]
+
+
+def test_dura_preflight_opt_out_and_preset_map(monkeypatch):
+    """BENCH_PREFLIGHT=0 skips even with violating paths pinned; the
+    pipeline_chaos preset map resolves to the live bus/services planes
+    (which must pass their own gate); engine presets map to no dura
+    paths and skip."""
+    sys.path.insert(0, str(ROOT))
+    try:
+        import bench
+    finally:
+        sys.path.remove(str(ROOT))
+    monkeypatch.setenv("BENCH_PREFLIGHT", "0")
+    monkeypatch.setenv("BENCH_DURACHECK_PATHS",
+                       "tests/fixtures/duracheck")
+    assert bench.duracheck_preflight() is None
+    monkeypatch.setenv("BENCH_PREFLIGHT", "1")
+    monkeypatch.delenv("BENCH_DURACHECK_PATHS")
+    monkeypatch.setenv("BENCH_PRESET", "rag2k")
+    assert bench.duracheck_preflight() is None
+    monkeypatch.setenv("BENCH_PRESET", "pipeline_chaos")
+    assert bench.duracheck_preflight() is None   # live planes CLEAN
+
+
 def test_mesh_scatter_out_spec_flip_fails_the_lane(tmp_path):
     """Flip the mesh scatter's pool out_specs to replicated: the
     shard_map returns a shard-local-shaped pool as the global result,

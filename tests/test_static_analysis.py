@@ -579,6 +579,219 @@ def test_repo_race_group_clean_with_cross_pass():
     assert "[race]" in proc.stderr, proc.stderr
 
 
+# ---------------------------------------------------------------------------
+# duracheck (the `dura` group): the crash-safety / exactly-once
+# contracts from docs/RESILIENCE.md. Each rule proven against its
+# fixture — one true positive AND one clean negative — plus tripwires
+# that re-introduce the REAL shipped bug classes (PR-11 commit/publish
+# window, PR-12 journal ordering, the finisher's transient re-raise)
+# and assert the lane turns red.
+# ---------------------------------------------------------------------------
+
+DURA_FIXTURES = ROOT / "tests" / "fixtures" / "duracheck"
+
+from copilot_for_consensus_tpu.analysis import duracheck  # noqa: E402
+
+
+def _dura_findings(fixture: str, rule: str):
+    out = analyze_files([DURA_FIXTURES / fixture], {"dura"})
+    return [f for f in out if f.rule == rule]
+
+
+@pytest.mark.parametrize("fixture,rule,bad_marker,good_marker", [
+    ("commit_publish_window.py", "dura-commit-publish-window",
+     "BadFreshOnlyPublisher", "GoodRepublishStored"),
+    ("raw_publish.py", "dura-raw-publish", "BadRawEnvelopePublisher",
+     "GoodTypedPublisher"),
+    ("ack_swallow.py", "dura-ack-swallow", "BadSwallowingHandler",
+     "GoodClassifyingHandler"),
+    ("journal_order.py", "dura-journal-order", "BadSubmitAfterEnqueue",
+     "GoodJournalOrder"),
+    ("idempotent_write.py", "dura-idempotent-write", "BadBlindInsert",
+     "GoodDupTolerantInsert"),
+    ("sqlite_ledger.py", "dura-sqlite-ledger", "BadLedger",
+     "GoodLedger"),
+])
+def test_dura_rule_true_positive_and_clean_negative(fixture, rule,
+                                                    bad_marker,
+                                                    good_marker):
+    found = _dura_findings(fixture, rule)
+    assert any(bad_marker in f.context or bad_marker in f.message
+               for f in found), (rule, found)
+    assert not any(good_marker in f.context or good_marker in f.message
+                   for f in found), (rule, found)
+
+
+def test_dura_rules_registered_under_dura_group():
+    """duracheck.RULES and the CLI's RULES map must stay in sync (the
+    group-scoped baseline judgment keys off this mapping)."""
+    from copilot_for_consensus_tpu.analysis import RULES
+    for rule in duracheck.RULES:
+        assert RULES.get(rule) == "dura", rule
+
+
+def test_journal_order_flags_both_halves():
+    """Submit-before-enqueue AND retire-after-harvest are one
+    contract; each half must flag independently."""
+    ctxs = {f.context for f in
+            _dura_findings("journal_order.py", "dura-journal-order")}
+    assert "BadSubmitAfterEnqueue.submit" in ctxs, ctxs
+    assert "BadRetireBeforeHarvest.harvest" in ctxs, ctxs
+    assert not any("GoodJournalOrder" in c for c in ctxs), ctxs
+
+
+def test_sqlite_ledger_flags_all_three_disciplines():
+    msgs = "\n".join(f.message for f in
+                     _dura_findings("sqlite_ledger.py",
+                                    "dura-sqlite-ledger"))
+    assert "journal_mode=WAL" in msgs, msgs
+    assert "transaction" in msgs, msgs
+    assert "owner-joined close" in msgs, msgs
+
+
+def test_ack_swallow_accepts_all_three_classifying_exits():
+    """re-raise, `return exc`, and a *Failed-event publish are the
+    legitimate exits — none of GoodClassifyingHandler's three handlers
+    may flag, and the swallowing handler is the only finding."""
+    found = _dura_findings("ack_swallow.py", "dura-ack-swallow")
+    assert {f.context for f in found} == \
+        {"BadSwallowingHandler.on_JobReady"}, found
+
+
+def test_raw_publish_flags_wire_protocol_op():
+    """A raw broker `pub` op is the sneakier outbox bypass — it must
+    flag alongside the publish_envelope form."""
+    found = _dura_findings("raw_publish.py", "dura-raw-publish")
+    assert any(f.context == "BadRawBrokerOp.on_FlushRequested"
+               for f in found), found
+
+
+def test_effect_provenance_not_name_tokens(tmp_path):
+    """Receivers resolve by PROVENANCE: a renamed field bound from an
+    `EventPublisher`-annotated param is a publisher; an unrelated
+    object whose method merely shares a name is not."""
+    mod = tmp_path / "renamed.py"
+    mod.write_text(
+        "class RenamedFieldHandler:\n"
+        "    def __init__(self, bus: EventPublisher):\n"
+        "        self.bus = bus\n\n"
+        "    def on_ThingHappened(self, event):\n"
+        "        self.bus.publish_envelope(event.to_envelope(), 'x')\n\n\n"
+        "class NotAPublisher:\n"
+        "    def __init__(self, codec):\n"
+        "        self.codec = codec\n\n"
+        "    def on_ThingHappened(self, event):\n"
+        "        self.codec.publish_envelope(event)\n")
+    found = [f for f in analyze_files([mod], {"dura"})
+             if f.rule == "dura-raw-publish"]
+    assert any("RenamedFieldHandler" in f.context for f in found), found
+    assert not any("NotAPublisher" in f.context for f in found), found
+
+
+# -- tripwires on the REAL runtime files: re-introduce each shipped
+#    durability bug class
+
+_PARSING = ROOT / "copilot_for_consensus_tpu" / "services" / "parsing.py"
+_SERVICES_BASE = ROOT / "copilot_for_consensus_tpu" / "services" / "base.py"
+
+
+def test_dropping_redelivery_republish_fails_the_lane(tmp_path):
+    """PR-11 regression: publish only the fresh rows (drop
+    `stored_unchunked` from the republish) and the commit/publish
+    crash window is back — dura-commit-publish-window must flag."""
+    src = _PARSING.read_text()
+    needle = 'to_publish[b["archive_id"]] = fresh + stored_unchunked'
+    assert needle in src, "_store_parsed moved; update the test"
+    mutated = tmp_path / "parsing_mutated.py"
+    mutated.write_text(src.replace(
+        needle, 'to_publish[b["archive_id"]] = fresh', 1))
+    found = [f for f in analyze_files([mutated], {"dura"})
+             if f.rule == "dura-commit-publish-window"]
+    assert any("_store_parsed" in f.context for f in found), found
+    # the unmutated file is clean under the dura group
+    assert analyze_files([_PARSING], {"dura"}) == []
+
+
+def test_submit_after_scheduler_insert_fails_the_lane(tmp_path):
+    """PR-12 regression: a scheduler insertion before `record_submit`
+    re-opens the crash window where admitted work is invisible to
+    restart replay — dura-journal-order must flag."""
+    src = _GEN.read_text()
+    needle = "                ids = _trace.current_ids()\n"
+    assert src.count(needle) == 1, "submit block moved; update the test"
+    mutated = tmp_path / "generation_mutated.py"
+    mutated.write_text(src.replace(
+        needle, needle + "                self._sched.enqueue(prompt)\n",
+        1))
+    found = [f for f in analyze_files([mutated], {"dura"})
+             if f.rule == "dura-journal-order"]
+    assert any(f.context == "GenerationEngine.submit"
+               for f in found), found
+    assert analyze_files([_GEN], {"dura"}) == []
+
+
+def test_swallowed_retryable_in_wave_finisher_fails_the_lane(tmp_path):
+    """Contract regression: remove the finisher's re-raise after the
+    transient (PublishError/RetryableError) metrics bump and the nack/
+    redeliver path is silently gone — dura-ack-swallow must flag."""
+    src = _SERVICES_BASE.read_text()
+    needle = ('                        labels={"event": etype, '
+              '"ok": "false"})\n'
+              '                    raise\n')
+    assert src.count(needle) == 1, "finisher catch moved; update the test"
+    mutated = tmp_path / "base_mutated.py"
+    mutated.write_text(src.replace(
+        needle,
+        '                        labels={"event": etype, '
+        '"ok": "false"})\n', 1))
+    found = [f for f in analyze_files([mutated], {"dura"})
+             if f.rule == "dura-ack-swallow"]
+    assert any("_finish_wave_envelope" in f.context for f in found), found
+
+
+# -- baseline round trip + full-repo cleanliness for the dura family
+
+
+def test_dura_baseline_round_trip(tmp_path, capsys):
+    """dura findings ride the existing baseline machinery: a justified
+    entry silences the finding; a TODO placeholder warns on a normal
+    run and fails under --strict."""
+    fixture = DURA_FIXTURES / "ack_swallow.py"
+    found = [f for f in analyze_files([fixture], {"dura"})
+             if f.rule == "dura-ack-swallow"]
+    assert found
+    entries = [{"rule": f.rule, "path": f.path, "context": f.context,
+                "message": f.message,
+                "justification": "fixture: deliberate swallow kept to "
+                                 "prove the baseline round trip"}
+               for f in found]
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(entries))
+    args = ["--group", "dura", "--baseline", str(bl), str(fixture)]
+    assert jaxlint_main(args) == 0, capsys.readouterr().out
+    for e in entries:
+        e["justification"] = "TODO: explain why this is deliberate"
+    bl.write_text(json.dumps(entries))
+    assert jaxlint_main(args) == 0          # non-strict: warn only
+    assert "baseline-unjustified" in capsys.readouterr().err
+    rc = jaxlint_main(["--strict"] + args)
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "baseline-unjustified" in out.out
+
+
+def test_repo_dura_group_clean():
+    """The full-repo dura run is clean under --strict — the acceptance
+    bar for dogfooding the durability contracts over the live
+    pipeline and serving planes."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "copilot_for_consensus_tpu.analysis",
+         "--group", "dura", "--strict"], cwd=ROOT,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[dura]" in proc.stderr, proc.stderr
+
+
 def test_repo_is_clean_end_to_end():
     """The whole tree passes every jaxlint group (modulo the committed,
     justified baseline). --fast skips import smoke, which the suite

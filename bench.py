@@ -584,6 +584,91 @@ def shardcheck_preflight() -> dict | None:
     }
 
 
+#: presets whose timed run leans on a compiled-artifact property the
+#: hlo family pins — paged routes (no-materialize fingerprints, pool
+#: donation aliases, program-cache cardinality), the mesh preset
+#: (collective budgets), and the decode/spec arms (HBM peak budgets).
+#: The remaining presets keep preflight latency down: shardcheck
+#: already traces them, and compiling is the expensive half.
+HLO_PREFLIGHT_PRESETS = frozenset(
+    {"paged_capacity", "multichip_serving", "decode_heavy",
+     "spec_decode"})
+
+
+def hlocheck_preflight() -> dict | None:
+    """Lower + compile the preset's engine dispatches on CPU
+    (analysis/hlocheck.py: donation survives as input_output_alias,
+    no forbidden materializing ops, collective budgets, HBM peak
+    budgets, program-cache cardinality) BEFORE burning TPU time. A
+    violation returns an ok:false artifact dict (the caller exits 2,
+    matching shardcheck_preflight) — a dropped pool alias or a GSPMD
+    reshard regression would otherwise surface as an OOM or a 2x step
+    time halfway through the timed run. ``BENCH_HLOCHECK=0`` disables
+    just this gate (compiling costs ~tens of seconds) without
+    touching the cheaper shard/dura preflights; infra failures warn
+    and let the bench proceed: the gate must never be the thing that
+    eats the artifact."""
+    if os.environ.get("BENCH_PREFLIGHT", "1") != "1":
+        return None
+    if os.environ.get("BENCH_HLOCHECK", "1") != "1":
+        return None
+    preset = os.environ.get("BENCH_PRESET", "")
+    modules = os.environ.get("BENCH_HLOCHECK_MODULES")
+    if modules:
+        modules = [m.strip() for m in modules.split(",") if m.strip()]
+    else:
+        if preset not in HLO_PREFLIGHT_PRESETS:
+            return None
+        from copilot_for_consensus_tpu.analysis.contracts import (
+            HLO_CONTRACT_MODULES,
+        )
+
+        # only modules that BOTH the preset exercises and the hlo
+        # registry covers: multichip_serving's mesh/sharding modules
+        # declare no lowering specs, so they trace (shardcheck) but
+        # don't compile here
+        modules = [m for m in PRESET_CONTRACT_MODULES.get(preset, [])
+                   if m in HLO_CONTRACT_MODULES]
+    if not modules:
+        return None
+    log(f"hlocheck preflight: {', '.join(modules)}")
+    from copilot_for_consensus_tpu.analysis import hlocheck
+
+    data, detail = hlocheck.run_worker(
+        modules, baseline=os.path.join(REPO, "jaxlint_baseline.json"),
+        timeout=600)
+    if data is None:
+        log(f"hlocheck preflight: {detail}; continuing")
+        return None
+    findings = data.get("findings", [])
+    # same worker-infra convention as shardcheck: an unusable jax in
+    # the subprocess reports as an hlo-contract finding with path
+    # "jax" — environment for the bench, warn-and-continue
+    infra = [f for f in findings if f.get("path") == "jax"]
+    findings = [f for f in findings if f.get("path") != "jax"]
+    for f in infra:
+        log(f"hlocheck preflight infra failure ({f['message']}); "
+            f"continuing")
+    if not findings:
+        if not infra:
+            log("hlocheck preflight: CLEAN")
+        return None
+    rendered = [f"{f['path']}:{f['line']}: {f['rule']}: {f['message']}"
+                for f in findings[:20]]
+    for ln in rendered:
+        log(f"hlocheck preflight: {ln}")
+    return {
+        "metric": "hlocheck-preflight",
+        "value": 0.0,
+        "unit": "",
+        "ok": False,
+        "reason": f"hlocheck preflight failed: {len(findings)} "
+                  f"compiled-artifact violation(s) in "
+                  f"{', '.join(modules)}",
+        "findings": rendered,
+    }
+
+
 #: pipeline presets run the dura (durability-contract) rule family
 #: over the planes their storm exercises, the way engine presets run
 #: shardcheck; value = the source roots duracheck scans.
@@ -2519,6 +2604,12 @@ def main() -> None:
     # rather than discovering a dropped donation alias or KV-layout
     # mismatch as an OOM mid-run on the TPU.
     preflight_artifact = shardcheck_preflight()
+    if preflight_artifact is None:
+        # the paged/mesh/decode presets additionally gate on the
+        # compiled artifact (hlocheck: aliases survive compilation,
+        # no materializing ops, collective/HBM budgets) — trace-level
+        # cleanliness alone has shipped both failure classes
+        preflight_artifact = hlocheck_preflight()
     if preflight_artifact is None:
         # pipeline presets gate on the durability contracts instead of
         # (not before) jitted-entrypoint tracing — engine presets map

@@ -31,6 +31,15 @@ over the repo and exits non-zero on any non-baselined finding:
   rules, collective axis binding, donation aliasing, KV-cache layout
   agreement, and padding-bucket coverage. Skipped under ``--fast`` and
   for explicit-path runs (it is registry-wide, not per-file).
+* ``hlo`` group (hlocheck.py): the POST-LOWERING pass — actually
+  lowers and compiles the contract-declared jitted entrypoints under
+  the virtual 8-device CPU platform and verifies properties of the
+  compiled artifact itself: donation survives as input_output_alias,
+  forbidden-op fingerprints (no pool-working-set gather on the kernel
+  route), exact collective counts vs the declared budget, peak HBM vs
+  the declared budget, and program-cache cardinality across bucket
+  tables. Skipped under ``--fast`` and for explicit-path runs, like
+  ``shard``.
 
 Suppression: inline ``# jaxlint: disable=<rule>`` with a justification,
 or an entry in ``jaxlint_baseline.json`` (every entry must carry a
@@ -80,7 +89,7 @@ GROUPS = {
 }
 
 #: groups that run once per invocation, not per file
-SEMANTIC_GROUPS = {"shard"}
+SEMANTIC_GROUPS = {"shard", "hlo"}
 ALL_GROUPS = set(GROUPS) | SEMANTIC_GROUPS
 
 #: every individual rule id → its group (for ``--rules`` filtering and
@@ -114,6 +123,15 @@ RULES.update({rule: "shard" for rule in (
     "shard-kv-layout",
     "shard-bucket",
     "shard-contract",
+)})
+# keep in sync with hlocheck.RULES (test_hlocheck.py enforces it)
+RULES.update({rule: "hlo" for rule in (
+    "hlo-donation-alias",
+    "hlo-materialize",
+    "hlo-collective-budget",
+    "hlo-peak-memory",
+    "hlo-program-cache",
+    "hlo-contract",
 )})
 
 
@@ -252,18 +270,21 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"jaxlint: no such file: {p}", file=sys.stderr)
             return 2
         findings = analyze_files(analyzed, groups)
-        if "shard" in groups:
-            print("jaxlint: shard group only runs on full-repo "
+        for sem_group in sorted(SEMANTIC_GROUPS & groups):
+            print(f"jaxlint: {sem_group} group only runs on full-repo "
                   "invocations (it traces the contract registry, not "
                   "files); skipped", file=sys.stderr)
             # a skipped group must not judge baseline entries: keeping
-            # 'shard' here would mark still-valid shard entries stale
-            groups = groups - {"shard"}
+            # it here would mark still-valid entries stale
+            groups = groups - {sem_group}
     else:
-        # The semantic worker is spawned FIRST so its ~10s jax-import +
-        # trace pass overlaps the ast groups and the import-smoke
-        # subprocess instead of serializing after them.
+        # The semantic workers are spawned FIRST so their ~10s
+        # jax-import + trace/lower passes overlap the ast groups and
+        # the import-smoke subprocess instead of serializing after
+        # them. The two workers also overlap EACH OTHER — they are
+        # independent subprocesses over disjoint rule families.
         shard_proc = None
+        hlo_proc = None
         if "shard" in groups:
             if args.fast:
                 print("jaxlint: shard group skipped under --fast",
@@ -273,6 +294,15 @@ def main(argv: list[str] | None = None) -> int:
                 from copilot_for_consensus_tpu.analysis import shardcheck
 
                 shard_proc = shardcheck.spawn_worker()
+        if "hlo" in groups:
+            if args.fast:
+                print("jaxlint: hlo group skipped under --fast",
+                      file=sys.stderr)
+                groups = groups - {"hlo"}   # don't judge its baseline
+            else:
+                from copilot_for_consensus_tpu.analysis import hlocheck
+
+                hlo_proc = hlocheck.spawn_worker()
         # package files get every selected ast group in ONE parse; the
         # policy extras (scripts/tools/root entry files) get policy
         # only; a semantic-only run parses nothing
@@ -298,6 +328,12 @@ def main(argv: list[str] | None = None) -> int:
                 findings.extend(policy.check_import_smoke())
         if shard_proc is not None:
             sem, sem_checked = shardcheck.check_semantic(proc=shard_proc)
+            findings.extend(sem)
+            seen = {p.resolve() for p in analyzed}
+            analyzed += [p for p in sem_checked
+                         if p.resolve() not in seen]
+        if hlo_proc is not None:
+            sem, sem_checked = hlocheck.check_semantic(proc=hlo_proc)
             findings.extend(sem)
             seen = {p.resolve() for p in analyzed}
             analyzed += [p for p in sem_checked

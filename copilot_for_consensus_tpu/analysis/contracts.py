@@ -73,6 +73,19 @@ CONTRACT_MODULES = (
     "copilot_for_consensus_tpu.vectorstore.tpu",
 )
 
+#: modules whose contract cases (additionally) declare HLO lowering
+#: specs — the registry the POST-lowering ``hlo`` group
+#: (``analysis/hlocheck.py``) walks by default. A subset of the serving
+#: plane on purpose: every case here is lowered AND compiled per run,
+#: so membership is the compile-time budget of the pass. Keep in sync
+#: with docs/STATIC_ANALYSIS.md.
+HLO_CONTRACT_MODULES = (
+    "copilot_for_consensus_tpu.engine.generation",
+    "copilot_for_consensus_tpu.engine.prefix_cache",
+    "copilot_for_consensus_tpu.engine.roles",
+    "copilot_for_consensus_tpu.ops.paged_attention",
+)
+
 
 class ContractSkip(Exception):
     """Raised by a factory when the environment cannot host the check
@@ -91,6 +104,53 @@ def require_devices(n: int) -> None:
         raise ContractSkip(
             f"needs {n} devices, have {have} (run under XLA_FLAGS="
             f"--xla_force_host_platform_device_count={n})")
+
+
+@dataclass
+class HloSpec:
+    """Budgets a case declares against its LOWERED/COMPILED artifact —
+    the post-lowering ``hlo`` rule family (``analysis/hlocheck.py``).
+    Where shardcheck verifies the trace, hlocheck verifies what XLA
+    actually emitted; each field feeds one rule:
+
+    * ``forbid_ops`` (sequence of ``(stablehlo_op, min_elements)``) →
+      the lowered StableHLO must contain no instance of the named op
+      producing a result at/above ``min_elements`` elements
+      (``hlo-materialize``). This is how the kernel route pins "no
+      pool-working-set gather" as a contract instead of a trace-spy:
+      the threshold sits above the largest legitimate small gather
+      (embedding lookups) and below the working-set size. Checked on
+      the pre-optimization lowering so XLA fusion cannot hide the op.
+    * ``collectives`` (mapping op name → exact count) → the compiled
+      program's all-reduce/all-gather/reduce-scatter/collective-permute
+      /all-to-all counts must match exactly; ops absent from the
+      mapping must be absent from the program
+      (``hlo-collective-budget``). Catches GSPMD reshard insertion of
+      the RoPE-miscompile class.
+    * ``peak_bytes`` → ``compiled.memory_analysis()`` peak
+      (argument + output + temp − aliased) must not exceed the budget
+      (``hlo-peak-memory``). Budgets carry deliberate ~2× headroom
+      over the measured tiny-config peak: they gate structural
+      blowups (a materialized working set), not byte-level drift —
+      byte-level drift is what docs/artifacts/HLO_BUDGETS.json diffs.
+    * ``variants`` (sequence of ``(label, fn, args)`` or
+      ``(label, fn, args, kwargs)``) + ``expected_programs`` → lowering
+      every variant must yield exactly ``expected_programs`` distinct
+      programs (``hlo-program-cache``). Declare the expected count as
+      a literal cross-product so widening a bucket table without
+      updating the declaration turns the lane red.
+
+    ``donate_argnums`` needs no field here: any hlo-bearing case that
+    declares ``donate_argnums`` is automatically compiled and its
+    ``input_output_alias`` entries counted against the donated leaves
+    (``hlo-donation-alias``).
+    """
+
+    forbid_ops: Sequence[tuple] = ()
+    collectives: Mapping[str, int] | None = None
+    peak_bytes: int | None = None
+    variants: Sequence[tuple] = ()
+    expected_programs: int | None = None
 
 
 @dataclass
@@ -119,6 +179,9 @@ class ContractCase:
       (``shard-bucket``). The table need not be prompt padding: the
       engine's verify contract declares its speculative draft-length
       set (token width per verify program) through the same fields.
+    * ``hlo`` (an :class:`HloSpec`) → the case is additionally lowered
+      and compiled by the post-lowering ``hlo`` group; see
+      :class:`HloSpec` for the rule-by-rule mapping.
     """
 
     label: str = ""
@@ -133,6 +196,7 @@ class ContractCase:
     kv_caches: Sequence[tuple] = ()
     buckets: Sequence[int] | None = None
     bucket_covers: Sequence[int] = ()
+    hlo: HloSpec | None = None
 
 
 @dataclass(frozen=True)

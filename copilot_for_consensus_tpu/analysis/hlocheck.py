@@ -1,0 +1,672 @@
+"""hlocheck — post-lowering verification of compiled-artifact contracts.
+
+Every other analysis family (jaxlint ast rules, shardcheck, racecheck,
+duracheck) verifies contracts BEFORE XLA lowers the program, and the
+repo has paid twice for what that misses: the tp-within-head_dim RoPE
+miscompile hid for 15 PRs because trace-level checks cannot see what
+GSPMD actually emitted, and the kernel route's gather-elimination
+guarantee was pinned only by a one-off trace spy. This module closes
+the gap: it lowers the engine's REAL jitted dispatches via
+``fn.lower(...)`` / ``.compile()`` under the same virtual 8-device CPU
+platform shardcheck uses, and verifies the declared
+:class:`~.contracts.HloSpec` budgets against the artifact itself:
+
+* ``hlo-donation-alias`` — every ``donate_argnums`` leaf must survive
+  as a compiled ``input_output_alias`` entry. shard-donation can only
+  shape-match the trace; XLA still drops aliases (pruned params,
+  layout mismatches) with a warning nobody reads, silently turning
+  zero-copy pool updates into full-HBM copies per dispatch.
+* ``hlo-materialize`` — per-contract forbidden-op fingerprints on the
+  lowered StableHLO: the kernel route's paged dispatches must contain
+  no pool-working-set ``gather`` at/above the declared element
+  threshold. The pre-optimization lowering is checked on purpose —
+  XLA fusion can hide the op, and the algebraic simplifier could fold
+  a sentinel away; the lowering cannot lie about what was traced.
+* ``hlo-collective-budget`` — the compiled program's
+  all-reduce / all-gather / reduce-scatter / collective-permute /
+  all-to-all counts must match the declared budget exactly (ops absent
+  from the budget must be absent from the program). This is the
+  RoPE-miscompile-class tripwire: GSPMD reshard insertion shows up as
+  a changed collective count long before a TPU run shows it as a
+  wrong answer or a 2x step time.
+* ``hlo-peak-memory`` — ``compiled.memory_analysis()`` peak
+  (argument + output + temp − aliased bytes) per dispatch, gated
+  against the declared budget, so a paged_gather_kv-style working-set
+  blowup fails CI instead of an HBM OOM on hardware. Measured peaks
+  are snapshotted in docs/artifacts/HLO_BUDGETS.json (regenerate with
+  ``--budgets``).
+* ``hlo-program-cache`` — lowering every declared bucket-table variant
+  (prefill buckets × draft widths × chunk) must yield exactly the
+  declared number of distinct programs: a widened table that forgets
+  its declaration is a retrace/program-cache explosion.
+* ``hlo-contract`` — the contract itself is broken (module fails to
+  import, declares no HLO specs, lowering/compilation raises): the
+  registry must not rot silently.
+
+Run it alone (``python -m copilot_for_consensus_tpu.analysis.hlocheck``)
+or let the main CLI fold it in (``--group hlo``; skipped under
+``--fast`` and for explicit-path runs — compiling is the expensive
+half of the lane). In-process, :func:`check_modules` is the API tests
+drive fixtures and mutated modules through; ``labels=`` /
+``only_rules=`` narrow a tripwire run to one case and one artifact so
+mutation tests stay cheap. Findings flow through the same inline
+``# jaxlint: disable=`` suppression and justified-baseline machinery
+as every other jaxlint rule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import hashlib
+import json
+import os
+import pathlib
+import re
+import sys
+import warnings
+
+from copilot_for_consensus_tpu.analysis.base import (
+    DEFAULT_BASELINE,
+    Finding,
+    ROOT,
+    Suppressions,
+    rel,
+)
+from copilot_for_consensus_tpu.analysis.contracts import (
+    HLO_CONTRACT_MODULES,
+    ContractCase,
+    ContractSkip,
+)
+from copilot_for_consensus_tpu.analysis.shardcheck import (
+    _oneline,
+    _spec_path,
+    finish_worker,
+    load_contract_module,
+    worker_env,
+)
+
+RULES = (
+    "hlo-donation-alias",
+    "hlo-materialize",
+    "hlo-collective-budget",
+    "hlo-peak-memory",
+    "hlo-program-cache",
+    "hlo-contract",
+)
+
+#: the collective-op vocabulary of hlo-collective-budget: every op here
+#: is counted in the compiled text and compared against the declared
+#: budget (absent from the budget == must be absent from the program).
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+
+# ---------------------------------------------------------------------------
+# contract collection (hlo-bearing cases only)
+# ---------------------------------------------------------------------------
+
+
+def collect(modules=None):
+    """Import the HLO contract modules and read their tables. Returns
+    ``(entries, findings)`` like shardcheck.collect; a module that
+    imports but declares no contracts is registry rot here too."""
+    specs = HLO_CONTRACT_MODULES if modules is None else modules
+    entries = []
+    findings: list[Finding] = []
+    for spec in specs:
+        try:
+            mod = load_contract_module(str(spec))
+        except Exception as exc:
+            findings.append(Finding(
+                "hlo-contract", _spec_path(str(spec)), 1,
+                f"contract module failed to import: "
+                f"{type(exc).__name__}: {_oneline(exc)}"))
+            continue
+        path = pathlib.Path(mod.__file__)
+        table = getattr(mod, "SHARDCHECK_CONTRACTS", None)
+        if not table:
+            findings.append(Finding(
+                "hlo-contract", rel(path), 1,
+                "module declares no SHARDCHECK_CONTRACTS — the "
+                "post-lowering pass no longer covers it"))
+            continue
+        entries.extend((c, path) for c in table)
+    return entries, findings
+
+
+# ---------------------------------------------------------------------------
+# lowering / compiling one case
+# ---------------------------------------------------------------------------
+
+
+def _resolve_lowerable(fn):
+    """Split a case fn into ``(lowerable, bound_args, bound_kwargs)``.
+
+    The engine declares either the jitted fn itself or a
+    ``functools.partial`` binding its static args; both are lowered
+    through the REAL jit wrapper so the artifact carries the real
+    ``donate_argnums``. Wrapping a jitted fn in a second ``jax.jit``
+    would instead verify the OUTER jit's (empty) donation — never do
+    that. A plain callable (the fixture route) is wrapped once here;
+    its donation promise must live on a jit of its own to be real.
+    """
+    import jax
+
+    if isinstance(fn, functools.partial) and hasattr(fn.func, "lower"):
+        return fn.func, fn.args, dict(fn.keywords)
+    if hasattr(fn, "lower"):
+        return fn, (), {}
+    return jax.jit(fn), (), {}
+
+
+def _lower(fn, args, kwargs):
+    jfn, pre_args, pre_kwargs = _resolve_lowerable(fn)
+    with warnings.catch_warnings():
+        # donation-dropped warnings fire at lower time; the alias check
+        # on the compiled artifact is the structured report of the same
+        # fact, so the warning text itself is noise here
+        warnings.simplefilter("ignore")
+        return jfn.lower(*pre_args, *args,
+                         **{**pre_kwargs, **dict(kwargs)})
+
+
+def _compile(lowered):
+    with warnings.catch_warnings():
+        # donation-dropped warnings are exactly what hlo-donation-alias
+        # reports as findings; the warning text itself is noise here
+        warnings.simplefilter("ignore")
+        return lowered.compile()
+
+
+# ---------------------------------------------------------------------------
+# per-artifact checks
+# ---------------------------------------------------------------------------
+
+_ALIAS_RE = re.compile(r"(?:may|must)-alias")
+_RESULT_SHAPE_RE = re.compile(r"->\s*tensor<([0-9]+(?:x[0-9]+)*)x")
+_LOC_RE = re.compile(r"loc\([^)]*\)")
+
+
+def _check_donation_alias(case: ContractCase, compiled_text: str):
+    """Count compiled input_output_alias entries against the donated
+    input leaves. Count-based on purpose: under a mesh the header's
+    entry_computation_layout prints per-device shapes, so shape
+    matching against the declared (global) avals would misfire."""
+    if not case.donate_argnums:
+        return []
+    import jax
+
+    leaves = 0
+    for argnum in case.donate_argnums:
+        if argnum >= len(case.args):
+            return [("hlo-contract",
+                     f"donate_argnums entry {argnum} out of range for "
+                     f"{len(case.args)} declared args")]
+        leaves += len(jax.tree_util.tree_leaves(case.args[argnum]))
+    header = compiled_text.splitlines()[0] if compiled_text else ""
+    aliases = len(_ALIAS_RE.findall(header))
+    if aliases < leaves:
+        return [(
+            "hlo-donation-alias",
+            f"declared {leaves} donated input leaf(s) "
+            f"(donate_argnums={tuple(case.donate_argnums)}) but the "
+            f"compiled artifact carries {aliases} input_output_alias "
+            f"entr{'y' if aliases == 1 else 'ies'} — XLA dropped the "
+            f"alias and the donated buffer double-allocates on every "
+            f"dispatch")]
+    return []
+
+
+def _shape_elements(dims: str) -> int:
+    n = 1
+    for d in dims.split("x"):
+        n *= int(d)
+    return n
+
+
+def _check_materialize(case: ContractCase, lowered_text: str):
+    """Scan the lowered StableHLO for forbidden ops at/above their
+    element thresholds (result-tensor element count)."""
+    out = []
+    for op, min_elements in case.hlo.forbid_ops:
+        needle = f"stablehlo.{op}"
+        count = 0
+        worst = None
+        for line in lowered_text.splitlines():
+            if needle not in line:
+                continue
+            m = _RESULT_SHAPE_RE.search(line)
+            if not m:
+                continue
+            n = _shape_elements(m.group(1))
+            if n >= min_elements:
+                count += 1
+                if worst is None or n > worst[1]:
+                    worst = (m.group(1), n)
+        if count:
+            out.append((
+                "hlo-materialize",
+                f"lowered program contains {count} forbidden "
+                f"'{op}' op(s) at/above {min_elements} elements "
+                f"(largest tensor<{worst[0]}> = {worst[1]}) — the "
+                f"working set materializes instead of being read in "
+                f"place"))
+    return out
+
+
+def collective_counts(compiled_text: str) -> dict[str, int]:
+    """Count collective ops in a compiled HLO text. ``-start`` forms
+    count as the op; ``-done`` halves and operand references
+    (``%all-reduce.5``) do not."""
+    counts = {}
+    for op in COLLECTIVE_OPS:
+        pat = re.compile(r"(?<![-\w])" + re.escape(op)
+                         + r"(?:-start)?\(")
+        counts[op] = len(pat.findall(compiled_text))
+    return counts
+
+
+def _check_collectives(case: ContractCase, compiled_text: str):
+    budget = case.hlo.collectives
+    if budget is None:
+        return []
+    unknown = sorted(set(budget) - set(COLLECTIVE_OPS))
+    if unknown:
+        return [("hlo-contract",
+                 f"collective budget names unknown op(s) {unknown}; "
+                 f"known: {list(COLLECTIVE_OPS)}")]
+    actual = collective_counts(compiled_text)
+    out = []
+    for op in COLLECTIVE_OPS:
+        want = int(budget.get(op, 0))
+        got = actual[op]
+        if got != want:
+            out.append((
+                "hlo-collective-budget",
+                f"compiled program has {got} '{op}' op(s), budget "
+                f"declares {want} — GSPMD reshard insertion (or a "
+                f"lost collective) changed the communication "
+                f"pattern"))
+    return out
+
+
+def peak_stats(compiled) -> dict[str, int]:
+    """argument/output/temp/alias bytes and the derived peak for one
+    compiled artifact (the numbers HLO_BUDGETS.json snapshots)."""
+    ma = compiled.memory_analysis()
+    arg = int(ma.argument_size_in_bytes)
+    out = int(ma.output_size_in_bytes)
+    tmp = int(ma.temp_size_in_bytes)
+    ali = int(ma.alias_size_in_bytes)
+    return {"argument_bytes": arg, "output_bytes": out,
+            "temp_bytes": tmp, "alias_bytes": ali,
+            "peak_bytes": arg + out + tmp - ali}
+
+
+def _check_peak(case: ContractCase, stats: dict[str, int] | None):
+    budget = case.hlo.peak_bytes
+    if budget is None or stats is None:
+        return []
+    peak = stats["peak_bytes"]
+    if peak > budget:
+        return [(
+            "hlo-peak-memory",
+            f"compiled peak {peak} bytes (argument "
+            f"{stats['argument_bytes']} + output "
+            f"{stats['output_bytes']} + temp {stats['temp_bytes']} − "
+            f"aliased {stats['alias_bytes']}) exceeds the declared "
+            f"budget of {budget} bytes — a working-set/materialization "
+            f"regression that would OOM at production scale")]
+    return []
+
+
+def _program_digest(lowered_text: str) -> str:
+    # strip MLIR location metadata so two variants differ only if the
+    # program differs, not if a declaration moved by a line
+    return hashlib.sha1(
+        _LOC_RE.sub("", lowered_text).encode()).hexdigest()
+
+
+def _check_program_cache(case: ContractCase):
+    spec = case.hlo
+    if spec.expected_programs is None:
+        return []
+    digests: dict[str, list[str]] = {}
+    for variant in spec.variants:
+        label, fn, vargs = variant[0], variant[1], variant[2]
+        vkwargs = variant[3] if len(variant) > 3 else {}
+        try:
+            text = _lower(fn, vargs, vkwargs).as_text()
+        except Exception as exc:
+            return [("hlo-contract",
+                     f"program-cache variant '{label}' failed to "
+                     f"lower: {type(exc).__name__}: {_oneline(exc)}")]
+        digests.setdefault(_program_digest(text), []).append(label)
+    distinct = len(digests)
+    if distinct != spec.expected_programs:
+        shared = [labels for labels in digests.values()
+                  if len(labels) > 1]
+        detail = (f"; variants sharing a program: {shared}" if shared
+                  else "")
+        return [(
+            "hlo-program-cache",
+            f"{len(spec.variants)} declared bucket-table variant(s) "
+            f"lower to {distinct} distinct program(s), contract "
+            f"declares {spec.expected_programs} — the bucket "
+            f"cross-product drifted from its declaration (program-"
+            f"cache explosion or redundant bucket){detail}")]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+
+def check_modules(modules=None, labels=None, only_rules=None,
+                  report=None):
+    """Collect the hlo-bearing contract cases and verify their
+    artifacts. Returns ``(findings, checked_paths, skips)`` with the
+    same shapes and suppression semantics as shardcheck.check_modules.
+
+    ``labels`` (set of case labels) and ``only_rules`` (set of rule
+    names) narrow the run — a tripwire test that only needs one case's
+    lowering should not pay for eighteen compiles. Artifacts are built
+    lazily from the selection: a run that only needs ``hlo-materialize``
+    never compiles, one that only needs ``hlo-program-cache`` only
+    lowers the variants.
+
+    ``report`` (a dict) collects per-case :func:`peak_stats` under the
+    case context key — the ``--budgets`` snapshot route.
+    """
+    entries, findings = collect(modules)
+    checked: list[pathlib.Path] = []
+    seen_paths: set[pathlib.Path] = set()
+    skips: list[tuple[str, str]] = []
+    suppressions: dict[pathlib.Path, Suppressions] = {}
+    # paths whose contracts produced at least one hlo-bearing case /
+    # at least one skip — a module with neither has rotted out of the
+    # pass and must say so rather than silently passing
+    specced: set[pathlib.Path] = set()
+    skipped_paths: set[pathlib.Path] = set()
+
+    def selected(rule: str) -> bool:
+        return only_rules is None or rule in only_rules
+
+    def suppressed(path: pathlib.Path, rule: str, line: int) -> bool:
+        if path not in suppressions:
+            try:
+                suppressions[path] = Suppressions(path.read_text())
+            except OSError:
+                suppressions[path] = Suppressions("")
+        return suppressions[path].is_suppressed(rule, line)
+
+    def emit(path, lineno, context, results):
+        for rule, message in results:
+            if not suppressed(path, rule, lineno):
+                findings.append(Finding(rule, rel(path), lineno,
+                                        message, context))
+
+    for con, path in entries:
+        if path not in seen_paths:
+            seen_paths.add(path)
+            checked.append(path)
+        try:
+            produced = con.factory()
+        except ContractSkip as skip:
+            skips.append((con.name, str(skip)))
+            skipped_paths.add(path)
+            continue
+        except Exception as exc:
+            emit(path, con.lineno, con.name,
+                 [("hlo-contract",
+                   f"contract factory raised {type(exc).__name__}: "
+                   f"{_oneline(exc)}")])
+            continue
+        cases = produced if isinstance(produced, (list, tuple)) \
+            else [produced]
+        for case in cases:
+            if not isinstance(case, ContractCase) or case.hlo is None:
+                continue
+            specced.add(path)
+            if labels is not None and case.label not in labels:
+                continue
+            context = f"{con.name}:{case.label}" if case.label \
+                else con.name
+            spec = case.hlo
+            results = []
+
+            if selected("hlo-program-cache"):
+                results += _check_program_cache(case)
+
+            need_compile = case.fn is not None and (
+                (bool(case.donate_argnums)
+                 and selected("hlo-donation-alias"))
+                or (spec.collectives is not None
+                    and selected("hlo-collective-budget"))
+                or (spec.peak_bytes is not None
+                    and (selected("hlo-peak-memory")
+                         or report is not None)))
+            need_lower = need_compile or (
+                case.fn is not None and bool(spec.forbid_ops)
+                and selected("hlo-materialize"))
+
+            lowered = compiled = None
+            if need_lower:
+                try:
+                    lowered = _lower(case.fn, case.args, case.kwargs)
+                except ContractSkip as skip:
+                    skips.append((context, str(skip)))
+                    skipped_paths.add(path)
+                    emit(path, con.lineno, context, results)
+                    continue
+                except Exception as exc:
+                    results.append((
+                        "hlo-contract",
+                        f"lowering failed: {type(exc).__name__}: "
+                        f"{_oneline(exc)}"))
+            if lowered is not None and spec.forbid_ops \
+                    and selected("hlo-materialize"):
+                results += _check_materialize(case, lowered.as_text())
+            if lowered is not None and need_compile:
+                try:
+                    compiled = _compile(lowered)
+                except Exception as exc:
+                    results.append((
+                        "hlo-contract",
+                        f"compilation failed: {type(exc).__name__}: "
+                        f"{_oneline(exc)}"))
+            if compiled is not None:
+                compiled_text = compiled.as_text()
+                if selected("hlo-donation-alias"):
+                    results += _check_donation_alias(case,
+                                                     compiled_text)
+                if selected("hlo-collective-budget"):
+                    results += _check_collectives(case, compiled_text)
+                stats = None
+                try:
+                    stats = peak_stats(compiled)
+                except Exception as exc:
+                    # memory_analysis is backend-dependent; its absence
+                    # is an environment note, not a contract breach
+                    skips.append((context,
+                                  f"memory_analysis unavailable: "
+                                  f"{_oneline(exc)}"))
+                if stats is not None:
+                    if selected("hlo-peak-memory"):
+                        results += _check_peak(case, stats)
+                    if report is not None:
+                        report[context] = dict(
+                            stats, budget_bytes=spec.peak_bytes)
+            emit(path, con.lineno, context, results)
+
+    if labels is None and only_rules is None:
+        for path in sorted(seen_paths - specced - skipped_paths):
+            findings.append(Finding(
+                "hlo-contract", rel(path), 1,
+                "module's contracts declare no HloSpec — the "
+                "post-lowering pass no longer covers it"))
+    return findings, checked, skips
+
+
+# ---------------------------------------------------------------------------
+# subprocess runner (what the main CLI and bench preflight call)
+# ---------------------------------------------------------------------------
+
+
+def spawn_worker(modules=None, baseline=None):
+    """Start the hlocheck worker subprocess (same CPU-platform /
+    8-virtual-device env contract as shardcheck.spawn_worker; spawn
+    early, :func:`finish_worker` late — compiling is the slowest pass
+    in the lane, so the main CLI overlaps it with everything else)."""
+    import subprocess
+
+    cmd = [sys.executable, "-m",
+           "copilot_for_consensus_tpu.analysis.hlocheck", "--json"]
+    if modules:
+        cmd += ["--modules", ",".join(str(m) for m in modules)]
+    if baseline:
+        cmd += ["--baseline", str(baseline)]
+    else:
+        cmd += ["--no-baseline"]
+    return subprocess.Popen(cmd, cwd=ROOT, env=worker_env(),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def run_worker(modules=None, baseline=None, timeout: float = 900.0):
+    """spawn + finish in one call (the bench preflight route)."""
+    return finish_worker(spawn_worker(modules, baseline), timeout)
+
+
+def check_semantic(modules=None, timeout: float = 900.0, proc=None):
+    """Run the post-lowering pass in a subprocess (or collect an
+    already-spawned ``proc``). Returns ``(findings, checked_paths)``;
+    an infra failure is itself an ``hlo-contract`` finding, never a
+    silent pass."""
+    self_path = rel(pathlib.Path(__file__))
+    if proc is None:
+        proc = spawn_worker(modules)
+    data, detail = finish_worker(proc, timeout)
+    if data is None:
+        return [Finding("hlo-contract", self_path, 1, detail)], []
+    for ctx, reason in data.get("skips", ()):
+        print(f"jaxlint: hlocheck skipped {ctx}: {reason}",
+              file=sys.stderr)
+    findings = [Finding(d["rule"], d["path"], d["line"], d["message"],
+                        d.get("context", ""))
+                for d in data.get("findings", ())]
+    checked = [ROOT / p for p in data.get("checked", ())]
+    return findings, checked
+
+
+def write_budgets(report: dict, path: pathlib.Path) -> None:
+    """Write the per-dispatch memory snapshot (the HLO_BUDGETS.json
+    artifact future PRs diff the way BENCH_*.json diffs throughput)."""
+    payload = {
+        "generated_by": "python -m copilot_for_consensus_tpu.analysis"
+                        ".hlocheck --budgets <path>",
+        "device_count": 8,
+        "platform": "cpu (virtual 8-device; bytes are per-device "
+                    "logical buffer sizes from compiled"
+                    ".memory_analysis())",
+        "peak_definition": "argument_bytes + output_bytes + temp_bytes"
+                           " - alias_bytes",
+        "cases": {k: report[k] for k in sorted(report)},
+    }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2, sort_keys=False)
+                    + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m copilot_for_consensus_tpu.analysis.hlocheck",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--modules",
+                    help="comma list of dotted modules or .py paths "
+                         "(default: contracts.HLO_CONTRACT_MODULES)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON line")
+    ap.add_argument("--budgets", metavar="PATH",
+                    help="also write the per-dispatch memory snapshot "
+                         "(docs/artifacts/HLO_BUDGETS.json) here")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="apply this jaxlint baseline file (entries "
+                         "with hlo-* rules) before reporting")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything); "
+                         "the main CLI spawns the worker with this, "
+                         "as it applies the baseline itself")
+    args = ap.parse_args(argv)
+
+    from copilot_for_consensus_tpu.analysis.shardcheck import (
+        _force_cpu_env,
+    )
+
+    _force_cpu_env(os.environ)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as exc:
+        msg = f"jax unavailable: {type(exc).__name__}: {_oneline(exc)}"
+        if args.json:
+            print(json.dumps({"findings": [
+                {"rule": "hlo-contract", "path": "jax", "line": 1,
+                 "message": msg, "context": ""}], "checked": [],
+                "skips": []}))
+        else:
+            print(msg, file=sys.stderr)
+        return 1
+
+    modules = [m.strip() for m in args.modules.split(",")
+               if m.strip()] if args.modules else None
+    report: dict = {}
+    findings, checked, skips = check_modules(modules, report=report)
+    if args.budgets:
+        write_budgets(report, pathlib.Path(args.budgets))
+    if not args.no_baseline:
+        from copilot_for_consensus_tpu.analysis.base import (
+            apply_baseline,
+            load_baseline,
+        )
+
+        entries, errors = load_baseline(pathlib.Path(args.baseline))
+        for err in errors:
+            print(f"hlocheck: {err}", file=sys.stderr)
+        if not errors:
+            entries = [e for e in entries
+                       if str(e.get("rule", "")).startswith("hlo-")]
+            findings, _ = apply_baseline(findings, entries)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "message": f.message, "context": f.context}
+                         for f in findings],
+            "checked": [rel(p) for p in checked],
+            "skips": list(skips),
+            "report": report,
+        }))
+    else:
+        for ctx, reason in skips:
+            print(f"hlocheck: skipped {ctx}: {reason}", file=sys.stderr)
+        for f in findings:
+            print(f.render())
+        verdict = "CLEAN" if not findings \
+            else f"{len(findings)} finding(s)"
+        print(f"hlocheck: {len(checked)} contract module(s): {verdict}",
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -51,6 +51,7 @@ import numpy as np
 
 from copilot_for_consensus_tpu.analysis.contracts import (
     ContractCase,
+    HloSpec,
     checkable,
 )
 from copilot_for_consensus_tpu.engine.faults import (
@@ -3722,7 +3723,16 @@ def _shardcheck_generation_engine():
     The tiny shapes don't weaken the checks: layout agreement, alias
     feasibility, and bucket coverage are shape-RELATION properties, and
     the relations here are the same ones the serving-size engine
-    builds."""
+    builds.
+
+    Cases carrying an ``hlo=HloSpec(...)`` are ADDITIONALLY lowered and
+    compiled by the post-lowering pass (analysis/hlocheck.py): donated
+    args must survive as compiled input_output_alias entries, the
+    kernel route must lower with no pool-working-set gather, sharded
+    dispatches must keep their declared collective counts, and every
+    dispatch's compiled memory peak is gated (budgets carry ~2×
+    headroom over the measured tiny-config peak — see
+    docs/artifacts/HLO_BUDGETS.json for the measured numbers)."""
     import functools
 
     from copilot_for_consensus_tpu.models.configs import DecoderConfig
@@ -3755,7 +3765,8 @@ def _shardcheck_generation_engine():
                   S((n,), i32), key),
             donate_argnums=(3,), kv_group=group,
             kv_caches=(("slot-cache", cache),),
-            buckets=eng.buckets, bucket_covers=(eng.prompt_limit,)),
+            buckets=eng.buckets, bucket_covers=(eng.prompt_limit,),
+            hlo=HloSpec(peak_bytes=470_000)),
         ContractCase(
             label="admit-seeded", fn=eng._admit_seeded_fn,
             args=(eng.params, S((n, bucket), i32), S((n,), i32),
@@ -3770,7 +3781,8 @@ def _shardcheck_generation_engine():
             args=(eng.params, S((eng.num_slots,), i32),
                   S((eng.num_slots,), i32), cache, key),
             donate_argnums=(3,), kv_group=group,
-            kv_caches=(("slot-cache", cache),)),
+            kv_caches=(("slot-cache", cache),),
+            hlo=HloSpec(peak_bytes=470_000)),
         ContractCase(
             label="verify",
             # token width = largest declared draft length + 1 (the
@@ -3785,7 +3797,8 @@ def _shardcheck_generation_engine():
             donate_argnums=(4,), kv_group=group,
             kv_caches=(("slot-cache", cache),),
             buckets=tuple(k + 1 for k in eng.spec_draft_lens),
-            bucket_covers=(max(eng.spec_draft_lens) + 1,)),
+            bucket_covers=(max(eng.spec_draft_lens) + 1,),
+            hlo=HloSpec(peak_bytes=510_000)),
         ContractCase(
             label="piggyback",
             fn=functools.partial(eng._piggy_fn, kv_len=eng.max_len),
@@ -3830,7 +3843,20 @@ def _paged_contract_cases(cfg, group):
       ``ops.paged_attention.KERNEL_BLOCK_PACK``, the pool layout
       declares ``kv_pool.POOL_BLOCK_PACK``, and the dispatch side
       declares its own literal — flipping any one of the three (the
-      block-pack tripwire) is a ``shard-kv-layout`` finding.
+      block-pack tripwire) is a ``shard-kv-layout`` finding;
+    * the KERNEL route's dispatches additionally declare an
+      ``hlo-materialize`` fingerprint (no gather at/above the pool
+      working-set size in the lowered StableHLO) — the gather
+      elimination PR 16 shipped is a CONTRACT here, not a test detail,
+      and re-introducing a ``paged_gather_kv`` call turns the hlo lane
+      red; the reference route declares the same budget family WITHOUT
+      the fingerprint (its gather is the design being replaced) so the
+      two routes' compiled peaks stay individually gated;
+    * the ``program-cache`` case lowers one variant per declared
+      bucket (prefill buckets × verify draft widths × the chunk
+      program) and pins the distinct-program count to the literal
+      cross-product — widening any bucket table without updating the
+      declaration is an ``hlo-program-cache`` finding.
     """
     import functools
 
@@ -3869,6 +3895,13 @@ def _paged_contract_cases(cfg, group):
     nb_view = eng._view_width(kv_len, w) // eng._block
     tgroup = "engine.generation-kv-table"
     pgroup = "engine.generation-kv-pack"
+    # hlo-materialize fingerprint: one gather materializing the pool
+    # working set (L × B × Hkv × kv_len × Dh result elements) is the
+    # paged_gather_kv pattern the kernel route exists to eliminate;
+    # legitimate small gathers (embedding lookup: B × bucket × d_model
+    # = 2048 elements here) sit well below the threshold
+    ws_elems = cfg.n_layers * b * cfg.n_kv_heads * kv_len * cfg.head_dim
+    no_gather = (("gather", ws_elems),)
 
     def tbl(rows, width):
         return S((rows, width), table_dtype)
@@ -3888,7 +3921,10 @@ def _paged_contract_cases(cfg, group):
                   key),
             donate_argnums=(3, 4), kv_group=group,
             kv_caches=(("kv-pool", pool),),
-            buckets=eng.buckets, bucket_covers=(eng.prompt_limit,)),
+            buckets=eng.buckets, bucket_covers=(eng.prompt_limit,),
+            # admission scatters into the pool; it must never gather
+            # the working set back out on EITHER route
+            hlo=HloSpec(forbid_ops=no_gather, peak_bytes=440_000)),
         ContractCase(
             label="admit-seeded-paged", fn=eng._admit_seeded_paged_fn,
             args=(eng.params, S((n, bucket), i32), S((n,), i32),
@@ -3905,7 +3941,12 @@ def _paged_contract_cases(cfg, group):
                   S((b, nb_view), jnp.dtype(BLOCK_TABLE_DTYPE)),
                   tbl(b, w), tbl(b, w), key),
             donate_argnums=(3, 4), kv_group=group,
-            kv_caches=(("kv-pool", pool),)),
+            kv_caches=(("kv-pool", pool),),
+            # the REFERENCE route gathers its working set by design —
+            # no forbid_ops; the peak budget documents (and caps) the
+            # materialization cost the kernel route removes (measured
+            # 327K vs the kernel decode's 189K)
+            hlo=HloSpec(peak_bytes=650_000)),
         ContractCase(
             label="decode-paged-table", kv_group=tgroup,
             kv_caches=(("block-table",
@@ -3921,7 +3962,8 @@ def _paged_contract_cases(cfg, group):
             donate_argnums=(4, 5), kv_group=group,
             kv_caches=(("kv-pool", pool),),
             buckets=tuple(k + 1 for k in eng.spec_draft_lens),
-            bucket_covers=(max(eng.spec_draft_lens) + 1,)),
+            bucket_covers=(max(eng.spec_draft_lens) + 1,),
+            hlo=HloSpec(peak_bytes=700_000)),
         ContractCase(
             label="chunk-paged",
             fn=functools.partial(eng._chunk_paged_fn, kv_len=kv_len),
@@ -3943,7 +3985,8 @@ def _paged_contract_cases(cfg, group):
                   pool["k"], pool["v"], S((n, 2), i32), S((n,), i32),
                   tbl(n, bucket), tbl(n, bucket), key),
             donate_argnums=(3, 4), kv_group=group,
-            kv_caches=(("kv-pool", pool),)),
+            kv_caches=(("kv-pool", pool),),
+            hlo=HloSpec(forbid_ops=no_gather, peak_bytes=460_000)),
         ContractCase(
             label="decode-paged-kernel",
             fn=functools.partial(eng_k._decode_paged_fn, kv_len=kv_len,
@@ -3953,7 +3996,10 @@ def _paged_contract_cases(cfg, group):
                   S((b, nb_view), jnp.dtype(BLOCK_TABLE_DTYPE)),
                   tbl(b, w), tbl(b, w), key),
             donate_argnums=(3, 4), kv_group=group,
-            kv_caches=(("kv-pool", pool),)),
+            kv_caches=(("kv-pool", pool),),
+            # PR 16's gather-elimination guarantee, as a contract: the
+            # kernel decode lowers with NO working-set gather
+            hlo=HloSpec(forbid_ops=no_gather, peak_bytes=380_000)),
         ContractCase(
             label="decode-paged-kernel-table", kv_group=tgroup,
             kv_caches=(("block-table",
@@ -3970,7 +4016,8 @@ def _paged_contract_cases(cfg, group):
             donate_argnums=(4, 5), kv_group=group,
             kv_caches=(("kv-pool", pool),),
             buckets=tuple(k + 1 for k in eng_k.spec_draft_lens),
-            bucket_covers=(max(eng_k.spec_draft_lens) + 1,)),
+            bucket_covers=(max(eng_k.spec_draft_lens) + 1,),
+            hlo=HloSpec(forbid_ops=no_gather, peak_bytes=360_000)),
         ContractCase(
             label="chunk-paged-kernel",
             fn=functools.partial(eng_k._chunk_paged_fn, kv_len=kv_len),
@@ -3980,7 +4027,8 @@ def _paged_contract_cases(cfg, group):
                      // eng_k._block), jnp.dtype(BLOCK_TABLE_DTYPE)),
                   tbl(b, eng_k._block), tbl(b, eng_k._block), key),
             donate_argnums=(4, 5), kv_group=group,
-            kv_caches=(("kv-pool", pool),)),
+            kv_caches=(("kv-pool", pool),),
+            hlo=HloSpec(forbid_ops=no_gather, peak_bytes=380_000)),
         # ---- block packing (engine.generation-kv-pack): kernel-side
         # KERNEL_BLOCK_PACK (anchor), pool-side POOL_BLOCK_PACK, and
         # the dispatch-side literal must all name the same lane width
@@ -3998,6 +4046,46 @@ def _paged_contract_cases(cfg, group):
             label="dispatch-block-pack", kv_group=pgroup,
             kv_caches=(("block-pack",
                         {"pack": S((block_pack,), i32)}),)),
+        # ---- program-cache cardinality: one variant per declared
+        # bucket; the distinct compiled-program count must equal the
+        # LITERAL cross-product below. Widening prefill_buckets or
+        # spec_draft_lens (or chunking by a new width) without
+        # updating this declaration is an hlo-program-cache finding —
+        # the silent version of that drift is a retrace explosion ----
+        ContractCase(
+            label="program-cache",
+            hlo=HloSpec(
+                variants=tuple(
+                    (f"admit@{bk}", eng._admit_paged_fn,
+                     (eng.params, S((n, bk), i32), S((n,), i32),
+                      pool["k"], pool["v"], tbl(n, bk), tbl(n, bk),
+                      key))
+                    for bk in eng.buckets
+                ) + tuple(
+                    (f"verify@{k + 1}",
+                     functools.partial(eng._verify_paged_fn,
+                                       kv_len=kv_len),
+                     (eng.params, S((b, k + 1), i32), S((b,), i32),
+                      S((b,), i32), pool["k"], pool["v"],
+                      S((b, eng._view_width(kv_len, k + 1)
+                         // eng._block),
+                        jnp.dtype(BLOCK_TABLE_DTYPE)),
+                      tbl(b, k + 1), tbl(b, k + 1), key))
+                    for k in eng.spec_draft_lens
+                ) + (
+                    ("chunk@block",
+                     functools.partial(eng._chunk_paged_fn,
+                                       kv_len=kv_len),
+                     (eng.params, S((b, eng._block), i32),
+                      S((b,), i32), S((b,), i32), pool["k"],
+                      pool["v"],
+                      S((b, eng._view_width(kv_len, eng._block)
+                         // eng._block),
+                        jnp.dtype(BLOCK_TABLE_DTYPE)),
+                      tbl(b, eng._block), tbl(b, eng._block), key)),
+                ),
+                # 2 prefill buckets + 3 verify draft widths + 1 chunk
+                expected_programs=6)),
     ]
 
 
@@ -4020,7 +4108,15 @@ def _paged_mesh_contract_cases(cfg, group):
       ``kv_pool.BLOCK_TABLE_DTYPE`` under dp sharding
       (``engine.generation-kv-table`` group membership);
     * the KV handoff import (disaggregated roles) donates both pool
-      halves like every other pool writer.
+      halves like every other pool writer;
+    * the two decode dispatches (reference and kernel route) declare
+      exact ``hlo-collective-budget`` counts: GSPMD reshard insertion
+      — the RoPE-miscompile class — shows up as a changed collective
+      count in the compiled program long before a TPU run shows it as
+      a wrong answer or a step-time cliff. The budgets are the
+      compiled ground truth of this mesh/config; a legitimate
+      partitioning change updates them HERE, next to the declaration,
+      never in the baseline file.
     """
     import functools
 
@@ -4100,7 +4196,12 @@ def _paged_mesh_contract_cases(cfg, group):
                   pool["k"], pool["v"], tbl(b, nb_view),
                   tbl(b, w), tbl(b, w), key),
             donate_argnums=(3, 4), kv_group=group,
-            kv_caches=(("kv-pool-mesh", pool),)),
+            kv_caches=(("kv-pool-mesh", pool),),
+            hlo=HloSpec(
+                collectives={"all-reduce": 5, "all-gather": 10,
+                             "collective-permute": 8,
+                             "all-to-all": 1},
+                peak_bytes=240_000)),
         ContractCase(
             label="decode-paged-mesh-table", kv_group=tgroup,
             kv_caches=(("block-table",
@@ -4135,7 +4236,8 @@ def _paged_mesh_contract_cases(cfg, group):
                      cfg.head_dim), eng.kv_dtype),
                   S((1, 16), i32), S((1, 16), i32)),
             donate_argnums=(0, 1), kv_group=group,
-            kv_caches=(("kv-pool-mesh", pool),)),
+            kv_caches=(("kv-pool-mesh", pool),),
+            hlo=HloSpec(peak_bytes=140_000)),
         # ---- kernel route under the mesh: the shard-mapped partial
         # keeps the dp-sharded pool donated and the shard-local block
         # tables on the canonical dtype (same layout groups — the
@@ -4149,7 +4251,12 @@ def _paged_mesh_contract_cases(cfg, group):
                   pool["k"], pool["v"], tbl(b, nb_view),
                   tbl(b, w), tbl(b, w), key),
             donate_argnums=(3, 4), kv_group=group,
-            kv_caches=(("kv-pool-mesh", pool),)),
+            kv_caches=(("kv-pool-mesh", pool),),
+            # the kernel route reads pool blocks in place — fewer
+            # gather-side collectives than the reference route above
+            hlo=HloSpec(
+                collectives={"all-reduce": 3, "all-gather": 6},
+                peak_bytes=175_000)),
         ContractCase(
             label="decode-paged-mesh-kernel-table", kv_group=tgroup,
             kv_caches=(("block-table",

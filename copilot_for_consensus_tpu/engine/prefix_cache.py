@@ -513,8 +513,17 @@ def _shardcheck_prefix_publish():
     dropped alias means a full second pool per publish), and the pool's
     k/v halves must share one block layout with the slot cache they
     gather from. The engine-level agreement with admit/decode programs
-    is declared in ``engine/generation.py``."""
-    from copilot_for_consensus_tpu.analysis.contracts import ContractCase
+    is declared in ``engine/generation.py``.
+
+    The ``hlo`` spec sends the same program through the post-lowering
+    pass: the donated pool must survive as compiled input_output_alias
+    entries (not just shape-match the trace) and the compiled peak is
+    gated — a publish that copies the pool would double the resident
+    allocation, which is exactly a peak-budget breach."""
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        ContractCase,
+        HloSpec,
+    )
     from copilot_for_consensus_tpu.models.configs import DecoderConfig
 
     cfg = DecoderConfig(name="shardcheck-tiny", vocab_size=64,
@@ -536,4 +545,5 @@ def _shardcheck_prefix_publish():
         kv_group="engine.prefix-cache-kv",
         kv_caches=(("pool", pool),
                    ("slot-cache", {"k": cache_leaf, "v": cache_leaf})),
+        hlo=HloSpec(peak_bytes=40_000),
     )

@@ -46,6 +46,7 @@ from dataclasses import dataclass
 
 import jax
 
+from copilot_for_consensus_tpu.analysis.contracts import checkable
 from copilot_for_consensus_tpu.engine.generation import (
     Completion,
     GenerationEngine,
@@ -237,3 +238,76 @@ class DisaggregatedEngine:
             request_id=public_id, prompt_len=c.prompt_len,
             tokens=c.tokens, finish_reason=c.finish_reason,
             prefill_s=c.prefill_s, decode_s=c.decode_s)
+
+
+# ---------------------------------------------------------------------------
+# hlocheck contracts (analysis/hlocheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("roles-handoff")
+def _hlocheck_roles_handoff():
+    """The KV-handoff pair over a REAL role split (prefill 1×4 +
+    decode 1×4 on the 8 virtual devices), verified post-lowering:
+
+    * ``handoff-import`` donates both decode-pool halves and the
+      aliases must SURVIVE compilation — a dropped alias here means
+      every handoff double-buffers the whole decode pool, the exact
+      failure mode disaggregation exists to avoid (decode HBM is the
+      scarce resource);
+    * ``handoff-export`` is deliberately NOT donated (it is a pure
+      read of the LIVE prefill pool — the source blocks keep serving
+      until the handoff object exists, see generation.py), so it only
+      declares a compiled-peak budget: the export's dense view is the
+      one intentional materialization in the handoff path and its
+      size must stay a couple of blocks, never the pool.
+    """
+    import jax.numpy as jnp
+
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        ContractCase,
+        HloSpec,
+        require_devices,
+    )
+    from copilot_for_consensus_tpu.models.configs import DecoderConfig
+
+    require_devices(8)
+    cfg = DecoderConfig(name="shardcheck-tiny", vocab_size=64,
+                        d_model=32, n_layers=2, n_heads=4,
+                        n_kv_heads=2, d_ff=64, max_seq_len=128)
+    deng = DisaggregatedEngine(
+        cfg, roles=RoleConfig(prefill_dp=1, tp=4),
+        engine_kw=dict(num_slots=4, max_len=64,
+                       prefill_buckets=(16, 32), decode_window=4,
+                       windows_per_dispatch=1, prefill_chunk=8,
+                       prefix_cache_blocks=4, kv_pool_blocks=32))
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    pool_pre = {"k": S(deng.prefill._pool.k.shape,
+                       deng.prefill._pool.k.dtype),
+                "v": S(deng.prefill._pool.v.shape,
+                       deng.prefill._pool.v.dtype)}
+    pool_dec = {"k": S(deng.decode._pool.k.shape,
+                       deng.decode._pool.k.dtype),
+                "v": S(deng.decode._pool.v.shape,
+                       deng.decode._pool.v.dtype)}
+    blk = deng.decode._block
+    nb = 2                       # blocks per handoff in tiny shapes
+    dense = S((cfg.n_layers, 1, cfg.n_kv_heads, nb * blk,
+               cfg.head_dim), deng.decode.kv_dtype)
+    return [
+        ContractCase(
+            label="handoff-export", fn=deng.prefill._export_fn,
+            args=(pool_pre["k"], pool_pre["v"], S((1, nb), i32)),
+            kv_group="engine.roles-kv",
+            kv_caches=(("prefill-pool", pool_pre),),
+            hlo=HloSpec(peak_bytes=70_000)),
+        ContractCase(
+            label="handoff-import", fn=deng.decode._import_fn,
+            args=(pool_dec["k"], pool_dec["v"], dense, dense,
+                  S((1, nb * blk), i32), S((1, nb * blk), i32)),
+            donate_argnums=(0, 1),
+            kv_group="engine.roles-kv",
+            kv_caches=(("decode-pool", pool_dec),),
+            hlo=HloSpec(peak_bytes=140_000)),
+    ]

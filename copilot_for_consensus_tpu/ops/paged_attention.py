@@ -44,6 +44,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from copilot_for_consensus_tpu.analysis.contracts import checkable
 from copilot_for_consensus_tpu.ops.attention import decode_attention
 
 try:  # Pallas TPU lowering — import-light so host-only tools survive
@@ -315,3 +316,70 @@ def paged_decode_attention(
             q, pool_k_l, pool_v_l, tables, lengths, window=window)
     k, v = paged_gather_layer(pool_k_l, pool_v_l, tables)
     return decode_attention(q, k, v, lengths, window=window)
+
+
+# ---------------------------------------------------------------------------
+# hlocheck contracts (analysis/hlocheck.py)
+# ---------------------------------------------------------------------------
+
+
+@checkable("paged-attention-kernel")
+def _hlocheck_paged_attention():
+    """The two attention routes, verified at the op level against
+    their own lowered artifacts (the engine-level contracts in
+    engine/generation.py verify whole dispatches; this pins the claim
+    where it is made — module docstring: "the pool is read by POINTER,
+    no gathered contiguous copy ever materializes"):
+
+    * ``partial-pallas``: the flash-partial kernel must lower with NO
+      gather at/above the per-layer working-set size
+      (B × Hkv × NB·blk × D result elements). On CPU the kernel runs
+      in interpret mode, which lowers the block walk to
+      dynamic-slice-driven loops — pointer reads either way; a gather
+      showing up here means someone re-routed the kernel through the
+      reference materialization.
+    * ``decode-xla-reference``: the reference route gathers that exact
+      view BY DESIGN (it is the bit-identity anchor for the CPU e2e
+      gates), so it declares only a compiled-peak budget — the cost of
+      the materialization stays bounded and measured
+      (docs/artifacts/HLO_BUDGETS.json) instead of forbidden.
+    """
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        ContractCase,
+        HloSpec,
+    )
+
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    f32 = jnp.float32
+    b, hq, hkv, d, blk, nbtot, nb, n_l = 4, 4, 2, 8, 8, 16, 8, 2
+    r = hq // hkv                # grouped query rows per kv head
+    # one slot's view of the layer pool: the materialization the
+    # kernel route must never emit
+    ws_elems = b * hkv * nb * blk * d
+    pool = S((n_l, nbtot, hkv, blk, d), jnp.bfloat16)
+    pool_l = S((nbtot, hkv, blk, d), jnp.bfloat16)
+    # deliberate non-donation, twice over: these jits exist only to be
+    # LOWERED by hlocheck (never executed), and both routes are pure
+    # READS of the live pool — the engine's scatter dispatches own the
+    # pool update and its donation aliases (engine/generation.py).
+    # jaxlint: disable=donation
+    partial_fn = jax.jit(functools.partial(
+        paged_attention_partial_pallas, window=0, interpret=True))
+    # jaxlint: disable=donation
+    xla_fn = jax.jit(functools.partial(
+        paged_decode_attention, window=0, impl="xla"))
+    return [
+        ContractCase(
+            label="partial-pallas", fn=partial_fn,
+            args=(S((b, hkv, r, d), f32), pool, pool,
+                  S((1,), i32), S((b, nb), i32), S((b,), i32),
+                  S((b,), i32)),
+            hlo=HloSpec(forbid_ops=(("gather", ws_elems),),
+                        peak_bytes=90_000)),
+        ContractCase(
+            label="decode-xla-reference", fn=xla_fn,
+            args=(S((b, hq, d), f32), pool_l, pool_l,
+                  S((b, nb), i32), S((b,), i32)),
+            hlo=HloSpec(peak_bytes=60_000)),
+    ]

@@ -1,0 +1,48 @@
+"""hlocheck fixture: hlo-donation-alias — a donated buffer whose
+output dtype mismatch makes XLA silently drop the input_output_alias
+(the donation survives tracing, dies at compilation), plus the clean
+in-place update whose alias survives into the compiled artifact."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    HloSpec,
+    contract,
+)
+
+
+def bad_alias():
+    import jax
+    import jax.numpy as jnp
+
+    def step(cache, x):
+        # output is f32 — no dtype-matching output for the donated
+        # bf16 buffer, so the compiled program carries zero aliases
+        return (cache + x).astype(jnp.float32)
+
+    S = jax.ShapeDtypeStruct
+    return ContractCase(
+        fn=jax.jit(step, donate_argnums=(0,)),
+        args=(S((4, 8), jnp.bfloat16), S((4, 8), jnp.bfloat16)),
+        donate_argnums=(0,),
+        hlo=HloSpec())
+
+
+def good_alias():
+    import jax
+    import jax.numpy as jnp
+
+    def step(cache, x):
+        return cache.at[0].set(x[0])
+
+    S = jax.ShapeDtypeStruct
+    return ContractCase(
+        fn=jax.jit(step, donate_argnums=(0,)),
+        args=(S((4, 8), jnp.bfloat16), S((1, 8), jnp.bfloat16)),
+        donate_argnums=(0,),
+        hlo=HloSpec())
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_alias", bad_alias),
+    contract("good_alias", good_alias),
+]

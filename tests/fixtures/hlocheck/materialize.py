@@ -1,0 +1,49 @@
+"""hlocheck fixture: hlo-materialize — a lowered program that gathers
+a working set at/above the declared element threshold (the
+paged_gather_kv failure shape), plus the clean small-index gather that
+stays under it."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    HloSpec,
+    contract,
+)
+
+
+def bad_materialize():
+    import jax
+    import jax.numpy as jnp
+
+    def step(pool, idx):
+        # advanced indexing over 32 of 64 rows: a [32, 64] = 2048-
+        # element stablehlo.gather in the lowering — the working set
+        # materializes instead of being read in place
+        return pool[idx].sum()
+
+    S = jax.ShapeDtypeStruct
+    return ContractCase(
+        fn=jax.jit(step),
+        args=(S((64, 64), jnp.float32), S((32,), jnp.int32)),
+        hlo=HloSpec(forbid_ops=(("gather", 1024),)))
+
+
+def good_materialize():
+    import jax
+    import jax.numpy as jnp
+
+    def step(pool, idx):
+        # 4 rows → a [4, 64] = 256-element gather, under the 1024
+        # threshold: small per-step indexing is the tolerated shape
+        return pool[idx].sum()
+
+    S = jax.ShapeDtypeStruct
+    return ContractCase(
+        fn=jax.jit(step),
+        args=(S((64, 64), jnp.float32), S((4,), jnp.int32)),
+        hlo=HloSpec(forbid_ops=(("gather", 1024),)))
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_materialize", bad_materialize),
+    contract("good_materialize", good_materialize),
+]

@@ -1,0 +1,38 @@
+"""hlocheck fixture: hlo-peak-memory — a dispatch whose compiled peak
+(argument + output + temp − aliased bytes) blows through its declared
+HBM budget (the working-set-blowup shape that OOMs at production
+scale), plus the same program under an honest budget."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    HloSpec,
+    contract,
+)
+
+
+def _case(budget_bytes):
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        return (x @ x.T).sum(axis=1)
+
+    # [256, 256] f32 argument alone is 262144 bytes
+    return ContractCase(
+        fn=jax.jit(step),
+        args=(jax.ShapeDtypeStruct((256, 256), jnp.float32),),
+        hlo=HloSpec(peak_bytes=budget_bytes))
+
+
+def bad_peak():
+    return _case(1024)
+
+
+def good_peak():
+    return _case(4 << 20)
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_peak", bad_peak),
+    contract("good_peak", good_peak),
+]

@@ -1,0 +1,46 @@
+"""hlocheck fixture: hlo-program-cache — a bucket-table declaration
+that has drifted from the programs it actually lowers to (three
+distinct shapes against a declared cardinality of two: a program-cache
+explosion waiting for production traffic), plus the honest
+declaration including a deliberate duplicate variant proving the
+digest sees programs, not labels."""
+
+from copilot_for_consensus_tpu.analysis.contracts import (
+    ContractCase,
+    HloSpec,
+    contract,
+)
+
+
+def _variants(widths):
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        return x * 2.0
+
+    S = jax.ShapeDtypeStruct
+    return tuple((f"bucket@{w}", step, (S((4, w), jnp.float32),))
+                 for w in widths)
+
+
+def bad_cache():
+    # widths (8, 16, 32) lower to 3 distinct programs — the declared
+    # cardinality of 2 is the stale pre-widening declaration
+    return ContractCase(
+        hlo=HloSpec(variants=_variants((8, 16, 32)),
+                    expected_programs=2))
+
+
+def good_cache():
+    # the duplicate width 8 shares a program with the first variant:
+    # 4 declared variants, 3 distinct programs, honestly declared
+    return ContractCase(
+        hlo=HloSpec(variants=_variants((8, 16, 32, 8)),
+                    expected_programs=3))
+
+
+SHARDCHECK_CONTRACTS = [
+    contract("bad_cache", bad_cache),
+    contract("good_cache", good_cache),
+]

@@ -2,11 +2,14 @@
 # interpret-mode parity of the partial kernel + combine_partials fold
 # against the XLA reference across GQA ratios, sliding windows, fp8
 # pools, mixed fill levels, and parked rows; the kv_kernel constructor
-# guards; the no-materialization trace gate (no paged dispatch on the
-# kernel route may call paged_gather_kv — the test fails if the
-# materializing gather reappears in a traced program); and engine-level
-# greedy token equality between the kernel and reference routes across
-# the plain, prefix-cache, spec-decode, and chunked-prefill paths.
+# guards; the no-materialization gate (now an hlo-materialize contract
+# on the lowered StableHLO of every kernel-route paged dispatch — this
+# file keeps the tripwire proving the hlo lane turns red when the
+# materializing gather is re-introduced); and engine-level greedy token
+# equality between the kernel and reference routes across the plain,
+# prefix-cache, spec-decode, and chunked-prefill paths.
+import pathlib
+
 import numpy as np
 import pytest
 
@@ -169,43 +172,58 @@ def test_kv_kernel_constructor_guards_and_resolution():
 
 
 # ---------------------------------------------------------------------------
-# no-materialization gate: the kernel route must never gather the pool
+# no-materialization gate: the kernel route must never gather the pool.
+# The PROD gate is the hlo lane now — the kernel-route contract cases in
+# generation.py declare ``HloSpec(forbid_ops=...)`` and hlocheck scans
+# the real lowered StableHLO of every paged dispatch (strictly stronger
+# than the runtime trace spy this file used to carry: a gather inlined
+# WITHOUT calling paged_gather_kv is invisible to a spy, but not to the
+# lowering). What stays here is the tripwire proving the lane turns red
+# when the materializing gather is re-introduced.
 # ---------------------------------------------------------------------------
 
 
-def test_kernel_route_never_traces_the_materializing_gather(monkeypatch):
-    """THE tentpole's accounting: tracing + running every kernel-route
-    paged program (seeded admission, windowed decode, chunked prefill)
-    must not call paged_gather_kv even once — if the working-set
-    materialization reappears in any dispatch body, this fails. The
-    reference engine is the positive control proving the spy sees
-    traced calls."""
-    from copilot_for_consensus_tpu.ops import paged_attention as pa
+def test_reintroduced_pool_gather_turns_the_hlo_lane_red(tmp_path):
+    """Re-introduce a ``paged_gather_kv`` of the whole committed pool
+    working set into ``_decode_paged_kernel``'s body (the exact shape
+    of the pre-ISSUE-16 reference route) on a COPY of generation.py:
+    hlocheck's hlo-materialize rule must flag the lowered gather. The
+    unmutated file is the negative control — same case, same rule,
+    clean."""
+    from copilot_for_consensus_tpu.analysis import hlocheck
+    from copilot_for_consensus_tpu.engine import generation
 
-    calls = {"n": 0}
-    real = pa.paged_gather_kv
-
-    def spy(pool_k, pool_v, bids):
-        calls["n"] += 1
-        return real(pool_k, pool_v, bids)
-
-    monkeypatch.setattr(pa, "paged_gather_kv", spy)
-    params = _params()
-    rng = np.random.default_rng(4)
-    shared = rng.integers(3, CFG.vocab_size, size=70).tolist()
-    prompts = [shared + rng.integers(3, CFG.vocab_size,
-                                     size=10).tolist()
-               for _ in range(3)]
-    ker = _engine(params, "pallas", kv_pool_blocks=16,
-                  prefix_cache_blocks=8)
-    for _round in range(2):          # round 2 traces seeded admission
-        ker.generate(prompts, max_new_tokens=6)
-    assert ker.kv_pool_stats()["zero_copy_admits"] > 0
-    assert calls["n"] == 0, "kernel route materialized the pool"
-    ref = _engine(params, "reference", kv_pool_blocks=16,
-                  prefix_cache_blocks=8)
-    ref.generate(prompts, max_new_tokens=6)
-    assert calls["n"] > 0            # the spy does see traced gathers
+    gen = pathlib.Path(generation.__file__)
+    src = gen.read_text()
+    # anchor 1: the decode variant's partial_fn (the seeded/verify/
+    # chunk variants bind `lns`, so this needle is unique to decode)
+    anchor = "                    def partial_fn(li, q_rows, lengths, q_pos):\n"
+    assert src.count(anchor) == 1, "decode body moved; update the test"
+    gather = ("                    mk_ws, mv_ws = paged_gather_kv("
+              "pool_k, pool_v, tables)\n")
+    # anchor 2: decode's pool scatter (unique: only decode scatters
+    # k_all). The gathered working set must be USED — a dead gather is
+    # DCE'd before lowering and would never reach the StableHLO.
+    scatter = ("                    pool_k, pool_v = scatter_kfn(\n"
+               "                        pool_k, pool_v, k_all, v_all, "
+               "sbids, soffs)")
+    assert src.count(scatter) == 1, "decode scatter moved; update the test"
+    use = ("                    k_all = k_all + 0.0 * mk_ws"
+           "[:, :, :, :k_all.shape[3], :].astype(k_all.dtype)\n")
+    mutated = tmp_path / "generation_gather_mutated.py"
+    mutated.write_text(src.replace(anchor, gather + anchor, 1)
+                       .replace(scatter, use + scatter, 1))
+    findings, _, skips = hlocheck.check_modules(
+        [str(mutated)], labels={"decode-paged-kernel"},
+        only_rules={"hlo-materialize"})
+    assert skips == [], skips
+    assert any(f.rule == "hlo-materialize"
+               and "decode-paged-kernel" in f.context
+               for f in findings), [f.render() for f in findings]
+    clean, _, _ = hlocheck.check_modules(
+        [str(gen)], labels={"decode-paged-kernel"},
+        only_rules={"hlo-materialize"})
+    assert clean == [], [f.render() for f in clean]
 
 
 # ---------------------------------------------------------------------------

@@ -12,14 +12,16 @@ PKG = pathlib.Path(__file__).resolve().parent.parent / "copilot_for_consensus_tp
 
 # Modules allowed to touch the environment: the config layer itself, secret
 # providers, and device/mesh bootstrap (XLA flags must be set pre-init).
-# analysis/shardcheck.py is bootstrap of the same kind: it forces the CPU
-# platform + virtual device count for its analysis subprocess BEFORE jax's
-# backend initializes — it is a dev/CI tool, not a runtime service.
+# analysis/shardcheck.py and analysis/hlocheck.py are bootstrap of the same
+# kind: they force the CPU platform + virtual device count for their analysis
+# subprocess BEFORE jax's backend initializes — dev/CI tools, not runtime
+# services.
 ALLOWLIST = {
     "core/config.py",
     "security/secrets.py",
     "parallel/mesh.py",
     "analysis/shardcheck.py",
+    "analysis/hlocheck.py",
 }
 
 PATTERN = re.compile(r"os\.environ|os\.getenv")

@@ -181,6 +181,49 @@ def test_item_inside_decode_jit_fails_the_lane(tmp_path):
     assert any("_decode" in f.context for f in found), found
 
 
+def test_deleting_decode_donation_fails_the_hlo_lane(tmp_path):
+    """The post-lowering view of the same mutation: with
+    donate_argnums gone from the decode jit, the COMPILED artifact
+    carries no input_output_alias for the cache the contract still
+    declares donated — hlo-donation-alias must flag (the ast donation
+    rule sees the jit call; this sees what XLA actually kept)."""
+    from copilot_for_consensus_tpu.analysis import hlocheck
+
+    src = _GEN.read_text()
+    needle = "jax.jit(_decode, donate_argnums=(3,),"
+    assert needle in src, "decode jit signature moved; update the test"
+    mutated = tmp_path / "generation_hlo_donation_mutated.py"
+    mutated.write_text(src.replace(needle, "jax.jit(_decode,", 1))
+    findings, _, skips = hlocheck.check_modules(
+        [str(mutated)], labels={"decode"},
+        only_rules={"hlo-donation-alias"})
+    assert skips == [], skips
+    assert any(f.rule == "hlo-donation-alias" and ":decode" in f.context
+               for f in findings), [f.render() for f in findings]
+
+
+def test_widening_draft_buckets_fails_the_hlo_lane(tmp_path):
+    """Widen spec_draft_lens without touching the program-cache
+    contract's declared cardinality: the bucket cross-product lowers
+    to one more distinct program than declared — hlo-program-cache
+    must flag the drift before it ships as a retrace/program-cache
+    explosion."""
+    from copilot_for_consensus_tpu.analysis import hlocheck
+
+    src = _GEN.read_text()
+    needle = "spec_draft_lens=(0, 2, 4)"
+    assert src.count(needle) >= 1, "draft buckets moved; update the test"
+    mutated = tmp_path / "generation_hlo_buckets_mutated.py"
+    mutated.write_text(src.replace(needle, "spec_draft_lens=(0, 2, 4, 6)"))
+    findings, _, skips = hlocheck.check_modules(
+        [str(mutated)], labels={"program-cache"},
+        only_rules={"hlo-program-cache"})
+    assert skips == [], skips
+    assert any(f.rule == "hlo-program-cache"
+               and "7 declared" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
 # ---------------------------------------------------------------------------
 # baseline workflow: grandfathered findings must carry a justification;
 # matching entries silence findings; the e2e repo run is clean.

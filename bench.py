@@ -283,6 +283,26 @@ PRESETS = {
                       "BENCH_CHUNK_TOKENS": "128",
                       "BENCH_TTFT_SLO": "2.0",
                       "BENCH_ITL_SLO": "0.25"},
+    # ANN retrieval gate (ISSUE 19): one seeded clustered corpus
+    # ingested into BOTH vector-store routes — flat (the exact-scan
+    # recall oracle) and ivf (the sharded two-tier index) — then the
+    # same query set timed through each. The artifact carries
+    # recall@10 of ivf against the flat oracle, batched QPS and
+    # single-query p50/p95 per route, and lists_scanned_frac (the
+    # nprobe/nlist work-saving claim: the ivf route must answer from
+    # ≤15% of the posting lists while holding recall ≥0.95). Default
+    # corpus is the million-chunk target; the tier-1 smoke arm runs
+    # the same gate at 10k (tests/test_vectorstore_ann.py).
+    "ann_retrieval": {"BENCH_ANN_N": "1000000",
+                      "BENCH_ANN_DIM": "64",
+                      "BENCH_ANN_CLUSTERS": "1024",
+                      "BENCH_ANN_QUERIES": "256",
+                      "BENCH_ANN_BATCH": "64",
+                      "BENCH_ANN_TOPK": "10",
+                      "BENCH_ANN_NLIST": "0",
+                      "BENCH_ANN_NPROBE": "16",
+                      "BENCH_ANN_MESH": "none",
+                      "BENCH_ANN_SEED": "0"},
 }
 
 
@@ -328,6 +348,11 @@ PRESET_CONTRACT_MODULES = {
     "multichip_serving": ["copilot_for_consensus_tpu.engine.generation",
                           "copilot_for_consensus_tpu.parallel.mesh",
                           "copilot_for_consensus_tpu.parallel.sharding"],
+    # the vectorstore contract declares the fused ivf search dispatch
+    # (peak-memory budget; zero-collective budget on the mesh-sharded
+    # variant), the donated spill/posting-list patch programs, and the
+    # pow2 k-bucketed flat query program-cache family
+    "ann_retrieval": ["copilot_for_consensus_tpu.vectorstore.tpu"],
 }
 
 
@@ -496,6 +521,36 @@ def multichip_columns(scaling: dict, disagg: dict) -> dict:
     }
 
 
+def ann_columns(corpus_size: int, recall_at_10: float,
+                flat: dict, ivf: dict) -> dict:
+    """ann_retrieval columns: the cross-round contract
+    (tests/test_bench.py). ``flat``/``ivf`` are per-route result dicts
+    ({"qps", "p50_ms", "p95_ms"} — ivf additionally carries the
+    last_query_stats fields "lists_scanned_frac"/"spill_fraction" and
+    the index shape "nlist"/"nprobe"). ``ann_ok`` is the gate the
+    tentpole claims: approximate recall ≥0.95 against the exact-scan
+    oracle while touching ≤15% of the posting lists, at higher QPS."""
+    return {
+        "corpus_size": int(corpus_size),
+        "recall_at_10": round(float(recall_at_10), 4),
+        "flat_qps": round(float(flat.get("qps", 0.0)), 2),
+        "ivf_qps": round(float(ivf.get("qps", 0.0)), 2),
+        "flat_query_p50_ms": round(float(flat.get("p50_ms", 0.0)), 3),
+        "flat_query_p95_ms": round(float(flat.get("p95_ms", 0.0)), 3),
+        "ivf_query_p50_ms": round(float(ivf.get("p50_ms", 0.0)), 3),
+        "ivf_query_p95_ms": round(float(ivf.get("p95_ms", 0.0)), 3),
+        "lists_scanned_frac": round(
+            float(ivf.get("lists_scanned_frac", 1.0)), 4),
+        "spill_fraction": round(float(ivf.get("spill_fraction", 0.0)), 4),
+        "nlist": int(ivf.get("nlist", 0)),
+        "nprobe": int(ivf.get("nprobe", 0)),
+        "ann_ok": bool(
+            float(recall_at_10) >= 0.95
+            and float(ivf.get("lists_scanned_frac", 1.0)) <= 0.15
+            and float(ivf.get("qps", 0.0)) > float(flat.get("qps", 0.0))),
+    }
+
+
 def telemetry_columns(eng, last_n: int | None = None) -> dict:
     """Flight-recorder latency columns (engine/telemetry.py), sourced
     from the engine's OWN request spans and step records instead of
@@ -592,7 +647,7 @@ def shardcheck_preflight() -> dict | None:
 #: already traces them, and compiling is the expensive half.
 HLO_PREFLIGHT_PRESETS = frozenset(
     {"paged_capacity", "multichip_serving", "decode_heavy",
-     "spec_decode"})
+     "spec_decode", "ann_retrieval"})
 
 
 def hlocheck_preflight() -> dict | None:
@@ -1090,6 +1145,137 @@ def mixed_traffic_headline() -> dict:
         "completed_on": on["completed"],
         "completed_off": off["completed"],
         "chunk_dispatches": on["sched"].get("chunk_dispatches", 0),
+    }
+
+
+# -- ANN retrieval gate (vectorstore/tpu.py + vectorstore/ivf.py) -------
+
+def ann_retrieval_headline() -> dict:
+    """Two vector-store routes over ONE seeded clustered corpus: flat
+    (exact scan — the recall oracle) and ivf (two-tier sharded index).
+    Both ingest the same vectors, answer the same queries; the artifact
+    gates the tentpole claim — recall@10 ≥ 0.95 against the oracle
+    while scanning ≤ 15% of the posting lists, at higher QPS. The ivf
+    warmup batch is timed separately as ``index_build_s`` because the
+    coarse quantizer trains lazily on the first query
+    (vectorstore/ivf.py retrain policy), not during ingest — ingest
+    must never block on a k-means fit."""
+    import numpy as np
+
+    from copilot_for_consensus_tpu.vectorstore.tpu import TPUVectorStore
+
+    preset_vals = PRESETS["ann_retrieval"]
+
+    def knob(name: str, default: str) -> str:
+        return os.environ.get(name, preset_vals.get(name, default))
+
+    n = int(knob("BENCH_ANN_N", "1000000"))
+    dim = int(knob("BENCH_ANN_DIM", "64"))
+    clusters = int(knob("BENCH_ANN_CLUSTERS", "1024"))
+    n_queries = int(knob("BENCH_ANN_QUERIES", "256"))
+    batch = int(knob("BENCH_ANN_BATCH", "64"))
+    top_k = int(knob("BENCH_ANN_TOPK", "10"))
+    nlist = int(knob("BENCH_ANN_NLIST", "0"))
+    nprobe = int(knob("BENCH_ANN_NPROBE", "16"))
+    mesh_cfg = knob("BENCH_ANN_MESH", "none")
+    seed = int(knob("BENCH_ANN_SEED", "0"))
+
+    # Clustered synthetic corpus — the shape real chunk embeddings
+    # have (mailing-list threads cluster by topic), and the shape IVF
+    # exists for. Queries draw from the SAME cluster mixture, so the
+    # oracle's true neighbors concentrate in few posting lists.
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((clusters, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    noise = 0.15
+
+    def draw(count: int) -> np.ndarray:
+        which = rng.integers(0, clusters, size=count)
+        return (centers[which] + noise * rng.standard_normal(
+            (count, dim), dtype=np.float32))
+
+    corpus = draw(n)
+    queries = draw(n_queries)
+
+    def build(index_kind: str):
+        cfg: dict = {"dimension": dim, "index": index_kind}
+        if index_kind == "ivf":
+            cfg["mesh"] = (mesh_cfg if mesh_cfg in ("none", "auto")
+                           else int(mesh_cfg))
+            cfg["ivf_nprobe"] = nprobe
+            if nlist:
+                cfg["ivf_nlist"] = nlist
+        store = TPUVectorStore(cfg)
+        t0 = time.perf_counter()
+        store.add_embeddings(
+            (str(i), corpus[i], None) for i in range(n))
+        return store, time.perf_counter() - t0
+
+    def run_route(store) -> dict:
+        # Warmup batch OUTSIDE the timed window: compiles the search
+        # programs, and on the ivf route trains the coarse quantizer.
+        t0 = time.perf_counter()
+        store.query_batch(list(queries[:min(batch, n_queries)]),
+                          top_k=top_k)
+        warm_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        results = []
+        for s in range(0, n_queries, batch):
+            results.extend(store.query_batch(
+                list(queries[s:s + batch]), top_k=top_k))
+        qps = n_queries / max(time.perf_counter() - t0, 1e-9)
+        lat = []
+        for q in queries[:min(64, n_queries)]:
+            t1 = time.perf_counter()
+            store.query(q, top_k=top_k)
+            lat.append((time.perf_counter() - t1) * 1e3)
+        lat.sort()
+        stats = dict(store.last_query_stats or {})
+        return {
+            "ids": [[h.id for h in hits] for hits in results],
+            "qps": qps,
+            "p50_ms": lat[len(lat) // 2],
+            "p95_ms": lat[min(len(lat) - 1, int(0.95 * len(lat)))],
+            "warm_s": warm_s,
+            **{k: stats[k] for k in ("lists_scanned_frac",
+                                     "spill_fraction") if k in stats},
+        }
+
+    log(f"ann_retrieval: ingesting {n} x {dim} into flat route")
+    flat_store, flat_ingest_s = build("flat")
+    log("ann_retrieval: flat route (exact oracle)")
+    flat = run_route(flat_store)
+    flat_store.close()
+    log(f"ann_retrieval: ingesting {n} x {dim} into ivf route")
+    ivf_store, ivf_ingest_s = build("ivf")
+    log("ann_retrieval: ivf route")
+    ivf = run_route(ivf_store)
+    ivf.update(nlist=getattr(ivf_store._ivf, "nlist", 0) or 0,
+               nprobe=nprobe)
+
+    recalls = [len(set(a) & set(b)) / max(len(b), 1)
+               for a, b in zip(ivf["ids"], flat["ids"]) if b]
+    recall = float(np.mean(recalls)) if recalls else 0.0
+    cols = ann_columns(n, recall, flat, ivf)
+    ivf_store.close()
+    log(f"ann_retrieval: recall@{top_k} {cols['recall_at_10']} "
+        f"lists_scanned_frac {cols['lists_scanned_frac']} "
+        f"qps ivf {cols['ivf_qps']} vs flat {cols['flat_qps']}")
+    return {
+        "metric": f"ANN retrieval recall@{top_k} vs exact scan "
+                  f"({n}-vector corpus, {cols['nlist']}-list ivf, "
+                  f"nprobe {nprobe})",
+        "value": cols["recall_at_10"],
+        "unit": f"recall@{top_k}",
+        # the speedup the approximate route buys at this recall
+        "vs_baseline": round(cols["ivf_qps"]
+                             / max(cols["flat_qps"], 1e-9), 3),
+        **cols,
+        "index_build_s": round(ivf["warm_s"], 3),
+        "flat_ingest_s": round(flat_ingest_s, 3),
+        "ivf_ingest_s": round(ivf_ingest_s, 3),
+        "queries": n_queries,
+        "dim": dim,
     }
 
 
@@ -2305,6 +2491,10 @@ def headline() -> dict:
     if os.environ.get("BENCH_PRESET", "") == "chaos":
         # The resilience gate is a two-arm fault-injection run.
         return chaos_headline()
+    if os.environ.get("BENCH_PRESET", "") == "ann_retrieval":
+        # The retrieval gate times two vector-store routes over one
+        # corpus — no generation engine at all.
+        return ann_retrieval_headline()
 
     # Preset values fill in behind explicit env vars WITHOUT mutating
     # os.environ — extra_rows() children inherit this process's env, so

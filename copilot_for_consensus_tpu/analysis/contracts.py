@@ -84,6 +84,7 @@ HLO_CONTRACT_MODULES = (
     "copilot_for_consensus_tpu.engine.prefix_cache",
     "copilot_for_consensus_tpu.engine.roles",
     "copilot_for_consensus_tpu.ops.paged_attention",
+    "copilot_for_consensus_tpu.vectorstore.tpu",
 )
 
 

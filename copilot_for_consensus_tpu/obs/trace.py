@@ -56,7 +56,7 @@ TRACE_KEY = "trace"
 #: span kinds the pipeline emits (doc + test anchor). ``stage`` spans
 #: are the only ones that land in the stage latency histograms.
 SPAN_KINDS = ("publish", "stage", "store_write", "vector_upsert",
-              "engine_submit", "engine_replay")
+              "retrieval", "engine_submit", "engine_replay")
 
 # ---------------------------------------------------------------------------
 # Metric registry — what the tracing layer emits, in the

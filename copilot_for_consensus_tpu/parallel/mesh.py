@@ -82,6 +82,15 @@ def local_mesh(tp: int | None = None) -> Mesh:
     return build_mesh(MeshConfig(dp=n // t, tp=t))
 
 
+def retrieval_mesh(n_devices: int | None = None) -> Mesh:
+    """ANN retrieval plane (vectorstore/ivf.py): dp-only mesh — every
+    device owns one posting-list shard, no tp axis because the
+    candidate rescore is a shard-local matvec with a host top-k merge
+    (no collectives in the search dispatch, by contract)."""
+    n = n_devices if n_devices is not None else len(jax.devices())
+    return build_mesh(MeshConfig(dp=n, tp=1), devices=jax.devices()[:n])
+
+
 def largest_pow2_leq(n: int) -> int:
     return 1 << (n.bit_length() - 1) if n else 1
 

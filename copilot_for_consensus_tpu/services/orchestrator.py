@@ -172,8 +172,20 @@ class OrchestrationService(BaseService):
         tid = thread["thread_id"]
         qvec = self._query_vector(thread)
         if self.vector_store is not None and qvec is not None:
-            hits = self.vector_store.query(
-                qvec, top_k=pool, flt={"thread_id": tid})
+            # top-k context selection is a first-class traced stage:
+            # the span carries the store's route/nprobe/lists-scanned
+            # stats so tracepath can attribute retrieval latency to
+            # the index configuration, not just "orchestrator time"
+            from copilot_for_consensus_tpu.obs import trace
+            with trace.child_span("retrieval", "vector_topk",
+                                  thread_id=tid, top_k=pool) as sp:
+                hits = self.vector_store.query(
+                    qvec, top_k=pool, flt={"thread_id": tid})
+                stats = getattr(self.vector_store,
+                                "last_query_stats", None)
+                if stats:
+                    sp.attrs.update(stats)
+                sp.attrs["hits"] = len(hits)
             if hits:
                 by_id = {
                     d["chunk_id"]: d for d in self.store.query_documents(

@@ -481,6 +481,11 @@ def build_pipeline(config: Mapping[str, Any] | None = None) -> Pipeline:
         metrics = create_metrics_collector(cfg["metrics"])
     else:
         metrics = InMemoryMetrics()
+    # Retrieval telemetry: the store emits vectorstore_query_* series
+    # into the same collector the services use. set_metrics forwards
+    # through the tracing/fault wrappers (__getattr__ passthrough);
+    # drivers without native metrics inherit the base no-op.
+    vector_store.set_metrics(metrics)
     if cfg.get("logger"):
         # e.g. {"driver": "shipping", "host": "logstore", "port": 5140}
         # — tees JSON records to the logstore so "query by correlation

@@ -30,6 +30,11 @@ class VectorStore(abc.ABC):
     def close(self) -> None:
         pass
 
+    def set_metrics(self, collector: Any) -> None:
+        """Wire a MetricsCollector so the store can emit retrieval
+        telemetry (``vectorstore_*`` series). Default: drop it —
+        drivers without native metrics stay silent."""
+
     @abc.abstractmethod
     def add_embedding(self, vec_id: str, vector: Sequence[float],
                       metadata: Mapping[str, Any] | None = None) -> None: ...

@@ -516,3 +516,58 @@ def test_unknown_kv_kernel_fails_loudly():
     assert artifact["ok"] is False
     assert "BENCH_KV_KERNEL" in artifact["reason"]
     assert "pallass" in artifact["reason"]
+
+
+def test_ann_retrieval_preset_registered():
+    """ISSUE 19: the ANN retrieval gate — million-vector default
+    corpus, auto-sized index (nlist=0), a probe budget that keeps
+    lists_scanned_frac well under the 0.15 ceiling, and preflights
+    that trace + compile the vectorstore contract family (the fused
+    search dispatch carries an hlo peak/collective budget)."""
+    assert "ann_retrieval" in bench.PRESETS
+    p = bench.PRESETS["ann_retrieval"]
+    assert int(p["BENCH_ANN_N"]) == 1_000_000
+    assert int(p["BENCH_ANN_TOPK"]) == 10
+    assert int(p["BENCH_ANN_NLIST"]) == 0        # auto: ~sqrt(n)
+    # at auto nlist for 1M (1024 lists), the preset's nprobe must sit
+    # under the 15% scanned-lists ceiling the artifact gates on
+    assert int(p["BENCH_ANN_NPROBE"]) / 1024 <= 0.15
+    mods = bench.PRESET_CONTRACT_MODULES["ann_retrieval"]
+    assert "copilot_for_consensus_tpu.vectorstore.tpu" in mods
+    # the ivf search dispatch declares compiled-artifact budgets, so
+    # the preset must run the hlocheck preflight, not just shardcheck
+    assert "ann_retrieval" in bench.HLO_PREFLIGHT_PRESETS
+    from copilot_for_consensus_tpu.analysis.contracts import (
+        HLO_CONTRACT_MODULES,
+    )
+    assert "copilot_for_consensus_tpu.vectorstore.tpu" in (
+        HLO_CONTRACT_MODULES)
+
+
+def test_ann_columns_contract():
+    """The ann_retrieval artifact columns are a cross-round contract:
+    recall/QPS/latency per route plus the scanned-lists fraction, and
+    the ann_ok gate = recall >= 0.95 AND frac <= 0.15 AND ivf faster."""
+    flat = {"qps": 120.0, "p50_ms": 8.0, "p95_ms": 11.0}
+    ivf = {"qps": 900.0, "p50_ms": 1.1, "p95_ms": 1.9,
+           "lists_scanned_frac": 0.0156, "spill_fraction": 0.01,
+           "nlist": 1024, "nprobe": 16}
+    cols = bench.ann_columns(1_000_000, 0.973, flat, ivf)
+    assert set(cols) >= {"corpus_size", "recall_at_10", "flat_qps",
+                         "ivf_qps", "flat_query_p50_ms",
+                         "flat_query_p95_ms", "ivf_query_p50_ms",
+                         "ivf_query_p95_ms", "lists_scanned_frac",
+                         "spill_fraction", "nlist", "nprobe", "ann_ok"}
+    assert cols["recall_at_10"] == 0.973
+    assert cols["ivf_qps"] == 900.0
+    assert cols["lists_scanned_frac"] == 0.0156
+    assert cols["ann_ok"] is True
+    # each gate leg flips it independently
+    assert not bench.ann_columns(10, 0.90, flat, ivf)["ann_ok"]
+    assert not bench.ann_columns(
+        10, 0.99, flat, {**ivf, "lists_scanned_frac": 0.5})["ann_ok"]
+    assert not bench.ann_columns(
+        10, 0.99, flat, {**ivf, "qps": 50.0})["ann_ok"]
+    # degenerate empty dicts stay well-formed (failed arm)
+    empty = bench.ann_columns(0, 0.0, {}, {})
+    assert empty["ann_ok"] is False and empty["nlist"] == 0

@@ -91,6 +91,14 @@ from copilot_for_consensus_tpu.services.lifecycle import (  # noqa: E402
 )
 
 KNOWN_SERIES |= set(LIFECYCLE_METRICS)
+
+# Retrieval series (vectorstore/tpu.py) — query latency/route counters,
+# ivf probe/spill gauges — same registry-next-to-emitter discipline.
+from copilot_for_consensus_tpu.vectorstore.tpu import (  # noqa: E402
+    VECTORSTORE_METRICS,
+)
+
+KNOWN_SERIES |= set(VECTORSTORE_METRICS)
 # [a-z0-9_]: engine series contain digits (engine_e2e_seconds)
 _SERIES_RE = re.compile(r"\b(copilot_[a-z0-9_]+|up|push_time_seconds)\b")
 

@@ -704,3 +704,57 @@ def test_dead_letter_listing_surfaces_correlation_and_trace_ids():
         sub.close()
     finally:
         broker.stop()
+
+
+def test_orchestrator_retrieval_span_carries_index_stats():
+    """Top-k context selection is a first-class traced stage (ISSUE
+    19): the orchestrator's retrieval span carries the vector store's
+    last_query_stats (route / nprobe / lists_scanned_frac) so
+    tracepath can attribute retrieval latency to the index
+    configuration, not just "orchestrator time"."""
+    from copilot_for_consensus_tpu.services.orchestrator import (
+        OrchestrationService,
+    )
+
+    class Hit:
+        def __init__(self, i):
+            self.id = f"c{i}"
+            self.score = 0.9 - 0.1 * i
+
+    class StubVS:
+        last_query_stats = None
+
+        def query(self, vec, top_k=10, flt=None):
+            self.last_query_stats = {
+                "route": "ivf", "queries": 1, "nprobe": 8,
+                "lists_scanned_frac": 0.0625}
+            return [Hit(i) for i in range(3)]
+
+    class StubEmb:
+        def embed(self, text):
+            return [0.1] * 8
+
+    class StubStore:
+        def query_documents(self, coll, q, sort=None, limit=None):
+            if "chunk_id" in q:
+                return [{"chunk_id": f"c{i}", "thread_id": "t1",
+                         "text": f"chunk {i}", "message_doc_id": "m",
+                         "token_count": 3} for i in range(3)]
+            return [{"chunk_id": "c0", "thread_id": "t1",
+                     "text": "body", "seq": 0}]
+
+    svc = OrchestrationService(object(), StubStore(),
+                               vector_store=StubVS(),
+                               embedding_provider=StubEmb())
+    cands = svc._retrieve_context({"thread_id": "t1",
+                                   "subject": "consensus"})
+    assert [c.chunk_id for c in cands] == ["c0", "c1", "c2"]
+    spans = [s for s in trace.get_collector().spans()
+             if s.kind == "retrieval"]
+    assert len(spans) == 1
+    sp = spans[0]
+    assert sp.name == "vector_topk"
+    assert sp.attrs["route"] == "ivf"
+    assert sp.attrs["nprobe"] == 8
+    assert sp.attrs["lists_scanned_frac"] == 0.0625
+    assert sp.attrs["hits"] == 3

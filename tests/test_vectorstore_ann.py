@@ -1,0 +1,266 @@
+# ANN (IVF) retrieval vs the flat exact-scan oracle (ISSUE 19).
+#
+# The tier-1 lane carries the RECALL GATE the bench preset claims at
+# million scale — same clustered geometry, 10k vectors so the fast lane
+# stays fast — plus the index invariants (locator coverage across
+# retrain/upsert/delete, filtered parity, persistence). The
+# million-vector arm lives behind @slow next to the full bench preset.
+import numpy as np
+import pytest
+
+from copilot_for_consensus_tpu.vectorstore.ivf import (
+    IVFParams,
+    ListShardAllocator,
+    next_pow2,
+)
+from copilot_for_consensus_tpu.vectorstore.tpu import TPUVectorStore
+
+DIM = 32
+
+
+def _clustered(n, clusters, dim=DIM, seed=0, noise=0.15):
+    """Same corpus geometry as BENCH_PRESET=ann_retrieval: cluster
+    centers on the unit sphere, members center + gaussian noise."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((clusters, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    which = rng.integers(0, clusters, size=n)
+    pts = centers[which] + noise * rng.standard_normal(
+        (n, dim), dtype=np.float32)
+    return pts, centers, rng
+
+
+def _fill(store, vecs, meta=None):
+    store.add_embeddings(
+        (f"v{i}", vecs[i], (meta(i) if meta else None))
+        for i in range(len(vecs)))
+
+
+def _ids(hits):
+    return [h.id for h in hits]
+
+
+def _recall(store_ivf, store_flat, queries, top_k=10):
+    approx = store_ivf.query_batch(list(queries), top_k=top_k)
+    exact = store_flat.query_batch(list(queries), top_k=top_k)
+    return float(np.mean([
+        len(set(_ids(a)) & set(_ids(e))) / max(len(e), 1)
+        for a, e in zip(approx, exact) if e]))
+
+
+# -- jax-free unit surface ----------------------------------------------
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (0, 1, 2, 3, 8, 9, 1000)] == \
+        [1, 1, 2, 4, 8, 16, 1024]
+
+
+def test_ivf_params_from_config():
+    p = IVFParams.from_config({"ivf_nlist": 64, "ivf_nprobe": 4,
+                               "ivf_min_train": 16})
+    assert (p.nlist, p.nprobe, p.min_train) == (64, 4, 16)
+    d = IVFParams.from_config({})
+    assert d.nlist == 0 and d.nprobe >= 1 and d.min_train > 0
+
+
+def test_allocator_balances_and_places_every_list():
+    """LPT placement: every list gets exactly one slot inside its
+    shard's slot range, and the heaviest/lightest shard row totals stay
+    within one max-list of each other (greedy LPT bound)."""
+    rng = np.random.default_rng(3)
+    sizes = rng.integers(1, 1000, size=37)
+    alloc = ListShardAllocator(num_shards=8, nlist=37)
+    slot_of_list = alloc.assign(sizes)
+    assert sorted(set(slot_of_list)) == sorted(slot_of_list)  # unique
+    sps = alloc.slots_per_shard
+    assert sps * 8 >= 37
+    load = np.zeros(8)
+    for l, slot in enumerate(slot_of_list):
+        shard = slot // sps
+        assert 0 <= slot - shard * sps < sps
+        load[shard] += sizes[l]
+    assert load.max() - load.min() <= sizes.max()
+
+
+def test_retrain_policy():
+    from copilot_for_consensus_tpu.vectorstore.ivf import IVFIndex
+    idx = IVFIndex(DIM, IVFParams(min_train=100, spill_fraction=0.25,
+                                  growth_factor=2.0))
+    assert not idx.needs_retrain(99)        # untrained, too small
+    assert idx.needs_retrain(100)           # untrained, enough rows
+    idx.trained = True
+    idx.trained_at_n = 100
+    idx._indexed_live = 100
+    assert not idx.needs_retrain(110)       # no drift
+    assert idx.needs_retrain(200)           # corpus doubled
+    idx._spill_live = 50                    # spill_frac 1/3 > 0.25
+    assert idx.needs_retrain(150)
+
+
+# -- recall gate (the bench preset's claim, tier-1 scale) ---------------
+
+def _pair(n, clusters, seed=0, *, nprobe, nlist=0, min_train=256):
+    vecs, centers, rng = _clustered(n, clusters, seed=seed)
+    flat = TPUVectorStore({"dimension": DIM})
+    ivf = TPUVectorStore({"dimension": DIM, "index": "ivf",
+                          "ivf_nprobe": nprobe, "ivf_nlist": nlist,
+                          "ivf_min_train": min_train})
+    _fill(flat, vecs)
+    _fill(ivf, vecs)
+    return flat, ivf, centers, rng
+
+
+def test_recall_gate_clustered_10k():
+    """The tentpole gate at tier-1 scale: recall@10 >= 0.95 against
+    the exact oracle while scanning <= 15% of the posting lists."""
+    flat, ivf, centers, rng = _pair(10_000, 64, nprobe=16)
+    queries = (centers[rng.integers(0, 64, size=32)]
+               + 0.15 * rng.standard_normal((32, DIM), dtype=np.float32))
+    recall = _recall(ivf, flat, queries)
+    stats = ivf.last_query_stats
+    assert stats["route"] == "ivf"
+    assert recall >= 0.95, recall
+    assert stats["lists_scanned_frac"] <= 0.15, stats
+
+
+def test_uniform_corpus_full_probe_is_exact():
+    """Adversarial uniform corpus (no cluster structure to exploit):
+    probing EVERY list must reproduce the exact scan identically —
+    the approximation error comes only from skipped lists, never from
+    the fused gather/rescore path itself."""
+    rng = np.random.default_rng(7)
+    vecs = rng.standard_normal((1500, DIM), dtype=np.float32)
+    flat = TPUVectorStore({"dimension": DIM})
+    ivf = TPUVectorStore({"dimension": DIM, "index": "ivf",
+                          "ivf_nlist": 16, "ivf_nprobe": 16,
+                          "ivf_min_train": 64})
+    _fill(flat, vecs)
+    _fill(ivf, vecs)
+    queries = rng.standard_normal((16, DIM), dtype=np.float32)
+    assert _recall(ivf, flat, queries) == 1.0
+
+
+def test_filtered_query_parity():
+    """Metadata-filtered retrieval must agree between routes — the ivf
+    route falls back rather than return an under-filled filtered set."""
+    vecs, _, rng = _clustered(2000, 16, seed=11)
+    meta = lambda i: {"thread_id": f"t{i % 7}"}          # noqa: E731
+    flat = TPUVectorStore({"dimension": DIM})
+    ivf = TPUVectorStore({"dimension": DIM, "index": "ivf",
+                          "ivf_nprobe": 4, "ivf_min_train": 128})
+    _fill(flat, vecs, meta)
+    _fill(ivf, vecs, meta)
+    q = vecs[42]
+    ivf.query(q, top_k=5)                  # trigger training
+    got = ivf.query(q, top_k=5, flt={"thread_id": "t3"})
+    want = flat.query(q, top_k=5, flt={"thread_id": "t3"})
+    assert _ids(got) == _ids(want)
+    assert all(h.metadata["thread_id"] == "t3" for h in got)
+
+
+# -- index invariants ---------------------------------------------------
+
+def test_upsert_delete_retrain_invariants():
+    """Across train → upsert → delete, the index locator must cover
+    every live row EXACTLY once (posting lists + spill, no dupes, no
+    orphans), and queries must see upserts/deletes immediately."""
+    vecs, _, rng = _clustered(600, 8, seed=5)
+    store = TPUVectorStore({"dimension": DIM, "index": "ivf",
+                            "ivf_nlist": 8, "ivf_nprobe": 8,
+                            "ivf_min_train": 64})
+    _fill(store, vecs)
+    store.query(vecs[0], top_k=1)          # train
+    ivf = store._ivf
+    assert ivf.trained
+
+    def live_rows():
+        return {r for r in range(len(store._ids))
+                if r not in store._deleted_rows}
+
+    assert set(ivf._locator) == live_rows()
+    assert ivf.live_count == len(live_rows())
+
+    # upsert an existing id with a brand-new direction: the spill
+    # catches it without retraining, and search finds it first
+    probe = np.zeros(DIM, dtype=np.float32)
+    probe[DIM - 1] = 1.0
+    store.add_embedding("v7", probe, None)
+    assert _ids(store.query(probe, top_k=1)) == ["v7"]
+    assert set(ivf._locator) == live_rows()
+
+    # batched delete drops the rows from the index and from results
+    store.delete([f"v{i}" for i in range(20)])
+    assert store.count() == 580
+    assert set(ivf._locator) == live_rows()
+    hits = store.query(vecs[3], top_k=10)
+    assert not set(_ids(hits)) & {f"v{i}" for i in range(20)}
+
+
+def test_persistence_roundtrip_preserves_trained_index(tmp_path):
+    vecs, _, rng = _clustered(400, 8, seed=9)
+    path = str(tmp_path / "store.npz")
+    store = TPUVectorStore({"dimension": DIM, "index": "ivf",
+                            "ivf_nlist": 8, "ivf_nprobe": 8,
+                            "ivf_min_train": 64, "persist_path": path})
+    _fill(store, vecs)
+    want = _ids(store.query(vecs[5], top_k=5))   # trains + answers
+    gen = store._ivf.generation
+    store.save()
+
+    again = TPUVectorStore({"dimension": DIM, "index": "ivf",
+                            "ivf_nlist": 8, "ivf_nprobe": 8,
+                            "ivf_min_train": 64, "persist_path": path})
+    assert again.load() == 400
+    # restored index is ALREADY trained from the saved centroids — the
+    # first query must answer from it, not kick off a k-means fit
+    assert again._ivf is not None and again._ivf.trained
+    assert _ids(again.query(vecs[5], top_k=5)) == want
+    assert again._ivf.generation == gen
+    assert again.last_query_stats["route"] == "ivf"
+
+
+def test_bulk_load_does_not_reingest_per_row(tmp_path, monkeypatch):
+    """load() restores via ONE device upload; a per-row add_embedding
+    loop (the old path) would re-pay normalization + device sync per
+    vector at million scale."""
+    vecs, _, _ = _clustered(100, 4, seed=13)
+    path = str(tmp_path / "store.npz")
+    store = TPUVectorStore({"dimension": DIM, "persist_path": path})
+    _fill(store, vecs)
+    store.save()
+
+    again = TPUVectorStore({"dimension": DIM, "persist_path": path})
+    def boom(*a, **k):
+        raise AssertionError("load() must not ingest row-by-row")
+    monkeypatch.setattr(again, "add_embedding", boom)
+    monkeypatch.setattr(again, "add_embeddings", boom)
+    assert again.load() == 100
+    assert _ids(again.query(vecs[17], top_k=1)) == ["v17"]
+
+
+def test_topk_bucketing_stays_correct_at_odd_k():
+    """query top_k values between pow2 buckets share device programs
+    (the hlo program-cache contract); correctness must not depend on
+    the requested k landing on a bucket boundary."""
+    vecs, _, rng = _clustered(500, 8, seed=17)
+    flat = TPUVectorStore({"dimension": DIM})
+    _fill(flat, vecs)
+    q = rng.standard_normal(DIM).astype(np.float32)
+    full = _ids(flat.query(q, top_k=16))
+    for k in (1, 3, 7, 11, 13):
+        assert _ids(flat.query(q, top_k=k)) == full[:k]
+
+
+# -- million-vector arm (bench-preset scale) ----------------------------
+
+@pytest.mark.slow
+def test_recall_gate_clustered_1m():
+    flat, ivf, centers, rng = _pair(
+        1_000_000, 1024, nprobe=16, min_train=65536)
+    queries = (centers[rng.integers(0, 1024, size=64)]
+               + 0.15 * rng.standard_normal((64, DIM),
+                                            dtype=np.float32))
+    recall = _recall(ivf, flat, queries)
+    stats = ivf.last_query_stats
+    assert recall >= 0.95, recall
+    assert stats["lists_scanned_frac"] <= 0.15, stats

@@ -177,6 +177,10 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("tracepath", help="pipeline trace critical-path "
                    "analyzer (bottleneck stage)", add_help=False)
 
+    sub.add_parser("slo", help="SLO scoreboard over telemetry spools "
+                   "(merged registries, error-budget burn)",
+                   add_help=False)
+
     for name, hlp in (("export-data", "dump all collections to JSONL"),
                       ("import-data", "load a JSONL dump")):
         mig = sub.add_parser(name, help=hlp)
@@ -217,6 +221,10 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         return tp_main(argv[1:])
+    if argv and argv[0] == "slo":
+        from copilot_for_consensus_tpu.obs.slo import main as slo_main
+
+        return slo_main(argv[1:])
 
     args = ap.parse_args(argv)
     if args.cmd == "serve":

@@ -54,6 +54,7 @@ from typing import Any
 from copilot_for_consensus_tpu.obs.metrics import (
     InMemoryMetrics,
     MetricsCollector,
+    check_registry_labels,
 )
 
 # ---------------------------------------------------------------------------
@@ -206,10 +207,11 @@ METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
     # ---- disaggregated prefill/decode roles (engine/roles.py +
     # GenerationEngine(role=...); docs/PERF.md#multi-chip-serving) ----
     "engine_role_occupancy": (
-        "gauge", ("engine", "role"),
+        "gauge", ("engine", "engine_role"),
         "Occupied slots / total slots per role instance (active + "
         "chunking + handoff-parked) — the prefill/decode split's "
-        "saturation view."),
+        "saturation view. Label is engine_role (not role): role is "
+        "reserved for the cross-process aggregator's stamp."),
     "engine_role_handoff_blocks_total": (
         "counter", ("engine",),
         "KV pool blocks moved through the prefill→decode handoff "
@@ -237,6 +239,10 @@ METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "checkpointed to the journal — the tokens a crash right now "
         "would recompute."),
 }
+
+# Registration-time contract: reserved proc/role labels collide here,
+# loudly, not at scrape time when the aggregator stamps them.
+check_registry_labels(METRICS, owner="ENGINE_METRICS")
 
 #: step-record kinds the engines emit (doc + test anchor)
 STEP_KINDS = ("prefill", "prefill_seeded", "prefill_chunk", "decode",
@@ -610,8 +616,10 @@ class EngineTelemetry:
     # -- disaggregated roles (engine/roles.py) --------------------------
 
     def gauge_role_occupancy(self, role: str, occupancy: float) -> None:
+        # engine_role, not role: the bare label is reserved for the
+        # cross-process aggregator's proc/role stamp (obs/ship.py).
         self.metrics.gauge("engine_role_occupancy", float(occupancy),
-                           {**self._labels, "role": role or "both"})
+                           {**self._labels, "engine_role": role or "both"})
 
     def on_handoff(self, blocks: int, wait_s: float) -> None:
         """One prefill→decode KV handoff completed: ``blocks`` pool

@@ -19,6 +19,31 @@ from typing import Any, Iterable
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                    10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
+# Labels the cross-process aggregator (obs/ship.py) stamps onto every
+# merged series. A registry that declares them for its own use would be
+# silently shadowed at merge time, so registration rejects them up front.
+RESERVED_LABELS = ("proc", "role")
+
+
+def check_registry_labels(registry: dict, owner: str = "") -> dict:
+    """Validate a metric registry's declared labels at registration time.
+
+    ``registry`` maps series name -> (type, labels-tuple, help). Raises
+    ``ValueError`` if any series declares a label in ``RESERVED_LABELS``
+    — the collision must be loud at import, not at scrape time when the
+    aggregator stamps ``proc``/``role`` over it. Returns the registry so
+    declarations can be wrapped in-place.
+    """
+    for name, (_typ, labels, _help) in registry.items():
+        clash = [lb for lb in labels if lb in RESERVED_LABELS]
+        if clash:
+            raise ValueError(
+                f"metric registry {owner or '<anonymous>'!s} declares "
+                f"reserved label(s) {clash} on series {name!r}; "
+                f"{RESERVED_LABELS} are stamped by the telemetry "
+                f"aggregator and may not be declared by a registry")
+    return registry
+
 
 def _label_key(labels: dict[str, str] | None) -> tuple:
     return tuple(sorted((labels or {}).items()))
@@ -89,7 +114,46 @@ class InMemoryMetrics(MetricsCollector):
                 if value <= bound:
                     entry[2][i] += 1
 
+    def merge_histogram(self, name, labels, dsum, dcount, dbuckets):
+        """Merge a pre-bucketed histogram delta into this collector.
+
+        Used by the cross-process aggregator: a shipped spool row carries
+        ``(sum, count, cumulative-bucket-counts)`` deltas that must add
+        element-wise rather than re-observe (the raw samples are gone).
+        ``dbuckets`` must be cumulative counts over ``self.buckets``.
+        """
+        if len(dbuckets) != len(self.buckets):
+            raise ValueError(
+                f"histogram {name!r}: bucket layout mismatch "
+                f"({len(dbuckets)} vs {len(self.buckets)} bounds)")
+        with self._lock:
+            series = self.histograms.setdefault(name, {})
+            key = _label_key(labels)
+            if key not in series:
+                series[key] = [0.0, 0, [0] * len(self.buckets)]
+            entry = series[key]
+            entry[0] += dsum
+            entry[1] += dcount
+            for i, dc in enumerate(dbuckets):
+                entry[2][i] += dc
+
     # -- accessors ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Deep-copied, lock-consistent view of all series.
+
+        The shipper diffs successive snapshots to build delta rows, so
+        the copy must not alias live bucket lists.
+        """
+        with self._lock:
+            return {
+                "counters": {n: dict(s) for n, s in self.counters.items()},
+                "gauges": {n: dict(s) for n, s in self.gauges.items()},
+                "histograms": {
+                    n: {k: [e[0], e[1], list(e[2])] for k, e in s.items()}
+                    for n, s in self.histograms.items()
+                },
+            }
 
     def counter_value(self, name: str, labels: dict[str, str] | None = None) -> float:
         with self._lock:

@@ -1,0 +1,321 @@
+"""Declarative SLO scoreboard over merged telemetry registries.
+
+Before this module every SLO verdict in the repo was an ad-hoc inline
+comparison (``mixed_traffic``'s ``slo_ok`` closure, the alert pack's
+hand-written thresholds). This is the single place an objective is
+*declared* — series + percentile + threshold + evaluation window +
+workload-class label — and *evaluated*, over any ``InMemoryMetrics``
+registry: a single process's, or the cross-process merge a
+``TelemetryAggregator`` (obs/ship.py) builds from N spools. That makes
+the scoreboard the gate machinery for the multi-process arc: the
+ROADMAP item-1 criterion "hold interactive TTFT p99 while batch stays
+within 10%" is an :class:`SLObjective` here, judged over real merged
+histograms rather than a parsed summary line.
+
+Percentiles are computed from the registry's cumulative histogram
+buckets exactly the way PromQL's ``histogram_quantile`` does (linear
+interpolation inside the bucket, capped at the largest finite bound),
+so a verdict here and a Grafana panel over the same scrape agree.
+Error-budget burn is ``violation_fraction / budget`` — burn > 1 means
+the window has spent more than its allowance of slow requests even if
+the percentile point estimate still sits under the threshold.
+
+CLI: ``python -m copilot_for_consensus_tpu slo <spools-or-dirs...>``
+renders the scoreboard for the default registry (rc 1 on any breach),
+feeding the same rows ``bench.py`` publishes as ``slo_ok`` columns and
+``infra/grafana/dashboards/slo.json`` visualizes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping
+
+from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+
+
+def _matches(key: tuple, labels: Mapping[str, str]) -> bool:
+    """Subset label match: every filter pair present in the key."""
+    have = dict(key)
+    return all(have.get(k) == v for k, v in labels.items())
+
+
+def _merged_entry(metrics: InMemoryMetrics, name: str,
+                  labels: Mapping[str, str]) -> list | None:
+    """Sum a histogram's entries across all label keys matching
+    ``labels`` (the aggregator fans one series out per proc/role; an
+    objective without a proc filter judges the whole fleet)."""
+    series = metrics.histograms.get(name)
+    if not series:
+        return None
+    merged: list | None = None
+    for key, (total, count, buckets) in series.items():
+        if not _matches(key, labels):
+            continue
+        if merged is None:
+            merged = [0.0, 0, [0] * len(buckets)]
+        merged[0] += total
+        merged[1] += count
+        for i, b in enumerate(buckets):
+            merged[2][i] += b
+    return merged
+
+
+def histogram_percentile(metrics: InMemoryMetrics, name: str, q: float,
+                         labels: Mapping[str, str] | None = None) \
+        -> float | None:
+    """``histogram_quantile(q, ...)`` over an in-memory registry.
+
+    Returns None when the (label-filtered) series has no observations.
+    Interpolates linearly inside the winning bucket and caps at the
+    largest finite bound — PromQL semantics, so dashboards and this
+    scoreboard cannot disagree about the same scrape.
+    """
+    entry = _merged_entry(metrics, name, labels or {})
+    if entry is None or entry[1] == 0:
+        return None
+    _total, count, cumulative = entry[0], entry[1], entry[2]
+    rank = q * count
+    prev_cum, prev_bound = 0, 0.0
+    for bound, cum in zip(metrics.buckets, cumulative):
+        if cum >= rank:
+            width = cum - prev_cum
+            frac = (rank - prev_cum) / width if width else 1.0
+            return prev_bound + (bound - prev_bound) * frac
+        prev_cum, prev_bound = cum, bound
+    return metrics.buckets[-1]
+
+
+def histogram_cdf(metrics: InMemoryMetrics, name: str, x: float,
+                  labels: Mapping[str, str] | None = None) \
+        -> float | None:
+    """Estimated fraction of observations <= ``x`` (linear inside the
+    straddling bucket) — the violation-fraction / error-budget input."""
+    entry = _merged_entry(metrics, name, labels or {})
+    if entry is None or entry[1] == 0:
+        return None
+    count, cumulative = entry[1], entry[2]
+    prev_cum, prev_bound = 0, 0.0
+    for bound, cum in zip(metrics.buckets, cumulative):
+        if x <= bound:
+            width = bound - prev_bound
+            frac = (x - prev_bound) / width if width else 1.0
+            return (prev_cum + (cum - prev_cum) * frac) / count
+        prev_cum, prev_bound = cum, bound
+    return 1.0
+
+
+@dataclass(frozen=True)
+class SLObjective:
+    """One declarative objective.
+
+    ``series`` is the full exposition name (``copilot_engine_ttft_
+    seconds``); evaluation strips the registry namespace. ``labels``
+    narrows to a label subset (e.g. ``{"role": "decode"}`` judges only
+    decode-role processes in a merged registry). ``budget`` is the
+    allowed violation fraction: burn = violations/budget, burn > 1 is
+    an exhausted error budget.
+    """
+
+    name: str
+    series: str
+    percentile: float
+    threshold_s: float
+    window: str = "bench"
+    workload: str = ""
+    labels: Mapping[str, str] = field(default_factory=dict)
+    budget: float = 0.01
+
+    def registry_name(self, namespace: str) -> str:
+        prefix = f"{namespace}_"
+        if self.series.startswith(prefix):
+            return self.series[len(prefix):]
+        return self.series
+
+    def evaluate(self, metrics: InMemoryMetrics) -> dict:
+        """Scoreboard row for this objective over ``metrics``.
+
+        ``ok`` is None (not False) with zero observations — an absent
+        workload is "no data", which callers gate explicitly
+        (``require_data=True`` in :meth:`SLORegistry.evaluate`).
+        """
+        name = self.registry_name(metrics.namespace)
+        entry = _merged_entry(metrics, name, self.labels)
+        observations = entry[1] if entry else 0
+        row = {
+            "name": self.name, "series": self.series,
+            "workload": self.workload, "window": self.window,
+            "percentile": self.percentile,
+            "threshold_s": self.threshold_s,
+            "labels": dict(self.labels),
+            "observations": observations,
+            "budget": self.budget,
+            "value_s": None, "violation_fraction": None,
+            "burn": None, "ok": None,
+        }
+        if not observations:
+            return row
+        value = histogram_percentile(metrics, name, self.percentile,
+                                     self.labels)
+        cdf = histogram_cdf(metrics, name, self.threshold_s, self.labels)
+        violation = max(0.0, 1.0 - (cdf if cdf is not None else 1.0))
+        burn = violation / self.budget if self.budget > 0 else (
+            0.0 if violation == 0.0 else float("inf"))
+        row.update({
+            "value_s": round(value, 6),
+            "violation_fraction": round(violation, 6),
+            "burn": round(burn, 4),
+            "ok": bool(value <= self.threshold_s),
+        })
+        return row
+
+    def check(self, value: float) -> dict:
+        """Judge an externally computed percentile value (bench arms
+        that measure per-request latencies directly) against this
+        objective — same row shape, no histogram behind it."""
+        return {
+            "name": self.name, "series": self.series,
+            "workload": self.workload, "window": self.window,
+            "percentile": self.percentile,
+            "threshold_s": self.threshold_s,
+            "labels": dict(self.labels),
+            "observations": None, "budget": self.budget,
+            "value_s": round(float(value), 6),
+            "violation_fraction": None, "burn": None,
+            "ok": bool(value <= self.threshold_s),
+        }
+
+
+class SLORegistry:
+    """Named set of objectives; registration collides loudly."""
+
+    def __init__(self, objectives: Iterable[SLObjective] = ()):
+        self._objectives: dict[str, SLObjective] = {}
+        for obj in objectives:
+            self.register(obj)
+
+    def register(self, objective: SLObjective) -> SLObjective:
+        if objective.name in self._objectives:
+            raise ValueError(
+                f"SLO objective {objective.name!r} already registered "
+                f"— objectives are declarative and unique by name")
+        self._objectives[objective.name] = objective
+        return objective
+
+    def objectives(self) -> list[SLObjective]:
+        return list(self._objectives.values())
+
+    def get(self, name: str) -> SLObjective:
+        return self._objectives[name]
+
+    def evaluate(self, metrics: InMemoryMetrics, *,
+                 require_data: bool = False) -> dict:
+        """Scoreboard over ``metrics``: per-objective rows + verdict.
+
+        ``ok`` is True when every objective *with data* holds;
+        ``require_data=True`` additionally fails objectives that saw
+        zero observations (bench gates use this — a workload that
+        never ran must not pass its SLO vacuously).
+        """
+        rows = [obj.evaluate(metrics) for obj in self.objectives()]
+        evaluated = [r for r in rows if r["ok"] is not None]
+        ok = all(r["ok"] for r in evaluated)
+        if require_data and len(evaluated) != len(rows):
+            ok = False
+        return {"objectives": rows, "evaluated": len(evaluated),
+                "total": len(rows), "ok": bool(ok)}
+
+
+def default_registry() -> SLORegistry:
+    """The serving-plane defaults — thresholds match the bench knobs
+    (BENCH_TTFT_SLO=2.0 / BENCH_ITL_SLO=0.25, bench.py mixed_traffic)
+    and the alert pack; the slo.json dashboard renders exactly these
+    (pinned by tests/test_slo.py)."""
+    return SLORegistry([
+        SLObjective(
+            name="interactive-ttft-p99",
+            series="copilot_engine_ttft_seconds",
+            percentile=0.99, threshold_s=2.0, window="bench",
+            workload="interactive", budget=0.01),
+        SLObjective(
+            name="interactive-itl-p95",
+            series="copilot_engine_itl_seconds",
+            percentile=0.95, threshold_s=0.25, window="bench",
+            workload="interactive", budget=0.05),
+        SLObjective(
+            name="queue-wait-p99",
+            series="copilot_engine_queue_wait_seconds",
+            percentile=0.99, threshold_s=5.0, window="bench",
+            workload="batch", budget=0.01),
+        SLObjective(
+            name="stage-latency-p95",
+            series="copilot_pipeline_stage_duration_seconds",
+            percentile=0.95, threshold_s=30.0, window="bench",
+            workload="batch", budget=0.05),
+        SLObjective(
+            name="kv-handoff-wait-p99",
+            series="copilot_engine_role_handoff_wait_seconds",
+            percentile=0.99, threshold_s=1.0, window="bench",
+            workload="disaggregated", budget=0.01),
+    ])
+
+
+def render_scoreboard(board: dict) -> str:
+    """Human-readable scoreboard (the CLI's default output)."""
+    lines = ["SLO scoreboard "
+             f"({board['evaluated']}/{board['total']} objectives with "
+             f"data; overall {'OK' if board['ok'] else 'BREACH'})"]
+    for r in board["objectives"]:
+        if r["ok"] is None:
+            verdict, value = "no-data", "-"
+        else:
+            verdict = "ok" if r["ok"] else "BREACH"
+            value = f"{r['value_s']:.4f}s"
+        burn = ("-" if r["burn"] is None else f"{r['burn']:.2f}")
+        lines.append(
+            f"  [{verdict:>7}] {r['name']}: "
+            f"p{int(r['percentile'] * 100)}({r['series']}) = {value} "
+            f"(threshold {r['threshold_s']}s, burn {burn}, "
+            f"workload {r['workload'] or '-'}, window {r['window']})")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="copilot-for-consensus-tpu slo",
+        description="Evaluate the declarative SLO registry over "
+                    "telemetry spools (obs/ship.py) and print the "
+                    "scoreboard. Exit 1 on any breach.")
+    parser.add_argument(
+        "sources", nargs="*",
+        help="spool files or directories of *.spool.sqlite3 (a "
+             "multichip bench run's spool dir, a chaos kill phase's "
+             "recovered spool, ...)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the scoreboard as JSON")
+    parser.add_argument("--require-data", action="store_true",
+                        help="fail objectives with zero observations "
+                             "(bench-gate semantics)")
+    args = parser.parse_args(argv)
+
+    from copilot_for_consensus_tpu.obs.ship import TelemetryAggregator
+
+    agg = TelemetryAggregator()
+    for src in args.sources:
+        p = pathlib.Path(src)
+        if p.is_dir():
+            agg.ingest_dir(p)
+        else:
+            agg.ingest_spool(p)
+
+    board = default_registry().evaluate(
+        agg.metrics, require_data=args.require_data)
+    board["sources"] = {"spools": agg.stats()}
+    if args.json:
+        print(json.dumps(board, indent=2, sort_keys=True))
+    else:
+        print(render_scoreboard(board))
+    return 0 if board["ok"] else 1

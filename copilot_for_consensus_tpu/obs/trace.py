@@ -50,6 +50,8 @@ import uuid
 from dataclasses import asdict, dataclass, field
 from typing import Any, Iterable, Mapping
 
+from copilot_for_consensus_tpu.obs.metrics import check_registry_labels
+
 #: envelope key carrying the trace context block
 TRACE_KEY = "trace"
 
@@ -85,6 +87,10 @@ PIPELINE_METRICS: dict[str, tuple[str, tuple[str, ...], str]] = {
         "Spans evicted from the bounded trace ring (size the "
         "collector up if this moves during an investigation)."),
 }
+
+# proc/role are stamped by the cross-process aggregator (obs/ship.py);
+# declaring them here must fail at import, not at scrape time.
+check_registry_labels(PIPELINE_METRICS, owner="PIPELINE_METRICS")
 
 
 def prometheus_series(namespace: str = "copilot") -> dict[str, str]:

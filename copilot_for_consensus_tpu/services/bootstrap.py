@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Mapping
 
 from copilot_for_consensus_tpu.obs.logging import get_logger
+from copilot_for_consensus_tpu.obs.metrics import check_registry_labels
 from copilot_for_consensus_tpu.services.http import (
     HTTPServer,
     Router,
@@ -133,6 +134,10 @@ BUS_METRICS = {
         "counter", ("service",),
         "consumption pauses taken under depth-watermark backpressure"),
 }
+
+# proc/role are stamped by the cross-process aggregator (obs/ship.py);
+# declaring them here must fail at import, not at scrape time.
+check_registry_labels(BUS_METRICS, owner="BUS_METRICS")
 
 
 class _BusGaugeMetrics:

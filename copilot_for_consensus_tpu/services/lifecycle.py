@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from copilot_for_consensus_tpu.obs.metrics import check_registry_labels
+
 #: lifecycle states, in order
 STARTING = "starting"
 READY = "ready"
@@ -66,6 +68,10 @@ LIFECYCLE_METRICS = {
         "Process lifecycle state: 0 starting, 1 ready, 2 draining, "
         "3 stopped. /readyz serves 503 in every state but ready."),
 }
+
+# proc/role are stamped by the cross-process aggregator (obs/ship.py);
+# declaring them here must fail at import, not at scrape time.
+check_registry_labels(LIFECYCLE_METRICS, owner="LIFECYCLE_METRICS")
 
 
 class ServiceLifecycle:

@@ -24,6 +24,15 @@ exact step — the child half of the ``pipeline_chaos`` kill phase
   flushed), the process SIGKILLs ITSELF — a real, unhandled process
   death at a deterministic point mid-storm, with queued requests,
   active slots and partially-checkpointed tokens all live.
+* ``--spool PATH --proc NAME``: ship telemetry (metric deltas, step
+  records, submit/replay spans) into a crash-safe spool (obs/ship.py),
+  flushed synchronously per step BEFORE the kill check — so the
+  SIGKILLed process's committed spans/steps are recoverable from its
+  spool, the ``telemetry_recovered_ok`` gate of the kill phase. Span
+  ids are derived deterministically from correlation ids, so the
+  resume process's ``engine_replay`` spans parent onto the killed
+  process's ``engine_submit`` spans — a real cross-OS-process trace
+  the tracepath orphan audit must join, not miscount.
 
 Weights come from the fixed tiny config + seed at f32, so every child
 process builds the bit-identical engine and greedy outputs across
@@ -34,16 +43,19 @@ kill/restart must equal an uninterrupted run's exactly
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import signal
 import sys
+import time
 
 
-def build_engine(journal):
+def build_engine(journal, telemetry: bool = False):
     """The shared tiny deterministic engine (f32 compute AND kv: exact
     greedy bit-identity for continuations, the chaos-preset dtype
-    argument)."""
+    argument). ``telemetry`` is host-side bookkeeping only — token
+    streams stay bit-identical either way."""
     import jax.numpy as jnp
 
     from copilot_for_consensus_tpu.engine.generation import (
@@ -57,8 +69,17 @@ def build_engine(journal):
     return GenerationEngine(
         cfg, num_slots=4, max_len=192, prefill_buckets=(32, 64),
         dtype=jnp.float32, kv_dtype=jnp.float32, seed=0,
-        decode_window=4, windows_per_dispatch=1, telemetry=False,
+        decode_window=4, windows_per_dispatch=1, telemetry=telemetry,
         journal=journal)
+
+
+def _span_ids(cid: str) -> tuple[str, str, str]:
+    """Deterministic (trace_id, submit_span_id, replay_span_id) from a
+    correlation id — both sides of a kill/resume pair derive the SAME
+    ids, which is what lets the replay span (resume process) parent
+    onto the submit span (killed process) across spools."""
+    digest = hashlib.sha256(cid.encode()).hexdigest()
+    return digest[:32], digest[32:48], digest[48:64]
 
 
 def storm_prompts(n: int, seed: int) -> list[list[int]]:
@@ -95,6 +116,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="SIGKILL this process after step N (0 = run "
                          "to completion)")
     ap.add_argument("--max-steps", type=int, default=2000)
+    ap.add_argument("--spool", default="",
+                    help="telemetry spool path (obs/ship.py); ships "
+                         "metric deltas + step records + submit/"
+                         "replay spans, flushed per step so committed "
+                         "rows survive the SIGKILL")
+    ap.add_argument("--proc", default="",
+                    help="process name stamped on shipped telemetry "
+                         "(default: storm-<pid>)")
     args = ap.parse_args(argv)
 
     from copilot_for_consensus_tpu.engine.journal import EngineJournal
@@ -105,7 +134,39 @@ def main(argv: list[str] | None = None) -> int:
     # directly (deadline-expired rows, fully-generated rows)
     old_cids = {e.request_id: e.correlation_id
                 for e in journal.unfinished()}
-    eng = build_engine(journal)
+    eng = build_engine(journal, telemetry=bool(args.spool))
+
+    shipper = None
+    collector = None
+    if args.spool:
+        from copilot_for_consensus_tpu.obs.ship import TelemetryShipper
+        from copilot_for_consensus_tpu.obs.trace import (
+            Span,
+            TraceCollector,
+        )
+
+        collector = TraceCollector(capacity=4096)
+        proc = args.proc or f"storm-{os.getpid()}"
+        shipper = TelemetryShipper(
+            args.spool, proc=proc,
+            role="resume" if resume else "serve",
+            metrics=eng.telemetry.metrics,
+            collector=collector, recorder=eng.telemetry.recorder)
+
+    def _record_lifecycle_span(cid: str, kind: str) -> None:
+        if collector is None:
+            return
+        trace_id, submit_id, replay_id = _span_ids(cid)
+        if kind == "engine_submit":
+            span_id, parent = submit_id, ""
+        else:  # engine_replay parents onto the ORIGINAL submit span,
+            #    which lives in the killed process's spool
+            span_id, parent = replay_id, submit_id
+        collector.record(Span(
+            trace_id=trace_id, span_id=span_id, parent_span_id=parent,
+            name="journal_storm", kind=kind, service="journal_storm",
+            start_wall=time.time(), correlation_id=cid))
+
     cid_of: dict[int, str] = dict(old_cids)
     cid_of.update(dict(eng.journal_recovered))
     if not resume:
@@ -113,6 +174,10 @@ def main(argv: list[str] | None = None) -> int:
             rid = eng.submit(p, args.new_tokens,
                              correlation_id=f"js-{i}")
             cid_of[rid] = f"js-{i}"
+            _record_lifecycle_span(f"js-{i}", "engine_submit")
+    else:
+        for _rid, cid in eng.journal_recovered:
+            _record_lifecycle_span(cid, "engine_replay")
 
     out = open(args.out, "a", encoding="utf-8")  # noqa: SIM115
     steps = 0
@@ -128,11 +193,20 @@ def main(argv: list[str] | None = None) -> int:
             completed += 1
         out.flush()
         os.fsync(out.fileno())
+        if shipper is not None:
+            # synchronous per-step flush BEFORE the kill check: every
+            # step that fsynced its completions also committed its
+            # telemetry — the recovery gate's invariant
+            shipper.flush()
         if args.kill_after_step and steps == args.kill_after_step:
             # a REAL unhandled process death: no atexit, no flushes,
             # no journal close — exactly what the journal must survive
             os.kill(os.getpid(), signal.SIGKILL)
     out.close()
+    spool_stats = None
+    if shipper is not None:
+        spool_stats = shipper.stats()
+        shipper.close()
     with open(args.result, "w", encoding="utf-8") as f:
         json.dump({
             "resume": resume,
@@ -142,6 +216,7 @@ def main(argv: list[str] | None = None) -> int:
             "journal_abandoned": eng.journal_abandoned,
             "journal_depth": journal.depth(),
             "journal_stats": journal.stats(),
+            "spool": spool_stats,
         }, f)
     return 0
 

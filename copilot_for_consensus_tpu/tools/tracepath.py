@@ -8,14 +8,21 @@ judged against (SCALE_BROKER.json shows 59.6 msg/s with queues 4x past
 the warn SLO, but until now nothing could say WHERE the time goes).
 
 Programmatic surface: :func:`analyze` (bench.py's trace columns),
-:func:`trace_path` (one trace's ordered stage chain). CLI:
+:func:`trace_path` (one trace's ordered stage chain),
+:func:`collect_sources` (merge spans from mixed sources). CLI:
 
     python -m copilot_for_consensus_tpu.tools.tracepath dump.json
     python -m ...tools.tracepath dump.json --json
     python -m ...tools.tracepath dump.json --trace <trace_id>
+    python -m ...tools.tracepath spools/ --live
 
-where ``dump.json`` is a ``TraceCollector.dump()`` file (the
-``spans`` key) or a bare JSON list of span dicts.
+Sources may be ``TraceCollector.dump()`` files (the ``spans`` key), a
+bare JSON list of span dicts, telemetry spool files
+(``*.spool.sqlite3``, obs/ship.py — spans gain their writer's ``proc``
+stamp), or directories scanned for both. A trace whose stages ran in
+different OS processes reconstructs from the union: the orphan audit
+runs over the merged span set, so a parent recorded in another
+process's spool resolves instead of miscounting as an orphan.
 """
 
 from __future__ import annotations
@@ -25,6 +32,7 @@ import json
 import pathlib
 from typing import Any, Iterable, Mapping
 
+from copilot_for_consensus_tpu.obs.ship import SPOOL_SUFFIX
 from copilot_for_consensus_tpu.obs.trace import Span, orphan_spans
 
 #: canonical forward-path stage order (service names), used to sort the
@@ -40,14 +48,60 @@ def _as_dicts(spans: Iterable[Span | Mapping[str, Any]]
 
 
 def load_spans(path: str | pathlib.Path) -> list[dict[str, Any]]:
-    """Span dicts from a collector dump file (``{"spans": [...]}``) or
-    a bare JSON list."""
-    data = json.loads(pathlib.Path(path).read_text())
+    """Span dicts from one source file: a collector dump
+    (``{"spans": [...]}``), a bare JSON list, or a telemetry spool
+    (``*.spool.sqlite3`` — spans come back stamped with the writing
+    process's ``proc``)."""
+    p = pathlib.Path(path)
+    if p.name.endswith(SPOOL_SUFFIX):
+        return load_spool_spans(p)
+    data = json.loads(p.read_text())
     if isinstance(data, Mapping):
         data = data.get("spans", [])
     if not isinstance(data, list):
         raise ValueError(f"{path}: not a span dump")
     return [dict(d) for d in data]
+
+
+def load_spool_spans(path: str | pathlib.Path) -> list[dict[str, Any]]:
+    """Span rows from one telemetry spool, ``proc``-stamped."""
+    from copilot_for_consensus_tpu.obs.ship import read_spool
+
+    spool = read_spool(path)
+    spans = []
+    for _seq, kind, payload in spool["rows"]:
+        if kind != "span":
+            continue
+        d = dict(payload)
+        d["proc"] = spool["proc"]
+        if spool["role"] and not d.get("service"):
+            d["service"] = spool["role"]
+        spans.append(d)
+    return spans
+
+
+def collect_sources(sources: Iterable[str | pathlib.Path], *,
+                    include_live: bool = False) -> list[dict[str, Any]]:
+    """Merge spans from mixed sources: dump files, spool files, and
+    directories (scanned non-recursively for ``*.json`` dumps and
+    ``*.spool.sqlite3`` spools). ``include_live=True`` appends the
+    in-process collector's ring — the live source, for tooling that
+    runs inside the process under observation."""
+    spans: list[dict[str, Any]] = []
+    for src in sources:
+        p = pathlib.Path(src)
+        if p.is_dir():
+            for child in sorted(p.iterdir()):
+                if (child.name.endswith(SPOOL_SUFFIX)
+                        or child.suffix == ".json"):
+                    spans.extend(load_spans(child))
+        else:
+            spans.extend(load_spans(p))
+    if include_live:
+        from copilot_for_consensus_tpu.obs.trace import get_collector
+
+        spans.extend(s.as_dict() for s in get_collector().spans())
+    return spans
 
 
 def _pct(sorted_vals: list[float], q: float) -> float:
@@ -96,8 +150,20 @@ def analyze(spans: Iterable[Span | Mapping[str, Any]]) -> dict[str, Any]:
     stages: dict[str, dict[str, list[float]]] = {}
     errors: dict[str, int] = {}
     trace_ids = set()
+    procs = set()
+    by_id = {d.get("span_id", ""): d for d in dicts}
+    cross_proc_edges = 0
     for d in dicts:
         trace_ids.add(d.get("trace_id", ""))
+        if d.get("proc"):
+            procs.add(d["proc"])
+        parent = by_id.get(d.get("parent_span_id", ""))
+        if (parent is not None
+                and d.get("proc", "") != parent.get("proc", "")):
+            # a parent link that crosses an OS-process boundary — the
+            # join the spool merge exists for (these used to be
+            # miscounted as orphans when each proc audited alone)
+            cross_proc_edges += 1
         if d.get("kind") != "stage":
             continue
         st = stages.setdefault(d["name"], {"dur": [], "wait": []})
@@ -128,6 +194,8 @@ def analyze(spans: Iterable[Span | Mapping[str, Any]]) -> dict[str, Any]:
         "traces": len(trace_ids),
         "spans": len(dicts),
         "orphan_spans": len(orphan_spans(dicts)),
+        "procs": sorted(procs),
+        "cross_proc_edges": cross_proc_edges,
         "stages": out_stages,
         "stage_p95_s": {n: s["p95_s"] for n, s in out_stages.items()},
         "queue_wait_p95_s": {n: s["queue_wait_p95_s"]
@@ -164,6 +232,7 @@ def trace_path(spans: Iterable[Span | Mapping[str, Any]],
         "attempt": int(d.get("attempt", 0)),
         "status": d.get("status", "ok"),
         "correlation_id": d.get("correlation_id", ""),
+        "proc": d.get("proc", ""),
     } for d in stage_spans]
     starts = [d.get("start_wall", 0.0) for d in dicts]
     ends = [d.get("start_wall", 0.0) + d.get("duration_s", 0.0)
@@ -171,6 +240,7 @@ def trace_path(spans: Iterable[Span | Mapping[str, Any]],
     return {
         "trace_id": trace_id,
         "spans": len(dicts),
+        "procs": sorted({d["proc"] for d in dicts if d.get("proc")}),
         "roots": roots,
         "edges": {p: sorted(cs) for p, cs in sorted(children.items())},
         "path": hops,
@@ -185,9 +255,12 @@ def trace_path(spans: Iterable[Span | Mapping[str, Any]],
 
 def render_report(analysis: Mapping[str, Any]) -> str:
     """Human-readable table for the CLI."""
+    procs = analysis.get("procs") or []
+    proc_note = (f"  procs {len(procs)} ({', '.join(procs)})"
+                 if procs else "")
     lines = [
         f"traces {analysis['traces']}  spans {analysis['spans']}  "
-        f"orphans {analysis['orphan_spans']}",
+        f"orphans {analysis['orphan_spans']}{proc_note}",
         f"{'stage':<14} {'n':>6} {'p50':>9} {'p95':>9} "
         f"{'wait p50':>9} {'wait p95':>9} {'err':>4}",
     ]
@@ -206,17 +279,19 @@ def render_report(analysis: Mapping[str, Any]) -> str:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="pipeline trace critical-path analyzer")
-    ap.add_argument("dumps", nargs="+",
-                    help="TraceCollector dump file(s) (raw format)")
+    ap.add_argument("dumps", nargs="+", metavar="source",
+                    help="span sources: TraceCollector dump file(s), "
+                         "telemetry spool file(s) (*.spool.sqlite3), "
+                         "or directories holding either")
     ap.add_argument("--trace", default="",
                     help="reconstruct one trace id instead of the "
                          "aggregate stage report")
+    ap.add_argument("--live", action="store_true",
+                    help="also merge the in-process collector's spans")
     ap.add_argument("--json", action="store_true",
                     help="emit machine-readable JSON")
     args = ap.parse_args(argv)
-    spans: list[dict[str, Any]] = []
-    for p in args.dumps:
-        spans.extend(load_spans(p))
+    spans = collect_sources(args.dumps, include_live=args.live)
     if args.trace:
         out: dict[str, Any] = trace_path(spans, args.trace)
         print(json.dumps(out, indent=2))

@@ -49,6 +49,7 @@ from copilot_for_consensus_tpu.analysis.contracts import (
     HloSpec,
     checkable,
 )
+from copilot_for_consensus_tpu.obs.metrics import check_registry_labels
 from copilot_for_consensus_tpu.storage.base import matches_filter
 from copilot_for_consensus_tpu.vectorstore._inverted import InvertedIndexMixin
 from copilot_for_consensus_tpu.vectorstore.base import (
@@ -90,6 +91,10 @@ VECTORSTORE_METRICS = {
         "counter", (),
         "coarse-quantizer (re)trains — drift policy firings"),
 }
+
+# proc/role are stamped by the cross-process aggregator (obs/ship.py);
+# declaring them here must fail at import, not at scrape time.
+check_registry_labels(VECTORSTORE_METRICS, owner="VECTORSTORE_METRICS")
 
 # hlo-peak-memory budgets for the IVF search dispatch at the contract
 # factories' tiny shapes (~2× the measured compiled peak — they gate

@@ -36,19 +36,22 @@ def fixtures_dir() -> pathlib.Path:
     return FIXTURES
 
 
-# -- engine flight-recorder CI artifact --------------------------------
+# -- telemetry-bundle CI artifact --------------------------------------
 #
 # When COPILOT_FLIGHT_RECORD_DIR is set (ci.yml exports it for the test
 # lanes), engine telemetry auto-dumps land there on engine errors, and
 # the hook below additionally dumps every live recorder when a test
-# FAILS — ci.yml uploads the directory as the engine-flight-records
-# artifact, so a red engine suite ships its post-mortem (per-dispatch
-# step records + in-flight correlation ids) instead of just a
-# traceback. The env read happens here in the harness, not in the
-# package (test_no_runtime_env_vars policy).
+# FAILS — flight records, pipeline trace dumps, AND every live
+# telemetry shipper's spool (obs/ship.py) land in ONE directory that
+# ci.yml uploads as the telemetry-bundle artifact. A red suite ships
+# its whole post-mortem (per-dispatch step records, span DAGs readable
+# by tools/tracepath, crash-safe spools readable by the aggregator and
+# the slo CLI) instead of a bare traceback. The env read happens here
+# in the harness, not in the package (test_no_runtime_env_vars policy).
 _FLIGHT_DIR = os.environ.get("COPILOT_FLIGHT_RECORD_DIR", "")
 if _FLIGHT_DIR:
     from copilot_for_consensus_tpu.engine import telemetry as _telemetry
+    from copilot_for_consensus_tpu.obs import ship as _ship
     from copilot_for_consensus_tpu.obs import trace as _trace
 
     _telemetry.set_default_dump_dir(_FLIGHT_DIR)
@@ -57,6 +60,9 @@ if _FLIGHT_DIR:
     # spans + queue waits + correlation ids, readable by
     # tools/tracepath) alongside the engine flight records.
     _trace.set_default_dump_dir(_FLIGHT_DIR)
+    # Shippers built without an explicit path spool here too — the
+    # failure hook flushes them so committed rows are in the bundle.
+    _ship.set_default_spool_dir(_FLIGHT_DIR)
 
 
 @pytest.hookimpl(hookwrapper=True)
@@ -71,8 +77,10 @@ def pytest_runtest_makereport(item, call):
         from copilot_for_consensus_tpu.engine import (
             telemetry as _telemetry,
         )
+        from copilot_for_consensus_tpu.obs import ship as _ship
         from copilot_for_consensus_tpu.obs import trace as _trace
 
         tag = re.sub(r"[^A-Za-z0-9._-]+", "_", item.nodeid)[-80:]
         _telemetry.dump_all(_FLIGHT_DIR, tag=tag)
         _trace.dump_all(_FLIGHT_DIR, tag=f"pipeline-trace-{tag}")
+        _ship.dump_all(_FLIGHT_DIR, tag=f"telemetry-{tag}")

@@ -1,0 +1,47 @@
+"""duracheck fixture: dura-sqlite-ledger over the telemetry-spool
+shape (obs/ship.py TelemetrySpool).
+
+The spool's whole crash-safety claim is WAL + one transaction per
+flush: committed rows survive SIGKILL, and a kill mid-flush loses the
+WHOLE in-flight batch, never a partial one. A spool without those is a
+telemetry ledger that lies to the recovery reader.
+"""
+
+import sqlite3
+
+
+class BadSpool:
+    """All three violations: rollback-journal mode (a SIGKILL mid-write
+    can corrupt the spool), per-row autocommit in the flush loop (a
+    kill mid-flush commits a TORN batch — the recovery gate would see
+    a metrics delta without its spans), and no close."""
+
+    def __init__(self, path):
+        self._db = sqlite3.connect(path)
+
+    def append(self, rows):
+        for kind, payload in rows:
+            self._db.execute(
+                "INSERT INTO rows (kind, payload) VALUES (?, ?)",
+                (kind, payload))
+        self._db.commit()
+
+
+class GoodSpool:
+    """The shipped shape: WAL on open, the whole flush in ONE
+    transaction, owner-joined close via the local-alias idiom."""
+
+    def __init__(self, path):
+        self._db = sqlite3.connect(path)
+        self._db.execute("PRAGMA journal_mode=WAL")
+
+    def append(self, rows):
+        with self._db:
+            for kind, payload in rows:
+                self._db.execute(
+                    "INSERT INTO rows (kind, payload) VALUES (?, ?)",
+                    (kind, payload))
+
+    def close(self):
+        db = self._db
+        db.close()

@@ -1,0 +1,47 @@
+# racecheck fixture: race-thread-lifecycle over the telemetry-shipper
+# pump shape (obs/ship.py TelemetryShipper) — the background flush
+# thread must poll a stop Event and be joined by its owner; a
+# daemon-and-forget pump keeps flushing into a spool its owner already
+# closed (sqlite on a closed handle) at interpreter teardown.
+import threading
+import time
+
+
+class BadShipPump:
+    """Fire-and-forget: the flush loop never polls a stop Event and
+    the thread is never joined — close() can yank the spool out from
+    under a live flush."""
+
+    def __init__(self, spool):
+        self._spool = spool
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self):
+        while True:
+            time.sleep(0.25)  # jaxlint: disable=blocking-call
+            self._spool.append([])
+
+    def close(self):
+        self._spool.close()            # the pump races this
+
+
+class GoodShipPump:
+    """The shipped shape: stop-aware wait loop + owner-joined stop()
+    before the spool closes."""
+
+    def __init__(self, spool):
+        self._spool = spool
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.25)
+            self._spool.append([])
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._spool.close()

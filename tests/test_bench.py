@@ -246,7 +246,8 @@ def test_pipeline_chaos_columns_contract():
              "queue_wait_p95_s": {"chunking": 1.2},
              "bottleneck_stage": "chunking", "orphan_spans": 0,
              "journal_replayed": 7, "shutdown_redeliveries": 0,
-             "extra_key_ignored": 1}
+             "telemetry_recovered_ok": True, "spool_rows": 30,
+             "spool_lost": 0, "extra_key_ignored": 1}
     cols = bench.pipeline_chaos_columns(audit)
     assert set(cols) == {"lost", "duplicated", "quarantined",
                          "replayed_publishes", "redelivered",
@@ -263,7 +264,12 @@ def test_pipeline_chaos_columns_contract():
                          # phase's warm-restart replays and the
                          # graceful-drain arm's shutdown-caused
                          # redeliveries (zero is the gate)
-                         "journal_replayed", "shutdown_redeliveries"}
+                         "journal_replayed", "shutdown_redeliveries",
+                         # cross-process telemetry columns (obs/ship,
+                         # ISSUE 20): the SIGKILLed child's committed
+                         # spool survived and merged with zero orphans
+                         "telemetry_recovered_ok", "spool_rows",
+                         "spool_lost"}
     assert cols["quarantined"] == 5
     assert cols["replayed_publishes"] == 104
     assert cols["max_depth_backpressure_off"] == 88
@@ -272,14 +278,20 @@ def test_pipeline_chaos_columns_contract():
     assert cols["orphan_spans"] == 0
     assert cols["journal_replayed"] == 7
     assert cols["shutdown_redeliveries"] == 0
-    # empty audit degrades to zeros/empties, not KeyErrors
+    assert cols["telemetry_recovered_ok"] is True
+    assert cols["spool_rows"] == 30 and cols["spool_lost"] == 0
+    # empty audit degrades to zeros/empties, not KeyErrors — and the
+    # telemetry verdict degrades to False / -1 lost (unknown), never a
+    # vacuous pass
     empty = bench.pipeline_chaos_columns({})
     assert empty["bottleneck_stage"] == ""
     assert empty["stage_p95_s"] == {}
     assert empty["queue_wait_p95_s"] == {}
+    assert empty["telemetry_recovered_ok"] is False
+    assert empty["spool_lost"] == -1
     assert all(v == 0 for k, v in empty.items()
                if k not in ("bottleneck_stage", "stage_p95_s",
-                            "queue_wait_p95_s"))
+                            "queue_wait_p95_s", "spool_lost"))
 
 
 def test_telemetry_columns_contract():
@@ -462,9 +474,35 @@ def test_multichip_columns_contract():
     assert cols["handoff_ms"] == 12.5
     assert cols["itl_p95_disagg_s"] == 0.05
     assert set(cols["scaling"]) == {"1", "2", "4", "8"}
+    # no spool merge: the spool columns degrade to unknown, never to a
+    # vacuous pass
+    assert cols["slo_ok"] is None
+    assert cols["spool_rows"] == 0 and cols["spool_lost"] == -1
+    assert all(row["ttft_p99_spool_s"] is None
+               for row in cols["scaling"].values())
     # degenerate single-chip sweep stays well-formed
     one = bench.multichip_columns({1: {"tok_s": 0.0}}, {})
     assert one["scaling_efficiency"] == 0.0
+
+
+def test_multichip_columns_spool_merge():
+    """ISSUE 20: the parent merges every child's telemetry spool and
+    publishes spool-derived TTFT per chip count, fleet ITL p95, row
+    accounting and the declarative SLO verdict next to the measured
+    columns."""
+    scaling = {1: {"tok_s": 100.0, "ttft_p99_s": 0.01},
+               2: {"tok_s": 180.0, "ttft_p99_s": 0.012}}
+    spool = {"ttft_p99_by_chips": {"1": 0.011, "2": 0.013},
+             "itl_p95_s": 0.04, "spool_rows": 21, "spool_lost": 0,
+             "slo_ok": True,
+             "slo": {"interactive-ttft-p99": True}}
+    cols = bench.multichip_columns(scaling, {}, spool)
+    assert cols["scaling"]["1"]["ttft_p99_spool_s"] == 0.011
+    assert cols["scaling"]["2"]["ttft_p99_spool_s"] == 0.013
+    assert cols["itl_p95_s"] == 0.04
+    assert cols["spool_rows"] == 21 and cols["spool_lost"] == 0
+    assert cols["slo_ok"] is True
+    assert cols["slo"] == {"interactive-ttft-p99": True}
 
 
 def test_kv_kernel_route_preset_keys():

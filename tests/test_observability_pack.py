@@ -99,6 +99,15 @@ from copilot_for_consensus_tpu.vectorstore.tpu import (  # noqa: E402
 )
 
 KNOWN_SERIES |= set(VECTORSTORE_METRICS)
+
+# Telemetry-shipping self-metrics (obs/ship.py) — spool row counters,
+# flush latency, spool depth — same registry-next-to-emitter
+# discipline (ISSUE 20).
+from copilot_for_consensus_tpu.obs.ship import (  # noqa: E402
+    SHIP_METRICS,
+)
+
+KNOWN_SERIES |= set(SHIP_METRICS)
 # [a-z0-9_]: engine series contain digits (engine_e2e_seconds)
 _SERIES_RE = re.compile(r"\b(copilot_[a-z0-9_]+|up|push_time_seconds)\b")
 
@@ -493,6 +502,60 @@ def test_resource_gauges_on_metrics_exposition():
         r"^copilot_process_memory_limit_bytes (\S+)", body,
         _re.M).group(1))
     assert limit > rss
+
+
+# -- cross-process telemetry plane (obs/ship.py, ISSUE 20) ---------------
+
+
+def test_reserved_labels_collide_loudly_at_registration():
+    """proc/role are stamped by the TelemetryAggregator on every merged
+    series — a registry that declares them itself would silently alias
+    across processes, so check_registry_labels refuses it."""
+    from copilot_for_consensus_tpu.obs.metrics import (
+        RESERVED_LABELS,
+        check_registry_labels,
+    )
+
+    for reserved in RESERVED_LABELS:
+        bad = {"copilot_x_total": ("counter", (reserved,), "h")}
+        with pytest.raises(ValueError, match=reserved):
+            check_registry_labels(bad, owner="test")
+    # every shipped registry in the repo passes (the import-time call
+    # in each module already enforces this; assert it stays true)
+    for owner, registry in (
+            ("ENGINE_METRICS", ENGINE_METRICS),
+            ("BUS_METRICS", BUS_METRICS),
+            ("PIPELINE_METRICS", PIPELINE_METRICS),
+            ("LIFECYCLE_METRICS", LIFECYCLE_METRICS),
+            ("VECTORSTORE_METRICS", VECTORSTORE_METRICS),
+            ("SHIP_METRICS", SHIP_METRICS)):
+        check_registry_labels(registry, owner=owner)
+
+
+def test_merged_exposition_has_no_cross_process_type_conflicts():
+    """Two processes shipping the SAME series as DIFFERENT types would
+    render two contradictory # TYPE lines in the merged scrape — the
+    aggregator must refuse; same-typed series from N procs merge into
+    one family with proc/role labels."""
+    from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+    from copilot_for_consensus_tpu.obs.ship import TelemetryAggregator
+
+    agg = TelemetryAggregator()
+    m1 = InMemoryMetrics(namespace="copilot")
+    m1.increment("jobs_total", 3.0, {"q": "a"})
+    m2 = InMemoryMetrics(namespace="copilot")
+    m2.increment("jobs_total", 2.0, {"q": "a"})
+    agg.merge_registry(m1, proc="p1", role="engine")
+    agg.merge_registry(m2, proc="p2", role="engine")
+    body = agg.render_prometheus()
+    assert body.count("# TYPE copilot_jobs_total counter") == 1
+    assert 'copilot_jobs_total{proc="p1",q="a",role="engine"} 3' in body
+    assert 'copilot_jobs_total{proc="p2",q="a",role="engine"} 2' in body
+    # same series shipped as a gauge by a third process: refused loudly
+    m3 = InMemoryMetrics(namespace="copilot")
+    m3.gauge("jobs_total", 1.0, {"q": "a"})
+    with pytest.raises(ValueError, match="type conflict"):
+        agg.merge_registry(m3, proc="p3", role="engine")
 
 
 def test_gateway_metrics_exposes_resource_gauges():

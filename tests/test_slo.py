@@ -1,0 +1,263 @@
+# Declarative SLO scoreboard (obs/slo.py, ISSUE 20): PromQL-parity
+# percentile/CDF math over in-memory histograms, objective evaluation
+# with error-budget burn, registry uniqueness, the CLI, and the
+# contract tying default_registry() to the Grafana dashboard
+# (infra/grafana/dashboards/slo.json) so the scoreboard and the panels
+# can never judge different series or thresholds.
+import json
+import pathlib
+
+import pytest
+
+from copilot_for_consensus_tpu.obs import slo
+from copilot_for_consensus_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    InMemoryMetrics,
+)
+from copilot_for_consensus_tpu.obs.slo import (
+    SLObjective,
+    SLORegistry,
+    default_registry,
+    histogram_cdf,
+    histogram_percentile,
+    render_scoreboard,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+SLO_DASHBOARD = ROOT / "infra" / "grafana" / "dashboards" / "slo.json"
+
+
+def _metrics(observations):
+    m = InMemoryMetrics(namespace="copilot")
+    for value in observations:
+        m.observe("lat_seconds", value)
+    return m
+
+
+# -- percentile / CDF math (PromQL histogram_quantile parity) ------------
+
+
+def test_percentile_interpolates_inside_the_bucket():
+    # 50 obs land in the first bucket (<=0.005), 50 in the third
+    # (<=0.025); cumulative counts: [50, 50, 100, ...]
+    m = _metrics([0.004] * 50 + [0.02] * 50)
+    # rank 50 resolves in the first bucket, fully interpolated
+    assert histogram_percentile(m, "lat_seconds", 0.50) == \
+        pytest.approx(0.005)
+    # rank 75: halfway through the (0.01, 0.025] bucket
+    assert histogram_percentile(m, "lat_seconds", 0.75) == \
+        pytest.approx(0.01 + (0.025 - 0.01) * 0.5)
+    assert histogram_percentile(m, "lat_seconds", 1.0) == \
+        pytest.approx(0.025)
+
+
+def test_percentile_caps_at_largest_finite_bound():
+    # beyond every finite bucket: PromQL caps at the top bound rather
+    # than extrapolating
+    m = _metrics([1000.0] * 10)
+    assert histogram_percentile(m, "lat_seconds", 0.99) == \
+        DEFAULT_BUCKETS[-1]
+
+
+def test_percentile_none_without_observations():
+    assert histogram_percentile(
+        InMemoryMetrics(namespace="copilot"), "lat_seconds", 0.99) is None
+
+
+def test_percentile_respects_label_filter():
+    m = InMemoryMetrics(namespace="copilot")
+    m.observe("lat_seconds", 0.004, {"proc": "fast"})
+    m.observe("lat_seconds", 40.0, {"proc": "slow"})
+    fast = histogram_percentile(m, "lat_seconds", 0.5, {"proc": "fast"})
+    slow = histogram_percentile(m, "lat_seconds", 0.5, {"proc": "slow"})
+    both = histogram_percentile(m, "lat_seconds", 0.99)
+    assert fast <= 0.005 < slow
+    assert both > 1.0                           # fleet view merges procs
+
+
+def test_cdf_fraction_under_threshold():
+    m = _metrics([0.004] * 50 + [0.02] * 50)
+    assert histogram_cdf(m, "lat_seconds", 0.01) == pytest.approx(0.5)
+    assert histogram_cdf(m, "lat_seconds", 0.025) == pytest.approx(1.0)
+    assert histogram_cdf(m, "lat_seconds", 700.0) == 1.0
+    assert histogram_cdf(m, "lat_seconds", 0.0) == pytest.approx(0.0)
+
+
+# -- objectives ----------------------------------------------------------
+
+
+def _objective(threshold=2.0, budget=0.01):
+    return SLObjective(name="lat-p99", series="copilot_lat_seconds",
+                       percentile=0.99, threshold_s=threshold,
+                       window="unit", workload="interactive",
+                       budget=budget)
+
+
+def test_objective_holds_under_threshold():
+    row = _objective().evaluate(_metrics([0.004] * 100))
+    assert row["ok"] is True
+    assert row["observations"] == 100
+    assert row["value_s"] <= 0.005
+    assert row["violation_fraction"] == 0.0
+    assert row["burn"] == 0.0
+
+
+def test_objective_breach_and_budget_burn():
+    # 10% of requests at 3s against a 2s threshold and a 1% budget:
+    # the p99 breaches AND the error budget burns >1
+    row = _objective().evaluate(_metrics([0.004] * 90 + [3.0] * 10))
+    assert row["ok"] is False
+    assert row["value_s"] > 2.0
+    # the slow 10% land past the 2.5 bound; the 2.0 threshold sits in
+    # the flat (1.0, 2.5] bucket, so the CDF there is exactly 0.9
+    assert row["violation_fraction"] == pytest.approx(0.1)
+    assert row["burn"] == pytest.approx(10.0)
+
+
+def test_burn_can_exhaust_while_percentile_holds():
+    # 3% slow against a 1% budget: p99... breaches here, so pick p50 —
+    # the point estimate holds while the budget is triple-spent
+    obj = SLObjective(name="lat-p50", series="copilot_lat_seconds",
+                      percentile=0.50, threshold_s=2.0, budget=0.01)
+    row = obj.evaluate(_metrics([0.004] * 97 + [4.0] * 3))
+    assert row["ok"] is True
+    assert row["burn"] > 1.0
+
+
+def test_objective_no_data_is_none_not_false():
+    row = _objective().evaluate(InMemoryMetrics(namespace="copilot"))
+    assert row["ok"] is None
+    assert row["observations"] == 0
+    assert row["value_s"] is None
+
+
+def test_check_judges_external_value():
+    good = _objective().check(0.5)
+    bad = _objective().check(2.5)
+    assert good["ok"] is True and bad["ok"] is False
+    assert good["value_s"] == 0.5
+    assert good["name"] == "lat-p99" and good["observations"] is None
+
+
+# -- registry ------------------------------------------------------------
+
+
+def test_registry_rejects_duplicate_names():
+    reg = SLORegistry([_objective()])
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register(_objective())
+
+
+def test_registry_evaluate_and_require_data():
+    reg = SLORegistry([
+        _objective(),
+        SLObjective(name="other-p99", series="copilot_other_seconds",
+                    percentile=0.99, threshold_s=1.0),
+    ])
+    board = reg.evaluate(_metrics([0.004] * 10))
+    assert board["ok"] is True                  # no-data rows don't fail
+    assert board["evaluated"] == 1 and board["total"] == 2
+    strict = reg.evaluate(_metrics([0.004] * 10), require_data=True)
+    assert strict["ok"] is False                # ...unless the gate asks
+
+
+def test_default_registry_names_and_series():
+    reg = default_registry()
+    by_name = {o.name: o for o in reg.objectives()}
+    assert set(by_name) == {
+        "interactive-ttft-p99", "interactive-itl-p95", "queue-wait-p99",
+        "stage-latency-p95", "kv-handoff-wait-p99"}
+    # thresholds must match the bench knobs (BENCH_TTFT_SLO/
+    # BENCH_ITL_SLO defaults) and the alert pack
+    assert by_name["interactive-ttft-p99"].threshold_s == 2.0
+    assert by_name["interactive-itl-p95"].threshold_s == 0.25
+    assert by_name["kv-handoff-wait-p99"].workload == "disaggregated"
+    for obj in reg.objectives():
+        assert obj.series.startswith("copilot_")
+
+
+def test_render_scoreboard_verdicts():
+    reg = default_registry()
+    m = InMemoryMetrics(namespace="copilot")
+    for _ in range(100):
+        m.observe("engine_ttft_seconds", 0.02)
+    text = render_scoreboard(reg.evaluate(m))
+    assert "interactive-ttft-p99" in text
+    assert "[     ok]" in text and "no-data" in text
+    for _ in range(100):
+        m.observe("engine_ttft_seconds", 4.0)
+    text = render_scoreboard(reg.evaluate(m))
+    assert "BREACH" in text
+
+
+# -- CLI over spools -----------------------------------------------------
+
+
+def _spool_with(tmp_path, name, values):
+    from copilot_for_consensus_tpu.obs.ship import (
+        TelemetryShipper,
+        spool_path,
+    )
+
+    m = InMemoryMetrics(namespace="copilot")
+    for v in values:
+        m.observe(name, v)
+    ship = TelemetryShipper(spool_path(tmp_path, "cli"), proc="cli",
+                            role="serve", metrics=m)
+    ship.close()
+    return ship.path
+
+
+def test_cli_scoreboard_over_spool(tmp_path, capsys):
+    path = _spool_with(tmp_path, "engine_ttft_seconds", [0.02] * 100)
+    assert slo.main([path]) == 0
+    assert "SLO scoreboard" in capsys.readouterr().out
+    assert slo.main([path, "--require-data"]) == 1   # 4 objectives idle
+    capsys.readouterr()
+    assert slo.main([str(tmp_path), "--json"]) == 0  # dir ingestion
+    board = json.loads(capsys.readouterr().out)
+    rows = {r["name"]: r for r in board["objectives"]}
+    assert rows["interactive-ttft-p99"]["ok"] is True
+    assert rows["interactive-ttft-p99"]["observations"] == 100
+
+
+def test_cli_exits_one_on_breach(tmp_path, capsys):
+    path = _spool_with(tmp_path, "engine_ttft_seconds", [4.0] * 100)
+    assert slo.main([path]) == 1
+    assert "BREACH" in capsys.readouterr().out
+
+
+# -- dashboard contract --------------------------------------------------
+
+
+def test_default_registry_matches_slo_dashboard():
+    """Every default objective must be rendered by slo.json with the
+    SAME series and threshold — a drifted dashboard would show green
+    while the scoreboard (and bench gates) judge red."""
+    dash = json.loads(SLO_DASHBOARD.read_text())
+    exprs = " ".join(t["expr"]
+                     for p in dash["panels"]
+                     for t in p.get("targets", ()))
+    for obj in default_registry().objectives():
+        assert f"{obj.series}_bucket" in exprs, obj.name
+        assert f"histogram_quantile({obj.percentile}" in exprs, obj.name
+        assert f"/ {obj.threshold_s}" in exprs, obj.name
+
+
+def test_slo_dashboard_burn_panel_uses_real_bucket_bounds():
+    """The burn panels select a single ``le`` bucket as the threshold
+    proxy; it must be a real DEFAULT_BUCKETS bound or the series
+    silently never matches."""
+    dash = json.loads(SLO_DASHBOARD.read_text())
+    bounds = {str(b) for b in DEFAULT_BUCKETS}
+    for panel in dash["panels"]:
+        for target in panel.get("targets", ()):
+            expr = target["expr"]
+            start = 0
+            while True:
+                i = expr.find('le="', start)
+                if i < 0:
+                    break
+                j = expr.index('"', i + 4)
+                assert expr[i + 4:j] in bounds, expr
+                start = j

@@ -328,6 +328,11 @@ def _race_findings(fixture: str, rule: str):
      "GoodPool"),
     ("wrapper_shadow.py", "race-wrapper-shadow", "BadWrapper",
      "GoodWrapper"),
+    # telemetry-shipper pump (ISSUE 20): a fire-and-forget flush
+    # thread must flag; the TelemetryShipper shape (stop-aware wait
+    # loop + owner-joined stop before the spool closes) stays clean
+    ("ship_pump.py", "race-thread-lifecycle", "BadShipPump",
+     "GoodShipPump"),
 ])
 def test_race_rule_true_positive_and_clean_negative(fixture, rule,
                                                     bad_marker,
@@ -565,6 +570,58 @@ def test_unlocked_broker_stats_fails_the_lane(tmp_path):
                for f in found), found
 
 
+_SHIP = ROOT / "copilot_for_consensus_tpu" / "obs" / "ship.py"
+
+
+def test_fire_and_forget_ship_pump_fails_the_lane(tmp_path):
+    """ISSUE-20 tripwire on the REAL shipper: replace the pump's
+    stop-aware wait loop with a bare sleep loop AND drop the owner
+    join — race-thread-lifecycle must flag the now-unstoppable pump
+    thread."""
+    src = _SHIP.read_text()
+    loop_needle = ("        while not self._stop.is_set():\n"
+                   "            self._stop.wait(self.interval_s)\n")
+    join_needle = ("        if thread is not None:\n"
+                   "            thread.join(timeout=5.0)\n")
+    assert loop_needle in src and join_needle in src, \
+        "TelemetryShipper pump/stop moved; update the test"
+    mutated = tmp_path / "ship_mutated.py"
+    mutated.write_text(
+        src.replace(loop_needle,
+                    "        while True:\n"
+                    "            time.sleep(self.interval_s)\n", 1)
+        .replace(join_needle, "", 1))
+    found = [f for f in analyze_files([mutated])
+             if f.rule == "race-thread-lifecycle"]
+    assert any("TelemetryShipper" in f.context or "_pump" in f.message
+               for f in found), found
+    # the unmutated file is part of the clean e2e run (no findings)
+
+
+def test_torn_spool_flush_fails_the_lane(tmp_path):
+    """ISSUE-20 tripwire on the REAL spool: drop the one-transaction
+    wrapper around the append loop (per-row autocommit — a SIGKILL
+    mid-flush would commit a torn batch) — dura-sqlite-ledger must
+    flag the unscoped mutating loop."""
+    src = _SHIP.read_text()
+    needle = ("                with self._db:\n"
+              "                    for kind, payload in batch:\n"
+              "                        self._db.execute(\n")
+    assert needle in src, "TelemetrySpool.append moved; update the test"
+    mutated = tmp_path / "spool_mutated.py"
+    mutated.write_text(src.replace(
+        needle,
+        "                for kind, payload in batch:\n"
+        "                    self._db.execute(\n", 1).replace(
+        "                            \"INSERT INTO rows (kind, payload) \"\n"
+        "                            \"VALUES (?, ?)\", (kind, payload))\n",
+        "                        \"INSERT INTO rows (kind, payload) \"\n"
+        "                        \"VALUES (?, ?)\", (kind, payload))\n", 1))
+    found = [f for f in analyze_files([mutated], {"dura"})
+             if f.rule == "dura-sqlite-ledger"]
+    assert any("transaction" in f.message for f in found), found
+
+
 # -- baseline round trip + CLI group filter for the race family
 
 
@@ -654,6 +711,9 @@ def _dura_findings(fixture: str, rule: str):
      "GoodDupTolerantInsert"),
     ("sqlite_ledger.py", "dura-sqlite-ledger", "BadLedger",
      "GoodLedger"),
+    # telemetry spool (ISSUE 20): a spool without WAL + one-transaction
+    # flushes must flag; the TelemetrySpool shape stays clean
+    ("ship_spool.py", "dura-sqlite-ledger", "BadSpool", "GoodSpool"),
 ])
 def test_dura_rule_true_positive_and_clean_negative(fixture, rule,
                                                     bad_marker,

@@ -1,0 +1,373 @@
+# Cross-process telemetry plane (obs/ship.py, ISSUE 20): crash-safe
+# spooling, shipper lifecycle, and the aggregator's merge semantics —
+# counters sum, gauges LWW, histogram buckets merge, reserved
+# proc/role stamping, type-conflict refusal, (proc, seq) dedup — plus
+# the real-SIGKILL recovery and cross-OS-process trace-join contracts
+# the pipeline_chaos kill phase gates on.
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+from copilot_for_consensus_tpu.obs.ship import (
+    SPOOL_SUFFIX,
+    TelemetryAggregator,
+    TelemetryShipper,
+    TelemetrySpool,
+    list_spools,
+    read_spool,
+    spool_path,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# -- spool ----------------------------------------------------------------
+
+
+def test_spool_round_trip(tmp_path):
+    path = tmp_path / f"p1{SPOOL_SUFFIX}"
+    spool = TelemetrySpool(path, proc="p1", role="engine")
+    n = spool.append([("metrics", {"counters": []}),
+                      ("span", {"span_id": "s1"})])
+    assert n == 2
+    spool.close()
+    back = read_spool(path)
+    assert back["proc"] == "p1" and back["role"] == "engine"
+    assert back["lost"] == 0
+    assert [(seq, kind) for seq, kind, _p in back["rows"]] == \
+        [(1, "metrics"), (2, "span")]
+    assert back["rows"][1][2] == {"span_id": "s1"}
+
+
+def test_spool_append_is_one_transaction(tmp_path):
+    """A failing row aborts the WHOLE batch — no torn flushes."""
+    spool = TelemetrySpool(tmp_path / f"p{SPOOL_SUFFIX}", proc="p")
+    spool.append([("metrics", {"a": 1})])
+
+    class Unserializable:
+        pass
+
+    with pytest.raises(TypeError):
+        spool.append([("metrics", {"b": 2}),
+                      ("span", {"x": Unserializable()})])
+    spool.close()
+    back = read_spool(spool.path)
+    assert len(back["rows"]) == 1 and back["lost"] == 0
+
+
+def test_list_spools_filters_suffix(tmp_path):
+    TelemetrySpool(spool_path(tmp_path, "a"), proc="a").close()
+    TelemetrySpool(spool_path(tmp_path, "b"), proc="b").close()
+    (tmp_path / "other.json").write_text("{}")
+    found = list_spools(tmp_path)
+    assert len(found) == 2
+    assert all(p.endswith(SPOOL_SUFFIX) for p in found)
+
+
+def test_spool_path_sanitizes_proc_name(tmp_path):
+    assert "/" not in pathlib.Path(
+        spool_path(tmp_path, "a/b c")).name.replace(SPOOL_SUFFIX, "")
+
+
+# -- shipper --------------------------------------------------------------
+
+
+def _shipper(tmp_path, metrics, **kw):
+    return TelemetryShipper(
+        tmp_path / f"proc{SPOOL_SUFFIX}", proc="proc", role="engine",
+        metrics=metrics, **kw)
+
+
+def test_shipper_ships_metric_deltas(tmp_path):
+    m = InMemoryMetrics(namespace="copilot")
+    m.increment("jobs_total", 3.0, {"q": "a"})
+    ship = _shipper(tmp_path, m)
+    ship.flush()
+    m.increment("jobs_total", 2.0, {"q": "a"})
+    m.observe("wait_seconds", 0.3)
+    ship.close()
+
+    agg = TelemetryAggregator()
+    stats = agg.ingest_spool(ship.path)
+    assert stats["lost"] == 0 and stats["applied"] > 0
+    body = agg.render_prometheus()
+    # deltas re-sum to the true total, stamped with proc/role
+    assert ('copilot_jobs_total{proc="proc",q="a",role="engine"} 5'
+            in body)
+    assert 'copilot_wait_seconds_count{proc="proc",role="engine"} 1' \
+        in body
+
+
+def test_idle_shipper_appends_nothing(tmp_path):
+    """A sourceless flush appends no rows (the pump runs every
+    interval; an idle process must not grow its spool)."""
+    ship = TelemetryShipper(tmp_path / f"idle{SPOOL_SUFFIX}",
+                            proc="idle")
+    assert ship.flush() == 0
+    assert ship.flush() == 0
+    assert ship.stats()["committed_rows"] == 0
+    ship.close()
+
+
+def test_repeated_flushes_never_double_count(tmp_path):
+    """Deltas, not snapshots: N flushes of the same registry re-sum to
+    the true total on the aggregator side."""
+    m = InMemoryMetrics(namespace="copilot")
+    ship = _shipper(tmp_path, m)
+    for _ in range(5):
+        m.increment("jobs_total", 1.0)
+        ship.flush()
+    ship.close()
+    agg = TelemetryAggregator()
+    agg.ingest_spool(ship.path)
+    assert agg.metrics.counter_value(
+        "jobs_total", {"proc": "proc", "role": "engine"}) == 5.0
+
+
+def test_shipper_mark_baselines_out_warmup(tmp_path):
+    """mark() snapshots the registry without shipping: only
+    observations AFTER it land in the spool (bench children call it
+    post-warmup so compile time never pollutes the histograms)."""
+    m = InMemoryMetrics(namespace="copilot")
+    m.observe("ttft_seconds", 30.0)              # "warmup compile"
+    ship = _shipper(tmp_path, m)
+    ship.mark()
+    m.observe("ttft_seconds", 0.02)              # "timed run"
+    ship.close()
+    agg = TelemetryAggregator()
+    agg.ingest_spool(ship.path)
+    entry = agg.metrics.histograms["ttft_seconds"]
+    (key, (total, count, _buckets)), = entry.items()
+    assert count == 1 and total == pytest.approx(0.02)
+
+
+def test_shipper_pump_thread_lifecycle(tmp_path):
+    m = InMemoryMetrics(namespace="copilot")
+    ship = _shipper(tmp_path, m, interval_s=0.01)
+    ship.start()
+    assert ship._thread is not None
+    m.increment("jobs_total", 1.0)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if ship.stats()["committed_rows"] > 0:
+            break
+        time.sleep(0.01)
+    assert ship.stats()["committed_rows"] > 0, "pump never flushed"
+    ship.stop()
+    assert ship._thread is None                 # joined, not abandoned
+    ship.close()
+
+
+def test_shipper_ships_spans_and_steps_once(tmp_path):
+    from copilot_for_consensus_tpu.obs.trace import Span, TraceCollector
+
+    collector = TraceCollector(capacity=64)
+    collector.record(Span(trace_id="t1", span_id="s1",
+                          parent_span_id="", name="stage", kind="stage",
+                          service="svc", start_wall=time.time()))
+    m = InMemoryMetrics(namespace="copilot")
+    ship = _shipper(tmp_path, m, collector=collector)
+    ship.flush()
+    ship.flush()                                 # dedup by span_id
+    ship.close()
+    rows = read_spool(ship.path)["rows"]
+    assert sum(1 for _s, kind, _p in rows if kind == "span") == 1
+
+
+# -- aggregator merge semantics ------------------------------------------
+
+
+def _spool_from(tmp_path, proc, role, fill):
+    m = InMemoryMetrics(namespace="copilot")
+    ship = TelemetryShipper(
+        spool_path(tmp_path, proc), proc=proc, role=role, metrics=m)
+    fill(m)
+    ship.close()
+    return ship.path
+
+
+def test_counters_sum_and_gauges_lww_across_processes(tmp_path):
+    p1 = _spool_from(tmp_path, "p1", "serve",
+                     lambda m: (m.increment("jobs_total", 3.0),
+                                m.gauge("depth", 7.0)))
+    p2 = _spool_from(tmp_path, "p2", "serve",
+                     lambda m: (m.increment("jobs_total", 2.0),
+                                m.gauge("depth", 1.0)))
+    agg = TelemetryAggregator()
+    agg.ingest_dir(tmp_path)
+    body = agg.render_prometheus()
+    assert 'copilot_jobs_total{proc="p1",role="serve"} 3' in body
+    assert 'copilot_jobs_total{proc="p2",role="serve"} 2' in body
+    assert 'copilot_depth{proc="p1",role="serve"} 7' in body
+    assert 'copilot_depth{proc="p2",role="serve"} 1' in body
+    assert body.count("# TYPE copilot_jobs_total counter") == 1
+    del p1, p2
+
+
+def test_histogram_buckets_merge_elementwise(tmp_path):
+    for proc in ("p1", "p2"):
+        _spool_from(tmp_path, proc, "serve",
+                    lambda m: m.observe("lat_seconds", 0.03))
+    agg = TelemetryAggregator()
+    agg.ingest_dir(tmp_path)
+    series = agg.metrics.histograms["lat_seconds"]
+    assert len(series) == 2                     # one per proc
+    total = sum(entry[1] for entry in series.values())
+    assert total == 2
+
+
+def test_reingest_is_deduped_by_proc_seq(tmp_path):
+    path = _spool_from(tmp_path, "p1", "serve",
+                       lambda m: m.increment("jobs_total", 3.0))
+    agg = TelemetryAggregator()
+    first = agg.ingest_spool(path)
+    again = agg.ingest_spool(path)
+    assert first["applied"] > 0
+    assert again["applied"] == 0 and again["skipped"] == first["applied"]
+    assert ('copilot_jobs_total{proc="p1",role="serve"} 3'
+            in agg.render_prometheus())
+
+
+def test_cross_process_type_conflict_raises(tmp_path):
+    _spool_from(tmp_path, "p1", "serve",
+                lambda m: m.increment("jobs_total", 1.0))
+    _spool_from(tmp_path, "p2", "serve",
+                lambda m: m.gauge("jobs_total", 1.0))
+    agg = TelemetryAggregator()
+    with pytest.raises(ValueError, match="type conflict"):
+        agg.ingest_dir(tmp_path)
+
+
+def test_reserved_labels_in_shipped_series_rejected(tmp_path):
+    path = _spool_from(
+        tmp_path, "p1", "serve",
+        lambda m: m.increment("jobs_total", 1.0, {"proc": "liar"}))
+    agg = TelemetryAggregator()
+    with pytest.raises(ValueError, match="reserved"):
+        agg.ingest_spool(path)
+
+
+# -- SIGKILL survival (real process death) --------------------------------
+
+
+_KILL_CHILD = r"""
+import os, signal, sys
+from copilot_for_consensus_tpu.obs.metrics import InMemoryMetrics
+from copilot_for_consensus_tpu.obs.ship import TelemetryShipper
+
+m = InMemoryMetrics(namespace="copilot")
+ship = TelemetryShipper(sys.argv[1], proc="victim", role="serve",
+                        metrics=m)
+m.increment("committed_total", 1.0)
+ship.flush()                                   # committed: must survive
+m.increment("committed_total", 41.0)           # never flushed: may die
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+def test_committed_spool_rows_survive_sigkill(tmp_path):
+    path = tmp_path / f"victim{SPOOL_SUFFIX}"
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_CHILD, str(path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL), \
+        (proc.returncode, proc.stderr)
+    back = read_spool(path)
+    assert back["lost"] == 0
+    assert len(back["rows"]) >= 1
+    agg = TelemetryAggregator()
+    agg.ingest_spool(path)
+    assert ('copilot_committed_total{proc="victim",role="serve"} 1'
+            in agg.render_prometheus())
+
+
+# -- cross-OS-process trace join -----------------------------------------
+
+
+def test_trace_joins_across_two_process_spools(tmp_path):
+    """A ≥5-stage trace whose spans live in TWO spools (the kill/resume
+    shape journal_storm ships) must reconstruct with zero orphans once
+    merged — and show orphans from either spool alone."""
+    from copilot_for_consensus_tpu.obs.trace import Span, TraceCollector
+    from copilot_for_consensus_tpu.tools import tracepath
+
+    def spool_with_spans(proc, role, spans):
+        collector = TraceCollector(capacity=64)
+        for s in spans:
+            collector.record(s)
+        ship = TelemetryShipper(spool_path(tmp_path, proc), proc=proc,
+                                role=role, collector=collector)
+        ship.close()
+        return ship.path
+
+    def span(sid, parent, name, kind="stage"):
+        return Span(trace_id="t" * 32, span_id=sid,
+                    parent_span_id=parent, name=name, kind=kind,
+                    service=name, start_wall=time.time(),
+                    correlation_id="cid-1")
+
+    # process A: the first three stages; process B: two more stages
+    # parented onto A's spans (the cross-process edges)
+    spool_with_spans("proc-a", "serve", [
+        span("a1", "", "ingest"), span("a2", "a1", "parse"),
+        span("a3", "a2", "chunk")])
+    spool_with_spans("proc-b", "resume", [
+        span("b1", "a3", "embed"), span("b2", "b1", "report")])
+
+    merged = TelemetryAggregator()
+    merged.ingest_dir(tmp_path)
+    audit = tracepath.analyze(merged.spans())
+    assert audit["orphan_spans"] == 0, audit
+    assert audit["cross_proc_edges"] >= 1
+    assert set(audit["procs"]) == {"proc-a", "proc-b"}
+    # count the stages on the reconstructed trace
+    spans = merged.spans_by_trace()["t" * 32]
+    assert len(spans) == 5
+    # either spool alone: b1's parent a3 is missing → orphan
+    alone = TelemetryAggregator()
+    alone.ingest_spool(spool_path(tmp_path, "proc-b"))
+    assert tracepath.analyze(alone.spans())["orphan_spans"] > 0
+
+
+def test_tracepath_collect_sources_reads_spool_dirs(tmp_path):
+    from copilot_for_consensus_tpu.obs.trace import Span, TraceCollector
+    from copilot_for_consensus_tpu.tools import tracepath
+
+    collector = TraceCollector(capacity=8)
+    collector.record(Span(trace_id="t1", span_id="s1",
+                          parent_span_id="", name="x", kind="stage",
+                          service="x", start_wall=time.time()))
+    ship = TelemetryShipper(spool_path(tmp_path, "p1"), proc="p1",
+                            role="serve", collector=collector)
+    ship.close()
+    spans = tracepath.collect_sources([str(tmp_path)])
+    assert len(spans) == 1
+    assert spans[0]["proc"] == "p1"              # proc-stamped
+    spans = tracepath.collect_sources([ship.path])
+    assert len(spans) == 1
+
+
+# -- conftest bundle hook -------------------------------------------------
+
+
+def test_dump_all_flushes_live_shippers(tmp_path):
+    from copilot_for_consensus_tpu.obs import ship as ship_mod
+
+    m = InMemoryMetrics(namespace="copilot")
+    shipper = TelemetryShipper(spool_path(tmp_path, "live"),
+                               proc="live", role="serve", metrics=m)
+    m.increment("jobs_total", 1.0)
+    ship_mod.dump_all(tmp_path, tag="unit")
+    manifest = json.loads(
+        (tmp_path / "unit-spools.json").read_text())
+    assert any(s["proc"] == "live" for s in manifest["spools"])
+    assert read_spool(shipper.path)["rows"], "dump_all did not flush"
+    shipper.close()
